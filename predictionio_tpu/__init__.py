@@ -1,0 +1,21 @@
+"""predictionio_tpu — a TPU-native machine-learning server framework.
+
+A ground-up rebuild of the capability surface of PredictionIO (reference:
+DrahmA/PredictionIO, Scala/Spark) on a JAX/XLA substrate:
+
+- REST event collection into a pluggable event store (``predictionio_tpu.data``)
+- engines composed from the DASE controller API — DataSource / Preparator /
+  Algorithm(s) / Serving, plus Evaluation (``predictionio_tpu.controller``)
+- a train -> persist -> deploy -> query lifecycle (``predictionio_tpu.workflow``,
+  ``predictionio_tpu.tools``)
+- metric-based evaluation with hyperparameter grid search
+- TPU compute kernels (blocked implicit ALS, NaiveBayes count reductions,
+  cosine top-N) under ``predictionio_tpu.ops`` running as pjit/shard_map
+  programs over a `jax.sharding.Mesh` (``predictionio_tpu.parallel``).
+
+Where the reference delegates compute to Apache Spark RDDs + MLlib, this
+framework materializes event data as column-oriented host batches destined for
+device-sharded arrays, and runs training/serving math as XLA programs.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,25 @@
+"""Event data layer: event model, property maps, storage backends, stores.
+
+Mirrors the capability of the reference's ``data`` module
+(data/src/main/scala/io/prediction/data) — event model + validation, property
+aggregation, pluggable storage, event-store access APIs, and the Event Server
+REST API — redesigned for a single-controller Python/JAX runtime.
+"""
+
+from predictionio_tpu.data.event import (
+    DataMap,
+    Event,
+    EventValidationError,
+    PropertyMap,
+    validate_event,
+)
+from predictionio_tpu.data.bimap import BiMap
+
+__all__ = [
+    "BiMap",
+    "DataMap",
+    "Event",
+    "EventValidationError",
+    "PropertyMap",
+    "validate_event",
+]

@@ -1,0 +1,103 @@
+"""Property aggregation: fold $set/$unset/$delete streams into PropertyMaps.
+
+Capability parity with the reference's LEventAggregator
+(data/src/main/scala/io/prediction/data/storage/LEventAggregator.scala:39-145)
+and PEventAggregator (PEventAggregator.scala). The fold semantics:
+
+- events are processed in event-time order;
+- ``$set`` merges properties over the current map (creating it if absent);
+- ``$unset`` removes the named keys (no-op when no map exists yet);
+- ``$delete`` discards the map entirely;
+- any other event name leaves the state untouched;
+- first/last-updated times track only the special events' event times;
+- entities whose final state is "deleted" (or never set) are omitted.
+
+The reference runs this fold as a Spark ``aggregateByKey``; here it is a plain
+host-side fold — property aggregation is string/JSON manipulation that belongs
+on the host, with the *output* (feature batches) being what moves to TPU.
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+from typing import Dict, Iterable, Optional, Tuple
+
+from predictionio_tpu.data.event import DataMap, Event, PropertyMap
+
+_AGG_EVENTS = ("$set", "$unset", "$delete")
+
+
+class _Prop:
+    __slots__ = ("dm", "first_updated", "last_updated")
+
+    def __init__(self):
+        self.dm: Optional[DataMap] = None
+        self.first_updated: Optional[_dt.datetime] = None
+        self.last_updated: Optional[_dt.datetime] = None
+
+    def fold(self, e: Event) -> None:
+        if e.event not in _AGG_EVENTS:
+            return
+        if e.event == "$set":
+            self.dm = e.properties if self.dm is None else self.dm.merged(e.properties)
+        elif e.event == "$unset":
+            if self.dm is not None:
+                self.dm = self.dm.removed(list(e.properties.keys()))
+        elif e.event == "$delete":
+            self.dm = None
+        t = e.event_time
+        self.first_updated = t if self.first_updated is None else min(self.first_updated, t)
+        self.last_updated = t if self.last_updated is None else max(self.last_updated, t)
+
+    def to_property_map(self) -> Optional[PropertyMap]:
+        if self.dm is None:
+            return None
+        assert self.first_updated is not None and self.last_updated is not None
+        return PropertyMap(self.dm.fields, self.first_updated, self.last_updated)
+
+
+def aggregate_properties(events: Iterable[Event]) -> Dict[str, PropertyMap]:
+    """Aggregate per-entity properties from an event stream.
+
+    Returns {entityId: PropertyMap} for entities whose latest state exists
+    (reference LEventAggregator.aggregateProperties:39-66).
+    """
+    by_entity: Dict[str, list] = {}
+    for e in events:
+        by_entity.setdefault(e.entity_id, []).append(e)
+    out: Dict[str, PropertyMap] = {}
+    for entity_id, evs in by_entity.items():
+        evs.sort(key=lambda e: e.event_time)
+        prop = _Prop()
+        for e in evs:
+            prop.fold(e)
+        pm = prop.to_property_map()
+        if pm is not None:
+            out[entity_id] = pm
+    return out
+
+
+def aggregate_properties_single(events: Iterable[Event]) -> Optional[PropertyMap]:
+    """Aggregate properties of a single entity's event stream
+    (reference LEventAggregator.aggregatePropertiesSingle:67-91)."""
+    evs = sorted(events, key=lambda e: e.event_time)
+    prop = _Prop()
+    for e in evs:
+        prop.fold(e)
+    return prop.to_property_map()
+
+
+def aggregate_properties_keyed(
+    events: Iterable[Event],
+) -> Dict[Tuple[str, str], PropertyMap]:
+    """Aggregate grouped by (entityType, entityId) — used by stores that serve
+    multiple entity types from one scan."""
+    by_key: Dict[Tuple[str, str], list] = {}
+    for e in events:
+        by_key.setdefault((e.entity_type, e.entity_id), []).append(e)
+    out: Dict[Tuple[str, str], PropertyMap] = {}
+    for key, evs in by_key.items():
+        pm = aggregate_properties_single(evs)
+        if pm is not None:
+            out[key] = pm
+    return out

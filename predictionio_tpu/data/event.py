@@ -1,0 +1,359 @@
+"""Event model: Event, DataMap, PropertyMap, and validation.
+
+Capability parity with the reference event model
+(data/src/main/scala/io/prediction/data/storage/Event.scala:39-167,
+DataMap.scala:42-191, PropertyMap.scala:33-96, EventJson4sSupport.scala:29-213):
+
+- an Event is an immutable record of (event name, entity, optional target
+  entity, JSON property bag, event time, tags, prId, creation time);
+- names starting with ``$`` or ``pio_`` are reserved; the special events are
+  ``$set`` / ``$unset`` / ``$delete``; the built-in entity type is ``pio_pr``;
+- DataMap is an immutable JSON property bag with typed accessors and
+  merge/remove operators; PropertyMap additionally carries first/last-updated
+  times produced by property aggregation.
+
+Times are timezone-aware ``datetime`` (UTC default, matching
+EventValidation.defaultTimeZone, Event.scala:67).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import datetime as _dt
+import json
+import uuid
+from typing import Any, Iterator, Mapping, Optional, Sequence
+
+
+# --- reserved-name rules (reference Event.scala:65-167) ---
+
+SPECIAL_EVENTS = frozenset({"$set", "$unset", "$delete"})
+BUILTIN_ENTITY_TYPES = frozenset({"pio_pr"})
+BUILTIN_PROPERTIES: frozenset = frozenset()
+
+
+def is_reserved_prefix(name: str) -> bool:
+    return name.startswith("$") or name.startswith("pio_")
+
+
+def is_special_event(name: str) -> bool:
+    return name in SPECIAL_EVENTS
+
+
+class EventValidationError(ValueError):
+    """Raised when an event violates the validation rules."""
+
+
+def utcnow() -> _dt.datetime:
+    return _dt.datetime.now(_dt.timezone.utc)
+
+
+def _ensure_aware(t: _dt.datetime) -> _dt.datetime:
+    if t.tzinfo is None:
+        return t.replace(tzinfo=_dt.timezone.utc)
+    return t
+
+
+def parse_iso8601(s: str) -> _dt.datetime:
+    """Parse an ISO8601 timestamp, preserving its zone (UTC if naive)."""
+    if s.endswith("Z"):
+        s = s[:-1] + "+00:00"
+    return _ensure_aware(_dt.datetime.fromisoformat(s))
+
+
+def format_iso8601(t: _dt.datetime) -> str:
+    """Render with millisecond precision, e.g. 2026-07-29T12:00:00.000Z."""
+    t = _ensure_aware(t)
+    base = t.strftime("%Y-%m-%dT%H:%M:%S")
+    millis = t.microsecond // 1000
+    off = t.utcoffset()
+    if off is None or off == _dt.timedelta(0):
+        zone = "Z"
+    else:
+        total = int(off.total_seconds())
+        sign = "+" if total >= 0 else "-"
+        total = abs(total)
+        zone = f"{sign}{total // 3600:02d}:{(total % 3600) // 60:02d}"
+    return f"{base}.{millis:03d}{zone}"
+
+
+class DataMap(Mapping[str, Any]):
+    """Immutable JSON property bag (reference DataMap.scala:42-191).
+
+    Values are JSON-compatible Python values (str/int/float/bool/list/dict/
+    None). Supports typed access (``get``, ``get_opt``, ``get_or_else``,
+    ``require``), merge (``merged`` / ``|``) and key removal (``removed`` /
+    ``-``).
+    """
+
+    __slots__ = ("_fields",)
+
+    def __init__(self, fields: Optional[Mapping[str, Any]] = None):
+        object.__setattr__(self, "_fields", dict(fields or {}))
+
+    # Mapping protocol
+    def __getitem__(self, key: str) -> Any:
+        return self._fields[key]
+
+    def __iter__(self) -> Iterator[str]:
+        return iter(self._fields)
+
+    def __len__(self) -> int:
+        return len(self._fields)
+
+    def __contains__(self, key: object) -> bool:
+        return key in self._fields
+
+    @property
+    def fields(self) -> dict:
+        return dict(self._fields)
+
+    def is_empty(self) -> bool:
+        return not self._fields
+
+    def require(self, name: str) -> None:
+        if name not in self._fields:
+            raise KeyError(f"The field {name} is required.")
+
+    def get(self, name: str, default: Any = None) -> Any:
+        """Return the field value; fields present but JSON-null raise."""
+        if name in self._fields:
+            v = self._fields[name]
+            if v is None:
+                raise ValueError(f"The required field {name} cannot be null.")
+            return v
+        if default is not None:
+            return default
+        raise KeyError(f"The field {name} is required.")
+
+    def get_opt(self, name: str) -> Optional[Any]:
+        return self._fields.get(name)
+
+    def get_or_else(self, name: str, default: Any) -> Any:
+        v = self._fields.get(name)
+        return default if v is None else v
+
+    def merged(self, other: "DataMap | Mapping[str, Any]") -> "DataMap":
+        out = dict(self._fields)
+        out.update(dict(other))
+        return DataMap(out)
+
+    def removed(self, keys: Sequence[str]) -> "DataMap":
+        out = {k: v for k, v in self._fields.items() if k not in set(keys)}
+        return DataMap(out)
+
+    def __or__(self, other: "DataMap | Mapping[str, Any]") -> "DataMap":
+        return self.merged(other)
+
+    def __sub__(self, keys: Sequence[str]) -> "DataMap":
+        return self.removed(keys)
+
+    def __eq__(self, other: object) -> bool:
+        if isinstance(other, DataMap):
+            return self._fields == other._fields
+        if isinstance(other, Mapping):
+            return self._fields == dict(other)
+        return NotImplemented
+
+    def __hash__(self) -> int:
+        return hash(json.dumps(self._fields, sort_keys=True, default=str))
+
+    def __repr__(self) -> str:
+        return f"DataMap({self._fields!r})"
+
+    def to_json(self) -> dict:
+        return dict(self._fields)
+
+    @staticmethod
+    def from_json(obj: Optional[Mapping[str, Any]]) -> "DataMap":
+        return DataMap(obj or {})
+
+
+class PropertyMap(DataMap):
+    """DataMap plus aggregation timestamps (reference PropertyMap.scala:33-96)."""
+
+    __slots__ = ("first_updated", "last_updated")
+
+    def __init__(
+        self,
+        fields: Optional[Mapping[str, Any]],
+        first_updated: _dt.datetime,
+        last_updated: _dt.datetime,
+    ):
+        super().__init__(fields)
+        object.__setattr__(self, "first_updated", _ensure_aware(first_updated))
+        object.__setattr__(self, "last_updated", _ensure_aware(last_updated))
+
+    def __repr__(self) -> str:
+        return (
+            f"PropertyMap({self.fields!r}, first_updated={self.first_updated},"
+            f" last_updated={self.last_updated})"
+        )
+
+    def __eq__(self, other: object) -> bool:
+        if isinstance(other, PropertyMap):
+            return (
+                self.fields == other.fields
+                and self.first_updated == other.first_updated
+                and self.last_updated == other.last_updated
+            )
+        return super().__eq__(other)
+
+    __hash__ = DataMap.__hash__
+
+
+@dataclasses.dataclass(frozen=True)
+class Event:
+    """An immutable event record (reference Event.scala:39-57)."""
+
+    event: str
+    entity_type: str
+    entity_id: str
+    target_entity_type: Optional[str] = None
+    target_entity_id: Optional[str] = None
+    properties: DataMap = dataclasses.field(default_factory=DataMap)
+    event_time: _dt.datetime = dataclasses.field(default_factory=utcnow)
+    tags: tuple = ()
+    pr_id: Optional[str] = None
+    event_id: Optional[str] = None
+    creation_time: _dt.datetime = dataclasses.field(default_factory=utcnow)
+
+    def __post_init__(self):
+        if not isinstance(self.properties, DataMap):
+            object.__setattr__(self, "properties", DataMap(self.properties))
+        object.__setattr__(self, "event_time", _ensure_aware(self.event_time))
+        object.__setattr__(self, "creation_time", _ensure_aware(self.creation_time))
+        object.__setattr__(self, "tags", tuple(self.tags))
+
+    def with_event_id(self, event_id: str) -> "Event":
+        return dataclasses.replace(self, event_id=event_id)
+
+    # --- JSON (API format: ISO8601 times, reference EventJson4sSupport) ---
+
+    def to_json(self) -> dict:
+        out: dict = {
+            "eventId": self.event_id,
+            "event": self.event,
+            "entityType": self.entity_type,
+            "entityId": self.entity_id,
+        }
+        if self.target_entity_type is not None:
+            out["targetEntityType"] = self.target_entity_type
+        if self.target_entity_id is not None:
+            out["targetEntityId"] = self.target_entity_id
+        out["properties"] = self.properties.to_json()
+        out["eventTime"] = format_iso8601(self.event_time)
+        if self.tags:
+            out["tags"] = list(self.tags)
+        if self.pr_id is not None:
+            out["prId"] = self.pr_id
+        out["creationTime"] = format_iso8601(self.creation_time)
+        return out
+
+    @staticmethod
+    def from_json(obj: Mapping[str, Any], *, validate: bool = True) -> "Event":
+        try:
+            event = obj["event"]
+            entity_type = obj["entityType"]
+            entity_id = obj["entityId"]
+        except KeyError as e:
+            raise EventValidationError(f"field {e.args[0]} is required") from e
+        for f in ("event", "entityType", "entityId"):
+            if not isinstance(obj[f], str):
+                raise EventValidationError(f"field {f} must be a string")
+        raw_time = obj.get("eventTime")
+        if raw_time is not None:
+            if not isinstance(raw_time, str):
+                raise EventValidationError(
+                    f"eventTime {raw_time!r} must be an ISO8601 string"
+                )
+            try:
+                event_time = parse_iso8601(raw_time)
+            except (ValueError, TypeError) as e:
+                raise EventValidationError(
+                    f"eventTime {raw_time!r} is not ISO8601"
+                ) from e
+        else:
+            event_time = utcnow()
+        props = obj.get("properties") or {}
+        if not isinstance(props, Mapping):
+            raise EventValidationError("properties must be a JSON object")
+        e = Event(
+            event=event,
+            entity_type=entity_type,
+            entity_id=entity_id,
+            target_entity_type=obj.get("targetEntityType"),
+            target_entity_id=obj.get("targetEntityId"),
+            properties=DataMap(props),
+            event_time=event_time,
+            tags=tuple(obj.get("tags") or ()),
+            pr_id=obj.get("prId"),
+            event_id=obj.get("eventId"),
+        )
+        if validate:
+            validate_event(e)
+        return e
+
+
+def validate_event(e: Event) -> None:
+    """Apply the reference validation rules (Event.scala:110-140).
+
+    Raises EventValidationError on the first violated rule.
+    """
+
+    def req(cond: bool, msg: str) -> None:
+        if not cond:
+            raise EventValidationError(msg)
+
+    req(bool(e.event), "event must not be empty.")
+    req(bool(e.entity_type), "entityType must not be empty string.")
+    req(bool(e.entity_id), "entityId must not be empty string.")
+    req(
+        e.target_entity_type is None or bool(e.target_entity_type),
+        "targetEntityType must not be empty string",
+    )
+    req(
+        e.target_entity_id is None or bool(e.target_entity_id),
+        "targetEntityId must not be empty string.",
+    )
+    req(
+        (e.target_entity_type is None) == (e.target_entity_id is None),
+        "targetEntityType and targetEntityId must be specified together.",
+    )
+    req(
+        not (e.event == "$unset" and e.properties.is_empty()),
+        "properties cannot be empty for $unset event",
+    )
+    req(
+        not is_reserved_prefix(e.event) or is_special_event(e.event),
+        f"{e.event} is not a supported reserved event name.",
+    )
+    req(
+        not is_special_event(e.event)
+        or (e.target_entity_type is None and e.target_entity_id is None),
+        f"Reserved event {e.event} cannot have targetEntity",
+    )
+    req(
+        not is_reserved_prefix(e.entity_type)
+        or e.entity_type in BUILTIN_ENTITY_TYPES,
+        f"The entityType {e.entity_type} is not allowed. "
+        "'pio_' is a reserved name prefix.",
+    )
+    req(
+        e.target_entity_type is None
+        or not is_reserved_prefix(e.target_entity_type)
+        or e.target_entity_type in BUILTIN_ENTITY_TYPES,
+        f"The targetEntityType {e.target_entity_type} is not allowed. "
+        "'pio_' is a reserved name prefix.",
+    )
+    for k in e.properties:
+        req(
+            not is_reserved_prefix(k) or k in BUILTIN_PROPERTIES,
+            f"The property {k} is not allowed. 'pio_' is a reserved name prefix.",
+        )
+
+
+def new_event_id() -> str:
+    """Generate a unique event id (reference derives it from the storage row
+    key, HBEventsUtil.scala:93; here a random UUID hex suffices)."""
+    return uuid.uuid4().hex

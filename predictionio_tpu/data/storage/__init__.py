@@ -1,0 +1,264 @@
+"""Pluggable storage registry (reference Storage.scala:112-393).
+
+Backends are selected by configuration, not code: the environment (or an
+explicit config dict) declares *sources* (named client configs with a TYPE)
+and assigns the three *repositories* — METADATA, EVENTDATA, MODELDATA — to
+sources, exactly mirroring the reference's
+``PIO_STORAGE_SOURCES_<NAME>_TYPE/...`` and
+``PIO_STORAGE_REPOSITORIES_{METADATA,EVENTDATA,MODELDATA}_{NAME,SOURCE}``
+scheme (Storage.scala:122-191). DAO classes are resolved reflectively from
+the backend module by naming convention ``<Prefix><DAOName>``
+(Storage.scala:263-312), clients are cached per source (:202-208), and
+``verify_all_data_objects`` provides the smoke probe (:325-348).
+
+Built-in backends: ``memory`` (tests/dev), ``sqlite`` (persistent embedded
+default), ``localfs`` (model blobs).
+"""
+
+from __future__ import annotations
+
+import importlib
+import os
+import re
+import threading
+from typing import Dict, Optional
+
+from predictionio_tpu.data.storage import base
+from predictionio_tpu.data.storage.base import (  # noqa: F401
+    UNSET,
+    AccessKey,
+    AccessKeys,
+    App,
+    Apps,
+    Channel,
+    Channels,
+    EngineInstance,
+    EngineInstances,
+    EngineManifest,
+    EngineManifests,
+    EvaluationInstance,
+    EvaluationInstances,
+    LEvents,
+    Model,
+    Models,
+    StorageError,
+)
+
+# backend type -> (module path, DAO class prefix)
+BUILTIN_BACKENDS: Dict[str, tuple] = {
+    "memory": ("predictionio_tpu.data.storage.memory", "Mem"),
+    "sqlite": ("predictionio_tpu.data.storage.sqlite", "SQLite"),
+    "localfs": ("predictionio_tpu.data.storage.localfs", "LocalFS"),
+}
+
+REPOSITORIES = ("METADATA", "EVENTDATA", "MODELDATA")
+
+_DEFAULT_ENV = {
+    "PIO_STORAGE_SOURCES_SQLITE_TYPE": "sqlite",
+    "PIO_STORAGE_SOURCES_LOCALFS_TYPE": "localfs",
+    "PIO_STORAGE_REPOSITORIES_METADATA_NAME": "pio_meta",
+    "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "SQLITE",
+    "PIO_STORAGE_REPOSITORIES_EVENTDATA_NAME": "pio_event",
+    "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "SQLITE",
+    "PIO_STORAGE_REPOSITORIES_MODELDATA_NAME": "pio_model",
+    "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "LOCALFS",
+}
+
+# all-memory config, used by tests and ephemeral servers
+MEMORY_CONFIG = {
+    "PIO_STORAGE_SOURCES_MEM_TYPE": "memory",
+    "PIO_STORAGE_REPOSITORIES_METADATA_NAME": "pio_meta",
+    "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "MEM",
+    "PIO_STORAGE_REPOSITORIES_EVENTDATA_NAME": "pio_event",
+    "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "MEM",
+    "PIO_STORAGE_REPOSITORIES_MODELDATA_NAME": "pio_model",
+    "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "MEM",
+}
+
+
+class StorageClientConfig:
+    """Per-source client config (reference StorageClientConfig,
+    Storage.scala:73-76). ``properties`` holds the remaining
+    ``PIO_STORAGE_SOURCES_<NAME>_<KEY>`` pairs keyed by KEY."""
+
+    def __init__(self, properties: Optional[Dict[str, str]] = None):
+        self.properties = dict(properties or {})
+
+    def __repr__(self) -> str:
+        return f"StorageClientConfig({self.properties!r})"
+
+
+_SOURCE_RE = re.compile(r"^PIO_STORAGE_SOURCES_([^_]+)_(.+)$")
+_REPO_RE = re.compile(r"^PIO_STORAGE_REPOSITORIES_([^_]+)_(NAME|SOURCE)$")
+
+
+class Storage:
+    """A configured storage universe: sources + repository assignments.
+
+    Construct with an explicit config mapping, or without one to read the
+    process environment (falling back to the sqlite/localfs defaults when no
+    PIO_STORAGE_* variables are present).
+    """
+
+    def __init__(self, config: Optional[Dict[str, str]] = None):
+        if config is None:
+            env = {
+                k: v for k, v in os.environ.items() if k.startswith("PIO_STORAGE_")
+            }
+            config = env if env else dict(_DEFAULT_ENV)
+        self._config = dict(config)
+        self._lock = threading.RLock()
+        self._clients: Dict[str, object] = {}
+        self._sources: Dict[str, Dict[str, str]] = {}
+        self._repos: Dict[str, Dict[str, str]] = {}
+        for k, v in self._config.items():
+            m = _SOURCE_RE.match(k)
+            if m:
+                self._sources.setdefault(m.group(1), {})[m.group(2)] = v
+                continue
+            m = _REPO_RE.match(k)
+            if m:
+                self._repos.setdefault(m.group(1), {})[m.group(2)] = v
+        for repo in REPOSITORIES:
+            if repo not in self._repos or "SOURCE" not in self._repos[repo]:
+                raise StorageError(
+                    f"repository {repo} is not assigned a source; set "
+                    f"PIO_STORAGE_REPOSITORIES_{repo}_SOURCE"
+                )
+
+    # --- source/client resolution ---
+
+    def _source_conf(self, source_name: str) -> Dict[str, str]:
+        conf = self._sources.get(source_name)
+        if conf is None or "TYPE" not in conf:
+            raise StorageError(
+                f"storage source {source_name} is not configured; set "
+                f"PIO_STORAGE_SOURCES_{source_name}_TYPE"
+            )
+        return conf
+
+    def _client(self, source_name: str):
+        with self._lock:
+            if source_name not in self._clients:
+                conf = self._source_conf(source_name)
+                module, _ = self._backend(conf["TYPE"])
+                props = {k: v for k, v in conf.items() if k != "TYPE"}
+                self._clients[source_name] = module.StorageClient(
+                    StorageClientConfig(props)
+                )
+            return self._clients[source_name]
+
+    @staticmethod
+    def _backend(type_name: str):
+        if type_name in BUILTIN_BACKENDS:
+            module_path, prefix = BUILTIN_BACKENDS[type_name]
+        else:
+            # extension point: a type names a module exposing PREFIX +
+            # StorageClient + <PREFIX><DAOName> classes
+            module_path, prefix = type_name, None
+        try:
+            module = importlib.import_module(module_path)
+        except ImportError as e:
+            raise StorageError(f"unknown storage backend type {type_name!r}") from e
+        if prefix is None:
+            prefix = getattr(module, "PREFIX", "")
+        return module, prefix
+
+    def get_data_object(self, source_name: str, namespace: str, dao_name: str):
+        """Reflective DAO lookup (reference Storage.getDataObject:263-312)."""
+        conf = self._source_conf(source_name)
+        module, prefix = self._backend(conf["TYPE"])
+        cls = getattr(module, f"{prefix}{dao_name}", None)
+        if cls is None:
+            raise StorageError(
+                f"backend {conf['TYPE']!r} does not implement {dao_name}"
+            )
+        return self._client(source_name).dao(cls, namespace)
+
+    def _repo_object(self, repo: str, dao_name: str):
+        r = self._repos[repo]
+        return self.get_data_object(r["SOURCE"], r.get("NAME", "pio"), dao_name)
+
+    # --- public accessors (reference Storage.scala:350-384) ---
+
+    def get_l_events(self):
+        return self._repo_object("EVENTDATA", "LEvents")
+
+    # the reference splits local/parallel event access (getLEvents/getPEvents);
+    # in the single-controller runtime both roles are served by one DAO
+    get_p_events = get_l_events
+
+    def get_meta_data_apps(self) -> Apps:
+        return self._repo_object("METADATA", "Apps")
+
+    def get_meta_data_access_keys(self) -> AccessKeys:
+        return self._repo_object("METADATA", "AccessKeys")
+
+    def get_meta_data_channels(self) -> Channels:
+        return self._repo_object("METADATA", "Channels")
+
+    def get_meta_data_engine_manifests(self) -> EngineManifests:
+        return self._repo_object("METADATA", "EngineManifests")
+
+    def get_meta_data_engine_instances(self) -> EngineInstances:
+        return self._repo_object("METADATA", "EngineInstances")
+
+    def get_meta_data_evaluation_instances(self) -> EvaluationInstances:
+        return self._repo_object("METADATA", "EvaluationInstances")
+
+    def get_model_data_models(self) -> Models:
+        return self._repo_object("MODELDATA", "Models")
+
+    # --- smoke probe (reference verifyAllDataObjects, Storage.scala:325-348) ---
+
+    def verify_all_data_objects(self) -> bool:
+        self.get_meta_data_apps()
+        self.get_meta_data_access_keys()
+        self.get_meta_data_channels()
+        self.get_meta_data_engine_manifests()
+        self.get_meta_data_engine_instances()
+        self.get_meta_data_evaluation_instances()
+        self.get_model_data_models()
+        events = self.get_l_events()
+        events.init(0)
+        events.insert(
+            __import__(
+                "predictionio_tpu.data.event", fromlist=["Event"]
+            ).Event(event="$set", entity_type="pio_pr", entity_id="0"),
+            0,
+        )
+        events.remove(0)
+        return True
+
+    def repositories(self) -> Dict[str, Dict[str, str]]:
+        return {k: dict(v) for k, v in self._repos.items()}
+
+    def sources(self) -> Dict[str, Dict[str, str]]:
+        return {k: dict(v) for k, v in self._sources.items()}
+
+
+# --- module-level default instance (lazy, resettable for tests) ---
+
+_default: Optional[Storage] = None
+_default_lock = threading.Lock()
+
+
+def get_storage() -> Storage:
+    global _default
+    with _default_lock:
+        if _default is None:
+            _default = Storage()
+        return _default
+
+
+def set_storage(storage: Optional[Storage]) -> None:
+    """Install (or clear, with None) the process-default Storage. Tests use
+    this to point the framework at a fresh in-memory universe."""
+    global _default
+    with _default_lock:
+        _default = storage
+
+
+def memory_storage() -> Storage:
+    """A fresh, fully in-memory storage universe."""
+    return Storage(dict(MEMORY_CONFIG))

@@ -1,0 +1,426 @@
+"""Storage DAO interfaces and metadata records.
+
+Capability parity with the reference storage layer
+(data/src/main/scala/io/prediction/data/storage/): the ``LEvents`` event DAO
+trait (LEvents.scala:37-328), and the seven metadata DAOs — Apps
+(Apps.scala:29-57), AccessKeys (AccessKeys.scala:31-64), Channels
+(Channels.scala:29-78), EngineManifests (EngineManifests.scala:34-62),
+EngineInstances (EngineInstances.scala:43-94), EvaluationInstances
+(EvaluationInstances.scala:39-78), Models (Models.scala:30-48).
+
+The reference splits event access into a local (LEvents) and a Spark-RDD
+(PEvents) trait; in the single-controller TPU runtime one DAO serves both
+roles — bulk reads return host iterators that the store layer columnarizes
+into device-bound batches (see predictionio_tpu.data.store).
+"""
+
+from __future__ import annotations
+
+import abc
+import dataclasses
+import datetime as _dt
+import re
+import secrets
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Union
+
+
+class _Unset:
+    """Sentinel distinguishing 'filter not given' from 'filter for absent'."""
+
+    _instance: Optional["_Unset"] = None
+
+    def __new__(cls):
+        if cls._instance is None:
+            cls._instance = super().__new__(cls)
+        return cls._instance
+
+    def __repr__(self) -> str:
+        return "UNSET"
+
+
+UNSET = _Unset()
+OptFilter = Union[_Unset, None, str]
+
+from predictionio_tpu.data.event import Event  # noqa: E402
+
+
+class StorageError(Exception):
+    """Backend failure (reference StorageException, Storage.scala:85-105)."""
+
+
+class LEvents(abc.ABC):
+    """Event CRUD DAO (reference LEvents.scala:37-328).
+
+    All operations are synchronous; the reference's Future-based API exists
+    to paper over blocking JVM clients, which a Python host thread does not
+    need. REST servers run these on worker threads.
+    """
+
+    @abc.abstractmethod
+    def init(self, app_id: int, channel_id: Optional[int] = None) -> bool:
+        """Initialize the backing table/namespace for an app (channel)."""
+
+    @abc.abstractmethod
+    def remove(self, app_id: int, channel_id: Optional[int] = None) -> bool:
+        """Remove all data for an app (channel)."""
+
+    @abc.abstractmethod
+    def close(self) -> None:
+        """Release client connections."""
+
+    @abc.abstractmethod
+    def insert(self, event: Event, app_id: int, channel_id: Optional[int] = None) -> str:
+        """Insert one event; returns the assigned eventId."""
+
+    @abc.abstractmethod
+    def get(
+        self, event_id: str, app_id: int, channel_id: Optional[int] = None
+    ) -> Optional[Event]:
+        """Get one event by id."""
+
+    @abc.abstractmethod
+    def delete(
+        self, event_id: str, app_id: int, channel_id: Optional[int] = None
+    ) -> bool:
+        """Delete one event by id; returns whether it existed."""
+
+    @abc.abstractmethod
+    def find(
+        self,
+        app_id: int,
+        channel_id: Optional[int] = None,
+        start_time: Optional[_dt.datetime] = None,
+        until_time: Optional[_dt.datetime] = None,
+        entity_type: Optional[str] = None,
+        entity_id: Optional[str] = None,
+        event_names: Optional[Sequence[str]] = None,
+        target_entity_type: OptFilter = UNSET,
+        target_entity_id: OptFilter = UNSET,
+        limit: Optional[int] = None,
+        reversed: bool = False,
+    ) -> Iterator[Event]:
+        """Find events with the reference's 9 filter dimensions
+        (LEvents.scala:164-176). ``start_time`` inclusive, ``until_time``
+        exclusive. ``target_entity_type=None`` (explicitly) filters for
+        events *without* a target entity; leave UNSET to not filter.
+        ``limit=None`` or -1 returns all. ``reversed`` returns descending
+        event-time order."""
+
+    # --- derived operations ---
+
+    def aggregate_properties(
+        self,
+        app_id: int,
+        entity_type: str,
+        channel_id: Optional[int] = None,
+        start_time: Optional[_dt.datetime] = None,
+        until_time: Optional[_dt.datetime] = None,
+        required: Optional[Sequence[str]] = None,
+    ) -> Dict[str, "PropertyMap"]:
+        """Aggregate $set/$unset/$delete into per-entity PropertyMaps
+        (reference LEvents.futureAggregateProperties:191-214)."""
+        from predictionio_tpu.data.aggregator import aggregate_properties
+
+        events = self.find(
+            app_id=app_id,
+            channel_id=channel_id,
+            start_time=start_time,
+            until_time=until_time,
+            entity_type=entity_type,
+            event_names=["$set", "$unset", "$delete"],
+        )
+        result = aggregate_properties(events)
+        if required:
+            req = list(required)
+            result = {
+                k: v for k, v in result.items() if all(r in v for r in req)
+            }
+        return result
+
+    def aggregate_properties_of_entity(
+        self,
+        app_id: int,
+        entity_type: str,
+        entity_id: str,
+        channel_id: Optional[int] = None,
+        start_time: Optional[_dt.datetime] = None,
+        until_time: Optional[_dt.datetime] = None,
+    ) -> Optional["PropertyMap"]:
+        """Single-entity variant (reference LEvents.scala:234-253)."""
+        from predictionio_tpu.data.aggregator import aggregate_properties_single
+
+        events = self.find(
+            app_id=app_id,
+            channel_id=channel_id,
+            start_time=start_time,
+            until_time=until_time,
+            entity_type=entity_type,
+            entity_id=entity_id,
+            event_names=["$set", "$unset", "$delete"],
+        )
+        return aggregate_properties_single(events)
+
+    def write(
+        self, events: Iterable[Event], app_id: int, channel_id: Optional[int] = None
+    ) -> List[str]:
+        """Bulk insert (reference PEvents.write:169-181)."""
+        return [self.insert(e, app_id, channel_id) for e in events]
+
+
+# --- metadata records ---
+
+
+@dataclasses.dataclass(frozen=True)
+class App:
+    """An app record (reference Apps.scala:29)."""
+
+    id: int
+    name: str
+    description: Optional[str] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class AccessKey:
+    """An access key granting event-API access to an app
+    (reference AccessKeys.scala:31). Empty ``events`` permits all."""
+
+    key: str
+    appid: int
+    events: tuple = ()
+
+    def __post_init__(self):
+        object.__setattr__(self, "events", tuple(self.events))
+
+
+@dataclasses.dataclass(frozen=True)
+class Channel:
+    """A named event channel within an app (reference Channels.scala:29)."""
+
+    id: int
+    name: str
+    appid: int
+
+    NAME_RE = re.compile(r"^[a-zA-Z0-9-]{1,16}$")
+
+    @staticmethod
+    def is_valid_name(s: str) -> bool:
+        return bool(Channel.NAME_RE.match(s))
+
+
+@dataclasses.dataclass(frozen=True)
+class EngineManifest:
+    """A built engine's registration (reference EngineManifests.scala:34)."""
+
+    id: str
+    version: str
+    name: str
+    description: Optional[str] = None
+    files: tuple = ()
+    engine_factory: str = ""
+
+    def __post_init__(self):
+        object.__setattr__(self, "files", tuple(self.files))
+
+
+@dataclasses.dataclass(frozen=True)
+class EngineInstance:
+    """A training-run record (reference EngineInstances.scala:43-94)."""
+
+    id: str
+    status: str
+    start_time: _dt.datetime
+    end_time: _dt.datetime
+    engine_id: str
+    engine_version: str
+    engine_variant: str
+    engine_factory: str
+    batch: str = ""
+    env: Dict[str, str] = dataclasses.field(default_factory=dict)
+    spark_conf: Dict[str, str] = dataclasses.field(default_factory=dict)
+    data_source_params: str = ""
+    preparator_params: str = ""
+    algorithms_params: str = ""
+    serving_params: str = ""
+
+
+@dataclasses.dataclass(frozen=True)
+class EvaluationInstance:
+    """An evaluation-run record (reference EvaluationInstances.scala:39-78)."""
+
+    id: str
+    status: str
+    start_time: _dt.datetime
+    end_time: _dt.datetime
+    evaluation_class: str = ""
+    engine_params_generator_class: str = ""
+    batch: str = ""
+    env: Dict[str, str] = dataclasses.field(default_factory=dict)
+    spark_conf: Dict[str, str] = dataclasses.field(default_factory=dict)
+    evaluator_results: str = ""
+    evaluator_results_html: str = ""
+    evaluator_results_json: str = ""
+
+
+@dataclasses.dataclass(frozen=True)
+class Model:
+    """A serialized model blob keyed by engine-instance id
+    (reference Models.scala:30)."""
+
+    id: str
+    models: bytes
+
+
+# --- metadata DAO interfaces ---
+
+
+class Apps(abc.ABC):
+    @abc.abstractmethod
+    def insert(self, app: App) -> Optional[int]:
+        """Insert; id 0 means auto-assign. Returns the assigned id."""
+
+    @abc.abstractmethod
+    def get(self, app_id: int) -> Optional[App]: ...
+
+    @abc.abstractmethod
+    def get_by_name(self, name: str) -> Optional[App]: ...
+
+    @abc.abstractmethod
+    def get_all(self) -> List[App]: ...
+
+    @abc.abstractmethod
+    def update(self, app: App) -> bool: ...
+
+    @abc.abstractmethod
+    def delete(self, app_id: int) -> bool: ...
+
+
+class AccessKeys(abc.ABC):
+    @abc.abstractmethod
+    def insert(self, access_key: AccessKey) -> Optional[str]:
+        """Insert; empty key means generate. Returns the key."""
+
+    @abc.abstractmethod
+    def get(self, key: str) -> Optional[AccessKey]: ...
+
+    @abc.abstractmethod
+    def get_all(self) -> List[AccessKey]: ...
+
+    @abc.abstractmethod
+    def get_by_app_id(self, app_id: int) -> List[AccessKey]: ...
+
+    @abc.abstractmethod
+    def update(self, access_key: AccessKey) -> bool: ...
+
+    @abc.abstractmethod
+    def delete(self, key: str) -> bool: ...
+
+    @staticmethod
+    def generate_key() -> str:
+        """64-char URL-safe random key (reference AccessKeys.scala:44-49)."""
+        while True:
+            k = secrets.token_urlsafe(48).replace("-", "8").replace("_", "9")
+            if len(k) >= 64:
+                return k[:64]
+
+
+class Channels(abc.ABC):
+    @abc.abstractmethod
+    def insert(self, channel: Channel) -> Optional[int]:
+        """Insert; id 0 means auto-assign. Returns the assigned id."""
+
+    @abc.abstractmethod
+    def get(self, channel_id: int) -> Optional[Channel]: ...
+
+    @abc.abstractmethod
+    def get_by_app_id(self, app_id: int) -> List[Channel]: ...
+
+    @abc.abstractmethod
+    def delete(self, channel_id: int) -> bool: ...
+
+
+class EngineManifests(abc.ABC):
+    @abc.abstractmethod
+    def insert(self, manifest: EngineManifest) -> None: ...
+
+    @abc.abstractmethod
+    def get(self, id: str, version: str) -> Optional[EngineManifest]: ...
+
+    @abc.abstractmethod
+    def get_all(self) -> List[EngineManifest]: ...
+
+    @abc.abstractmethod
+    def update(self, manifest: EngineManifest, upsert: bool = False) -> None: ...
+
+    @abc.abstractmethod
+    def delete(self, id: str, version: str) -> None: ...
+
+
+class EngineInstances(abc.ABC):
+    @abc.abstractmethod
+    def insert(self, instance: EngineInstance) -> str:
+        """Insert; empty id means generate. Returns the id."""
+
+    @abc.abstractmethod
+    def get(self, id: str) -> Optional[EngineInstance]: ...
+
+    @abc.abstractmethod
+    def get_all(self) -> List[EngineInstance]: ...
+
+    @abc.abstractmethod
+    def get_latest_completed(
+        self, engine_id: str, engine_version: str, engine_variant: str
+    ) -> Optional[EngineInstance]:
+        """Latest COMPLETED instance for an engine variant
+        (reference EngineInstances.getLatestCompleted:79)."""
+
+    @abc.abstractmethod
+    def get_completed(
+        self, engine_id: str, engine_version: str, engine_variant: str
+    ) -> List[EngineInstance]: ...
+
+    @abc.abstractmethod
+    def update(self, instance: EngineInstance) -> None: ...
+
+    @abc.abstractmethod
+    def delete(self, id: str) -> None: ...
+
+
+class EvaluationInstances(abc.ABC):
+    @abc.abstractmethod
+    def insert(self, instance: EvaluationInstance) -> str: ...
+
+    @abc.abstractmethod
+    def get(self, id: str) -> Optional[EvaluationInstance]: ...
+
+    @abc.abstractmethod
+    def get_all(self) -> List[EvaluationInstance]: ...
+
+    @abc.abstractmethod
+    def get_completed(self) -> List[EvaluationInstance]: ...
+
+    @abc.abstractmethod
+    def update(self, instance: EvaluationInstance) -> None: ...
+
+    @abc.abstractmethod
+    def delete(self, id: str) -> None: ...
+
+
+class Models(abc.ABC):
+    @abc.abstractmethod
+    def insert(self, model: Model) -> None: ...
+
+    @abc.abstractmethod
+    def get(self, id: str) -> Optional[Model]: ...
+
+    @abc.abstractmethod
+    def delete(self, id: str) -> None: ...
+
+
+# re-exported for type hints in aggregate_properties
+from predictionio_tpu.data.event import PropertyMap  # noqa: E402
+
+STATUS_INIT = "INIT"
+STATUS_TRAINING = "TRAINING"
+STATUS_EVALUATING = "EVALUATING"
+STATUS_COMPLETED = "COMPLETED"
+STATUS_FAILED = "FAILED"

@@ -1,0 +1,61 @@
+"""Local-filesystem model blob store (reference localfs/LocalFSModels.scala:30-59).
+
+Each model blob is one file ``<path>/pio_model_<id>``. The default MODELDATA
+backend — model pytrees serialized by the workflow land here.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, Optional
+
+from predictionio_tpu.data.storage import base
+from predictionio_tpu.data.storage.base import Model
+
+
+class StorageClient:
+    def __init__(self, config=None):
+        self.config = config
+        props = getattr(config, "properties", {}) or {}
+        self.path = props.get("PATH") or os.path.join(
+            os.environ.get("PIO_FS_BASEDIR", os.path.expanduser("~/.predictionio_tpu")),
+            "models",
+        )
+        os.makedirs(self.path, exist_ok=True)
+        self._daos: Dict[str, object] = {}
+        self._lock = threading.Lock()
+
+    def dao(self, cls, namespace: str):
+        key = f"{cls.__name__}:{namespace}"
+        with self._lock:
+            if key not in self._daos:
+                self._daos[key] = cls(client=self, config=self.config, namespace=namespace)
+            return self._daos[key]
+
+
+class LocalFSModels(base.Models):
+    def __init__(self, client: StorageClient, config=None, namespace: str = ""):
+        self._path = client.path
+        self._ns = namespace or "pio"
+
+    def _file(self, id: str) -> str:
+        safe = "".join(c if c.isalnum() or c in "-_." else "_" for c in id)
+        return os.path.join(self._path, f"{self._ns}_model_{safe}")
+
+    def insert(self, model: Model) -> None:
+        with open(self._file(model.id), "wb") as f:
+            f.write(model.models)
+
+    def get(self, id: str) -> Optional[Model]:
+        try:
+            with open(self._file(id), "rb") as f:
+                return Model(id, f.read())
+        except FileNotFoundError:
+            return None
+
+    def delete(self, id: str) -> None:
+        try:
+            os.remove(self._file(id))
+        except FileNotFoundError:
+            pass

@@ -1,0 +1,397 @@
+"""In-memory storage backend.
+
+The embedded default for tests and local development — the role HBase/
+Elasticsearch/LocalFS play in the reference, with the reference's DAO
+semantics (per-app/channel event namespaces that must be ``init``-ed before
+use, auto-increment ids, latest-completed queries). Thread-safe via a single
+lock per DAO; adequate because all mutation paths are host-side metadata ops.
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import threading
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+
+from predictionio_tpu.data.event import Event, new_event_id
+from predictionio_tpu.data.storage import base
+from predictionio_tpu.data.storage.base import (
+    UNSET,
+    AccessKey,
+    App,
+    Channel,
+    EngineInstance,
+    EngineManifest,
+    EvaluationInstance,
+    Model,
+    OptFilter,
+    StorageError,
+)
+
+
+class MemLEvents(base.LEvents):
+    def __init__(self, client=None, config=None, namespace: str = ""):
+        self._lock = threading.RLock()
+        # {(app_id, channel_id): {event_id: Event}} with per-namespace
+        # insertion-ordered dicts; find() sorts by event time on read.
+        self._tables: Dict[Tuple[int, Optional[int]], Dict[str, Event]] = {}
+
+    def _table(self, app_id: int, channel_id: Optional[int]) -> Dict[str, Event]:
+        key = (app_id, channel_id)
+        if key not in self._tables:
+            raise StorageError(
+                f"events table for app {app_id} channel {channel_id} not "
+                "initialized; run init() (pio app new) first"
+            )
+        return self._tables[key]
+
+    def init(self, app_id: int, channel_id: Optional[int] = None) -> bool:
+        with self._lock:
+            self._tables.setdefault((app_id, channel_id), {})
+        return True
+
+    def remove(self, app_id: int, channel_id: Optional[int] = None) -> bool:
+        with self._lock:
+            return self._tables.pop((app_id, channel_id), None) is not None
+
+    def close(self) -> None:
+        pass
+
+    def insert(self, event: Event, app_id: int, channel_id: Optional[int] = None) -> str:
+        with self._lock:
+            table = self._table(app_id, channel_id)
+            eid = event.event_id or new_event_id()
+            table[eid] = event.with_event_id(eid)
+            return eid
+
+    def get(
+        self, event_id: str, app_id: int, channel_id: Optional[int] = None
+    ) -> Optional[Event]:
+        with self._lock:
+            return self._table(app_id, channel_id).get(event_id)
+
+    def delete(
+        self, event_id: str, app_id: int, channel_id: Optional[int] = None
+    ) -> bool:
+        with self._lock:
+            return self._table(app_id, channel_id).pop(event_id, None) is not None
+
+    def find(
+        self,
+        app_id: int,
+        channel_id: Optional[int] = None,
+        start_time: Optional[_dt.datetime] = None,
+        until_time: Optional[_dt.datetime] = None,
+        entity_type: Optional[str] = None,
+        entity_id: Optional[str] = None,
+        event_names: Optional[Sequence[str]] = None,
+        target_entity_type: OptFilter = UNSET,
+        target_entity_id: OptFilter = UNSET,
+        limit: Optional[int] = None,
+        reversed: bool = False,
+    ) -> Iterator[Event]:
+        with self._lock:
+            events = list(self._table(app_id, channel_id).values())
+        names = set(event_names) if event_names is not None else None
+        start_time = _aware(start_time)
+        until_time = _aware(until_time)
+
+        def keep(e: Event) -> bool:
+            if start_time is not None and e.event_time < start_time:
+                return False
+            if until_time is not None and e.event_time >= until_time:
+                return False
+            if entity_type is not None and e.entity_type != entity_type:
+                return False
+            if entity_id is not None and e.entity_id != entity_id:
+                return False
+            if names is not None and e.event not in names:
+                return False
+            if target_entity_type is not UNSET and e.target_entity_type != target_entity_type:
+                return False
+            if target_entity_id is not UNSET and e.target_entity_id != target_entity_id:
+                return False
+            return True
+
+        out = [e for e in events if keep(e)]
+        out.sort(key=lambda e: e.event_time, reverse=reversed)
+        if limit is not None and limit >= 0:
+            out = out[:limit]
+        return iter(out)
+
+
+def _utcnow():
+    return _dt.datetime.now(_dt.timezone.utc)
+
+
+def _aware(t: Optional[_dt.datetime]) -> Optional[_dt.datetime]:
+    if t is not None and t.tzinfo is None:
+        return t.replace(tzinfo=_dt.timezone.utc)
+    return t
+
+
+class MemApps(base.Apps):
+    def __init__(self, client=None, config=None, namespace: str = ""):
+        self._lock = threading.RLock()
+        self._apps: Dict[int, App] = {}
+        self._next_id = 1
+
+    def insert(self, app: App) -> Optional[int]:
+        with self._lock:
+            if any(a.name == app.name for a in self._apps.values()):
+                return None
+            app_id = app.id
+            if app_id == 0:
+                app_id = self._next_id
+            if app_id in self._apps:
+                return None
+            self._next_id = max(self._next_id, app_id + 1)
+            self._apps[app_id] = App(app_id, app.name, app.description)
+            return app_id
+
+    def get(self, app_id: int) -> Optional[App]:
+        return self._apps.get(app_id)
+
+    def get_by_name(self, name: str) -> Optional[App]:
+        with self._lock:
+            for a in self._apps.values():
+                if a.name == name:
+                    return a
+        return None
+
+    def get_all(self) -> List[App]:
+        with self._lock:
+            return sorted(self._apps.values(), key=lambda a: a.id)
+
+    def update(self, app: App) -> bool:
+        with self._lock:
+            if app.id not in self._apps:
+                return False
+            self._apps[app.id] = app
+            return True
+
+    def delete(self, app_id: int) -> bool:
+        with self._lock:
+            return self._apps.pop(app_id, None) is not None
+
+
+class MemAccessKeys(base.AccessKeys):
+    def __init__(self, client=None, config=None, namespace: str = ""):
+        self._lock = threading.RLock()
+        self._keys: Dict[str, AccessKey] = {}
+
+    def insert(self, access_key: AccessKey) -> Optional[str]:
+        with self._lock:
+            key = access_key.key or self.generate_key()
+            if key in self._keys:
+                return None
+            self._keys[key] = AccessKey(key, access_key.appid, access_key.events)
+            return key
+
+    def get(self, key: str) -> Optional[AccessKey]:
+        return self._keys.get(key)
+
+    def get_all(self) -> List[AccessKey]:
+        with self._lock:
+            return list(self._keys.values())
+
+    def get_by_app_id(self, app_id: int) -> List[AccessKey]:
+        with self._lock:
+            return [k for k in self._keys.values() if k.appid == app_id]
+
+    def update(self, access_key: AccessKey) -> bool:
+        with self._lock:
+            if access_key.key not in self._keys:
+                return False
+            self._keys[access_key.key] = access_key
+            return True
+
+    def delete(self, key: str) -> bool:
+        with self._lock:
+            return self._keys.pop(key, None) is not None
+
+
+class MemChannels(base.Channels):
+    def __init__(self, client=None, config=None, namespace: str = ""):
+        self._lock = threading.RLock()
+        self._channels: Dict[int, Channel] = {}
+        self._next_id = 1
+
+    def insert(self, channel: Channel) -> Optional[int]:
+        if not Channel.is_valid_name(channel.name):
+            return None
+        with self._lock:
+            cid = channel.id or self._next_id
+            if cid in self._channels:
+                return None
+            self._next_id = max(self._next_id, cid + 1)
+            self._channels[cid] = Channel(cid, channel.name, channel.appid)
+            return cid
+
+    def get(self, channel_id: int) -> Optional[Channel]:
+        return self._channels.get(channel_id)
+
+    def get_by_app_id(self, app_id: int) -> List[Channel]:
+        with self._lock:
+            return [c for c in self._channels.values() if c.appid == app_id]
+
+    def delete(self, channel_id: int) -> bool:
+        with self._lock:
+            return self._channels.pop(channel_id, None) is not None
+
+
+class MemEngineManifests(base.EngineManifests):
+    def __init__(self, client=None, config=None, namespace: str = ""):
+        self._lock = threading.RLock()
+        self._manifests: Dict[Tuple[str, str], EngineManifest] = {}
+
+    def insert(self, manifest: EngineManifest) -> None:
+        with self._lock:
+            self._manifests[(manifest.id, manifest.version)] = manifest
+
+    def get(self, id: str, version: str) -> Optional[EngineManifest]:
+        return self._manifests.get((id, version))
+
+    def get_all(self) -> List[EngineManifest]:
+        with self._lock:
+            return list(self._manifests.values())
+
+    def update(self, manifest: EngineManifest, upsert: bool = False) -> None:
+        with self._lock:
+            key = (manifest.id, manifest.version)
+            if key in self._manifests or upsert:
+                self._manifests[key] = manifest
+
+    def delete(self, id: str, version: str) -> None:
+        with self._lock:
+            self._manifests.pop((id, version), None)
+
+
+def _new_instance_id() -> str:
+    import uuid
+
+    return uuid.uuid4().hex[:17]
+
+
+class MemEngineInstances(base.EngineInstances):
+    def __init__(self, client=None, config=None, namespace: str = ""):
+        self._lock = threading.RLock()
+        self._instances: Dict[str, EngineInstance] = {}
+
+    def insert(self, instance: EngineInstance) -> str:
+        import dataclasses as _dc
+
+        with self._lock:
+            iid = instance.id or _new_instance_id()
+            self._instances[iid] = _dc.replace(instance, id=iid)
+            return iid
+
+    def get(self, id: str) -> Optional[EngineInstance]:
+        return self._instances.get(id)
+
+    def get_all(self) -> List[EngineInstance]:
+        with self._lock:
+            return list(self._instances.values())
+
+    def get_completed(
+        self, engine_id: str, engine_version: str, engine_variant: str
+    ) -> List[EngineInstance]:
+        with self._lock:
+            out = [
+                i
+                for i in self._instances.values()
+                if i.status == base.STATUS_COMPLETED
+                and i.engine_id == engine_id
+                and i.engine_version == engine_version
+                and i.engine_variant == engine_variant
+            ]
+        out.sort(key=lambda i: i.start_time, reverse=True)
+        return out
+
+    def get_latest_completed(
+        self, engine_id: str, engine_version: str, engine_variant: str
+    ) -> Optional[EngineInstance]:
+        completed = self.get_completed(engine_id, engine_version, engine_variant)
+        return completed[0] if completed else None
+
+    def update(self, instance: EngineInstance) -> None:
+        with self._lock:
+            self._instances[instance.id] = instance
+
+    def delete(self, id: str) -> None:
+        with self._lock:
+            self._instances.pop(id, None)
+
+
+class MemEvaluationInstances(base.EvaluationInstances):
+    def __init__(self, client=None, config=None, namespace: str = ""):
+        self._lock = threading.RLock()
+        self._instances: Dict[str, EvaluationInstance] = {}
+
+    def insert(self, instance: EvaluationInstance) -> str:
+        import dataclasses as _dc
+
+        with self._lock:
+            iid = instance.id or _new_instance_id()
+            self._instances[iid] = _dc.replace(instance, id=iid)
+            return iid
+
+    def get(self, id: str) -> Optional[EvaluationInstance]:
+        return self._instances.get(id)
+
+    def get_all(self) -> List[EvaluationInstance]:
+        with self._lock:
+            return list(self._instances.values())
+
+    def get_completed(self) -> List[EvaluationInstance]:
+        with self._lock:
+            out = [
+                i
+                for i in self._instances.values()
+                if i.status == base.STATUS_COMPLETED
+            ]
+        out.sort(key=lambda i: i.start_time, reverse=True)
+        return out
+
+    def update(self, instance: EvaluationInstance) -> None:
+        with self._lock:
+            self._instances[instance.id] = instance
+
+    def delete(self, id: str) -> None:
+        with self._lock:
+            self._instances.pop(id, None)
+
+
+class MemModels(base.Models):
+    def __init__(self, client=None, config=None, namespace: str = ""):
+        self._lock = threading.RLock()
+        self._models: Dict[str, Model] = {}
+
+    def insert(self, model: Model) -> None:
+        with self._lock:
+            self._models[model.id] = model
+
+    def get(self, id: str) -> Optional[Model]:
+        return self._models.get(id)
+
+    def delete(self, id: str) -> None:
+        with self._lock:
+            self._models.pop(id, None)
+
+
+class StorageClient:
+    """Client object for the memory backend. Holds shared DAO instances so
+    that every lookup of the same source returns the same data (the
+    reference caches clients per source, Storage.scala:202-208)."""
+
+    def __init__(self, config=None):
+        self.config = config
+        self._daos: Dict[str, object] = {}
+        self._lock = threading.Lock()
+
+    def dao(self, cls, namespace: str):
+        key = f"{cls.__name__}:{namespace}"
+        with self._lock:
+            if key not in self._daos:
+                self._daos[key] = cls(client=self, config=self.config, namespace=namespace)
+            return self._daos[key]

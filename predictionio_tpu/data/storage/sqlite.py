@@ -1,0 +1,777 @@
+"""SQLite storage backend — the persistent embedded default.
+
+Plays the role of the reference's JDBC backend
+(data/src/main/scala/io/prediction/data/storage/jdbc/): one database file
+holds the metadata tables and per-app/channel event tables named
+``events_<app>[_<channel>]`` (the reference's table-per-app/channel scheme,
+JDBCUtils/HBEventsUtil). Event rows carry a millisecond timestamp column for
+ordered range scans (the role of the HBase row-key time component,
+hbase/HBEventsUtil.scala:82-130).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import datetime as _dt
+import json
+import os
+import sqlite3
+import threading
+from typing import Dict, Iterator, List, Optional, Sequence
+
+from predictionio_tpu.data.event import (
+    DataMap,
+    Event,
+    format_iso8601,
+    new_event_id,
+    parse_iso8601,
+)
+from predictionio_tpu.data.storage import base
+from predictionio_tpu.data.storage.base import (
+    UNSET,
+    AccessKey,
+    App,
+    Channel,
+    EngineInstance,
+    EngineManifest,
+    EvaluationInstance,
+    Model,
+    OptFilter,
+    StorageError,
+)
+
+
+def _ms(t: _dt.datetime) -> int:
+    if t.tzinfo is None:
+        t = t.replace(tzinfo=_dt.timezone.utc)
+    return int(t.timestamp() * 1000)
+
+
+def _utc_iso(t: _dt.datetime) -> str:
+    """UTC-normalized fixed-width ISO8601, so lexicographic TEXT ordering is
+    chronological (used for instance start/end times in ORDER BY)."""
+    if t.tzinfo is None:
+        t = t.replace(tzinfo=_dt.timezone.utc)
+    return format_iso8601(t.astimezone(_dt.timezone.utc))
+
+
+class _LockedCursor:
+    """Runs a statement under the client lock and materializes results, so
+    concurrent REST worker threads never interleave cursor state on the
+    shared connection."""
+
+    __slots__ = ("_rows", "rowcount", "lastrowid")
+
+    def __init__(self, client: "StorageClient", sql: str, params=()):
+        with client.lock:
+            cur = client.conn.execute(sql, params)
+            self._rows = cur.fetchall() if cur.description is not None else []
+            self.rowcount = cur.rowcount
+            self.lastrowid = cur.lastrowid
+
+    def fetchone(self):
+        return self._rows[0] if self._rows else None
+
+    def fetchall(self):
+        return self._rows
+
+
+class StorageClient:
+    """Shared sqlite connection per source (reference caches clients per
+    source name, Storage.scala:202-208). ``check_same_thread=False`` plus a
+    lock serializes access from REST worker threads."""
+
+    def __init__(self, config=None):
+        self.config = config
+        props = getattr(config, "properties", {}) or {}
+        path = props.get("PATH") or os.path.join(
+            os.environ.get("PIO_FS_BASEDIR", os.path.expanduser("~/.predictionio_tpu")),
+            "storage.db",
+        )
+        if path != ":memory:":
+            os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        self.path = path
+        self.conn = sqlite3.connect(path, check_same_thread=False)
+        self.conn.execute("PRAGMA journal_mode=WAL")
+        self.lock = threading.RLock()
+        self._daos: Dict[str, object] = {}
+
+    def execute(self, sql: str, params=()) -> _LockedCursor:
+        return _LockedCursor(self, sql, params)
+
+    def commit(self) -> None:
+        with self.lock:
+            self.conn.commit()
+
+    def dao(self, cls, namespace: str):
+        key = f"{cls.__name__}:{namespace}"
+        with self.lock:
+            if key not in self._daos:
+                self._daos[key] = cls(client=self, config=self.config, namespace=namespace)
+            return self._daos[key]
+
+
+def _table_name(namespace: str, suffix: str) -> str:
+    ns = "".join(c if c.isalnum() else "_" for c in (namespace or "pio"))
+    return f"{ns}_{suffix}"
+
+
+class SQLiteLEvents(base.LEvents):
+    def __init__(self, client: StorageClient, config=None, namespace: str = ""):
+        self._c = client
+        self._ns = namespace or "pio"
+
+    def _events_table(self, app_id: int, channel_id: Optional[int]) -> str:
+        name = _table_name(self._ns, f"events_{int(app_id)}")
+        if channel_id is not None:
+            name += f"_{int(channel_id)}"
+        return name
+
+    def init(self, app_id: int, channel_id: Optional[int] = None) -> bool:
+        t = self._events_table(app_id, channel_id)
+        with self._c.lock:
+            self._c.execute(
+                f"""CREATE TABLE IF NOT EXISTS {t} (
+                    id TEXT PRIMARY KEY,
+                    event TEXT NOT NULL,
+                    entity_type TEXT NOT NULL,
+                    entity_id TEXT NOT NULL,
+                    target_entity_type TEXT,
+                    target_entity_id TEXT,
+                    properties TEXT,
+                    event_time TEXT NOT NULL,
+                    event_time_ms INTEGER NOT NULL,
+                    tags TEXT,
+                    pr_id TEXT,
+                    creation_time TEXT NOT NULL
+                )"""
+            )
+            self._c.execute(
+                f"CREATE INDEX IF NOT EXISTS {t}_time ON {t} (event_time_ms)"
+            )
+            self._c.execute(
+                f"CREATE INDEX IF NOT EXISTS {t}_entity ON {t} "
+                f"(entity_type, entity_id, event_time_ms)"
+            )
+            self._c.commit()
+        return True
+
+    def remove(self, app_id: int, channel_id: Optional[int] = None) -> bool:
+        t = self._events_table(app_id, channel_id)
+        with self._c.lock:
+            self._c.execute(f"DROP TABLE IF EXISTS {t}")
+            self._c.commit()
+        return True
+
+    def close(self) -> None:
+        pass
+
+    def _exists(self, table: str) -> bool:
+        cur = self._c.execute(
+            "SELECT name FROM sqlite_master WHERE type='table' AND name=?", (table,)
+        )
+        return cur.fetchone() is not None
+
+    def insert(self, event: Event, app_id: int, channel_id: Optional[int] = None) -> str:
+        t = self._events_table(app_id, channel_id)
+        eid = event.event_id or new_event_id()
+        with self._c.lock:
+            if not self._exists(t):
+                raise StorageError(f"events table {t} not initialized")
+            self._c.execute(
+                f"INSERT OR REPLACE INTO {t} VALUES (?,?,?,?,?,?,?,?,?,?,?,?)",
+                (
+                    eid,
+                    event.event,
+                    event.entity_type,
+                    event.entity_id,
+                    event.target_entity_type,
+                    event.target_entity_id,
+                    json.dumps(event.properties.to_json()),
+                    format_iso8601(event.event_time),
+                    _ms(event.event_time),
+                    json.dumps(list(event.tags)),
+                    event.pr_id,
+                    format_iso8601(event.creation_time),
+                ),
+            )
+            self._c.commit()
+        return eid
+
+    @staticmethod
+    def _row_to_event(row) -> Event:
+        return Event(
+            event_id=row[0],
+            event=row[1],
+            entity_type=row[2],
+            entity_id=row[3],
+            target_entity_type=row[4],
+            target_entity_id=row[5],
+            properties=DataMap(json.loads(row[6]) if row[6] else {}),
+            event_time=parse_iso8601(row[7]),
+            tags=tuple(json.loads(row[9]) if row[9] else ()),
+            pr_id=row[10],
+            creation_time=parse_iso8601(row[11]),
+        )
+
+    def get(
+        self, event_id: str, app_id: int, channel_id: Optional[int] = None
+    ) -> Optional[Event]:
+        t = self._events_table(app_id, channel_id)
+        with self._c.lock:
+            if not self._exists(t):
+                raise StorageError(f"events table {t} not initialized")
+            cur = self._c.execute(f"SELECT * FROM {t} WHERE id=?", (event_id,))
+            row = cur.fetchone()
+        return self._row_to_event(row) if row else None
+
+    def delete(
+        self, event_id: str, app_id: int, channel_id: Optional[int] = None
+    ) -> bool:
+        t = self._events_table(app_id, channel_id)
+        with self._c.lock:
+            if not self._exists(t):
+                raise StorageError(f"events table {t} not initialized")
+            cur = self._c.execute(f"DELETE FROM {t} WHERE id=?", (event_id,))
+            self._c.commit()
+            return cur.rowcount > 0
+
+    def find(
+        self,
+        app_id: int,
+        channel_id: Optional[int] = None,
+        start_time: Optional[_dt.datetime] = None,
+        until_time: Optional[_dt.datetime] = None,
+        entity_type: Optional[str] = None,
+        entity_id: Optional[str] = None,
+        event_names: Optional[Sequence[str]] = None,
+        target_entity_type: OptFilter = UNSET,
+        target_entity_id: OptFilter = UNSET,
+        limit: Optional[int] = None,
+        reversed: bool = False,
+    ) -> Iterator[Event]:
+        t = self._events_table(app_id, channel_id)
+        clauses: List[str] = []
+        params: list = []
+        if start_time is not None:
+            clauses.append("event_time_ms >= ?")
+            params.append(_ms(start_time))
+        if until_time is not None:
+            clauses.append("event_time_ms < ?")
+            params.append(_ms(until_time))
+        if entity_type is not None:
+            clauses.append("entity_type = ?")
+            params.append(entity_type)
+        if entity_id is not None:
+            clauses.append("entity_id = ?")
+            params.append(entity_id)
+        if event_names is not None:
+            if event_names:
+                clauses.append(
+                    "event IN (" + ",".join("?" * len(event_names)) + ")"
+                )
+                params.extend(event_names)
+            else:
+                clauses.append("1=0")  # empty allow-list matches nothing
+        if target_entity_type is not UNSET:
+            if target_entity_type is None:
+                clauses.append("target_entity_type IS NULL")
+            else:
+                clauses.append("target_entity_type = ?")
+                params.append(target_entity_type)
+        if target_entity_id is not UNSET:
+            if target_entity_id is None:
+                clauses.append("target_entity_id IS NULL")
+            else:
+                clauses.append("target_entity_id = ?")
+                params.append(target_entity_id)
+        sql = f"SELECT * FROM {t}"
+        if clauses:
+            sql += " WHERE " + " AND ".join(clauses)
+        sql += f" ORDER BY event_time_ms {'DESC' if reversed else 'ASC'}"
+        if limit is not None and limit >= 0:
+            sql += f" LIMIT {int(limit)}"
+        with self._c.lock:
+            if not self._exists(t):
+                raise StorageError(f"events table {t} not initialized")
+            rows = self._c.execute(sql, params).fetchall()
+        return (self._row_to_event(r) for r in rows)
+
+
+class _SQLiteMetaBase:
+    def __init__(self, client: StorageClient, config=None, namespace: str = ""):
+        self._c = client
+        self._ns = namespace or "pio"
+        with self._c.lock:
+            self._create()
+            self._c.commit()
+
+    def _t(self, suffix: str) -> str:
+        return _table_name(self._ns, suffix)
+
+    def _create(self) -> None:
+        raise NotImplementedError
+
+
+class SQLiteApps(_SQLiteMetaBase, base.Apps):
+    def _create(self):
+        self._c.execute(
+            f"""CREATE TABLE IF NOT EXISTS {self._t('apps')} (
+                id INTEGER PRIMARY KEY AUTOINCREMENT,
+                name TEXT NOT NULL UNIQUE,
+                description TEXT)"""
+        )
+
+    def insert(self, app: App) -> Optional[int]:
+        with self._c.lock:
+            try:
+                if app.id:
+                    cur = self._c.execute(
+                        f"INSERT INTO {self._t('apps')} (id,name,description) VALUES (?,?,?)",
+                        (app.id, app.name, app.description),
+                    )
+                else:
+                    cur = self._c.execute(
+                        f"INSERT INTO {self._t('apps')} (name,description) VALUES (?,?)",
+                        (app.name, app.description),
+                    )
+                self._c.commit()
+                return cur.lastrowid if not app.id else app.id
+            except sqlite3.IntegrityError:
+                return None
+
+    def get(self, app_id: int) -> Optional[App]:
+        row = self._c.execute(
+            f"SELECT id,name,description FROM {self._t('apps')} WHERE id=?", (app_id,)
+        ).fetchone()
+        return App(*row) if row else None
+
+    def get_by_name(self, name: str) -> Optional[App]:
+        row = self._c.execute(
+            f"SELECT id,name,description FROM {self._t('apps')} WHERE name=?", (name,)
+        ).fetchone()
+        return App(*row) if row else None
+
+    def get_all(self) -> List[App]:
+        rows = self._c.execute(
+            f"SELECT id,name,description FROM {self._t('apps')} ORDER BY id"
+        ).fetchall()
+        return [App(*r) for r in rows]
+
+    def update(self, app: App) -> bool:
+        with self._c.lock:
+            cur = self._c.execute(
+                f"UPDATE {self._t('apps')} SET name=?,description=? WHERE id=?",
+                (app.name, app.description, app.id),
+            )
+            self._c.commit()
+            return cur.rowcount > 0
+
+    def delete(self, app_id: int) -> bool:
+        with self._c.lock:
+            cur = self._c.execute(
+                f"DELETE FROM {self._t('apps')} WHERE id=?", (app_id,)
+            )
+            self._c.commit()
+            return cur.rowcount > 0
+
+
+class SQLiteAccessKeys(_SQLiteMetaBase, base.AccessKeys):
+    def _create(self):
+        self._c.execute(
+            f"""CREATE TABLE IF NOT EXISTS {self._t('access_keys')} (
+                key TEXT PRIMARY KEY, appid INTEGER NOT NULL, events TEXT)"""
+        )
+
+    def insert(self, access_key: AccessKey) -> Optional[str]:
+        key = access_key.key or self.generate_key()
+        with self._c.lock:
+            try:
+                self._c.execute(
+                    f"INSERT INTO {self._t('access_keys')} VALUES (?,?,?)",
+                    (key, access_key.appid, json.dumps(list(access_key.events))),
+                )
+                self._c.commit()
+                return key
+            except sqlite3.IntegrityError:
+                return None
+
+    @staticmethod
+    def _row(row) -> AccessKey:
+        return AccessKey(row[0], row[1], tuple(json.loads(row[2] or "[]")))
+
+    def get(self, key: str) -> Optional[AccessKey]:
+        row = self._c.execute(
+            f"SELECT * FROM {self._t('access_keys')} WHERE key=?", (key,)
+        ).fetchone()
+        return self._row(row) if row else None
+
+    def get_all(self) -> List[AccessKey]:
+        return [
+            self._row(r)
+            for r in self._c.execute(
+                f"SELECT * FROM {self._t('access_keys')}"
+            ).fetchall()
+        ]
+
+    def get_by_app_id(self, app_id: int) -> List[AccessKey]:
+        return [
+            self._row(r)
+            for r in self._c.execute(
+                f"SELECT * FROM {self._t('access_keys')} WHERE appid=?", (app_id,)
+            ).fetchall()
+        ]
+
+    def update(self, access_key: AccessKey) -> bool:
+        with self._c.lock:
+            cur = self._c.execute(
+                f"UPDATE {self._t('access_keys')} SET appid=?,events=? WHERE key=?",
+                (access_key.appid, json.dumps(list(access_key.events)), access_key.key),
+            )
+            self._c.commit()
+            return cur.rowcount > 0
+
+    def delete(self, key: str) -> bool:
+        with self._c.lock:
+            cur = self._c.execute(
+                f"DELETE FROM {self._t('access_keys')} WHERE key=?", (key,)
+            )
+            self._c.commit()
+            return cur.rowcount > 0
+
+
+class SQLiteChannels(_SQLiteMetaBase, base.Channels):
+    def _create(self):
+        self._c.execute(
+            f"""CREATE TABLE IF NOT EXISTS {self._t('channels')} (
+                id INTEGER PRIMARY KEY AUTOINCREMENT,
+                name TEXT NOT NULL, appid INTEGER NOT NULL)"""
+        )
+
+    def insert(self, channel: Channel) -> Optional[int]:
+        if not Channel.is_valid_name(channel.name):
+            return None
+        with self._c.lock:
+            if channel.id:
+                self._c.execute(
+                    f"INSERT INTO {self._t('channels')} (id,name,appid) VALUES (?,?,?)",
+                    (channel.id, channel.name, channel.appid),
+                )
+                cid = channel.id
+            else:
+                cur = self._c.execute(
+                    f"INSERT INTO {self._t('channels')} (name,appid) VALUES (?,?)",
+                    (channel.name, channel.appid),
+                )
+                cid = cur.lastrowid
+            self._c.commit()
+            return cid
+
+    def get(self, channel_id: int) -> Optional[Channel]:
+        row = self._c.execute(
+            f"SELECT id,name,appid FROM {self._t('channels')} WHERE id=?",
+            (channel_id,),
+        ).fetchone()
+        return Channel(*row) if row else None
+
+    def get_by_app_id(self, app_id: int) -> List[Channel]:
+        rows = self._c.execute(
+            f"SELECT id,name,appid FROM {self._t('channels')} WHERE appid=?",
+            (app_id,),
+        ).fetchall()
+        return [Channel(*r) for r in rows]
+
+    def delete(self, channel_id: int) -> bool:
+        with self._c.lock:
+            cur = self._c.execute(
+                f"DELETE FROM {self._t('channels')} WHERE id=?", (channel_id,)
+            )
+            self._c.commit()
+            return cur.rowcount > 0
+
+
+class SQLiteEngineManifests(_SQLiteMetaBase, base.EngineManifests):
+    def _create(self):
+        self._c.execute(
+            f"""CREATE TABLE IF NOT EXISTS {self._t('engine_manifests')} (
+                id TEXT, version TEXT, name TEXT, description TEXT,
+                files TEXT, engine_factory TEXT,
+                PRIMARY KEY (id, version))"""
+        )
+
+    def insert(self, manifest: EngineManifest) -> None:
+        self.update(manifest, upsert=True)
+
+    def get(self, id: str, version: str) -> Optional[EngineManifest]:
+        row = self._c.execute(
+            f"SELECT * FROM {self._t('engine_manifests')} WHERE id=? AND version=?",
+            (id, version),
+        ).fetchone()
+        if not row:
+            return None
+        return EngineManifest(
+            row[0], row[1], row[2], row[3], tuple(json.loads(row[4] or "[]")), row[5]
+        )
+
+    def get_all(self) -> List[EngineManifest]:
+        rows = self._c.execute(
+            f"SELECT * FROM {self._t('engine_manifests')}"
+        ).fetchall()
+        return [
+            EngineManifest(r[0], r[1], r[2], r[3], tuple(json.loads(r[4] or "[]")), r[5])
+            for r in rows
+        ]
+
+    def update(self, manifest: EngineManifest, upsert: bool = False) -> None:
+        with self._c.lock:
+            self._c.execute(
+                f"INSERT OR REPLACE INTO {self._t('engine_manifests')} VALUES (?,?,?,?,?,?)",
+                (
+                    manifest.id,
+                    manifest.version,
+                    manifest.name,
+                    manifest.description,
+                    json.dumps(list(manifest.files)),
+                    manifest.engine_factory,
+                ),
+            )
+            self._c.commit()
+
+    def delete(self, id: str, version: str) -> None:
+        with self._c.lock:
+            self._c.execute(
+                f"DELETE FROM {self._t('engine_manifests')} WHERE id=? AND version=?",
+                (id, version),
+            )
+            self._c.commit()
+
+
+_EI_COLS = (
+    "id, status, start_time, end_time, engine_id, engine_version, "
+    "engine_variant, engine_factory, batch, env, spark_conf, "
+    "data_source_params, preparator_params, algorithms_params, serving_params"
+)
+
+
+class SQLiteEngineInstances(_SQLiteMetaBase, base.EngineInstances):
+    def _create(self):
+        self._c.execute(
+            f"""CREATE TABLE IF NOT EXISTS {self._t('engine_instances')} (
+                id TEXT PRIMARY KEY, status TEXT, start_time TEXT, end_time TEXT,
+                engine_id TEXT, engine_version TEXT, engine_variant TEXT,
+                engine_factory TEXT, batch TEXT, env TEXT, spark_conf TEXT,
+                data_source_params TEXT, preparator_params TEXT,
+                algorithms_params TEXT, serving_params TEXT)"""
+        )
+
+    @staticmethod
+    def _row(r) -> EngineInstance:
+        return EngineInstance(
+            id=r[0],
+            status=r[1],
+            start_time=parse_iso8601(r[2]),
+            end_time=parse_iso8601(r[3]),
+            engine_id=r[4],
+            engine_version=r[5],
+            engine_variant=r[6],
+            engine_factory=r[7],
+            batch=r[8] or "",
+            env=json.loads(r[9] or "{}"),
+            spark_conf=json.loads(r[10] or "{}"),
+            data_source_params=r[11] or "",
+            preparator_params=r[12] or "",
+            algorithms_params=r[13] or "",
+            serving_params=r[14] or "",
+        )
+
+    def _write(self, i: EngineInstance) -> None:
+        self._c.execute(
+            f"INSERT OR REPLACE INTO {self._t('engine_instances')} "
+            f"VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?,?,?)",
+            (
+                i.id,
+                i.status,
+                _utc_iso(i.start_time),
+                _utc_iso(i.end_time),
+                i.engine_id,
+                i.engine_version,
+                i.engine_variant,
+                i.engine_factory,
+                i.batch,
+                json.dumps(i.env),
+                json.dumps(i.spark_conf),
+                i.data_source_params,
+                i.preparator_params,
+                i.algorithms_params,
+                i.serving_params,
+            ),
+        )
+
+    def insert(self, instance: EngineInstance) -> str:
+        import uuid
+
+        iid = instance.id or uuid.uuid4().hex[:17]
+        with self._c.lock:
+            self._write(dataclasses.replace(instance, id=iid))
+            self._c.commit()
+        return iid
+
+    def get(self, id: str) -> Optional[EngineInstance]:
+        row = self._c.execute(
+            f"SELECT {_EI_COLS} FROM {self._t('engine_instances')} WHERE id=?", (id,)
+        ).fetchone()
+        return self._row(row) if row else None
+
+    def get_all(self) -> List[EngineInstance]:
+        rows = self._c.execute(
+            f"SELECT {_EI_COLS} FROM {self._t('engine_instances')}"
+        ).fetchall()
+        return [self._row(r) for r in rows]
+
+    def get_completed(
+        self, engine_id: str, engine_version: str, engine_variant: str
+    ) -> List[EngineInstance]:
+        rows = self._c.execute(
+            f"SELECT {_EI_COLS} FROM {self._t('engine_instances')} "
+            "WHERE status=? AND engine_id=? AND engine_version=? AND engine_variant=? "
+            "ORDER BY start_time DESC",
+            (base.STATUS_COMPLETED, engine_id, engine_version, engine_variant),
+        ).fetchall()
+        return [self._row(r) for r in rows]
+
+    def get_latest_completed(
+        self, engine_id: str, engine_version: str, engine_variant: str
+    ) -> Optional[EngineInstance]:
+        out = self.get_completed(engine_id, engine_version, engine_variant)
+        return out[0] if out else None
+
+    def update(self, instance: EngineInstance) -> None:
+        with self._c.lock:
+            self._write(instance)
+            self._c.commit()
+
+    def delete(self, id: str) -> None:
+        with self._c.lock:
+            self._c.execute(
+                f"DELETE FROM {self._t('engine_instances')} WHERE id=?", (id,)
+            )
+            self._c.commit()
+
+
+class SQLiteEvaluationInstances(_SQLiteMetaBase, base.EvaluationInstances):
+    def _create(self):
+        self._c.execute(
+            f"""CREATE TABLE IF NOT EXISTS {self._t('evaluation_instances')} (
+                id TEXT PRIMARY KEY, status TEXT, start_time TEXT, end_time TEXT,
+                evaluation_class TEXT, engine_params_generator_class TEXT,
+                batch TEXT, env TEXT, spark_conf TEXT,
+                evaluator_results TEXT, evaluator_results_html TEXT,
+                evaluator_results_json TEXT)"""
+        )
+
+    @staticmethod
+    def _row(r) -> EvaluationInstance:
+        return EvaluationInstance(
+            id=r[0],
+            status=r[1],
+            start_time=parse_iso8601(r[2]),
+            end_time=parse_iso8601(r[3]),
+            evaluation_class=r[4] or "",
+            engine_params_generator_class=r[5] or "",
+            batch=r[6] or "",
+            env=json.loads(r[7] or "{}"),
+            spark_conf=json.loads(r[8] or "{}"),
+            evaluator_results=r[9] or "",
+            evaluator_results_html=r[10] or "",
+            evaluator_results_json=r[11] or "",
+        )
+
+    def _write(self, i: EvaluationInstance) -> None:
+        self._c.execute(
+            f"INSERT OR REPLACE INTO {self._t('evaluation_instances')} "
+            f"VALUES (?,?,?,?,?,?,?,?,?,?,?,?)",
+            (
+                i.id,
+                i.status,
+                _utc_iso(i.start_time),
+                _utc_iso(i.end_time),
+                i.evaluation_class,
+                i.engine_params_generator_class,
+                i.batch,
+                json.dumps(i.env),
+                json.dumps(i.spark_conf),
+                i.evaluator_results,
+                i.evaluator_results_html,
+                i.evaluator_results_json,
+            ),
+        )
+
+    def insert(self, instance: EvaluationInstance) -> str:
+        import uuid
+
+        iid = instance.id or uuid.uuid4().hex[:17]
+        with self._c.lock:
+            self._write(dataclasses.replace(instance, id=iid))
+            self._c.commit()
+        return iid
+
+    def get(self, id: str) -> Optional[EvaluationInstance]:
+        row = self._c.execute(
+            f"SELECT * FROM {self._t('evaluation_instances')} WHERE id=?", (id,)
+        ).fetchone()
+        return self._row(row) if row else None
+
+    def get_all(self) -> List[EvaluationInstance]:
+        rows = self._c.execute(
+            f"SELECT * FROM {self._t('evaluation_instances')}"
+        ).fetchall()
+        return [self._row(r) for r in rows]
+
+    def get_completed(self) -> List[EvaluationInstance]:
+        rows = self._c.execute(
+            f"SELECT * FROM {self._t('evaluation_instances')} "
+            "WHERE status=? ORDER BY start_time DESC",
+            (base.STATUS_COMPLETED,),
+        ).fetchall()
+        return [self._row(r) for r in rows]
+
+    def update(self, instance: EvaluationInstance) -> None:
+        with self._c.lock:
+            self._write(instance)
+            self._c.commit()
+
+    def delete(self, id: str) -> None:
+        with self._c.lock:
+            self._c.execute(
+                f"DELETE FROM {self._t('evaluation_instances')} WHERE id=?", (id,)
+            )
+            self._c.commit()
+
+
+class SQLiteModels(_SQLiteMetaBase, base.Models):
+    def _create(self):
+        self._c.execute(
+            f"""CREATE TABLE IF NOT EXISTS {self._t('models')} (
+                id TEXT PRIMARY KEY, models BLOB)"""
+        )
+
+    def insert(self, model: Model) -> None:
+        with self._c.lock:
+            self._c.execute(
+                f"INSERT OR REPLACE INTO {self._t('models')} VALUES (?,?)",
+                (model.id, model.models),
+            )
+            self._c.commit()
+
+    def get(self, id: str) -> Optional[Model]:
+        row = self._c.execute(
+            f"SELECT id, models FROM {self._t('models')} WHERE id=?", (id,)
+        ).fetchone()
+        return Model(row[0], row[1]) if row else None
+
+    def delete(self, id: str) -> None:
+        with self._c.lock:
+            self._c.execute(
+                f"DELETE FROM {self._t('models')} WHERE id=?", (id,)
+            )
+            self._c.commit()

@@ -1,0 +1,29 @@
+"""Test harness configuration.
+
+Forces JAX onto a virtual 8-device CPU platform so multi-chip sharding tests
+run without TPU hardware — the test-tier the reference left empty (its CI
+covered distribution only via local-mode Spark, SURVEY.md §4).
+"""
+
+import os
+
+# Must be set before jax is imported anywhere.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+from predictionio_tpu.data import storage as storage_mod  # noqa: E402
+
+
+@pytest.fixture()
+def mem_storage():
+    """A fresh in-memory storage universe installed as the process default."""
+    s = storage_mod.memory_storage()
+    storage_mod.set_storage(s)
+    yield s
+    storage_mod.set_storage(None)

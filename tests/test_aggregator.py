@@ -1,0 +1,102 @@
+"""Property aggregation tests — $set/$unset/$delete folding.
+
+Mirrors the reference's LEventAggregatorSpec coverage
+(data/src/test/.../LEventAggregatorSpec.scala): latest-value merge, unset
+removal, delete reset, first/last updated times, non-special events ignored.
+"""
+
+import datetime as dt
+
+from predictionio_tpu.data.aggregator import (
+    aggregate_properties,
+    aggregate_properties_single,
+)
+from predictionio_tpu.data.event import DataMap, Event
+
+
+def t(minute):
+    return dt.datetime(2026, 7, 29, 12, minute, 0, tzinfo=dt.timezone.utc)
+
+
+def set_ev(eid, minute, props):
+    return Event(
+        event="$set", entity_type="user", entity_id=eid,
+        properties=DataMap(props), event_time=t(minute),
+    )
+
+
+def unset_ev(eid, minute, keys):
+    return Event(
+        event="$unset", entity_type="user", entity_id=eid,
+        properties=DataMap({k: None for k in keys}), event_time=t(minute),
+    )
+
+
+def delete_ev(eid, minute):
+    return Event(
+        event="$delete", entity_type="user", entity_id=eid, event_time=t(minute)
+    )
+
+
+def test_set_merge_latest_wins():
+    pm = aggregate_properties_single(
+        [set_ev("u", 1, {"a": 1, "b": 2}), set_ev("u", 3, {"b": 9, "c": 3})]
+    )
+    assert pm is not None
+    assert pm.fields == {"a": 1, "b": 9, "c": 3}
+    assert pm.first_updated == t(1)
+    assert pm.last_updated == t(3)
+
+
+def test_order_independent_of_input_order():
+    pm = aggregate_properties_single(
+        [set_ev("u", 3, {"b": 9}), set_ev("u", 1, {"a": 1, "b": 2})]
+    )
+    assert pm.fields == {"a": 1, "b": 9}
+
+
+def test_unset_removes_keys():
+    pm = aggregate_properties_single(
+        [set_ev("u", 1, {"a": 1, "b": 2}), unset_ev("u", 2, ["a"])]
+    )
+    assert pm.fields == {"b": 2}
+    assert pm.last_updated == t(2)
+
+
+def test_unset_before_any_set_is_noop():
+    pm = aggregate_properties_single([unset_ev("u", 1, ["a"]), set_ev("u", 2, {"x": 1})])
+    assert pm.fields == {"x": 1}
+
+
+def test_delete_resets():
+    pm = aggregate_properties_single(
+        [set_ev("u", 1, {"a": 1}), delete_ev("u", 2)]
+    )
+    assert pm is None
+    pm2 = aggregate_properties_single(
+        [set_ev("u", 1, {"a": 1}), delete_ev("u", 2), set_ev("u", 3, {"b": 2})]
+    )
+    assert pm2.fields == {"b": 2}
+    assert pm2.first_updated == t(1)  # tracks all special events' times
+
+
+def test_non_special_events_ignored():
+    rate = Event(
+        event="rate", entity_type="user", entity_id="u",
+        properties=DataMap({"rating": 5}), event_time=t(5),
+    )
+    pm = aggregate_properties_single([set_ev("u", 1, {"a": 1}), rate])
+    assert pm.fields == {"a": 1}
+    assert pm.last_updated == t(1)
+
+
+def test_multi_entity_grouping():
+    out = aggregate_properties(
+        [
+            set_ev("u1", 1, {"a": 1}),
+            set_ev("u2", 2, {"b": 2}),
+            delete_ev("u1", 3),
+        ]
+    )
+    assert set(out.keys()) == {"u2"}
+    assert out["u2"].fields == {"b": 2}

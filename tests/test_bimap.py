@@ -1,0 +1,43 @@
+"""BiMap tests (reference BiMapSpec, data/src/test/.../BiMapSpec.scala)."""
+
+import pytest
+
+from predictionio_tpu.data.bimap import BiMap
+
+
+def test_forward_and_inverse():
+    bm = BiMap({"a": 1, "b": 2})
+    assert bm["a"] == 1
+    assert bm.inverse()[2] == "b"
+    assert bm.inverse().inverse()["a"] == 1
+
+
+def test_values_must_be_unique():
+    with pytest.raises(ValueError):
+        BiMap({"a": 1, "b": 1})
+
+
+def test_string_int_dense_and_deterministic():
+    bm = BiMap.string_int(["u3", "u1", "u2", "u1"])
+    assert len(bm) == 3
+    assert sorted(bm.values()) == [0, 1, 2]
+    assert bm.to_dict() == BiMap.string_int(["u1", "u2", "u3"]).to_dict()
+    inv = bm.inverse()
+    assert {inv[i] for i in range(3)} == {"u1", "u2", "u3"}
+
+
+def test_int_index_insertion_order():
+    bm = BiMap.int_index(["z", "a", "z", "m"])
+    assert bm["z"] == 0 and bm["a"] == 1 and bm["m"] == 2
+
+
+def test_map_values_to_list():
+    bm = BiMap.string_int(["a", "b", "c"])
+    assert bm.map_values_to_list(["c", "a"]) == [bm["c"], bm["a"]]
+
+
+def test_get_and_contains():
+    bm = BiMap({"a": 1})
+    assert "a" in bm and "b" not in bm
+    assert bm.get("b") is None
+    assert bm.get("b", -1) == -1

@@ -1,0 +1,164 @@
+"""Event model tests — validation rules, DataMap ops, JSON round-trip.
+
+Covers the reference's Event validation semantics (Event.scala:110-140) and
+DataMap behavior (DataMapSpec, data/src/test/.../DataMapSpec.scala).
+"""
+
+import datetime as dt
+
+import pytest
+
+from predictionio_tpu.data.event import (
+    DataMap,
+    Event,
+    EventValidationError,
+    format_iso8601,
+    parse_iso8601,
+    validate_event,
+)
+
+
+def ev(**kw):
+    base = dict(event="rate", entity_type="user", entity_id="u1")
+    base.update(kw)
+    return Event(**base)
+
+
+class TestValidation:
+    def test_valid_plain_event(self):
+        validate_event(ev(target_entity_type="item", target_entity_id="i1"))
+
+    def test_empty_event_name(self):
+        with pytest.raises(EventValidationError):
+            validate_event(ev(event=""))
+
+    def test_empty_entity(self):
+        with pytest.raises(EventValidationError):
+            validate_event(ev(entity_type=""))
+        with pytest.raises(EventValidationError):
+            validate_event(ev(entity_id=""))
+
+    def test_target_entity_pairing(self):
+        with pytest.raises(EventValidationError):
+            validate_event(ev(target_entity_type="item"))
+        with pytest.raises(EventValidationError):
+            validate_event(ev(target_entity_id="i1"))
+        with pytest.raises(EventValidationError):
+            validate_event(ev(target_entity_type="", target_entity_id="i1"))
+
+    def test_unset_requires_properties(self):
+        with pytest.raises(EventValidationError):
+            validate_event(ev(event="$unset"))
+        validate_event(ev(event="$unset", properties=DataMap({"a": 1})))
+
+    def test_reserved_prefix_event_names(self):
+        for name in ("$set", "$unset", "$delete"):
+            if name == "$unset":
+                validate_event(ev(event=name, properties=DataMap({"a": 1})))
+            else:
+                validate_event(ev(event=name))
+        with pytest.raises(EventValidationError):
+            validate_event(ev(event="$custom"))
+        with pytest.raises(EventValidationError):
+            validate_event(ev(event="pio_thing"))
+
+    def test_special_event_cannot_target(self):
+        with pytest.raises(EventValidationError):
+            validate_event(
+                ev(event="$set", target_entity_type="item", target_entity_id="i1")
+            )
+
+    def test_builtin_entity_types(self):
+        validate_event(ev(entity_type="pio_pr"))
+        with pytest.raises(EventValidationError):
+            validate_event(ev(entity_type="pio_other"))
+        with pytest.raises(EventValidationError):
+            validate_event(
+                ev(target_entity_type="pio_other", target_entity_id="x")
+            )
+
+    def test_reserved_property_names(self):
+        with pytest.raises(EventValidationError):
+            validate_event(ev(properties=DataMap({"pio_x": 1})))
+        with pytest.raises(EventValidationError):
+            validate_event(ev(properties=DataMap({"$x": 1})))
+
+
+class TestDataMap:
+    def test_accessors(self):
+        dm = DataMap({"a": 1, "b": "s", "c": None, "d": [1, 2]})
+        assert dm.get("a") == 1
+        assert dm["b"] == "s"
+        assert dm.get_opt("c") is None
+        assert dm.get_opt("zz") is None
+        assert dm.get_or_else("zz", 9) == 9
+        assert dm.get_or_else("c", 9) == 9
+        with pytest.raises(ValueError):
+            dm.get("c")  # present-but-null required field
+        with pytest.raises(KeyError):
+            dm.get("zz")
+        with pytest.raises(KeyError):
+            dm.require("zz")
+
+    def test_merge_and_remove(self):
+        a = DataMap({"x": 1, "y": 2})
+        b = a.merged({"y": 3, "z": 4})
+        assert b == DataMap({"x": 1, "y": 3, "z": 4})
+        assert a == DataMap({"x": 1, "y": 2})  # immutable
+        c = b.removed(["x", "zz"])
+        assert c == DataMap({"y": 3, "z": 4})
+        assert (a | {"y": 9}) == DataMap({"x": 1, "y": 9})
+        assert (b - ["z"]) == DataMap({"x": 1, "y": 3})
+
+    def test_empty(self):
+        assert DataMap().is_empty()
+        assert not DataMap({"a": 1}).is_empty()
+
+
+class TestJson:
+    def test_round_trip(self):
+        t = dt.datetime(2026, 7, 29, 12, 30, 45, 123000, tzinfo=dt.timezone.utc)
+        e = Event(
+            event="buy",
+            entity_type="user",
+            entity_id="u1",
+            target_entity_type="item",
+            target_entity_id="i3",
+            properties=DataMap({"price": 9.99}),
+            event_time=t,
+            tags=("a", "b"),
+            pr_id="pr1",
+            event_id="e1",
+        )
+        j = e.to_json()
+        assert j["eventTime"] == "2026-07-29T12:30:45.123Z"
+        e2 = Event.from_json(j)
+        assert e2.event == e.event
+        assert e2.entity_id == e.entity_id
+        assert e2.target_entity_id == e.target_entity_id
+        assert e2.properties == e.properties
+        assert e2.event_time == e.event_time
+        assert e2.tags == e.tags
+        assert e2.pr_id == e.pr_id
+
+    def test_from_json_defaults(self):
+        e = Event.from_json({"event": "view", "entityType": "user", "entityId": "u"})
+        assert e.properties.is_empty()
+        assert e.event_time.tzinfo is not None
+
+    def test_from_json_validates(self):
+        with pytest.raises(EventValidationError):
+            Event.from_json({"event": "$bad", "entityType": "user", "entityId": "u"})
+        with pytest.raises(EventValidationError):
+            Event.from_json({"entityType": "user", "entityId": "u"})
+        with pytest.raises(EventValidationError):
+            Event.from_json(
+                {"event": "v", "entityType": "u", "entityId": "x", "eventTime": "nope"}
+            )
+
+    def test_timezone_preserved(self):
+        tz = dt.timezone(dt.timedelta(hours=8))
+        t = dt.datetime(2026, 1, 2, 3, 4, 5, tzinfo=tz)
+        s = format_iso8601(t)
+        assert s.endswith("+08:00")
+        assert parse_iso8601(s) == t

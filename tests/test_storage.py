@@ -1,0 +1,304 @@
+"""Storage tests, written once against the DAO interfaces and parameterized
+over backends — the reference's LEventsSpec/PEventsSpec pattern
+(data/src/test/.../LEventsSpec.scala:20-45).
+"""
+
+import datetime as dt
+
+import pytest
+
+from predictionio_tpu.data.event import DataMap, Event
+from predictionio_tpu.data.storage import (
+    MEMORY_CONFIG,
+    AccessKey,
+    App,
+    Channel,
+    EngineInstance,
+    EngineManifest,
+    EvaluationInstance,
+    Model,
+    Storage,
+    StorageError,
+    UNSET,
+    memory_storage,
+)
+from predictionio_tpu.data.storage.base import STATUS_COMPLETED, STATUS_INIT
+
+
+def sqlite_storage(tmp_path):
+    return Storage(
+        {
+            "PIO_STORAGE_SOURCES_SQLITE_TYPE": "sqlite",
+            "PIO_STORAGE_SOURCES_SQLITE_PATH": str(tmp_path / "s.db"),
+            "PIO_STORAGE_SOURCES_LOCALFS_TYPE": "localfs",
+            "PIO_STORAGE_SOURCES_LOCALFS_PATH": str(tmp_path / "models"),
+            "PIO_STORAGE_REPOSITORIES_METADATA_NAME": "meta",
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "SQLITE",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_NAME": "event",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "SQLITE",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_NAME": "model",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "LOCALFS",
+        }
+    )
+
+
+@pytest.fixture(params=["memory", "sqlite"])
+def storage(request, tmp_path):
+    if request.param == "memory":
+        return memory_storage()
+    return sqlite_storage(tmp_path)
+
+
+def t(minute, hour=12):
+    return dt.datetime(2026, 7, 29, hour, minute, 0, tzinfo=dt.timezone.utc)
+
+
+def mk(event="view", eid="u1", etype="user", minute=0, **kw):
+    return Event(
+        event=event, entity_type=etype, entity_id=eid, event_time=t(minute), **kw
+    )
+
+
+class TestLEvents:
+    def test_requires_init(self, storage):
+        le = storage.get_l_events()
+        with pytest.raises(StorageError):
+            le.insert(mk(), 99)
+
+    def test_insert_get_delete(self, storage):
+        le = storage.get_l_events()
+        le.init(1)
+        eid = le.insert(mk(properties=DataMap({"a": 1})), 1)
+        got = le.get(eid, 1)
+        assert got is not None
+        assert got.event_id == eid
+        assert got.properties == DataMap({"a": 1})
+        assert le.delete(eid, 1)
+        assert le.get(eid, 1) is None
+        assert not le.delete(eid, 1)
+
+    def test_channels_are_isolated(self, storage):
+        le = storage.get_l_events()
+        le.init(1)
+        le.init(1, 7)
+        le.insert(mk(eid="main"), 1)
+        le.insert(mk(eid="chan"), 1, 7)
+        assert [e.entity_id for e in le.find(1)] == ["main"]
+        assert [e.entity_id for e in le.find(1, 7)] == ["chan"]
+
+    def test_find_filters(self, storage):
+        le = storage.get_l_events()
+        le.init(2)
+        le.insert(mk("view", "u1", minute=1), 2)
+        le.insert(mk("buy", "u1", minute=2,
+                     target_entity_type="item", target_entity_id="i1"), 2)
+        le.insert(mk("view", "u2", minute=3), 2)
+        le.insert(mk("rate", "u2", "account", minute=4), 2)
+
+        assert len(list(le.find(2))) == 4
+        assert len(list(le.find(2, entity_type="user"))) == 3
+        assert [e.event for e in le.find(2, entity_id="u1")] == ["view", "buy"]
+        assert [e.event for e in le.find(2, event_names=["buy", "rate"])] == [
+            "buy", "rate"]
+        # time range: start inclusive, until exclusive
+        assert [e.event_time for e in le.find(2, start_time=t(2), until_time=t(4))] == [
+            t(2), t(3)]
+        # target entity filters incl. explicit-absent
+        assert [e.event for e in le.find(2, target_entity_id="i1")] == ["buy"]
+        assert len(list(le.find(2, target_entity_type=None))) == 3
+        # limit + reversed
+        assert [e.event_time for e in le.find(2, limit=2)] == [t(1), t(2)]
+        assert [e.event_time for e in le.find(2, limit=2, reversed=True)] == [
+            t(4), t(3)]
+        assert len(list(le.find(2, limit=-1))) == 4
+
+    def test_aggregate_properties(self, storage):
+        le = storage.get_l_events()
+        le.init(3)
+        le.insert(mk("$set", "u1", minute=1, properties=DataMap({"a": 1, "b": 2})), 3)
+        le.insert(mk("$set", "u1", minute=2, properties=DataMap({"b": 9})), 3)
+        le.insert(mk("$unset", "u1", minute=3, properties=DataMap({"a": None})), 3)
+        le.insert(mk("$set", "u2", minute=1, properties=DataMap({"c": 3})), 3)
+        le.insert(mk("$delete", "u3", minute=1), 3)
+        out = le.aggregate_properties(3, "user")
+        assert set(out) == {"u1", "u2"}
+        assert out["u1"].fields == {"b": 9}
+        assert out["u2"].fields == {"c": 3}
+        single = le.aggregate_properties_of_entity(3, "user", "u1")
+        assert single.fields == {"b": 9}
+        assert le.aggregate_properties_of_entity(3, "user", "zz") is None
+
+    def test_empty_event_names_matches_nothing(self, storage):
+        le = storage.get_l_events()
+        le.init(5)
+        le.insert(mk(), 5)
+        assert list(le.find(5, event_names=[])) == []
+        assert len(list(le.find(5, event_names=None))) == 1
+
+    def test_naive_time_filters_treated_as_utc(self, storage):
+        le = storage.get_l_events()
+        le.init(6)
+        le.insert(mk(minute=1), 6)
+        le.insert(mk(minute=5), 6)
+        naive = dt.datetime(2026, 7, 29, 12, 3, 0)  # no tzinfo
+        assert len(list(le.find(6, start_time=naive))) == 1
+        assert len(list(le.find(6, until_time=naive))) == 1
+
+    def test_remove(self, storage):
+        le = storage.get_l_events()
+        le.init(4)
+        le.insert(mk(), 4)
+        le.remove(4)
+        with pytest.raises(StorageError):
+            list(le.find(4))
+
+
+class TestMetadata:
+    def test_apps(self, storage):
+        apps = storage.get_meta_data_apps()
+        aid = apps.insert(App(0, "myapp", "desc"))
+        assert aid is not None and aid > 0
+        assert apps.get(aid).name == "myapp"
+        assert apps.get_by_name("myapp").id == aid
+        assert apps.insert(App(0, "myapp")) is None  # duplicate name
+        aid2 = apps.insert(App(0, "other"))
+        assert aid2 != aid
+        assert {a.name for a in apps.get_all()} == {"myapp", "other"}
+        assert apps.update(App(aid, "renamed", None))
+        assert apps.get(aid).name == "renamed"
+        assert apps.delete(aid2)
+        assert apps.get(aid2) is None
+
+    def test_access_keys(self, storage):
+        keys = storage.get_meta_data_access_keys()
+        k = keys.insert(AccessKey("", 1, ()))
+        assert len(k) == 64
+        assert keys.get(k).appid == 1
+        k2 = keys.insert(AccessKey("explicit-key", 2, ("buy",)))
+        assert k2 == "explicit-key"
+        assert keys.get(k2).events == ("buy",)
+        assert {x.key for x in keys.get_by_app_id(2)} == {"explicit-key"}
+        assert keys.update(AccessKey(k2, 2, ("buy", "view")))
+        assert keys.get(k2).events == ("buy", "view")
+        assert keys.delete(k2)
+        assert keys.get(k2) is None
+
+    def test_channels(self, storage):
+        chans = storage.get_meta_data_channels()
+        cid = chans.insert(Channel(0, "chan-1", 1))
+        assert cid is not None
+        assert chans.get(cid).name == "chan-1"
+        assert chans.insert(Channel(0, "bad name!", 1)) is None
+        assert chans.insert(Channel(0, "x" * 17, 1)) is None
+        chans.insert(Channel(0, "other", 2))
+        assert [c.name for c in chans.get_by_app_id(1)] == ["chan-1"]
+        assert chans.delete(cid)
+        assert chans.get(cid) is None
+
+    def test_engine_manifests(self, storage):
+        ems = storage.get_meta_data_engine_manifests()
+        m = EngineManifest("eng", "1.0", "My Engine", None, (), "pkg.Factory")
+        ems.insert(m)
+        assert ems.get("eng", "1.0").engine_factory == "pkg.Factory"
+        assert ems.get("eng", "2.0") is None
+        ems.update(
+            EngineManifest("eng", "1.0", "Renamed", None, (), "pkg.F2"), upsert=True
+        )
+        assert ems.get("eng", "1.0").name == "Renamed"
+        ems.delete("eng", "1.0")
+        assert ems.get("eng", "1.0") is None
+
+    def test_engine_instances(self, storage):
+        eis = storage.get_meta_data_engine_instances()
+
+        def inst(status, minute, variant="v1"):
+            return EngineInstance(
+                id="", status=status, start_time=t(minute), end_time=t(minute),
+                engine_id="e", engine_version="1", engine_variant=variant,
+                engine_factory="f",
+            )
+
+        i1 = eis.insert(inst(STATUS_INIT, 1))
+        assert eis.get(i1).status == STATUS_INIT
+        import dataclasses
+        eis.update(dataclasses.replace(eis.get(i1), status=STATUS_COMPLETED))
+        assert eis.get(i1).status == STATUS_COMPLETED
+        i2 = eis.insert(inst(STATUS_COMPLETED, 5))
+        eis.insert(inst(STATUS_COMPLETED, 3, variant="v2"))
+        latest = eis.get_latest_completed("e", "1", "v1")
+        assert latest.id == i2
+        assert len(eis.get_completed("e", "1", "v1")) == 2
+        eis.delete(i1)
+        assert eis.get(i1) is None
+
+    def test_latest_completed_across_timezones(self, storage):
+        eis = storage.get_meta_data_engine_instances()
+        tz9 = dt.timezone(dt.timedelta(hours=9))
+        older = EngineInstance(
+            id="", status=STATUS_COMPLETED,
+            start_time=dt.datetime(2026, 7, 29, 10, 0, tzinfo=tz9),  # 01:00Z
+            end_time=t(0), engine_id="tz", engine_version="1",
+            engine_variant="v", engine_factory="f",
+        )
+        newer = EngineInstance(
+            id="", status=STATUS_COMPLETED,
+            start_time=dt.datetime(2026, 7, 29, 2, 0, tzinfo=dt.timezone.utc),
+            end_time=t(0), engine_id="tz", engine_version="1",
+            engine_variant="v", engine_factory="f",
+        )
+        eis.insert(older)
+        newer_id = eis.insert(newer)
+        assert eis.get_latest_completed("tz", "1", "v").id == newer_id
+
+    def test_evaluation_instances(self, storage):
+        evs = storage.get_meta_data_evaluation_instances()
+        eid = evs.insert(
+            EvaluationInstance(
+                id="", status=STATUS_INIT, start_time=t(0), end_time=t(0),
+                evaluation_class="MyEval",
+            )
+        )
+        got = evs.get(eid)
+        assert got.evaluation_class == "MyEval"
+        import dataclasses
+        evs.update(
+            dataclasses.replace(got, status=STATUS_COMPLETED, evaluator_results="r")
+        )
+        assert [i.id for i in evs.get_completed()] == [eid]
+
+    def test_models(self, storage):
+        models = storage.get_model_data_models()
+        models.insert(Model("m1", b"\x00\x01bytes"))
+        assert models.get("m1").models == b"\x00\x01bytes"
+        assert models.get("nope") is None
+        models.delete("m1")
+        assert models.get("m1") is None
+
+
+class TestRegistry:
+    def test_verify_all_data_objects(self, storage):
+        assert storage.verify_all_data_objects()
+
+    def test_unknown_backend(self):
+        cfg = dict(MEMORY_CONFIG)
+        cfg["PIO_STORAGE_SOURCES_MEM_TYPE"] = "nosuchbackend"
+        with pytest.raises(StorageError):
+            Storage(cfg).get_l_events()
+
+    def test_missing_repo(self):
+        with pytest.raises(StorageError):
+            Storage({"PIO_STORAGE_SOURCES_MEM_TYPE": "memory"})
+
+    def test_client_cached_per_source(self):
+        s = memory_storage()
+        le1 = s.get_l_events()
+        le2 = s.get_l_events()
+        assert le1 is le2
+
+    def test_sqlite_persistence(self, tmp_path):
+        s1 = sqlite_storage(tmp_path)
+        le = s1.get_l_events()
+        le.init(1)
+        eid = le.insert(mk(), 1)
+        s2 = sqlite_storage(tmp_path)
+        assert s2.get_l_events().get(eid, 1) is not None
