@@ -1,0 +1,75 @@
+"""DASE controller API — the engine developer's surface.
+
+Capability parity with the reference's ``controller`` package
+(core/src/main/scala/io/prediction/controller/): DataSource / Preparator /
+Algorithm / Serving bases and variants, Engine + EngineParams + factories,
+Params JSON construction, the Metric family, MetricEvaluator, Evaluation,
+FastEvalEngine, and PersistentModel.
+"""
+
+from predictionio_tpu.controller.base import (
+    AverageServing,
+    BaseAlgorithm,
+    BaseDataSource,
+    BasePreparator,
+    BaseServing,
+    Controller,
+    FirstServing,
+    IdentityPreparator,
+    LAlgorithm,
+    LDataSource,
+    LPreparator,
+    LServing,
+    P2LAlgorithm,
+    PAlgorithm,
+    PDataSource,
+    PPreparator,
+    SanityCheck,
+    doer,
+)
+from predictionio_tpu.controller.engine import (
+    BaseEngine,
+    Engine,
+    EngineFactory,
+    EngineParams,
+    SimpleEngine,
+    SimpleEngineParams,
+    StopAfterPrepareInterruption,
+    StopAfterReadInterruption,
+    engine_params_from_file,
+)
+from predictionio_tpu.controller.evaluation import (
+    BaseEvaluator,
+    BaseEvaluatorResult,
+    EngineParamsGenerator,
+    Evaluation,
+    MetricEvaluator,
+    MetricEvaluatorResult,
+    MetricScores,
+)
+from predictionio_tpu.controller.fast_eval import FastEvalEngine
+from predictionio_tpu.controller.metrics import (
+    AverageMetric,
+    Metric,
+    OptionAverageMetric,
+    OptionStdevMetric,
+    QPAMetric,
+    StdevMetric,
+    SumMetric,
+    ZeroMetric,
+)
+from predictionio_tpu.controller.params import (
+    EmptyParams,
+    Params,
+    ParamsError,
+    params_from_json,
+    params_to_json,
+    params_to_json_string,
+)
+from predictionio_tpu.controller.persistent_model import (
+    LocalFileSystemPersistentModel,
+    PersistentModel,
+    PersistentModelManifest,
+)
+
+__all__ = [name for name in dir() if not name.startswith("_")]

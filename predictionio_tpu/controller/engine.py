@@ -1,0 +1,413 @@
+"""Engine: the concrete DASE composition with train/eval orchestration.
+
+Capability parity with the reference Engine
+(core/src/main/scala/io/prediction/controller/Engine.scala): class maps per
+DASE slot (:80), instance ``train`` (:154) delegating to the static train
+pipeline (:621-708 — read -> sanityCheck -> prepare -> per-algorithm train,
+with stop-after-read/prepare interruptions :662-686), ``eval`` (:311 ->
+:726-816 — per-fold train, supplement queries, per-algorithm batch predict,
+regroup per query, serve), ``prepare_deploy`` (:196-265 — re-train when the
+persisted form is absent, PersistentModel loading), and engine.json ->
+EngineParams extraction (:353-416).
+
+EngineParams / SimpleEngine mirror controller/EngineParams.scala:32-149.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+from typing import Any, Dict, List, Mapping, Optional, Sequence, Tuple, Type
+
+from predictionio_tpu.controller.base import (
+    BaseAlgorithm,
+    BaseDataSource,
+    BasePreparator,
+    BaseServing,
+    FirstServing,
+    IdentityPreparator,
+    SanityCheck,
+    doer,
+)
+from predictionio_tpu.controller.params import (
+    EmptyParams,
+    Params,
+    params_from_json,
+    params_to_json,
+)
+
+logger = logging.getLogger(__name__)
+
+
+class StopAfterReadInterruption(Exception):
+    """--stop-after-read debug stop (reference WorkflowUtils.scala:410)."""
+
+
+class StopAfterPrepareInterruption(Exception):
+    """--stop-after-prepare debug stop (reference WorkflowUtils.scala:412)."""
+
+
+@dataclasses.dataclass(frozen=True)
+class EngineParams:
+    """Named (name, params) per DASE slot + ordered algorithm list
+    (reference controller/EngineParams.scala:32)."""
+
+    data_source_params: Tuple[str, Params] = ("", EmptyParams())
+    preparator_params: Tuple[str, Params] = ("", EmptyParams())
+    algorithm_params_list: Tuple[Tuple[str, Params], ...] = ()
+    serving_params: Tuple[str, Params] = ("", EmptyParams())
+
+    def __post_init__(self):
+        object.__setattr__(
+            self, "algorithm_params_list", tuple(self.algorithm_params_list)
+        )
+
+    def to_json(self) -> Dict[str, Any]:
+        return {
+            "datasource": {
+                "name": self.data_source_params[0],
+                "params": params_to_json(self.data_source_params[1]),
+            },
+            "preparator": {
+                "name": self.preparator_params[0],
+                "params": params_to_json(self.preparator_params[1]),
+            },
+            "algorithms": [
+                {"name": n, "params": params_to_json(p)}
+                for n, p in self.algorithm_params_list
+            ],
+            "serving": {
+                "name": self.serving_params[0],
+                "params": params_to_json(self.serving_params[1]),
+            },
+        }
+
+
+def _as_class_map(classes) -> Dict[str, type]:
+    """A single class becomes the default-name map (reference's implicit
+    ``Map("" -> cls)`` helpers, Engine.scala:512-575)."""
+    if classes is None:
+        return {}
+    if isinstance(classes, Mapping):
+        return dict(classes)
+    return {"": classes}
+
+
+class BaseEngine:
+    """Abstract engine (reference core/BaseEngine.scala:35-100)."""
+
+    def train(self, ctx, engine_params: EngineParams, workflow_params) -> List[Any]:
+        raise NotImplementedError
+
+    def eval(
+        self, ctx, engine_params: EngineParams, workflow_params
+    ) -> List[Tuple[Any, List[Tuple[Any, Any, Any]]]]:
+        raise NotImplementedError
+
+    def batch_eval(
+        self, ctx, engine_params_list: Sequence[EngineParams], workflow_params
+    ) -> List[Tuple[EngineParams, List[Tuple[Any, List[Tuple[Any, Any, Any]]]]]]:
+        """Default: loop eval over the params grid
+        (reference BaseEngine.batchEval:79-90)."""
+        return [
+            (ep, self.eval(ctx, ep, workflow_params)) for ep in engine_params_list
+        ]
+
+    def jvalue_to_engine_params(self, json_obj: Mapping[str, Any]) -> EngineParams:
+        raise NotImplementedError
+
+
+class Engine(BaseEngine):
+    """The concrete 4-map engine (reference controller/Engine.scala:80)."""
+
+    def __init__(
+        self,
+        data_source_classes,
+        preparator_classes=None,
+        algorithm_classes=None,
+        serving_classes=None,
+    ):
+        self.data_source_class_map = _as_class_map(data_source_classes)
+        self.preparator_class_map = _as_class_map(
+            preparator_classes if preparator_classes is not None else IdentityPreparator
+        )
+        self.algorithm_class_map = _as_class_map(algorithm_classes)
+        self.serving_class_map = _as_class_map(
+            serving_classes if serving_classes is not None else FirstServing
+        )
+
+    # --- component instantiation ---
+
+    def _lookup(self, class_map: Dict[str, type], name: str, slot: str) -> type:
+        if name not in class_map:
+            raise KeyError(
+                f"{slot} class with name {name!r} is not defined; "
+                f"available: {sorted(class_map)}"
+            )
+        return class_map[name]
+
+    def make_components(self, engine_params: EngineParams):
+        ds_name, ds_params = engine_params.data_source_params
+        prep_name, prep_params = engine_params.preparator_params
+        serv_name, serv_params = engine_params.serving_params
+        data_source = doer(
+            self._lookup(self.data_source_class_map, ds_name, "DataSource"), ds_params
+        )
+        preparator = doer(
+            self._lookup(self.preparator_class_map, prep_name, "Preparator"),
+            prep_params,
+        )
+        algorithms = [
+            doer(self._lookup(self.algorithm_class_map, name, "Algorithm"), params)
+            for name, params in engine_params.algorithm_params_list
+        ]
+        if not algorithms:
+            raise ValueError("EngineParams defines no algorithms")
+        serving = doer(
+            self._lookup(self.serving_class_map, serv_name, "Serving"), serv_params
+        )
+        return data_source, preparator, algorithms, serving
+
+    # --- training pipeline (reference object Engine.train :621-708) ---
+
+    def train(self, ctx, engine_params: EngineParams, workflow_params) -> List[Any]:
+        data_source, preparator, algorithms, _ = self.make_components(engine_params)
+        return self._train_pipeline(
+            ctx, data_source, preparator, algorithms, workflow_params
+        )
+
+    @staticmethod
+    def _sanity(obj: Any, label: str, workflow_params) -> None:
+        if getattr(workflow_params, "skip_sanity_check", False):
+            return
+        if isinstance(obj, SanityCheck):
+            logger.info("%s: performing data sanity check", label)
+            obj.sanity_check()
+
+    def _train_pipeline(
+        self, ctx, data_source, preparator, algorithms, workflow_params
+    ) -> List[Any]:
+        td = data_source.read_training(ctx)
+        self._sanity(td, "TrainingData", workflow_params)
+        if getattr(workflow_params, "stop_after_read", False):
+            raise StopAfterReadInterruption()
+        pd = preparator.prepare(ctx, td)
+        self._sanity(pd, "PreparedData", workflow_params)
+        if getattr(workflow_params, "stop_after_prepare", False):
+            raise StopAfterPrepareInterruption()
+        models = []
+        for i, algo in enumerate(algorithms):
+            model = algo.train(ctx, pd)
+            self._sanity(model, f"Model of algorithm[{i}]", workflow_params)
+            models.append(model)
+        return models
+
+    # --- evaluation pipeline (reference object Engine.eval :726-816) ---
+
+    @staticmethod
+    def serve_fold(algorithms, models, serving, qa_pairs) -> List[Tuple[Any, Any, Any]]:
+        """Supplement queries, batch-predict per algorithm, regroup per
+        query index, serve (reference union + groupByKey + serve
+        :786-810). Shared by Engine.eval and FastEvalEngineWorkflow."""
+        queries = [(qx, serving.supplement(q)) for qx, (q, _) in enumerate(qa_pairs)]
+        per_query: Dict[int, List[Any]] = {qx: [] for qx, _ in queries}
+        for algo, model in zip(algorithms, models):
+            for qx, p in algo.batch_predict(model, queries):
+                per_query[qx].append(p)
+        return [
+            (q, serving.serve(q, per_query[qx]), a)
+            for qx, (q, a) in enumerate(qa_pairs)
+        ]
+
+    def eval(
+        self, ctx, engine_params: EngineParams, workflow_params
+    ) -> List[Tuple[Any, List[Tuple[Any, Any, Any]]]]:
+        data_source, preparator, algorithms, serving = self.make_components(
+            engine_params
+        )
+        eval_sets = data_source.read_eval(ctx)
+        out = []
+        for td, eval_info, qa_pairs in eval_sets:
+            pd = preparator.prepare(ctx, td)
+            models = [algo.train(ctx, pd) for algo in algorithms]
+            qpa = self.serve_fold(algorithms, models, serving, qa_pairs)
+            out.append((eval_info, qpa))
+        return out
+
+    # --- deploy-time model restoration (reference prepareDeploy :196-265) ---
+
+    def prepare_deploy(
+        self,
+        ctx,
+        engine_params: EngineParams,
+        engine_instance_id: str,
+        persisted_models: List[Any],
+        workflow_params,
+    ) -> List[Any]:
+        from predictionio_tpu.controller.persistent_model import (
+            PersistentModelManifest,
+            load_persistent_model,
+        )
+
+        _, _, algorithms, _ = self.make_components(engine_params)
+        if len(persisted_models) != len(algorithms):
+            raise ValueError(
+                f"persisted {len(persisted_models)} models for "
+                f"{len(algorithms)} algorithms"
+            )
+        if any(m is None for m in persisted_models):
+            # sharded/unserialized models are re-trained on deploy
+            # (reference Engine.scala:208-230)
+            logger.info("some persisted models are absent; re-training for deploy")
+            data_source, preparator, _, _ = self.make_components(engine_params)
+            td = data_source.read_training(ctx)
+            pd = preparator.prepare(ctx, td)
+            return [
+                algo.train(ctx, pd) if m is None else m
+                for algo, m in zip(algorithms, persisted_models)
+            ]
+        out = []
+        for algo, m in zip(algorithms, persisted_models):
+            if isinstance(m, PersistentModelManifest):
+                out.append(
+                    load_persistent_model(
+                        m, engine_instance_id, algo.params, ctx
+                    )
+                )
+            else:
+                out.append(m)
+        return out
+
+    def make_serializable_models(
+        self, ctx, engine_instance_id: str, engine_params: EngineParams,
+        models: List[Any],
+    ) -> List[Any]:
+        """Convert trained models to their persisted form
+        (reference makeSerializableModels :282-300): PersistentModel ->
+        save + manifest; sharded models that opt out -> None (re-trained on
+        deploy); everything else passes through for pickling."""
+        from predictionio_tpu.controller.persistent_model import (
+            PersistentModel,
+            PersistentModelManifest,
+        )
+
+        _, _, algorithms, _ = self.make_components(engine_params)
+        out = []
+        for algo, model in zip(algorithms, models):
+            if isinstance(model, PersistentModel):
+                saved = model.save(engine_instance_id, algo.params, ctx)
+                out.append(
+                    PersistentModelManifest(type(model).__module__ + "." + type(model).__qualname__)
+                    if saved
+                    else model
+                )
+            elif algo.sharded_model:
+                out.append(None)
+            else:
+                out.append(model)
+        return out
+
+    # --- engine.json -> EngineParams (reference :353-416) ---
+
+    def _params_for(
+        self, class_map: Dict[str, type], block: Optional[Mapping[str, Any]], slot: str
+    ) -> Tuple[str, Params]:
+        block = block or {}
+        name = block.get("name", "")
+        cls = self._lookup(class_map, name, slot)
+        params_cls = getattr(cls, "params_class", None)
+        raw = block.get("params") or {}
+        if params_cls is None:
+            if raw:
+                logger.warning(
+                    "%s %s has no params_class; wrapping raw JSON params — "
+                    "declare `params_class` on %s for typed params",
+                    slot, cls.__name__, cls.__name__,
+                )
+                return name, _DictParams(dict(raw))
+            return name, EmptyParams()
+        return name, params_from_json(raw, params_cls)
+
+    def jvalue_to_engine_params(self, json_obj: Mapping[str, Any]) -> EngineParams:
+        algo_blocks = json_obj.get("algorithms") or []
+        algorithm_params_list = []
+        for block in algo_blocks:
+            name, p = self._params_for(self.algorithm_class_map, block, "Algorithm")
+            algorithm_params_list.append((name, p))
+        if not algorithm_params_list:
+            # engine.json may omit algorithms when the engine defines exactly one
+            if len(self.algorithm_class_map) == 1:
+                only = next(iter(self.algorithm_class_map))
+                name, p = self._params_for(
+                    self.algorithm_class_map, {"name": only}, "Algorithm"
+                )
+                algorithm_params_list = [(name, p)]
+        return EngineParams(
+            data_source_params=self._params_for(
+                self.data_source_class_map, json_obj.get("datasource"), "DataSource"
+            ),
+            preparator_params=self._params_for(
+                self.preparator_class_map, json_obj.get("preparator"), "Preparator"
+            ),
+            algorithm_params_list=tuple(algorithm_params_list),
+            serving_params=self._params_for(
+                self.serving_class_map, json_obj.get("serving"), "Serving"
+            ),
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class _DictParams(Params):
+    """Fallback params wrapper for components that declare no params_class
+    but receive a JSON params block."""
+
+    values: Any = dataclasses.field(default_factory=dict)
+
+
+class SimpleEngine(Engine):
+    """1 algorithm + identity preparator + first serving
+    (reference controller/EngineParams.scala:127)."""
+
+    def __init__(self, data_source_class, algorithm_class):
+        super().__init__(
+            data_source_classes=data_source_class,
+            preparator_classes=IdentityPreparator,
+            algorithm_classes=algorithm_class,
+            serving_classes=FirstServing,
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class SimpleEngineParams:
+    """Sugar mirroring reference SimpleEngineParams :141."""
+
+    data_source_params: Params = EmptyParams()
+    algorithm_params: Params = EmptyParams()
+
+    def to_engine_params(self) -> EngineParams:
+        return EngineParams(
+            data_source_params=("", self.data_source_params),
+            algorithm_params_list=(("", self.algorithm_params),),
+        )
+
+
+class EngineFactory:
+    """User object returning an Engine (reference controller/EngineFactory.scala:24-37).
+
+    Subclass and implement ``apply()``; optionally override
+    ``engine_params(key)`` for params-by-key lookup.
+    """
+
+    def apply(self) -> BaseEngine:
+        raise NotImplementedError
+
+    def engine_params(self, key: str) -> EngineParams:
+        raise KeyError(f"engine params key {key!r} is not defined")
+
+
+def engine_params_from_file(engine: BaseEngine, path: str) -> EngineParams:
+    """Load an engine.json variant file into EngineParams."""
+    with open(path) as f:
+        variant = json.load(f)
+    return engine.jvalue_to_engine_params(variant)

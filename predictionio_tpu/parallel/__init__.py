@@ -1,0 +1,16 @@
+"""Device-mesh and sharding utilities — the distributed substrate.
+
+The reference's distributed substrate is Spark (RDD partitions + shuffles,
+SURVEY.md §2.11); here it is a `jax.sharding.Mesh` with XLA collectives over
+ICI/DCN. This package centralizes mesh construction and sharding helpers so
+algorithms declare *what* is sharded and XLA decides the collectives.
+"""
+
+from predictionio_tpu.parallel.mesh import (
+    default_mesh,
+    device_count,
+    make_mesh,
+    shard_batch,
+)
+
+__all__ = ["default_mesh", "device_count", "make_mesh", "shard_batch"]

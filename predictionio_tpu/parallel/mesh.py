@@ -1,0 +1,75 @@
+"""Mesh construction and batch-sharding helpers.
+
+Where the reference creates one SparkContext per workflow run
+(core/.../workflow/WorkflowContext.scala:26-45) and lets Spark place RDD
+partitions, the TPU build creates one `jax.sharding.Mesh` per workflow run
+and places device arrays with `NamedSharding`. Axis conventions:
+
+- ``data``  — batch/data parallelism (users, events, queries)
+- ``model`` — tensor/model parallelism (factor columns, vocabulary shards)
+
+Single-device runs use a trivial 1-device mesh so all algorithm code is
+written once against shard_map/pjit and degrades gracefully.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def device_count() -> int:
+    return len(jax.devices())
+
+
+def make_mesh(axes: Dict[str, int], devices: Optional[Sequence] = None) -> Mesh:
+    """Build a mesh with named axes, e.g. {"data": 4, "model": 2}.
+
+    The product of axis sizes must equal the device count used. Axis order
+    follows dict order; put the fastest-communication axis last so it maps
+    to adjacent devices (ICI neighbors on a TPU slice).
+    """
+    devs = list(devices) if devices is not None else jax.devices()
+    sizes = list(axes.values())
+    total = math.prod(sizes)
+    if total != len(devs):
+        raise ValueError(
+            f"mesh axes {axes} require {total} devices, have {len(devs)}"
+        )
+    dev_array = np.array(devs).reshape(sizes)
+    return Mesh(dev_array, tuple(axes.keys()))
+
+
+def default_mesh(axis_name: str = "data", devices: Optional[Sequence] = None) -> Mesh:
+    """1-D mesh over all (or the given) devices."""
+    devs = list(devices) if devices is not None else jax.devices()
+    return make_mesh({axis_name: len(devs)}, devs)
+
+
+def pad_to_multiple(n: int, multiple: int) -> int:
+    return ((n + multiple - 1) // multiple) * multiple
+
+
+def shard_batch(mesh: Mesh, array, axis: str = "data", batch_dim: int = 0):
+    """Pad an array's batch dim to the mesh axis size and place it sharded.
+
+    Returns (sharded_array, original_length). Padding keeps shapes static —
+    a divisible batch is what lets XLA tile onto the MXU without dynamic
+    shapes.
+    """
+    arr = np.asarray(array)
+    n = arr.shape[batch_dim]
+    size = mesh.shape[axis]
+    padded = pad_to_multiple(max(n, 1), size)
+    if padded != n:
+        pad_width = [(0, 0)] * arr.ndim
+        pad_width[batch_dim] = (0, padded - n)
+        arr = np.pad(arr, pad_width)
+    spec = [None] * arr.ndim
+    spec[batch_dim] = axis
+    sharding = NamedSharding(mesh, P(*spec))
+    return jax.device_put(arr, sharding), n

@@ -1,0 +1,1 @@
+"""Shared utilities: serialization, logging, timers."""

@@ -1,0 +1,36 @@
+"""Model (de)serialization: pytrees <-> bytes.
+
+The reference Kryo-serializes trained models into the MODELDATA store
+(core/.../workflow/CoreWorkflow.scala:71-76, KryoInstantiator
+CreateServer.scala:64-78). Here models are arbitrary Python objects whose
+pytree leaves may be device-resident jax.Arrays; serialization first pulls
+leaves to host numpy (one device->host transfer per leaf) so the blob is
+device-independent, then pickles.
+"""
+
+from __future__ import annotations
+
+import pickle
+from typing import Any
+
+import jax
+import numpy as np
+
+
+def to_host(pytree: Any) -> Any:
+    """Replace device arrays with host numpy arrays throughout a pytree."""
+
+    def pull(leaf):
+        if isinstance(leaf, jax.Array):
+            return np.asarray(leaf)
+        return leaf
+
+    return jax.tree_util.tree_map(pull, pytree)
+
+
+def dumps_model(models: Any) -> bytes:
+    return pickle.dumps(to_host(models), protocol=pickle.HIGHEST_PROTOCOL)
+
+
+def loads_model(data: bytes) -> Any:
+    return pickle.loads(data)

@@ -1,0 +1,19 @@
+"""Workflow layer: train/eval lifecycle orchestration + serving server.
+
+Capability parity with the reference's ``workflow`` package
+(core/src/main/scala/io/prediction/workflow/): WorkflowContext (the
+SparkContext factory analog — here a mesh + storage handle),
+WorkflowParams, CoreWorkflow (train/eval lifecycle + persistence), and
+CreateServer (the deployed engine REST server).
+"""
+
+from predictionio_tpu.workflow.context import WorkflowContext, workflow_context
+from predictionio_tpu.workflow.core_workflow import CoreWorkflow
+from predictionio_tpu.workflow.workflow_params import WorkflowParams
+
+__all__ = [
+    "CoreWorkflow",
+    "WorkflowContext",
+    "WorkflowParams",
+    "workflow_context",
+]

@@ -1,0 +1,176 @@
+"""CoreWorkflow: train/eval lifecycle with instance records + persistence.
+
+Capability parity with reference core/.../workflow/CoreWorkflow.scala:
+``run_train`` (:42-93 — context creation, engine.train, model serialization
+into MODELDATA, EngineInstance INIT->COMPLETED, stop-after interruption
+handling) and ``run_evaluation`` (:96-152 — EvaluationInstance record,
+EvaluationWorkflow, result storage in one-liner/HTML/JSON forms). The thin
+typed wrappers in reference Workflow.scala:82-135 collapse into these
+functions; EvaluationWorkflow.scala:31-42 is ``run_evaluation``'s middle
+two lines.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import datetime as _dt
+import logging
+import traceback
+from typing import List, Optional, Sequence
+
+from predictionio_tpu.controller.engine import (
+    BaseEngine,
+    EngineParams,
+    StopAfterPrepareInterruption,
+    StopAfterReadInterruption,
+)
+from predictionio_tpu.controller.evaluation import Evaluation
+from predictionio_tpu.data.storage.base import (
+    STATUS_COMPLETED,
+    STATUS_EVALUATING,
+    STATUS_FAILED,
+    STATUS_INIT,
+    STATUS_TRAINING,
+    EngineInstance,
+    EvaluationInstance,
+    Model,
+)
+from predictionio_tpu.utils.serialize import dumps_model
+from predictionio_tpu.workflow.context import WorkflowContext, workflow_context
+from predictionio_tpu.workflow.workflow_params import WorkflowParams
+
+logger = logging.getLogger(__name__)
+
+
+def _utcnow() -> _dt.datetime:
+    return _dt.datetime.now(_dt.timezone.utc)
+
+
+class CoreWorkflow:
+    @staticmethod
+    def run_train(
+        engine: BaseEngine,
+        engine_params: EngineParams,
+        engine_instance: EngineInstance,
+        ctx: Optional[WorkflowContext] = None,
+        workflow_params: Optional[WorkflowParams] = None,
+    ) -> Optional[str]:
+        """Train and persist. Returns the engine-instance id on success,
+        None when interrupted by a stop-after debug flag."""
+        workflow_params = workflow_params or WorkflowParams()
+        ctx = ctx or workflow_context(
+            mode="training", batch=workflow_params.batch or engine_instance.batch
+        )
+        storage = ctx.storage
+        instances = storage.get_meta_data_engine_instances()
+        instance_id = instances.insert(
+            dataclasses.replace(engine_instance, status=STATUS_INIT)
+        )
+        logger.info("run_train: engine instance %s created", instance_id)
+        try:
+            instances.update(
+                dataclasses.replace(
+                    instances.get(instance_id), status=STATUS_TRAINING
+                )
+            )
+            models = engine.train(ctx, engine_params, workflow_params)
+            if workflow_params.save_model:
+                serializable = (
+                    engine.make_serializable_models(
+                        ctx, instance_id, engine_params, models
+                    )
+                    if hasattr(engine, "make_serializable_models")
+                    else models
+                )
+                storage.get_model_data_models().insert(
+                    Model(id=instance_id, models=dumps_model(serializable))
+                )
+            instances.update(
+                dataclasses.replace(
+                    instances.get(instance_id),
+                    status=STATUS_COMPLETED,
+                    end_time=_utcnow(),
+                )
+            )
+            logger.info("run_train: engine instance %s completed", instance_id)
+            return instance_id
+        except (StopAfterReadInterruption, StopAfterPrepareInterruption) as e:
+            logger.info("training interrupted by %s", type(e).__name__)
+            instances.delete(instance_id)
+            return None
+        except Exception:
+            logger.error("training failed:\n%s", traceback.format_exc())
+            instances.update(
+                dataclasses.replace(
+                    instances.get(instance_id),
+                    status=STATUS_FAILED,
+                    end_time=_utcnow(),
+                )
+            )
+            raise
+
+    @staticmethod
+    def run_evaluation(
+        evaluation: Evaluation,
+        engine_params_list: Sequence[EngineParams],
+        evaluation_instance: Optional[EvaluationInstance] = None,
+        ctx: Optional[WorkflowContext] = None,
+        workflow_params: Optional[WorkflowParams] = None,
+    ):
+        """Evaluate a params grid; store + return the evaluator result."""
+        workflow_params = workflow_params or WorkflowParams()
+        ctx = ctx or workflow_context(mode="evaluation", batch=workflow_params.batch)
+        storage = ctx.storage
+        instances = storage.get_meta_data_evaluation_instances()
+        if evaluation_instance is None:
+            evaluation_instance = EvaluationInstance(
+                id="",
+                status="",
+                start_time=_utcnow(),
+                end_time=_utcnow(),
+                evaluation_class=type(evaluation).__name__,
+                batch=workflow_params.batch,
+            )
+        instance_id = instances.insert(
+            dataclasses.replace(evaluation_instance, status=STATUS_EVALUATING)
+        )
+        try:
+            engine = evaluation.engine
+            # EvaluationWorkflow.runEvaluation (reference :31-42)
+            engine_eval_data_set = engine.batch_eval(
+                ctx, list(engine_params_list), workflow_params
+            )
+            result = evaluation.evaluator.evaluate_base(
+                ctx, evaluation, engine_eval_data_set, workflow_params
+            )
+        except Exception:
+            logger.error("evaluation failed:\n%s", traceback.format_exc())
+            instances.update(
+                dataclasses.replace(
+                    instances.get(instance_id),
+                    status=STATUS_FAILED,
+                    end_time=_utcnow(),
+                )
+            )
+            raise
+        if result.no_save:
+            # reference CoreWorkflow.scala:127-129 — result not inserted
+            logger.info("evaluation result not inserted into database (no_save)")
+            instances.delete(instance_id)
+        else:
+            instances.update(
+                dataclasses.replace(
+                    instances.get(instance_id),
+                    status=STATUS_COMPLETED,
+                    end_time=_utcnow(),
+                    evaluator_results=result.to_one_liner(),
+                    evaluator_results_html=result.to_html(),
+                    evaluator_results_json=result.to_json(),
+                )
+            )
+        logger.info(
+            "run_evaluation: instance %s completed: %s",
+            instance_id,
+            result.to_one_liner(),
+        )
+        return result

@@ -1,0 +1,15 @@
+"""WorkflowParams (reference core/.../workflow/WorkflowParams.scala:27-42)."""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass
+class WorkflowParams:
+    batch: str = ""
+    verbose: int = 2
+    save_model: bool = True
+    skip_sanity_check: bool = False
+    stop_after_read: bool = False
+    stop_after_prepare: bool = False

@@ -1,0 +1,159 @@
+"""Fake DASE components for pipeline tests — the reference's SampleEngine
+pattern (core/src/test/scala/io/prediction/controller/SampleEngine.scala):
+tiny integer-id components so full train/eval pipelines run with no storage
+and no real model.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Tuple
+
+from predictionio_tpu.controller import (
+    AverageMetric,
+    BaseAlgorithm,
+    BaseDataSource,
+    BasePreparator,
+    BaseServing,
+    Params,
+    SanityCheck,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainingData(SanityCheck):
+    id: int
+    error: bool = False
+
+    def sanity_check(self) -> None:
+        if self.error:
+            raise ValueError(f"TrainingData {self.id} is in error state")
+
+
+@dataclasses.dataclass(frozen=True)
+class PreparedData:
+    id: int
+
+
+@dataclasses.dataclass(frozen=True)
+class Query:
+    qx: int
+
+
+@dataclasses.dataclass(frozen=True)
+class Actual:
+    qx: int
+
+
+@dataclasses.dataclass(frozen=True)
+class Prediction:
+    qx: int
+    models: Tuple = ()
+    supplemented: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class DSParams(Params):
+    id: int = 0
+    error: bool = False
+    n_eval_sets: int = 0
+    n_queries: int = 2
+
+
+class DataSource0(BaseDataSource):
+    """Counts reads so FastEval memoization tests can assert cache hits."""
+
+    read_training_count = 0
+    read_eval_count = 0
+
+    def read_training(self, ctx) -> TrainingData:
+        type(self).read_training_count += 1
+        return TrainingData(self.params.id, self.params.error)
+
+    def read_eval(self, ctx):
+        type(self).read_eval_count += 1
+        out = []
+        for s in range(self.params.n_eval_sets):
+            qa = [
+                (Query(qx), Actual(qx)) for qx in range(self.params.n_queries)
+            ]
+            out.append((TrainingData(self.params.id + s), s, qa))
+        return out
+
+
+@dataclasses.dataclass(frozen=True)
+class PrepParams(Params):
+    offset: int = 0
+
+
+class Preparator0(BasePreparator):
+    prepare_count = 0
+
+    def prepare(self, ctx, td: TrainingData) -> PreparedData:
+        type(self).prepare_count += 1
+        return PreparedData(td.id + self.params.offset)
+
+
+@dataclasses.dataclass(frozen=True)
+class AlgoParams(Params):
+    id: int = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class Model0:
+    algo_id: int
+    pd_id: int
+
+
+class Algo0(BaseAlgorithm):
+    train_count = 0
+    params_class = AlgoParams
+    query_class = Query
+
+    def train(self, ctx, pd: PreparedData) -> Model0:
+        type(self).train_count += 1
+        return Model0(self.params.id, pd.id)
+
+    def predict(self, model: Model0, query: Query) -> Prediction:
+        return Prediction(query.qx, models=((model.algo_id, model.pd_id),))
+
+
+class Algo1(Algo0):
+    pass
+
+
+class Serving0(BaseServing):
+    """Merges all algorithms' predictions, reference LServing0 style."""
+
+    def serve(self, query: Query, predictions) -> Prediction:
+        models = tuple(m for p in predictions for m in p.models)
+        return Prediction(query.qx, models=models)
+
+
+class SupplementServing(BaseServing):
+    """Marks queries as supplemented to prove supplement() runs pre-predict."""
+
+    def supplement(self, query: Query) -> Query:
+        return Query(query.qx + 1000)
+
+    def serve(self, query: Query, predictions) -> Prediction:
+        return Prediction(
+            query.qx,
+            models=tuple(m for p in predictions for m in p.models),
+            supplemented=all(p.qx >= 1000 for p in predictions),
+        )
+
+
+def reset_counters():
+    DataSource0.read_training_count = 0
+    DataSource0.read_eval_count = 0
+    Preparator0.prepare_count = 0
+    Algo0.train_count = 0
+    Algo1.train_count = 0
+
+
+class QxMetric(AverageMetric):
+    """Scores 1.0 when the served prediction echoes the query index."""
+
+    def calculate_point(self, q: Query, p: Prediction, a: Actual) -> float:
+        return 1.0 if p.qx == q.qx == a.qx else 0.0

@@ -1,0 +1,284 @@
+"""Controller API tests: params extraction, engine train/eval wiring,
+multi-algo ordering, serving, metrics — the reference's EngineTest /
+JsonExtractorSuite / MetricTest coverage
+(core/src/test/scala/io/prediction/controller/).
+"""
+
+import dataclasses
+from typing import List, Optional
+
+import pytest
+
+from predictionio_tpu.controller import (
+    EmptyParams,
+    Engine,
+    EngineParams,
+    FirstServing,
+    MetricEvaluator,
+    Params,
+    ParamsError,
+    SimpleEngine,
+    StopAfterPrepareInterruption,
+    StopAfterReadInterruption,
+    params_from_json,
+    params_to_json,
+)
+from predictionio_tpu.workflow import WorkflowContext, WorkflowParams
+
+from tests.fake_engine import (
+    Algo0,
+    Algo1,
+    AlgoParams,
+    DataSource0,
+    DSParams,
+    Preparator0,
+    PrepParams,
+    Query,
+    QxMetric,
+    Serving0,
+    SupplementServing,
+    reset_counters,
+)
+
+
+@pytest.fixture(autouse=True)
+def _reset():
+    reset_counters()
+
+
+def ctx():
+    return WorkflowContext(mode="test")
+
+
+def make_engine():
+    return Engine(
+        data_source_classes=DataSource0,
+        preparator_classes=Preparator0,
+        algorithm_classes={"a0": Algo0, "a1": Algo1},
+        serving_classes=Serving0,
+    )
+
+
+def make_params(ds_id=7, n_eval_sets=0, algos=(("a0", 1), ("a1", 2))):
+    return EngineParams(
+        data_source_params=("", DSParams(id=ds_id, n_eval_sets=n_eval_sets)),
+        preparator_params=("", PrepParams(offset=100)),
+        algorithm_params_list=tuple(
+            (name, AlgoParams(id=i)) for name, i in algos
+        ),
+        serving_params=("", EmptyParams()),
+    )
+
+
+class TestParams:
+    def test_extraction_with_defaults_and_coercion(self):
+        @dataclasses.dataclass(frozen=True)
+        class P(Params):
+            rank: int = 10
+            reg: float = 0.01
+            names: Optional[List[str]] = None
+
+        p = params_from_json({"rank": 20, "reg": 1, "names": ["a"]}, P)
+        assert p.rank == 20 and p.reg == 1.0 and p.names == ["a"]
+        assert isinstance(p.reg, float)
+        assert params_from_json({}, P) == P()
+
+    def test_unknown_field_rejected(self):
+        with pytest.raises(ParamsError):
+            params_from_json({"rnak": 20}, AlgoParams)
+
+    def test_missing_required_rejected(self):
+        @dataclasses.dataclass(frozen=True)
+        class P(Params):
+            required: int
+
+        with pytest.raises(ParamsError):
+            params_from_json({}, P)
+
+    def test_nested_dataclass(self):
+        @dataclasses.dataclass(frozen=True)
+        class Inner(Params):
+            x: int = 1
+
+        @dataclasses.dataclass(frozen=True)
+        class Outer(Params):
+            inner: Inner = Inner()
+
+        o = params_from_json({"inner": {"x": 5}}, Outer)
+        assert o.inner.x == 5
+        assert params_to_json(o) == {"inner": {"x": 5}}
+
+
+class TestEngineTrain:
+    def test_train_runs_all_stages_in_order(self):
+        engine = make_engine()
+        models = engine.train(ctx(), make_params(), WorkflowParams())
+        # preparator added 100 to ds id 7; each algo model records its id
+        assert [dataclasses.astuple(m) for m in models] == [(1, 107), (2, 107)]
+        assert DataSource0.read_training_count == 1
+        assert Preparator0.prepare_count == 1
+
+    def test_multi_algo_ordering_preserved(self):
+        engine = make_engine()
+        models = engine.train(
+            ctx(), make_params(algos=(("a1", 9), ("a0", 3))), WorkflowParams()
+        )
+        assert [m.algo_id for m in models] == [9, 3]
+
+    def test_sanity_check_runs_and_can_be_skipped(self):
+        engine = make_engine()
+        bad = EngineParams(
+            data_source_params=("", DSParams(id=1, error=True)),
+            preparator_params=("", PrepParams()),
+            algorithm_params_list=(("a0", AlgoParams()),),
+        )
+        with pytest.raises(ValueError, match="error state"):
+            engine.train(ctx(), bad, WorkflowParams())
+        engine.train(ctx(), bad, WorkflowParams(skip_sanity_check=True))
+
+    def test_stop_after_read_and_prepare(self):
+        engine = make_engine()
+        with pytest.raises(StopAfterReadInterruption):
+            engine.train(ctx(), make_params(), WorkflowParams(stop_after_read=True))
+        assert Preparator0.prepare_count == 0
+        with pytest.raises(StopAfterPrepareInterruption):
+            engine.train(
+                ctx(), make_params(), WorkflowParams(stop_after_prepare=True)
+            )
+        assert Algo0.train_count == 0
+
+    def test_no_algorithms_rejected(self):
+        engine = make_engine()
+        with pytest.raises(ValueError, match="no algorithms"):
+            engine.train(ctx(), make_params(algos=()), WorkflowParams())
+
+    def test_unknown_component_name_rejected(self):
+        engine = make_engine()
+        bad = EngineParams(
+            data_source_params=("", DSParams()),
+            algorithm_params_list=(("nope", AlgoParams()),),
+        )
+        with pytest.raises(KeyError, match="nope"):
+            engine.train(ctx(), bad, WorkflowParams())
+
+
+class TestEngineEval:
+    def test_eval_produces_qpa_per_fold(self):
+        engine = make_engine()
+        results = engine.eval(
+            ctx(), make_params(n_eval_sets=3), WorkflowParams()
+        )
+        assert len(results) == 3
+        for s, (eval_info, qpa) in enumerate(results):
+            assert eval_info == s
+            assert len(qpa) == 2
+            for qx, (q, p, a) in enumerate(qpa):
+                assert q == Query(qx)
+                assert a.qx == qx
+                # both algorithms' predictions merged by Serving0
+                assert p.models == ((1, 107 + s), (2, 107 + s))
+
+    def test_supplement_applied_before_predict(self):
+        engine = Engine(
+            data_source_classes=DataSource0,
+            preparator_classes=Preparator0,
+            algorithm_classes={"a0": Algo0},
+            serving_classes=SupplementServing,
+        )
+        ep = EngineParams(
+            data_source_params=("", DSParams(n_eval_sets=1)),
+            preparator_params=("", PrepParams()),
+            algorithm_params_list=(("a0", AlgoParams()),),
+        )
+        [(_, qpa)] = engine.eval(ctx(), ep, WorkflowParams())
+        assert all(p.supplemented for _, p, _ in qpa)
+
+    def test_batch_eval_loops_grid(self):
+        engine = make_engine()
+        grid = [make_params(n_eval_sets=1), make_params(n_eval_sets=2)]
+        out = engine.batch_eval(ctx(), grid, WorkflowParams())
+        assert len(out) == 2
+        assert out[0][0] is grid[0]
+        assert len(out[0][1]) == 1 and len(out[1][1]) == 2
+
+
+class TestEngineJson:
+    def test_jvalue_to_engine_params(self):
+        engine = make_engine()
+        variant = {
+            "datasource": {"params": {"id": 3, "n_eval_sets": 1}},
+            "preparator": {"params": {"offset": 10}},
+            "algorithms": [
+                {"name": "a0", "params": {"id": 5}},
+                {"name": "a1", "params": {"id": 6}},
+            ],
+            "serving": {},
+        }
+        # DataSource0/Preparator0 have no params_class: they fall back to
+        # dict params only when a params block exists
+        engine.data_source_class_map[""].params_class = DSParams
+        engine.preparator_class_map[""].params_class = PrepParams
+        try:
+            ep = engine.jvalue_to_engine_params(variant)
+        finally:
+            del engine.data_source_class_map[""].params_class
+            del engine.preparator_class_map[""].params_class
+        assert ep.data_source_params[1] == DSParams(id=3, n_eval_sets=1)
+        assert ep.preparator_params[1] == PrepParams(offset=10)
+        assert [(n, p.id) for n, p in ep.algorithm_params_list] == [
+            ("a0", 5), ("a1", 6)]
+
+    def test_single_algo_default(self):
+        engine = SimpleEngine(DataSource0, Algo0)
+        ep = engine.jvalue_to_engine_params({})
+        assert len(ep.algorithm_params_list) == 1
+
+
+class TestMetrics:
+    def _eval_data(self, hits, total):
+        from tests.fake_engine import Actual, Prediction
+
+        qpa = [
+            (Query(i), Prediction(i if i < hits else -1), Actual(i))
+            for i in range(total)
+        ]
+        return [(0, qpa)]
+
+    def test_average_metric(self):
+        m = QxMetric()
+        assert m.calculate(None, self._eval_data(3, 4)) == pytest.approx(0.75)
+
+    def test_compare_ordering(self):
+        m = QxMetric()
+        assert m.compare(1.0, 0.5) > 0
+        assert m.compare(0.5, 1.0) < 0
+        assert m.compare(0.5, 0.5) == 0
+
+    def test_stdev_and_sum(self):
+        from predictionio_tpu.controller import StdevMetric, SumMetric
+
+        class S(SumMetric):
+            def calculate_point(self, q, p, a):
+                return q.qx
+
+        class D(StdevMetric):
+            def calculate_point(self, q, p, a):
+                return q.qx
+
+        data = self._eval_data(0, 4)
+        assert S().calculate(None, data) == 6.0
+        assert D().calculate(None, data) == pytest.approx(1.1180339887)
+
+    def test_option_average_skips_none(self):
+        from predictionio_tpu.controller import OptionAverageMetric
+
+        class O(OptionAverageMetric):
+            def calculate_point(self, q, p, a):
+                return None if q.qx == 0 else float(q.qx)
+
+        assert O().calculate(None, self._eval_data(0, 3)) == pytest.approx(1.5)
+
+    def test_zero_metric(self):
+        from predictionio_tpu.controller import ZeroMetric
+
+        assert ZeroMetric().calculate(None, self._eval_data(0, 3)) == 0.0

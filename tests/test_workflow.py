@@ -1,0 +1,189 @@
+"""Workflow lifecycle tests: CoreWorkflow train/eval with instance records,
+model persistence, MetricEvaluator best-params selection, FastEvalEngine
+memoization — reference EngineWorkflowTest / EvaluationWorkflowTest /
+FastEvalEngineTest coverage.
+"""
+
+import dataclasses
+import datetime as dt
+
+import pytest
+
+from predictionio_tpu.controller import (
+    EmptyParams,
+    Engine,
+    EngineParams,
+    Evaluation,
+    FastEvalEngine,
+    MetricEvaluator,
+)
+from predictionio_tpu.data.storage.base import (
+    STATUS_COMPLETED,
+    STATUS_FAILED,
+    EngineInstance,
+)
+from predictionio_tpu.utils.serialize import loads_model
+from predictionio_tpu.workflow import CoreWorkflow, WorkflowContext, WorkflowParams
+
+from tests.fake_engine import (
+    Algo0,
+    Algo1,
+    AlgoParams,
+    DataSource0,
+    DSParams,
+    Model0,
+    Preparator0,
+    PrepParams,
+    QxMetric,
+    Serving0,
+    reset_counters,
+)
+
+
+@pytest.fixture(autouse=True)
+def _reset():
+    reset_counters()
+
+
+def make_engine(cls=Engine):
+    return cls(
+        data_source_classes=DataSource0,
+        preparator_classes=Preparator0,
+        algorithm_classes={"a0": Algo0, "a1": Algo1},
+        serving_classes=Serving0,
+    )
+
+
+def make_params(ds_id=7, n_eval_sets=0, algos=(("a0", 1),), offset=100):
+    return EngineParams(
+        data_source_params=("", DSParams(id=ds_id, n_eval_sets=n_eval_sets)),
+        preparator_params=("", PrepParams(offset=offset)),
+        algorithm_params_list=tuple((n, AlgoParams(id=i)) for n, i in algos),
+    )
+
+
+def make_instance():
+    now = dt.datetime.now(dt.timezone.utc)
+    return EngineInstance(
+        id="", status="", start_time=now, end_time=now,
+        engine_id="fake", engine_version="1", engine_variant="engine.json",
+        engine_factory="tests.fake_engine",
+    )
+
+
+class TestRunTrain:
+    def test_train_persists_models_and_completes(self, mem_storage):
+        ctx = WorkflowContext(mode="training", storage=mem_storage)
+        iid = CoreWorkflow.run_train(
+            make_engine(), make_params(), make_instance(), ctx=ctx
+        )
+        assert iid
+        inst = mem_storage.get_meta_data_engine_instances().get(iid)
+        assert inst.status == STATUS_COMPLETED
+        blob = mem_storage.get_model_data_models().get(iid)
+        models = loads_model(blob.models)
+        assert models == [Model0(1, 107)]
+        latest = mem_storage.get_meta_data_engine_instances().get_latest_completed(
+            "fake", "1", "engine.json"
+        )
+        assert latest.id == iid
+
+    def test_save_model_false_skips_persistence(self, mem_storage):
+        ctx = WorkflowContext(storage=mem_storage)
+        iid = CoreWorkflow.run_train(
+            make_engine(), make_params(), make_instance(), ctx=ctx,
+            workflow_params=WorkflowParams(save_model=False),
+        )
+        assert mem_storage.get_model_data_models().get(iid) is None
+
+    def test_stop_after_read_interrupts_cleanly(self, mem_storage):
+        ctx = WorkflowContext(storage=mem_storage)
+        iid = CoreWorkflow.run_train(
+            make_engine(), make_params(), make_instance(), ctx=ctx,
+            workflow_params=WorkflowParams(stop_after_read=True),
+        )
+        assert iid is None
+        assert mem_storage.get_meta_data_engine_instances().get_all() == []
+
+    def test_failure_marks_instance_failed(self, mem_storage):
+        ctx = WorkflowContext(storage=mem_storage)
+        bad = EngineParams(
+            data_source_params=("", DSParams(error=True)),
+            algorithm_params_list=(("a0", AlgoParams()),),
+        )
+        engine = make_engine()
+        with pytest.raises(ValueError):
+            CoreWorkflow.run_train(engine, bad, make_instance(), ctx=ctx)
+        insts = mem_storage.get_meta_data_engine_instances().get_all()
+        assert len(insts) == 1 and insts[0].status == STATUS_FAILED
+
+
+class TestRunEvaluation:
+    def test_grid_selects_best_params(self, mem_storage):
+        ctx = WorkflowContext(storage=mem_storage)
+        engine = make_engine()
+        evaluation = Evaluation().set_engine_metric(engine, QxMetric())
+        grid = [
+            make_params(n_eval_sets=2, algos=(("a0", 1),)),
+            make_params(n_eval_sets=2, algos=(("a0", 1), ("a1", 2))),
+        ]
+        result = CoreWorkflow.run_evaluation(evaluation, grid, ctx=ctx)
+        # Serving0 merges model tuples; QxMetric scores qx echo => both 1.0,
+        # first wins ties
+        assert result.best_idx == 0
+        assert result.best_score.score == 1.0
+        assert len(result.engine_params_scores) == 2
+        [inst] = mem_storage.get_meta_data_evaluation_instances().get_completed()
+        assert inst.status == STATUS_COMPLETED
+        assert "QxMetric" in inst.evaluator_results
+        assert inst.evaluator_results_json
+        assert "<table" in inst.evaluator_results_html
+
+    def test_best_json_output(self, mem_storage, tmp_path):
+        ctx = WorkflowContext(storage=mem_storage)
+        engine = make_engine()
+        out = tmp_path / "best.json"
+        evaluation = Evaluation().set_engine_metric(
+            engine, QxMetric(), output_path=str(out)
+        )
+        CoreWorkflow.run_evaluation(
+            evaluation, [make_params(n_eval_sets=1)], ctx=ctx
+        )
+        import json
+
+        best = json.loads(out.read_text())
+        assert best["algorithms"][0]["name"] == "a0"
+
+
+class TestFastEvalEngine:
+    def test_memoizes_shared_prefixes(self, mem_storage):
+        ctx = WorkflowContext(storage=mem_storage)
+        engine = make_engine(FastEvalEngine)
+        # 3 params sets sharing datasource+preparator; 2 share algorithms
+        base = make_params(n_eval_sets=2, algos=(("a0", 1),))
+        grid = [
+            base,
+            dataclasses.replace(
+                base, algorithm_params_list=(("a0", AlgoParams(id=9)),)
+            ),
+            dataclasses.replace(base, serving_params=("", EmptyParams())),
+        ]
+        out = engine.batch_eval(ctx, grid, WorkflowParams())
+        assert len(out) == 3
+        # datasource read once for the shared prefix (not 3×)
+        assert DataSource0.read_eval_count == 1
+        assert Preparator0.prepare_count == 2  # 2 folds × 1 shared prefix
+        # algo trained for 2 distinct algo-param sets × 2 folds
+        assert Algo0.train_count == 4
+        # grid entries 0 and 2 have identical (ds, prep, algo) prefix: the
+        # models and the serving results are shared
+        assert out[0][1] == out[2][1]
+
+    def test_results_match_plain_engine(self, mem_storage):
+        ctx = WorkflowContext(storage=mem_storage)
+        plain = make_engine(Engine)
+        fast = make_engine(FastEvalEngine)
+        grid = [make_params(n_eval_sets=2, algos=(("a0", 1), ("a1", 5)))]
+        res_plain = plain.batch_eval(ctx, grid, WorkflowParams())
+        res_fast = fast.batch_eval(ctx, grid, WorkflowParams())
+        assert [r[1] for r in res_plain] == [r[1] for r in res_fast]
