@@ -1,0 +1,239 @@
+"""Event store access layer for engine developers.
+
+Capability parity with the reference's store layer
+(data/src/main/scala/io/prediction/data/store/): ``PEventStore``
+(PEventStore.scala:30 — find + aggregateProperties by app *name*),
+``LEventStore`` (LEventStore.scala:146 — findByEntity serving-time lookups
+with timeout), and app-name/channel resolution (Common.scala:28-49).
+
+Where the reference returns RDDs, the batch API here returns host lists
+plus a columnar view (``EventColumns``) holding dense numpy id/value
+columns with BiMap indexes — the form that `jax.device_put` moves straight
+into HBM for kernel consumption (SURVEY.md §7 step 1).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import datetime as _dt
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from predictionio_tpu.data.bimap import BiMap
+from predictionio_tpu.data.event import Event, PropertyMap
+from predictionio_tpu.data.storage import Storage, get_storage
+from predictionio_tpu.data.storage.base import UNSET, OptFilter
+
+
+class AppNotFoundError(KeyError):
+    pass
+
+
+class ChannelNotFoundError(KeyError):
+    pass
+
+
+def app_name_to_id(
+    app_name: str, channel_name: Optional[str] = None, storage: Optional[Storage] = None
+) -> Tuple[int, Optional[int]]:
+    """Resolve appName (+ optional channel) to ids
+    (reference store/Common.scala:28-49)."""
+    storage = storage or get_storage()
+    app = storage.get_meta_data_apps().get_by_name(app_name)
+    if app is None:
+        raise AppNotFoundError(f"App {app_name!r} does not exist; use pio app new")
+    channel_id: Optional[int] = None
+    if channel_name is not None:
+        channels = storage.get_meta_data_channels().get_by_app_id(app.id)
+        match = [c for c in channels if c.name == channel_name]
+        if not match:
+            raise ChannelNotFoundError(
+                f"Channel {channel_name!r} does not exist in app {app_name!r}"
+            )
+        channel_id = match[0].id
+    return app.id, channel_id
+
+
+@dataclasses.dataclass
+class EventColumns:
+    """Column-oriented batch of (entity, target, value) triples with dense
+    indexes — the device-bound form of an event scan."""
+
+    entity_index: BiMap  # entityId -> dense int
+    target_index: BiMap  # targetEntityId -> dense int
+    entity_idx: np.ndarray  # [n] int32
+    target_idx: np.ndarray  # [n] int32
+    values: np.ndarray  # [n] float32
+    events: List[Event]  # originating events (host metadata)
+
+    @property
+    def n(self) -> int:
+        return len(self.values)
+
+
+class PEventStore:
+    """Batch event reads by app name (reference PEventStore.scala:30-116)."""
+
+    def __init__(self, storage: Optional[Storage] = None):
+        self._storage = storage
+
+    @property
+    def storage(self) -> Storage:
+        return self._storage or get_storage()
+
+    def find(
+        self,
+        app_name: str,
+        channel_name: Optional[str] = None,
+        start_time: Optional[_dt.datetime] = None,
+        until_time: Optional[_dt.datetime] = None,
+        entity_type: Optional[str] = None,
+        entity_id: Optional[str] = None,
+        event_names: Optional[Sequence[str]] = None,
+        target_entity_type: OptFilter = UNSET,
+        target_entity_id: OptFilter = UNSET,
+    ) -> Iterator[Event]:
+        app_id, channel_id = app_name_to_id(app_name, channel_name, self.storage)
+        return self.storage.get_p_events().find(
+            app_id=app_id,
+            channel_id=channel_id,
+            start_time=start_time,
+            until_time=until_time,
+            entity_type=entity_type,
+            entity_id=entity_id,
+            event_names=event_names,
+            target_entity_type=target_entity_type,
+            target_entity_id=target_entity_id,
+        )
+
+    def aggregate_properties(
+        self,
+        app_name: str,
+        entity_type: str,
+        channel_name: Optional[str] = None,
+        start_time: Optional[_dt.datetime] = None,
+        until_time: Optional[_dt.datetime] = None,
+        required: Optional[Sequence[str]] = None,
+    ) -> Dict[str, PropertyMap]:
+        app_id, channel_id = app_name_to_id(app_name, channel_name, self.storage)
+        return self.storage.get_p_events().aggregate_properties(
+            app_id=app_id,
+            entity_type=entity_type,
+            channel_id=channel_id,
+            start_time=start_time,
+            until_time=until_time,
+            required=required,
+        )
+
+    # --- columnar view: events -> device-ready arrays ---
+
+    def find_columns(
+        self,
+        app_name: str,
+        value_of=None,
+        entity_index: Optional[BiMap] = None,
+        target_index: Optional[BiMap] = None,
+        **find_kwargs,
+    ) -> EventColumns:
+        """Scan events and columnarize (entityId, targetEntityId, value).
+
+        ``value_of(event) -> float`` extracts the numeric value (default:
+        the ``rating`` property, else 1.0 — the implicit-feedback case).
+        Events without a target entity are skipped. Existing BiMaps may be
+        passed to keep indices aligned across scans (e.g. train vs eval).
+        """
+        events = [
+            e
+            for e in self.find(app_name, **find_kwargs)
+            if e.target_entity_id is not None
+        ]
+        if value_of is None:
+            def value_of(e: Event) -> float:
+                return float(e.properties.get_or_else("rating", 1.0))
+
+        if entity_index is None:
+            entity_index = BiMap.string_int(e.entity_id for e in events)
+        if target_index is None:
+            target_index = BiMap.string_int(e.target_entity_id for e in events)
+        kept = [
+            e
+            for e in events
+            if e.entity_id in entity_index and e.target_entity_id in target_index
+        ]
+        entity_idx = np.fromiter(
+            (entity_index[e.entity_id] for e in kept), np.int32, count=len(kept)
+        )
+        target_idx = np.fromiter(
+            (target_index[e.target_entity_id] for e in kept), np.int32, count=len(kept)
+        )
+        values = np.fromiter(
+            (value_of(e) for e in kept), np.float32, count=len(kept)
+        )
+        return EventColumns(
+            entity_index=entity_index,
+            target_index=target_index,
+            entity_idx=entity_idx,
+            target_idx=target_idx,
+            values=values,
+            events=kept,
+        )
+
+
+class LEventStore:
+    """Serving-time entity reads (reference LEventStore.scala:146-230).
+
+    The reference enforces a wall-clock timeout on these lookups because a
+    slow HBase read stalls the serving hot path; the embedded backends here
+    are local and fast, so the timeout parameter is accepted for parity and
+    currently unenforced.
+    """
+
+    def __init__(self, storage: Optional[Storage] = None):
+        self._storage = storage
+
+    @property
+    def storage(self) -> Storage:
+        return self._storage or get_storage()
+
+    def find_by_entity(
+        self,
+        app_name: str,
+        entity_type: str,
+        entity_id: str,
+        channel_name: Optional[str] = None,
+        event_names: Optional[Sequence[str]] = None,
+        target_entity_type: OptFilter = UNSET,
+        target_entity_id: OptFilter = UNSET,
+        start_time: Optional[_dt.datetime] = None,
+        until_time: Optional[_dt.datetime] = None,
+        limit: Optional[int] = None,
+        latest: bool = True,
+        timeout_seconds: float = 10.0,
+    ) -> Iterator[Event]:
+        app_id, channel_id = app_name_to_id(app_name, channel_name, self.storage)
+        return self.storage.get_l_events().find(
+            app_id=app_id,
+            channel_id=channel_id,
+            start_time=start_time,
+            until_time=until_time,
+            entity_type=entity_type,
+            entity_id=entity_id,
+            event_names=event_names,
+            target_entity_type=target_entity_type,
+            target_entity_id=target_entity_id,
+            limit=limit,
+            reversed=latest,
+        )
+
+    def find(
+        self,
+        app_name: str,
+        channel_name: Optional[str] = None,
+        timeout_seconds: float = 10.0,
+        **find_kwargs,
+    ) -> Iterator[Event]:
+        app_id, channel_id = app_name_to_id(app_name, channel_name, self.storage)
+        return self.storage.get_l_events().find(
+            app_id=app_id, channel_id=channel_id, **find_kwargs
+        )

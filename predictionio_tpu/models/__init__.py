@@ -1,0 +1,6 @@
+"""Engine templates — the "models" layer (reference examples/, SURVEY.md §2.10).
+
+Each template composes DASE components into a deployable engine:
+``recommendation`` (ALS), ``similarproduct`` (cosine over ALS item factors),
+``classification`` (NaiveBayes), ``ecommerce`` (ALS + business rules).
+"""

@@ -1,0 +1,42 @@
+"""Recommendation engine template — ALS collaborative filtering.
+
+Capability parity with the reference's scala-parallel-recommendation
+template family (examples/scala-parallel-recommendation/custom-query/src/
+main/scala/: Engine.scala, DataSource.scala, Preparator.scala,
+ALSAlgorithm.scala:24-105, Serving.scala), with MLlib ALS replaced by the
+TPU kernel in predictionio_tpu.ops.als.
+"""
+
+from predictionio_tpu.models.recommendation.engine import (
+    ALSAlgorithm,
+    ALSAlgorithmParams,
+    ALSModel,
+    DataSource,
+    DataSourceParams,
+    ItemScore,
+    PredictedResult,
+    Preparator,
+    PreparedData,
+    Query,
+    RecommendationEngineFactory,
+    Serving,
+    TrainingData,
+    recommendation_engine,
+)
+
+__all__ = [
+    "ALSAlgorithm",
+    "ALSAlgorithmParams",
+    "ALSModel",
+    "DataSource",
+    "DataSourceParams",
+    "ItemScore",
+    "PredictedResult",
+    "Preparator",
+    "PreparedData",
+    "Query",
+    "RecommendationEngineFactory",
+    "Serving",
+    "TrainingData",
+    "recommendation_engine",
+]

@@ -1,0 +1,330 @@
+"""Recommendation engine: DASE components around the TPU ALS kernel.
+
+Reference mapping (examples/scala-parallel-recommendation/custom-query/src/main/scala/):
+- Query/PredictedResult/ItemScore    <- Engine.scala
+- DataSource (PEventStore rate/buy reads, k-fold eval split) <- DataSource.scala
+- Preparator (ratings pass-through)  <- Preparator.scala
+- ALSAlgorithm (MLlib ALS -> ops.als.train_als; cosine/dot top-N predict)
+                                     <- ALSAlgorithm.scala:24-105
+- Serving (first prediction)         <- Serving.scala
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from predictionio_tpu.controller import (
+    BaseAlgorithm,
+    BaseDataSource,
+    BasePreparator,
+    EngineFactory,
+    FirstServing,
+    Params,
+    SanityCheck,
+)
+from predictionio_tpu.controller.engine import Engine
+from predictionio_tpu.data.bimap import BiMap
+from predictionio_tpu.data.store import PEventStore
+from predictionio_tpu.ops.als import (
+    ALSConfig,
+    ALSModelArrays,
+    ServingFactors,
+    train_als,
+)
+
+logger = logging.getLogger(__name__)
+
+
+# --- queries and results (reference Engine.scala) ---
+
+
+@dataclasses.dataclass(frozen=True)
+class Query:
+    user: str
+    num: int = 10
+
+
+@dataclasses.dataclass(frozen=True)
+class ItemScore:
+    item: str
+    score: float
+
+
+@dataclasses.dataclass(frozen=True)
+class PredictedResult:
+    item_scores: Tuple[ItemScore, ...] = ()
+
+    def __post_init__(self):
+        object.__setattr__(
+            self,
+            "item_scores",
+            tuple(
+                s if isinstance(s, ItemScore) else ItemScore(**s)
+                for s in self.item_scores
+            ),
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class ActualResult:
+    items: Tuple[str, ...] = ()
+
+
+# --- training data ---
+
+
+@dataclasses.dataclass
+class Rating:
+    user: str
+    item: str
+    rating: float
+
+
+@dataclasses.dataclass
+class TrainingData(SanityCheck):
+    user_idx: np.ndarray
+    item_idx: np.ndarray
+    ratings: np.ndarray
+    user_index: BiMap
+    item_index: BiMap
+
+    def sanity_check(self) -> None:
+        if len(self.ratings) == 0:
+            raise ValueError(
+                "ratings is empty — is the event store populated with "
+                "rate/buy events?"
+            )
+
+
+@dataclasses.dataclass
+class PreparedData:
+    td: TrainingData
+
+
+@dataclasses.dataclass(frozen=True)
+class DataSourceParams(Params):
+    app_name: str = "default"
+    channel_name: Optional[str] = None
+    event_names: Tuple[str, ...] = ("rate", "buy")
+    # k-fold eval config (reference DataSource readEval)
+    eval_k: Optional[int] = None
+    eval_query_num: int = 10
+    seed: int = 3
+
+
+class DataSource(BaseDataSource):
+    """Reads rate/buy events into dense-indexed rating columns
+    (reference DataSource.scala — PEventStore.find + Rating mapping;
+    'buy' events become rating 4.0 like the template's implicit mapping)."""
+
+    params_class = DataSourceParams
+
+    def _read_columns(self, ctx):
+        store = PEventStore(ctx.storage)
+
+        def value_of(e):
+            if e.event == "buy":
+                return 4.0
+            return float(e.properties.get_or_else("rating", 1.0))
+
+        return store.find_columns(
+            self.params.app_name,
+            value_of=value_of,
+            channel_name=self.params.channel_name,
+            entity_type="user",
+            target_entity_type="item",
+            event_names=list(self.params.event_names),
+        )
+
+    def read_training(self, ctx) -> TrainingData:
+        cols = self._read_columns(ctx)
+        logger.info(
+            "DataSource: %d ratings, %d users, %d items",
+            cols.n, len(cols.entity_index), len(cols.target_index),
+        )
+        return TrainingData(
+            user_idx=cols.entity_idx,
+            item_idx=cols.target_idx,
+            ratings=cols.values,
+            user_index=cols.entity_index,
+            item_index=cols.target_index,
+        )
+
+    def read_eval(self, ctx):
+        if not self.params.eval_k:
+            return []
+        cols = self._read_columns(ctx)
+        k = self.params.eval_k
+        rng = np.random.default_rng(self.params.seed)
+        fold_of = rng.integers(0, k, size=cols.n)
+        out = []
+        inv_item = cols.target_index.inverse()
+        inv_user = cols.entity_index.inverse()
+        for fold in range(k):
+            train_sel = fold_of != fold
+            test_sel = ~train_sel
+            td = TrainingData(
+                user_idx=cols.entity_idx[train_sel],
+                item_idx=cols.target_idx[train_sel],
+                ratings=cols.values[train_sel],
+                user_index=cols.entity_index,
+                item_index=cols.target_index,
+            )
+            # group held-out items per user -> (Query, ActualResult)
+            per_user = {}
+            for u, i in zip(
+                cols.entity_idx[test_sel].tolist(),
+                cols.target_idx[test_sel].tolist(),
+            ):
+                per_user.setdefault(u, []).append(inv_item[i])
+            qa = [
+                (
+                    Query(user=inv_user[u], num=self.params.eval_query_num),
+                    ActualResult(items=tuple(items)),
+                )
+                for u, items in per_user.items()
+            ]
+            out.append((td, {"fold": fold}, qa))
+        return out
+
+
+class Preparator(BasePreparator):
+    """Pass-through (reference Preparator.scala)."""
+
+    def prepare(self, ctx, td: TrainingData) -> PreparedData:
+        return PreparedData(td=td)
+
+
+# --- the ALS algorithm ---
+
+
+@dataclasses.dataclass(frozen=True)
+class ALSAlgorithmParams(Params):
+    rank: int = 10
+    num_iterations: int = 10
+    lambda_: float = 0.01
+    alpha: float = 1.0
+    implicit_prefs: bool = False
+    seed: Optional[int] = 3
+
+
+@dataclasses.dataclass
+class ALSModel:
+    """Trained factors + id indexes. Predict is one gather + one matmul +
+    top-k on device (reference ALSAlgorithm predict: cosine over factors,
+    ALSAlgorithm.scala:79-105). Device-resident serving state is built
+    lazily and excluded from pickling."""
+
+    arrays: ALSModelArrays
+    user_index: BiMap
+    item_index: BiMap
+    _serving: Optional[ServingFactors] = dataclasses.field(
+        default=None, repr=False, compare=False
+    )
+
+    def __getstate__(self):
+        state = self.__dict__.copy()
+        state["_serving"] = None
+        return state
+
+    @property
+    def serving(self) -> ServingFactors:
+        if self._serving is None:
+            self._serving = ServingFactors(
+                self.arrays.user_factors, self.arrays.item_factors
+            )
+        return self._serving
+
+    def recommend(self, user: str, num: int) -> PredictedResult:
+        [(_, result)] = self.recommend_many([(0, Query(user, num))])
+        return result
+
+    def recommend_many(self, queries) -> List[Tuple[int, PredictedResult]]:
+        """Vectorized top-N for indexed queries (the serving batch path)."""
+        known = [
+            (qx, self.user_index[q.user], q.num)
+            for qx, q in queries
+            if q.user in self.user_index
+        ]
+        unknown = [
+            (qx, PredictedResult())
+            for qx, q in queries
+            if q.user not in self.user_index
+        ]
+        if not known:
+            return unknown
+        max_num = max(n for _, _, n in known)
+        max_num = min(max_num, len(self.item_index))
+        scores, idx = self.serving.topn_by_user(
+            [u for _, u, _ in known], max_num
+        )
+        inv_item = self.item_index.inverse()
+        out = list(unknown)
+        for row, (qx, _, num) in enumerate(known):
+            item_scores = tuple(
+                ItemScore(item=inv_item[int(idx[row, j])], score=float(scores[row, j]))
+                for j in range(min(num, max_num))
+            )
+            out.append((qx, PredictedResult(item_scores=item_scores)))
+        return out
+
+
+class ALSAlgorithm(BaseAlgorithm):
+    """ALS on the workflow mesh (replaces MLlib ALS.train/trainImplicit,
+    reference ALSAlgorithm.scala:66-73)."""
+
+    params_class = ALSAlgorithmParams
+    query_class = Query
+
+    def train(self, ctx, pd: PreparedData) -> ALSModel:
+        td = pd.td
+        p: ALSAlgorithmParams = self.params
+        config = ALSConfig(
+            rank=p.rank,
+            iterations=p.num_iterations,
+            reg=p.lambda_,
+            alpha=p.alpha,
+            implicit_prefs=p.implicit_prefs,
+            seed=p.seed if p.seed is not None else 0,
+        )
+        mesh = ctx.mesh if ctx is not None else None
+        arrays = train_als(
+            td.user_idx,
+            td.item_idx,
+            td.ratings,
+            n_users=len(td.user_index),
+            n_items=len(td.item_index),
+            config=config,
+            mesh=mesh,
+        )
+        return ALSModel(
+            arrays=arrays, user_index=td.user_index, item_index=td.item_index
+        )
+
+    def predict(self, model: ALSModel, query: Query) -> PredictedResult:
+        return model.recommend(query.user, query.num)
+
+    def batch_predict(self, model: ALSModel, queries) -> List[Tuple[int, PredictedResult]]:
+        return model.recommend_many(queries)
+
+
+class Serving(FirstServing):
+    """First-algorithm serving (reference Serving.scala)."""
+
+
+def recommendation_engine() -> Engine:
+    return Engine(
+        data_source_classes=DataSource,
+        preparator_classes=Preparator,
+        algorithm_classes={"als": ALSAlgorithm},
+        serving_classes=Serving,
+    )
+
+
+class RecommendationEngineFactory(EngineFactory):
+    def apply(self) -> Engine:
+        return recommendation_engine()

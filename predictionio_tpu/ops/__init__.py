@@ -1,0 +1,8 @@
+"""TPU compute kernels: ALS, NaiveBayes reductions, cosine top-N.
+
+This package is the in-tree replacement for Spark MLlib's role in the
+reference (SURVEY.md §0): the numerical algorithms engine templates call.
+Everything here is jit/shard_map-compatible JAX with static shapes —
+host-side preprocessing produces padded, bucketed arrays; device code is
+pure functional XLA programs over a `jax.sharding.Mesh`.
+"""

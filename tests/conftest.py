@@ -7,13 +7,20 @@ covered distribution only via local-mode Spark, SURVEY.md §4).
 
 import os
 
-# Must be set before jax is imported anywhere.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU even when the ambient environment pins a TPU platform plugin
+# (JAX_PLATFORMS=axon is set by the host's sitecustomize before conftest
+# runs, so jax.config.update is the reliable override) — unit tests model
+# multi-chip behavior with virtual CPU devices; bench.py is the real-TPU
+# path.
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

@@ -1,0 +1,202 @@
+"""ALS kernel tests: exact normal-equation parity vs a numpy reference,
+convergence on a synthetic low-rank matrix, implicit mode, bucketing
+edge cases, and mesh-sharded execution on the virtual 8-device CPU mesh.
+"""
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.ops.als import (
+    ALSConfig,
+    bucketize,
+    predict_ratings,
+    recommend_batch,
+    rmse,
+    train_als,
+)
+from predictionio_tpu.parallel import default_mesh
+
+
+def synthetic(n_users=60, n_items=40, k=4, density=0.4, seed=1, noise=0.0):
+    rng = np.random.default_rng(seed)
+    U = rng.standard_normal((n_users, k)) / np.sqrt(k)
+    V = rng.standard_normal((n_items, k)) / np.sqrt(k)
+    R = U @ V.T + 3.0
+    mask = rng.random((n_users, n_items)) < density
+    u, i = np.nonzero(mask)
+    r = R[u, i] + noise * rng.standard_normal(len(u))
+    return u.astype(np.int32), i.astype(np.int32), r.astype(np.float32)
+
+
+class TestBucketize:
+    def test_buckets_cover_all_ratings(self):
+        u, i, r = synthetic()
+        side = bucketize(u, i, r, 60, bucket_sizes=(4, 16, 64), pad_rows_to=8)
+        total = sum(int(b.mask.sum()) for b in side.buckets)
+        assert total == len(u)
+        for b in side.buckets:
+            assert b.rows.shape[0] % 8 == 0
+            # all real rows' data reconstructs the original per-row sets
+            for j, rid in enumerate(b.rows):
+                if rid == 60:
+                    assert b.mask[j].sum() == 0
+                    continue
+                n = int(b.mask[j].sum())
+                expect = set(i[u == rid].tolist())
+                assert set(b.cols[j, :n].tolist()) == expect
+
+    def test_huge_row_gets_oversize_bucket(self):
+        u = np.zeros(100, np.int32)
+        i = np.arange(100, dtype=np.int32)
+        r = np.ones(100, np.float32)
+        side = bucketize(u, i, r, 1, bucket_sizes=(4, 16))
+        assert len(side.buckets) == 1
+        assert side.buckets[0].cols.shape[1] >= 100
+
+    def test_empty_rows_skipped(self):
+        u = np.array([5], np.int32)
+        i = np.array([0], np.int32)
+        r = np.array([1.0], np.float32)
+        side = bucketize(u, i, r, 10, bucket_sizes=(4,))
+        assert sum(b.rows.shape[0] for b in side.buckets) >= 1
+        assert side.counts[5] == 1 and side.counts.sum() == 1
+
+
+def numpy_als_half_step(Y, u, i, r, n_users, reg, weighted):
+    """Reference explicit normal-equation solve for every user."""
+    k = Y.shape[1]
+    X = np.zeros((n_users, k), np.float32)
+    for uu in range(n_users):
+        sel = u == uu
+        if not sel.any():
+            continue
+        Ys = Y[i[sel]]
+        A = Ys.T @ Ys
+        lam = reg * sel.sum() if weighted else reg
+        A += lam * np.eye(k)
+        b = Ys.T @ r[sel]
+        X[uu] = np.linalg.solve(A, b)
+    return X
+
+
+class TestExplicitALS:
+    def test_single_half_step_matches_numpy(self):
+        u, i, r = synthetic(n_users=30, n_items=20, seed=2)
+        cfg = ALSConfig(rank=4, iterations=1, reg=0.1, bucket_sizes=(4, 16, 64))
+        model = train_als(u, i, r, 30, 20, cfg)
+        # after iter 1: X solved against Y0; recompute X from returned Y? No —
+        # instead verify the fixpoint property on a fresh solve: the returned
+        # user factors must satisfy the normal equations for the *pre-update*
+        # item factors only in a 1-iteration run if we re-derive Y0. Easier and
+        # equally strong: run 0-iteration + manual numpy comparison on the
+        # final returned factors' item-side equations.
+        Xh = numpy_als_half_step(
+            model.item_factors, u, i, r, 30, reg=0.1, weighted=True
+        )
+        # user factors were solved against the *final* item factors in the
+        # last half-step? (ordering: user then item). So instead check the
+        # item side: item factors solved against final user factors.
+        Yh = numpy_als_half_step(
+            model.user_factors, i, u, r, 20, reg=0.1, weighted=True
+        )
+        np.testing.assert_allclose(model.item_factors, Yh, rtol=2e-3, atol=2e-4)
+
+    def test_converges_on_low_rank_matrix(self):
+        u, i, r = synthetic(n_users=80, n_items=50, k=4, density=0.5)
+        cfg = ALSConfig(rank=8, iterations=12, reg=0.01)
+        model = train_als(u, i, r, 80, 50, cfg)
+        assert rmse(model, u, i, r) < 0.08
+
+    def test_plain_reg_mode(self):
+        u, i, r = synthetic(n_users=30, n_items=20)
+        cfg = ALSConfig(rank=4, iterations=3, reg=0.05, reg_mode="plain")
+        model = train_als(u, i, r, 30, 20, cfg)
+        Yh = numpy_als_half_step(
+            model.user_factors, i, u, r, 20, reg=0.05, weighted=False
+        )
+        np.testing.assert_allclose(model.item_factors, Yh, rtol=2e-3, atol=2e-4)
+
+    def test_deterministic_given_seed(self):
+        u, i, r = synthetic()
+        cfg = ALSConfig(rank=4, iterations=2, seed=42)
+        m1 = train_als(u, i, r, 60, 40, cfg)
+        m2 = train_als(u, i, r, 60, 40, cfg)
+        np.testing.assert_array_equal(m1.user_factors, m2.user_factors)
+
+
+class TestImplicitALS:
+    def test_implicit_fits_preferences(self):
+        rng = np.random.default_rng(3)
+        n_users, n_items = 50, 30
+        # two user groups preferring two item groups
+        u_list, i_list, c_list = [], [], []
+        for uu in range(n_users):
+            group = uu % 2
+            items = rng.choice(
+                np.arange(group * 15, group * 15 + 15), size=8, replace=False
+            )
+            for it in items:
+                u_list.append(uu)
+                i_list.append(it)
+                c_list.append(rng.integers(1, 5))
+        u = np.array(u_list, np.int32)
+        i = np.array(i_list, np.int32)
+        r = np.array(c_list, np.float32)
+        cfg = ALSConfig(rank=8, iterations=8, reg=0.01, alpha=2.0, implicit_prefs=True)
+        model = train_als(u, i, r, n_users, n_items, cfg)
+        # predicted preference for observed pairs should beat cross-group items
+        pred_obs = predict_ratings(model, u, i).mean()
+        cross_i = (i + 15) % 30
+        pred_cross = predict_ratings(model, u, cross_i).mean()
+        assert pred_obs > 0.5
+        assert pred_obs > pred_cross + 0.3
+
+    def test_implicit_normal_equations(self):
+        u, i, r = synthetic(n_users=25, n_items=15, density=0.3)
+        r = np.abs(r)
+        cfg = ALSConfig(
+            rank=4, iterations=2, reg=0.1, alpha=1.5, implicit_prefs=True,
+            reg_mode="plain",
+        )
+        model = train_als(u, i, r, 25, 15, cfg)
+        X, Y = model.user_factors, model.item_factors
+        k = 4
+        G = X.T @ X
+        for it in range(15):
+            sel = i == it
+            if not sel.any():
+                continue
+            Xs = X[u[sel]]
+            w = 1.5 * r[sel]
+            A = G + (Xs * w[:, None]).T @ Xs + 0.1 * np.eye(k)
+            b = (Xs * (1 + w)[:, None]).sum(0)
+            np.testing.assert_allclose(Y[it], np.linalg.solve(A, b), rtol=2e-3, atol=2e-4)
+
+
+class TestMeshALS:
+    def test_sharded_training_matches_single_device(self):
+        u, i, r = synthetic(n_users=64, n_items=40)
+        cfg = ALSConfig(rank=4, iterations=3, reg=0.05)
+        single = train_als(u, i, r, 64, 40, cfg)
+        mesh = default_mesh("data")
+        assert mesh.shape["data"] == 8
+        sharded = train_als(u, i, r, 64, 40, cfg, mesh=mesh)
+        np.testing.assert_allclose(
+            single.user_factors, sharded.user_factors, rtol=1e-4, atol=1e-5
+        )
+        np.testing.assert_allclose(
+            single.item_factors, sharded.item_factors, rtol=1e-4, atol=1e-5
+        )
+
+
+class TestServingOps:
+    def test_recommend_batch_topn(self):
+        u, i, r = synthetic(n_users=20, n_items=30)
+        cfg = ALSConfig(rank=4, iterations=4)
+        model = train_als(u, i, r, 20, 30, cfg)
+        scores, idx = recommend_batch(model.user_factors[:5], model.item_factors, 7)
+        assert scores.shape == (5, 7) and idx.shape == (5, 7)
+        # scores descending, and they match the factors' dot products
+        assert (np.diff(scores, axis=1) <= 1e-6).all()
+        full = model.user_factors[:5] @ model.item_factors.T
+        np.testing.assert_allclose(scores[:, 0], full.max(axis=1), rtol=1e-5)
