@@ -1,0 +1,17 @@
+"""REST API layer: event server, stats, plugins.
+
+The reference implements these as spray/akka actor systems
+(data/src/main/scala/io/prediction/data/api/); here each service is a pure
+request-handling core (`EventAPI`) — directly unit-testable, mirroring the
+reference's spray-testkit route tests — wrapped by a stdlib threading HTTP
+server for deployment. Ingestion is host-side work and never touches the
+TPU; the store layer hands accumulated events to device-bound columnar
+batches at training time.
+"""
+
+from predictionio_tpu.api.event_server import (  # noqa: F401
+    EventAPI,
+    EventServer,
+    EventServerConfig,
+)
+from predictionio_tpu.api.stats import Stats, StatsTracker  # noqa: F401

@@ -1,0 +1,396 @@
+"""The Event Server: REST event collection on :7070.
+
+Capability parity with the reference EventServer
+(data/src/main/scala/io/prediction/data/api/EventServer.scala:50-531):
+
+  GET    /                      -> {"status": "alive"}
+  GET    /plugins.json          -> registered plugin descriptions
+  GET    /plugins/<type>/<name>/... -> plugin REST handler (auth)
+  POST   /events.json           -> insert one event, 201 {"eventId"}
+  GET    /events.json           -> batch query (9 filters, default limit 20)
+  GET    /events/<id>.json      -> one event or 404
+  DELETE /events/<id>.json      -> {"message": "Found"} or 404
+  GET    /stats.json            -> ingestion stats (requires stats=True)
+  POST   /webhooks/<name>.json  -> JSON connector -> insert, 201
+  GET    /webhooks/<name>.json  -> connector existence check
+  POST   /webhooks/<name>       -> form connector -> insert, 201
+  GET    /webhooks/<name>       -> connector existence check
+
+Auth matches the reference (EventServer.scala:81-107): every data route
+requires ?accessKey=...; an unknown key is 401, a missing key 401, an
+invalid ?channel= name 400. The spray/akka actor stack is replaced by a
+pure request core (`EventAPI.handle`) — unit-testable exactly like the
+reference's spray-testkit route specs — plus a `ThreadingHTTPServer`
+adapter (`EventServer`). Ingestion is purely host-side; the TPU only sees
+event data later, as columnar batches from the store layer.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional, Tuple
+
+from predictionio_tpu.data.event import (
+    Event,
+    EventValidationError,
+    parse_iso8601,
+)
+from predictionio_tpu.data.storage import Storage, get_storage
+from predictionio_tpu.data.storage.base import UNSET
+from predictionio_tpu.data.webhooks import (
+    ConnectorException,
+    to_event,
+)
+from predictionio_tpu.data.webhooks.mailchimp import MailChimpConnector
+from predictionio_tpu.data.webhooks.segmentio import SegmentIOConnector
+from predictionio_tpu.api.plugins import EventServerPlugin, EventServerPluginContext
+from predictionio_tpu.api.stats import StatsTracker
+
+logger = logging.getLogger(__name__)
+
+# reference WebhooksConnectors.scala:26-34
+JSON_CONNECTORS = {"segmentio": SegmentIOConnector()}
+FORM_CONNECTORS = {"mailchimp": MailChimpConnector()}
+
+DEFAULT_LIMIT = 20  # reference EventServer.scala:307
+
+
+@dataclasses.dataclass
+class EventServerConfig:
+    """Reference EventServerConfig (EventServer.scala:496-500)."""
+
+    ip: str = "localhost"
+    port: int = 7070
+    plugins: str = "plugins"
+    stats: bool = False
+
+
+class Response(Tuple[int, Any]):
+    pass
+
+
+def _message(status: int, message: str) -> Tuple[int, dict]:
+    return status, {"message": message}
+
+
+class EventAPI:
+    """Transport-independent request core for the event server."""
+
+    def __init__(
+        self,
+        storage: Optional[Storage] = None,
+        config: Optional[EventServerConfig] = None,
+        plugin_context: Optional[EventServerPluginContext] = None,
+    ):
+        self.storage = storage or get_storage()
+        self.config = config or EventServerConfig()
+        self.plugin_context = plugin_context or EventServerPluginContext()
+        self.stats = StatsTracker()
+        self._events = self.storage.get_l_events()
+        self._access_keys = self.storage.get_meta_data_access_keys()
+        self._channels = self.storage.get_meta_data_channels()
+
+    # --- auth (reference withAccessKey, EventServer.scala:81-107) ---
+
+    def _authenticate(
+        self, query: Dict[str, str]
+    ) -> Tuple[Optional[Tuple[int, Optional[int]]], Optional[Tuple[int, Any]]]:
+        """Returns ((app_id, channel_id), None) or (None, error_response)."""
+        key = query.get("accessKey")
+        if not key:
+            return None, _message(401, "Missing accessKey.")
+        access_key = self._access_keys.get(key)
+        if access_key is None:
+            return None, _message(401, "Invalid accessKey.")
+        channel_name = query.get("channel")
+        if channel_name is None:
+            return (access_key.appid, None), None
+        channels = self._channels.get_by_app_id(access_key.appid)
+        for c in channels:
+            if c.name == channel_name:
+                return (access_key.appid, c.id), None
+        return None, _message(400, f"Invalid channel '{channel_name}'.")
+
+    # --- dispatch ---
+
+    def handle(
+        self,
+        method: str,
+        path: str,
+        query: Optional[Dict[str, str]] = None,
+        body: Optional[bytes] = None,
+        form: Optional[Dict[str, str]] = None,
+    ) -> Tuple[int, Any]:
+        """Route one request; returns (status, json-compatible payload)."""
+        query = query or {}
+        try:
+            return self._route(method, path, query, body, form)
+        except Exception as e:  # reference Common.exceptionHandler
+            logger.exception("internal error handling %s %s", method, path)
+            return _message(500, str(e))
+
+    def _route(self, method, path, query, body, form) -> Tuple[int, Any]:
+        parts = [p for p in path.strip("/").split("/") if p]
+
+        if not parts:
+            if method == "GET":
+                return 200, {"status": "alive"}
+            return _message(405, "Method not allowed.")
+
+        if path == "/plugins.json" and method == "GET":
+            return 200, self.plugin_context.describe()
+
+        if parts[0] == "plugins" and len(parts) >= 3 and method == "GET":
+            auth, err = self._authenticate(query)
+            if err:
+                return err
+            app_id, channel_id = auth
+            plugin_type, plugin_name, args = parts[1], parts[2], parts[3:]
+            table = (
+                self.plugin_context.input_blockers
+                if plugin_type == EventServerPlugin.INPUT_BLOCKER
+                else self.plugin_context.input_sniffers
+            )
+            if plugin_name not in table:
+                return _message(404, f"Plugin {plugin_name} not found.")
+            return 200, table[plugin_name].handle_rest(app_id, channel_id, args)
+
+        if path == "/events.json":
+            auth, err = self._authenticate(query)
+            if err:
+                return err
+            app_id, channel_id = auth
+            if method == "POST":
+                return self._post_event(app_id, channel_id, body)
+            if method == "GET":
+                return self._find_events(app_id, channel_id, query)
+            return _message(405, "Method not allowed.")
+
+        if parts[0] == "events" and len(parts) == 2 and parts[1].endswith(".json"):
+            auth, err = self._authenticate(query)
+            if err:
+                return err
+            app_id, channel_id = auth
+            event_id = urllib.parse.unquote(parts[1][: -len(".json")])
+            if method == "GET":
+                event = self._events.get(event_id, app_id, channel_id)
+                if event is None:
+                    return _message(404, "Not Found")
+                return 200, event.to_json()
+            if method == "DELETE":
+                found = self._events.delete(event_id, app_id, channel_id)
+                return (
+                    (200, {"message": "Found"})
+                    if found
+                    else _message(404, "Not Found")
+                )
+            return _message(405, "Method not allowed.")
+
+        if path == "/stats.json" and method == "GET":
+            auth, err = self._authenticate(query)
+            if err:
+                return err
+            app_id, _ = auth
+            if not self.config.stats:
+                return _message(
+                    404,
+                    "To see stats, launch Event Server with --stats argument.",
+                )
+            return 200, self.stats.get(app_id)
+
+        if parts[0] == "webhooks" and len(parts) == 2:
+            auth, err = self._authenticate(query)
+            if err:
+                return err
+            app_id, channel_id = auth
+            name = parts[1]
+            if name.endswith(".json"):
+                return self._webhook_json(
+                    app_id, channel_id, name[: -len(".json")], method, body
+                )
+            return self._webhook_form(app_id, channel_id, name, method, form)
+
+        return _message(404, "Not Found")
+
+    # --- event handlers ---
+
+    def _insert(self, app_id, channel_id, event: Event) -> Tuple[int, Any]:
+        event_id = self._events.insert(event, app_id, channel_id)
+        self.plugin_context.notify_sniffers(app_id, channel_id, event)
+        result = (201, {"eventId": event_id})
+        if self.config.stats:
+            self.stats.bookkeeping(app_id, result[0], event)
+        return result
+
+    def _post_event(self, app_id, channel_id, body) -> Tuple[int, Any]:
+        try:
+            payload = json.loads((body or b"").decode("utf-8"))
+            event = Event.from_json(payload)
+        except (json.JSONDecodeError, UnicodeDecodeError, EventValidationError) as e:
+            return _message(400, str(e))
+        try:
+            self.plugin_context.run_blockers(app_id, channel_id, event)
+        except Exception as e:  # an input blocker rejected the event
+            return _message(403, str(e))
+        return self._insert(app_id, channel_id, event)
+
+    def _find_events(self, app_id, channel_id, query) -> Tuple[int, Any]:
+        try:
+            start_time = (
+                parse_iso8601(query["startTime"]) if "startTime" in query else None
+            )
+            until_time = (
+                parse_iso8601(query["untilTime"]) if "untilTime" in query else None
+            )
+            limit = int(query.get("limit", DEFAULT_LIMIT))
+            reversed_ = query.get("reversed", "false").lower() == "true"
+        except (ValueError, TypeError) as e:
+            return _message(400, str(e))
+        event_name = query.get("event")
+        events = list(
+            self._events.find(
+                app_id=app_id,
+                channel_id=channel_id,
+                start_time=start_time,
+                until_time=until_time,
+                entity_type=query.get("entityType"),
+                entity_id=query.get("entityId"),
+                event_names=[event_name] if event_name else None,
+                target_entity_type=query.get("targetEntityType", UNSET),
+                target_entity_id=query.get("targetEntityId", UNSET),
+                limit=None if limit == -1 else limit,
+                reversed=reversed_,
+            )
+        )
+        if not events:
+            return _message(404, "Not Found")
+        return 200, [e.to_json() for e in events]
+
+    # --- webhooks (reference api/Webhooks.scala:43-151) ---
+
+    def _webhook_json(
+        self, app_id, channel_id, web, method, body
+    ) -> Tuple[int, Any]:
+        connector = JSON_CONNECTORS.get(web)
+        if connector is None:
+            return _message(404, f"webhooks connection for {web} is not supported.")
+        if method == "GET":
+            return 200, {"message": "Ok"}
+        if method != "POST":
+            return _message(405, "Method not allowed.")
+        try:
+            payload = json.loads((body or b"").decode("utf-8"))
+            event = to_event(connector, payload)
+        except (
+            json.JSONDecodeError,
+            UnicodeDecodeError,
+            ConnectorException,
+            EventValidationError,
+        ) as e:
+            return _message(400, str(e))
+        return self._insert(app_id, channel_id, event)
+
+    def _webhook_form(
+        self, app_id, channel_id, web, method, form
+    ) -> Tuple[int, Any]:
+        connector = FORM_CONNECTORS.get(web)
+        if connector is None:
+            return _message(404, f"webhooks connection for {web} is not supported.")
+        if method == "GET":
+            return 200, {"message": "Ok"}
+        if method != "POST":
+            return _message(405, "Method not allowed.")
+        try:
+            event = to_event(connector, form or {})
+        except (ConnectorException, EventValidationError) as e:
+            return _message(400, str(e))
+        return self._insert(app_id, channel_id, event)
+
+
+class _Handler(BaseHTTPRequestHandler):
+    api: EventAPI  # set by server factory
+
+    def _dispatch(self, method: str) -> None:
+        parsed = urllib.parse.urlsplit(self.path)
+        query = dict(urllib.parse.parse_qsl(parsed.query))
+        length = int(self.headers.get("Content-Length") or 0)
+        body = self.rfile.read(length) if length else b""
+        form = None
+        ctype = (self.headers.get("Content-Type") or "").split(";")[0].strip()
+        if ctype == "application/x-www-form-urlencoded":
+            form = dict(urllib.parse.parse_qsl(body.decode("utf-8")))
+            body = b""
+        status, payload = self.api.handle(
+            method, parsed.path, query, body, form
+        )
+        data = json.dumps(payload).encode("utf-8")
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json; charset=utf-8")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_GET(self):  # noqa: N802
+        self._dispatch("GET")
+
+    def do_POST(self):  # noqa: N802
+        self._dispatch("POST")
+
+    def do_DELETE(self):  # noqa: N802
+        self._dispatch("DELETE")
+
+    def log_message(self, fmt, *args):  # route access logs to logging
+        logger.debug("%s - %s", self.address_string(), fmt % args)
+
+
+class EventServer:
+    """HTTP wrapper (reference EventServerActor + Run, EventServer.scala:471-531)."""
+
+    def __init__(
+        self,
+        storage: Optional[Storage] = None,
+        config: Optional[EventServerConfig] = None,
+        plugin_context: Optional[EventServerPluginContext] = None,
+    ):
+        self.config = config or EventServerConfig()
+        self.api = EventAPI(storage, self.config, plugin_context)
+        handler = type("BoundHandler", (_Handler,), {"api": self.api})
+        self.httpd = ThreadingHTTPServer(
+            (self.config.ip, self.config.port), handler
+        )
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self.httpd.server_address[1]
+
+    def start(self) -> "EventServer":
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, daemon=True
+        )
+        self._thread.start()
+        logger.info("Event Server listening on %s:%d", self.config.ip, self.port)
+        return self
+
+    def serve_forever(self) -> None:
+        logger.info("Event Server listening on %s:%d", self.config.ip, self.port)
+        self.httpd.serve_forever()
+
+    def shutdown(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+
+def create_event_server(
+    config: Optional[EventServerConfig] = None,
+    storage: Optional[Storage] = None,
+) -> EventServer:
+    """Reference EventServer.createEventServer (EventServer.scala:502-522)."""
+    return EventServer(storage=storage, config=config)

@@ -1,0 +1,126 @@
+"""Event-server plugin framework.
+
+Parity with the reference plugin surface
+(data/src/main/scala/io/prediction/data/api/EventServerPlugin.scala:20-33,
+EventServerPluginContext.scala:26-49, PluginsActor.scala:26-52): plugins
+are either *input blockers* (run synchronously on the ingestion path and
+may reject an event by raising) or *input sniffers* (observe events
+asynchronously). The reference discovers plugins with
+``java.util.ServiceLoader``; the Python equivalent is explicit
+registration on the context (or ``EventServerPluginContext.discover()``
+over subclass registries).
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+from typing import Dict, List, Optional, Sequence
+
+from predictionio_tpu.data.event import Event
+
+logger = logging.getLogger(__name__)
+
+
+class EventServerPlugin:
+    """Base plugin (reference EventServerPlugin.scala:20-33)."""
+
+    INPUT_BLOCKER = "inputblocker"
+    INPUT_SNIFFER = "inputsniffer"
+
+    plugin_name: str = "plugin"
+    plugin_description: str = ""
+    plugin_type: str = INPUT_SNIFFER
+
+    def process(
+        self, app_id: int, channel_id: Optional[int], event: Event, context
+    ) -> None:
+        """Blockers raise to reject the event; sniffers observe."""
+
+    def handle_rest(
+        self, app_id: int, channel_id: Optional[int], args: Sequence[str]
+    ) -> dict:
+        """Serve GET /plugins/<type>/<name>/... (reference handleREST)."""
+        return {}
+
+
+class EventServerPluginContext:
+    """Holds registered plugins split by type; sniffers run on a daemon
+    worker thread (the reference's PluginsActor mailbox)."""
+
+    def __init__(self, plugins: Sequence[EventServerPlugin] = ()):
+        self.input_blockers: Dict[str, EventServerPlugin] = {}
+        self.input_sniffers: Dict[str, EventServerPlugin] = {}
+        for p in plugins:
+            self.register(p)
+        self._queue: "queue.Queue" = queue.Queue()
+        self._worker: Optional[threading.Thread] = None
+
+    @classmethod
+    def discover(cls) -> "EventServerPluginContext":
+        """Instantiate every concrete EventServerPlugin subclass — the
+        Python stand-in for ServiceLoader discovery."""
+        plugins: List[EventServerPlugin] = []
+        for sub in EventServerPlugin.__subclasses__():
+            try:
+                plugins.append(sub())
+            except Exception:  # abstract/partial subclasses are skipped
+                logger.exception("plugin %s failed to instantiate", sub)
+        return cls(plugins)
+
+    def register(self, plugin: EventServerPlugin) -> None:
+        if plugin.plugin_type == EventServerPlugin.INPUT_BLOCKER:
+            self.input_blockers[plugin.plugin_name] = plugin
+        else:
+            self.input_sniffers[plugin.plugin_name] = plugin
+
+    def describe(self) -> dict:
+        """GET /plugins.json payload (reference EventServer.scala:122-143)."""
+
+        def block(plugins: Dict[str, EventServerPlugin]) -> dict:
+            return {
+                name: {
+                    "name": p.plugin_name,
+                    "description": p.plugin_description,
+                    "class": type(p).__module__ + "." + type(p).__qualname__,
+                }
+                for name, p in plugins.items()
+            }
+
+        return {
+            "plugins": {
+                "inputblockers": block(self.input_blockers),
+                "inputsniffers": block(self.input_sniffers),
+            }
+        }
+
+    # --- ingestion-path hooks ---
+
+    def run_blockers(
+        self, app_id: int, channel_id: Optional[int], event: Event
+    ) -> None:
+        for p in self.input_blockers.values():
+            p.process(app_id, channel_id, event, self)
+
+    def notify_sniffers(
+        self, app_id: int, channel_id: Optional[int], event: Event
+    ) -> None:
+        if not self.input_sniffers:
+            return
+        self._ensure_worker()
+        self._queue.put((app_id, channel_id, event))
+
+    def _ensure_worker(self) -> None:
+        if self._worker is None or not self._worker.is_alive():
+            self._worker = threading.Thread(target=self._drain, daemon=True)
+            self._worker.start()
+
+    def _drain(self) -> None:
+        while True:
+            app_id, channel_id, event = self._queue.get()
+            for p in self.input_sniffers.values():
+                try:
+                    p.process(app_id, channel_id, event, self)
+                except Exception:
+                    logger.exception("sniffer %s failed", p.plugin_name)

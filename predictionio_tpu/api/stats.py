@@ -1,0 +1,116 @@
+"""Event-server ingestion statistics.
+
+Parity with the reference Stats/StatsActor
+(data/src/main/scala/io/prediction/data/api/Stats.scala:40-79,
+StatsActor.scala:34-74): per-app counters keyed by
+(entityType, targetEntityType, event) and by HTTP status code, kept in
+three windows — long-lived since server start, the current clock hour, and
+the previous hour (rolled over lazily on update). The actor mailbox is
+replaced by a lock; counting happens on the REST worker thread.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import datetime as _dt
+import threading
+from typing import Dict, Optional, Tuple
+
+from predictionio_tpu.data.event import Event, format_iso8601, utcnow
+
+# (entityType, targetEntityType, event) — reference EntityTypesEvent
+ETE = Tuple[str, Optional[str], str]
+
+
+def _hour_floor(t: _dt.datetime) -> _dt.datetime:
+    return t.replace(minute=0, second=0, microsecond=0)
+
+
+class Stats:
+    """One counting window (reference Stats.scala:48-79)."""
+
+    def __init__(self, start_time: _dt.datetime):
+        self.start_time = start_time
+        self.end_time: Optional[_dt.datetime] = None
+        self.status_code_count: Dict[Tuple[int, int], int] = {}
+        self.ete_count: Dict[Tuple[int, ETE], int] = {}
+
+    def cutoff(self, end_time: _dt.datetime) -> None:
+        self.end_time = end_time
+
+    def update(self, app_id: int, status_code: int, event: Event) -> None:
+        sc_key = (app_id, status_code)
+        self.status_code_count[sc_key] = self.status_code_count.get(sc_key, 0) + 1
+        ete: ETE = (event.entity_type, event.target_entity_type, event.event)
+        e_key = (app_id, ete)
+        self.ete_count[e_key] = self.ete_count.get(e_key, 0) + 1
+
+    def get(self, app_id: int) -> dict:
+        """Snapshot for one app as JSON-compatible data
+        (reference StatsSnapshot)."""
+        return {
+            "startTime": format_iso8601(self.start_time),
+            "endTime": (
+                format_iso8601(self.end_time) if self.end_time else None
+            ),
+            "basic": [
+                {
+                    "entityType": ete[0],
+                    "targetEntityType": ete[1],
+                    "event": ete[2],
+                    "count": count,
+                }
+                for (aid, ete), count in sorted(
+                    self.ete_count.items(),
+                    key=lambda kv: (kv[0][0], kv[0][1][0], kv[0][1][1] or "", kv[0][1][2]),
+                )
+                if aid == app_id
+            ],
+            "statusCode": [
+                {"code": code, "count": count}
+                for (aid, code), count in sorted(self.status_code_count.items())
+                if aid == app_id
+            ],
+        }
+
+
+@dataclasses.dataclass
+class _Windows:
+    long_live: Stats
+    hourly: Stats
+    prev_hourly: Stats
+
+
+class StatsTracker:
+    """Thread-safe three-window tracker (reference StatsActor.scala:34-74)."""
+
+    def __init__(self, now: Optional[_dt.datetime] = None):
+        now = now or utcnow()
+        hour = _hour_floor(now)
+        prev = Stats(hour - _dt.timedelta(hours=1))
+        prev.cutoff(hour)
+        self._w = _Windows(Stats(now), Stats(hour), prev)
+        self._lock = threading.Lock()
+
+    def bookkeeping(
+        self, app_id: int, status_code: int, event: Event,
+        now: Optional[_dt.datetime] = None,
+    ) -> None:
+        now = now or utcnow()
+        current = _hour_floor(now)
+        with self._lock:
+            if current != self._w.hourly.start_time:
+                self._w.prev_hourly = self._w.hourly
+                self._w.prev_hourly.cutoff(current)
+                self._w.hourly = Stats(current)
+            self._w.hourly.update(app_id, status_code, event)
+            self._w.long_live.update(app_id, status_code, event)
+
+    def get(self, app_id: int) -> dict:
+        with self._lock:
+            return {
+                "time": format_iso8601(utcnow()),
+                "currentHour": self._w.hourly.get(app_id),
+                "prevHour": self._w.prev_hourly.get(app_id),
+                "longLive": self._w.long_live.get(app_id),
+            }

@@ -1,0 +1,48 @@
+"""Webhook connector framework.
+
+Capability parity with the reference webhooks layer
+(data/src/main/scala/io/prediction/data/webhooks/): connectors translate
+third-party payloads into the canonical event-JSON shape, which is then
+parsed through the same ``Event.from_json`` path as first-party events so
+validation stays uniform (ConnectorUtil.scala:28-46 makes the same point:
+connectors may only produce event JSON, never Event objects directly).
+
+A ``JsonConnector`` receives a parsed JSON object; a ``FormConnector``
+receives a flat str->str form-field map. The dispatch table lives in
+``predictionio_tpu.api.event_server`` (reference WebhooksConnectors.scala).
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Any, Dict, Mapping
+
+from predictionio_tpu.data.event import Event
+
+
+class ConnectorException(Exception):
+    """A payload could not be translated (reference ConnectorException)."""
+
+
+class JsonConnector(abc.ABC):
+    """Translate a third-party JSON payload into event JSON
+    (reference JsonConnector.scala:24-31)."""
+
+    @abc.abstractmethod
+    def to_event_json(self, data: Mapping[str, Any]) -> Dict[str, Any]:
+        ...
+
+
+class FormConnector(abc.ABC):
+    """Translate form-encoded fields into event JSON
+    (reference FormConnector.scala:24-32)."""
+
+    @abc.abstractmethod
+    def to_event_json(self, data: Mapping[str, str]) -> Dict[str, Any]:
+        ...
+
+
+def to_event(connector, data) -> Event:
+    """Connector payload -> Event, via the canonical JSON parse + validation
+    (reference ConnectorUtil.toEvent, ConnectorUtil.scala:38-45)."""
+    return Event.from_json(connector.to_event_json(data), validate=True)

@@ -1,0 +1,172 @@
+"""MailChimp webhook connector (form-encoded payloads).
+
+Parity with the reference MailChimpConnector
+(data/src/main/scala/io/prediction/data/webhooks/mailchimp/MailChimpConnector.scala):
+the six MailChimp webhook types map to events as
+
+  subscribe / unsubscribe / profile : user -> list, merge fields in props
+  upemail                           : user (new_id) -> list, old/new email
+  cleaned                           : entity = the list, campaign/reason/email
+  campaign                          : campaign -> list, subject/status/reason
+
+MailChimp timestamps ("fired_at") are "YYYY-MM-DD HH:MM:SS" in UTC and are
+rewritten to ISO8601 for the canonical event JSON.
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+from typing import Any, Dict, Mapping
+
+from predictionio_tpu.data.event import format_iso8601
+from predictionio_tpu.data.webhooks import ConnectorException, FormConnector
+
+
+def _fired_at_iso(data: Mapping[str, str]) -> str:
+    raw = _require(data, "fired_at")
+    try:
+        t = _dt.datetime.strptime(raw, "%Y-%m-%d %H:%M:%S").replace(
+            tzinfo=_dt.timezone.utc
+        )
+    except ValueError as e:
+        raise ConnectorException(
+            f"fired_at {raw!r} is not 'YYYY-MM-DD HH:MM:SS'"
+        ) from e
+    return format_iso8601(t)
+
+
+def _require(data: Mapping[str, str], key: str) -> str:
+    if key not in data:
+        raise ConnectorException(
+            f"The field '{key}' is required for MailChimp data."
+        )
+    return data[key]
+
+
+def _merges(data: Mapping[str, str]) -> Dict[str, Any]:
+    out: Dict[str, Any] = {
+        "EMAIL": _require(data, "data[merges][EMAIL]"),
+        "FNAME": _require(data, "data[merges][FNAME]"),
+        "LNAME": _require(data, "data[merges][LNAME]"),
+    }
+    interests = data.get("data[merges][INTERESTS]")
+    if interests is not None:
+        out["INTERESTS"] = interests
+    return out
+
+
+class MailChimpConnector(FormConnector):
+    def to_event_json(self, data: Mapping[str, str]) -> Dict[str, Any]:
+        handlers = {
+            "subscribe": self._subscribe,
+            "unsubscribe": self._unsubscribe,
+            "profile": self._profile,
+            "upemail": self._upemail,
+            "cleaned": self._cleaned,
+            "campaign": self._campaign,
+        }
+        msg_type = data.get("type")
+        if msg_type is None:
+            raise ConnectorException(
+                "The field 'type' is required for MailChimp data."
+            )
+        handler = handlers.get(msg_type)
+        if handler is None:
+            raise ConnectorException(
+                f"Cannot convert unknown MailChimp data type {msg_type} to event JSON"
+            )
+        return handler(data)
+
+    def _subscribe(self, d: Mapping[str, str]) -> Dict[str, Any]:
+        return {
+            "event": "subscribe",
+            "entityType": "user",
+            "entityId": _require(d, "data[id]"),
+            "targetEntityType": "list",
+            "targetEntityId": _require(d, "data[list_id]"),
+            "eventTime": _fired_at_iso(d),
+            "properties": {
+                "email": _require(d, "data[email]"),
+                "email_type": _require(d, "data[email_type]"),
+                "merges": _merges(d),
+                "ip_opt": _require(d, "data[ip_opt]"),
+                "ip_signup": _require(d, "data[ip_signup]"),
+            },
+        }
+
+    def _unsubscribe(self, d: Mapping[str, str]) -> Dict[str, Any]:
+        return {
+            "event": "unsubscribe",
+            "entityType": "user",
+            "entityId": _require(d, "data[id]"),
+            "targetEntityType": "list",
+            "targetEntityId": _require(d, "data[list_id]"),
+            "eventTime": _fired_at_iso(d),
+            "properties": {
+                "action": _require(d, "data[action]"),
+                "reason": _require(d, "data[reason]"),
+                "email": _require(d, "data[email]"),
+                "email_type": _require(d, "data[email_type]"),
+                "merges": _merges(d),
+                "ip_opt": _require(d, "data[ip_opt]"),
+                "campaign_id": _require(d, "data[campaign_id]"),
+            },
+        }
+
+    def _profile(self, d: Mapping[str, str]) -> Dict[str, Any]:
+        return {
+            "event": "profile",
+            "entityType": "user",
+            "entityId": _require(d, "data[id]"),
+            "targetEntityType": "list",
+            "targetEntityId": _require(d, "data[list_id]"),
+            "eventTime": _fired_at_iso(d),
+            "properties": {
+                "email": _require(d, "data[email]"),
+                "email_type": _require(d, "data[email_type]"),
+                "merges": _merges(d),
+                "ip_opt": _require(d, "data[ip_opt]"),
+            },
+        }
+
+    def _upemail(self, d: Mapping[str, str]) -> Dict[str, Any]:
+        return {
+            "event": "upemail",
+            "entityType": "user",
+            "entityId": _require(d, "data[new_id]"),
+            "targetEntityType": "list",
+            "targetEntityId": _require(d, "data[list_id]"),
+            "eventTime": _fired_at_iso(d),
+            "properties": {
+                "new_email": _require(d, "data[new_email]"),
+                "old_email": _require(d, "data[old_email]"),
+            },
+        }
+
+    def _cleaned(self, d: Mapping[str, str]) -> Dict[str, Any]:
+        return {
+            "event": "cleaned",
+            "entityType": "list",
+            "entityId": _require(d, "data[list_id]"),
+            "eventTime": _fired_at_iso(d),
+            "properties": {
+                "campaignId": _require(d, "data[campaign_id]"),
+                "reason": _require(d, "data[reason]"),
+                "email": _require(d, "data[email]"),
+            },
+        }
+
+    def _campaign(self, d: Mapping[str, str]) -> Dict[str, Any]:
+        return {
+            "event": "campaign",
+            "entityType": "campaign",
+            "entityId": _require(d, "data[id]"),
+            "targetEntityType": "list",
+            "targetEntityId": _require(d, "data[list_id]"),
+            "eventTime": _fired_at_iso(d),
+            "properties": {
+                "subject": _require(d, "data[subject]"),
+                "status": _require(d, "data[status]"),
+                "reason": _require(d, "data[reason]"),
+            },
+        }

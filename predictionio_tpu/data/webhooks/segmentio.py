@@ -1,0 +1,65 @@
+"""Segment.io webhook connector.
+
+Parity with the reference SegmentIOConnector
+(data/src/main/scala/io/prediction/data/webhooks/segmentio/SegmentIOConnector.scala:26-80):
+the six Segment spec message types (identify / track / alias / page /
+screen / group) become events named after the message type, with
+``entityType: "user"`` and the ``userId`` (or ``anonymousId``) as the
+entity id; type-specific payload fields land in ``properties``, with the
+optional ``context`` object merged alongside them.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Mapping
+
+from predictionio_tpu.data.webhooks import ConnectorException, JsonConnector
+
+# per message type: payload fields copied into event properties
+_TYPE_FIELDS = {
+    "identify": ("traits",),
+    "track": ("properties", "event"),
+    "alias": ("previousId",),
+    "page": ("name", "properties"),
+    "screen": ("name", "properties"),
+    "group": ("groupId", "traits"),
+}
+
+
+class SegmentIOConnector(JsonConnector):
+    def to_event_json(self, data: Mapping[str, Any]) -> Dict[str, Any]:
+        msg_type = data.get("type")
+        if msg_type is None:
+            raise ConnectorException(
+                "Cannot extract the message type from the Segment.io payload."
+            )
+        if msg_type not in _TYPE_FIELDS:
+            raise ConnectorException(
+                f"Cannot convert unknown type {msg_type} to event JSON."
+            )
+        user_id = data.get("userId") or data.get("anonymousId")
+        if not user_id:
+            raise ConnectorException(
+                "there was no `userId` or `anonymousId` in the common fields."
+            )
+        timestamp = data.get("timestamp")
+        if timestamp is None:
+            raise ConnectorException(
+                "there was no `timestamp` in the common fields."
+            )
+
+        properties: Dict[str, Any] = {}
+        context = data.get("context")
+        if context is not None:
+            properties["context"] = context
+        for field in _TYPE_FIELDS[msg_type]:
+            if data.get(field) is not None:
+                properties[field] = data[field]
+
+        return {
+            "event": msg_type,
+            "entityType": "user",
+            "entityId": user_id,
+            "eventTime": timestamp,
+            "properties": properties,
+        }

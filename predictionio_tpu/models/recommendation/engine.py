@@ -225,10 +225,14 @@ class ALSModel:
     _serving: Optional[ServingFactors] = dataclasses.field(
         default=None, repr=False, compare=False
     )
+    _inv_item: Optional[BiMap] = dataclasses.field(
+        default=None, repr=False, compare=False
+    )
 
     def __getstate__(self):
         state = self.__dict__.copy()
         state["_serving"] = None
+        state["_inv_item"] = None
         return state
 
     @property
@@ -262,7 +266,10 @@ class ALSModel:
         scores, idx = self.serving.topn_by_user(
             [u for _, u, _ in known], max_num
         )
-        inv_item = self.item_index.inverse()
+        # the inverse index is catalog-sized — build it once, not per request
+        if self._inv_item is None:
+            self._inv_item = self.item_index.inverse()
+        inv_item = self._inv_item
         out = list(unknown)
         for row, (qx, _, num) in enumerate(known):
             item_scores = tuple(

@@ -39,6 +39,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from predictionio_tpu.parallel.mesh import pad_to_multiple
+
 logger = logging.getLogger(__name__)
 
 
@@ -122,7 +124,7 @@ def bucketize(
         if not rids:
             continue
         n = len(rids)
-        n_pad = ((n + pad_rows_to - 1) // pad_rows_to) * pad_rows_to
+        n_pad = pad_to_multiple(n, pad_rows_to)
         b_rows = np.full(n_pad, n_rows, dtype=np.int32)
         b_cols = np.zeros((n_pad, L), dtype=np.int32)
         b_vals = np.zeros((n_pad, L), dtype=np.float32)
@@ -266,7 +268,7 @@ def train_als(
     def padded_rows(n: int) -> int:
         # +1 sentinel row for bucket padding, rounded up so the row dim
         # shards evenly over the mesh
-        return ((n + 1 + n_shards - 1) // n_shards) * n_shards
+        return pad_to_multiple(n + 1, n_shards)
 
     # MLlib-style init: nonnegative scaled normals on the item side;
     # sentinel/padding rows zero
@@ -351,8 +353,11 @@ def _topn_packed(factors_q, Y, n):
     scores = jnp.dot(factors_q, Y.T, preferred_element_type=jnp.float32)
     s, i = jax.lax.top_k(scores, n)  # [B, n] each — one MXU matmul + top_k
     # pack scores+indices into ONE buffer: device->host fetches cost a
-    # round trip per buffer (painfully so through relayed test rigs)
-    return jnp.concatenate([s, i.astype(jnp.float32)], axis=1)
+    # round trip per buffer (painfully so through relayed test rigs).
+    # Indices travel as raw int32 bits, not a float cast — a cast would
+    # corrupt ids >= 2^24 (float32 mantissa) on large catalogs.
+    i_bits = jax.lax.bitcast_convert_type(i, jnp.float32)
+    return jnp.concatenate([s, i_bits], axis=1)
 
 
 class ServingFactors:
@@ -372,7 +377,8 @@ class ServingFactors:
         """Top-N for explicit query factor rows [B, k]."""
         q = jax.device_put(np.asarray(user_rows, np.float32))
         packed = np.asarray(_topn_packed(q, self._if_dev, n))
-        return packed[:, :n], packed[:, n:].astype(np.int32)
+        idx = np.ascontiguousarray(packed[:, n:]).view(np.int32)
+        return packed[:, :n], idx
 
     def topn_by_user(self, user_ids: Sequence[int], n: int):
         """Top-N for known user indices (gathers rows host-side; the row

@@ -188,6 +188,19 @@ class TestMeshALS:
             single.item_factors, sharded.item_factors, rtol=1e-4, atol=1e-5
         )
 
+    def test_sharded_implicit_matches_single_device(self):
+        # exercises the sharded Gramian all-reduce (psum over the mesh axis)
+        u, i, r = synthetic(n_users=64, n_items=40)
+        cfg = ALSConfig(rank=4, iterations=3, reg=0.05, implicit_prefs=True)
+        single = train_als(u, i, r, 64, 40, cfg)
+        sharded = train_als(u, i, r, 64, 40, cfg, mesh=default_mesh("data"))
+        np.testing.assert_allclose(
+            single.user_factors, sharded.user_factors, rtol=1e-4, atol=1e-5
+        )
+        np.testing.assert_allclose(
+            single.item_factors, sharded.item_factors, rtol=1e-4, atol=1e-5
+        )
+
 
 class TestServingOps:
     def test_recommend_batch_topn(self):

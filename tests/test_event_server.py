@@ -1,0 +1,502 @@
+"""Event Server REST tests — the analog of the reference's spray-testkit
+route specs (EventServiceSpec.scala) plus webhook connector specs
+(data/src/test/.../webhooks/*Spec.scala)."""
+
+import datetime as dt
+import json
+import urllib.request
+
+import pytest
+
+from predictionio_tpu.api.event_server import (
+    EventAPI,
+    EventServer,
+    EventServerConfig,
+)
+from predictionio_tpu.api.plugins import (
+    EventServerPlugin,
+    EventServerPluginContext,
+)
+from predictionio_tpu.api.stats import StatsTracker
+from predictionio_tpu.data.event import Event
+from predictionio_tpu.data.storage.base import AccessKey, App, Channel
+from predictionio_tpu.data.webhooks import ConnectorException, to_event
+from predictionio_tpu.data.webhooks.mailchimp import MailChimpConnector
+from predictionio_tpu.data.webhooks.segmentio import SegmentIOConnector
+
+
+@pytest.fixture()
+def api(mem_storage):
+    apps = mem_storage.get_meta_data_apps()
+    app_id = apps.insert(App(id=0, name="testapp"))
+    keys = mem_storage.get_meta_data_access_keys()
+    keys.insert(AccessKey(key="secret", appid=app_id, events=()))
+    channels = mem_storage.get_meta_data_channels()
+    channel_id = channels.insert(Channel(id=0, name="mobile", appid=app_id))
+    mem_storage.get_l_events().init(app_id)
+    mem_storage.get_l_events().init(app_id, channel_id)
+    return EventAPI(storage=mem_storage)
+
+
+def post_event(api, payload, **query):
+    query.setdefault("accessKey", "secret")
+    return api.handle(
+        "POST", "/events.json", query, json.dumps(payload).encode()
+    )
+
+
+EVENT = {
+    "event": "rate",
+    "entityType": "user",
+    "entityId": "u1",
+    "targetEntityType": "item",
+    "targetEntityId": "i1",
+    "properties": {"rating": 4.5},
+    "eventTime": "2026-07-01T12:00:00.000Z",
+}
+
+
+class TestAuth:
+    def test_root_is_public(self, api):
+        assert api.handle("GET", "/") == (200, {"status": "alive"})
+
+    def test_missing_key_401(self, api):
+        status, body = api.handle("POST", "/events.json", {}, b"{}")
+        assert status == 401
+
+    def test_wrong_key_401(self, api):
+        status, _ = post_event(api, EVENT, accessKey="nope")
+        assert status == 401
+
+    def test_invalid_channel_400(self, api):
+        status, body = post_event(api, EVENT, channel="nochannel")
+        assert status == 400
+        assert "Invalid channel" in body["message"]
+
+    def test_valid_channel(self, api):
+        status, body = post_event(api, EVENT, channel="mobile")
+        assert status == 201
+
+
+class TestEventCrud:
+    def test_post_returns_201_with_event_id(self, api):
+        status, body = post_event(api, EVENT)
+        assert status == 201
+        assert body["eventId"]
+
+    def test_post_invalid_event_400(self, api):
+        status, _ = post_event(api, {"event": "rate"})  # no entity
+        assert status == 400
+
+    def test_post_reserved_event_400(self, api):
+        status, _ = post_event(
+            api, {"event": "$mycustom", "entityType": "user", "entityId": "x"}
+        )
+        assert status == 400
+
+    def test_get_by_id_and_delete(self, api):
+        _, body = post_event(api, EVENT)
+        eid = body["eventId"]
+        status, got = api.handle(
+            "GET", f"/events/{eid}.json", {"accessKey": "secret"}
+        )
+        assert status == 200
+        assert got["event"] == "rate"
+        assert got["properties"] == {"rating": 4.5}
+
+        status, body = api.handle(
+            "DELETE", f"/events/{eid}.json", {"accessKey": "secret"}
+        )
+        assert (status, body["message"]) == (200, "Found")
+        status, _ = api.handle(
+            "GET", f"/events/{eid}.json", {"accessKey": "secret"}
+        )
+        assert status == 404
+
+    def test_get_unknown_id_404(self, api):
+        status, _ = api.handle(
+            "GET", "/events/zzz.json", {"accessKey": "secret"}
+        )
+        assert status == 404
+
+    def test_channel_isolation(self, api):
+        post_event(api, EVENT, channel="mobile")
+        # default channel has no events
+        status, _ = api.handle("GET", "/events.json", {"accessKey": "secret"})
+        assert status == 404
+        status, body = api.handle(
+            "GET", "/events.json", {"accessKey": "secret", "channel": "mobile"}
+        )
+        assert status == 200 and len(body) == 1
+
+
+class TestBatchGet:
+    def _seed(self, api, n=30):
+        for k in range(n):
+            e = dict(EVENT)
+            e["entityId"] = f"u{k % 3}"
+            e["event"] = "rate" if k % 2 == 0 else "view"
+            e["eventTime"] = f"2026-07-01T12:00:{k:02d}.000Z"
+            post_event(api, e)
+
+    def test_default_limit_20(self, api):
+        self._seed(api, 30)
+        status, body = api.handle("GET", "/events.json", {"accessKey": "secret"})
+        assert status == 200
+        assert len(body) == 20
+
+    def test_limit_minus_one_returns_all(self, api):
+        self._seed(api, 30)
+        _, body = api.handle(
+            "GET", "/events.json", {"accessKey": "secret", "limit": "-1"}
+        )
+        assert len(body) == 30
+
+    def test_filters(self, api):
+        self._seed(api, 30)
+        _, body = api.handle(
+            "GET",
+            "/events.json",
+            {
+                "accessKey": "secret",
+                "limit": "-1",
+                "event": "view",
+                "entityId": "u1",
+            },
+        )
+        assert all(e["event"] == "view" and e["entityId"] == "u1" for e in body)
+
+    def test_time_range_and_reversed(self, api):
+        self._seed(api, 10)
+        _, body = api.handle(
+            "GET",
+            "/events.json",
+            {
+                "accessKey": "secret",
+                "limit": "-1",
+                "startTime": "2026-07-01T12:00:03.000Z",
+                "untilTime": "2026-07-01T12:00:07.000Z",
+                "reversed": "true",
+            },
+        )
+        times = [e["eventTime"] for e in body]
+        assert len(times) == 4  # 03,04,05,06 (until exclusive)
+        assert times == sorted(times, reverse=True)
+
+    def test_bad_time_400(self, api):
+        status, _ = api.handle(
+            "GET",
+            "/events.json",
+            {"accessKey": "secret", "startTime": "yesterday"},
+        )
+        assert status == 400
+
+    def test_empty_result_404(self, api):
+        status, _ = api.handle("GET", "/events.json", {"accessKey": "secret"})
+        assert status == 404
+
+
+class TestStats:
+    def test_stats_disabled_404(self, api):
+        status, body = api.handle(
+            "GET", "/stats.json", {"accessKey": "secret"}
+        )
+        assert status == 404
+
+    def test_stats_counts(self, mem_storage):
+        apps = mem_storage.get_meta_data_apps()
+        app_id = apps.insert(App(id=0, name="statsapp"))
+        mem_storage.get_meta_data_access_keys().insert(
+            AccessKey(key="sk", appid=app_id)
+        )
+        mem_storage.get_l_events().init(app_id)
+        api = EventAPI(
+            storage=mem_storage, config=EventServerConfig(stats=True)
+        )
+        for _ in range(3):
+            api.handle(
+                "POST",
+                "/events.json",
+                {"accessKey": "sk"},
+                json.dumps(EVENT).encode(),
+            )
+        status, body = api.handle("GET", "/stats.json", {"accessKey": "sk"})
+        assert status == 200
+        long_live = body["longLive"]
+        assert long_live["statusCode"] == [{"code": 201, "count": 3}]
+        assert long_live["basic"][0]["count"] == 3
+        assert long_live["basic"][0]["event"] == "rate"
+
+    def test_mixed_target_types_sortable(self):
+        # regression: None and str target types must co-sort in snapshots
+        tracker = StatsTracker()
+        tracker.bookkeeping(
+            1, 201, Event(event="buy", entity_type="user", entity_id="u")
+        )
+        tracker.bookkeeping(
+            1,
+            201,
+            Event(
+                event="rate",
+                entity_type="user",
+                entity_id="u",
+                target_entity_type="item",
+                target_entity_id="i",
+            ),
+        )
+        snap = tracker.get(1)
+        assert len(snap["longLive"]["basic"]) == 2
+
+    def test_hourly_rollover(self):
+        t0 = dt.datetime(2026, 7, 1, 10, 30, tzinfo=dt.timezone.utc)
+        tracker = StatsTracker(now=t0)
+        e = Event(event="buy", entity_type="user", entity_id="u")
+        tracker.bookkeeping(1, 201, e, now=t0)
+        t1 = t0 + dt.timedelta(hours=1)
+        tracker.bookkeeping(1, 201, e, now=t1)
+        snap = tracker.get(1)
+        assert snap["currentHour"]["statusCode"] == [{"code": 201, "count": 1}]
+        assert snap["prevHour"]["statusCode"] == [{"code": 201, "count": 1}]
+        assert snap["longLive"]["statusCode"] == [{"code": 201, "count": 2}]
+
+
+class RejectingBlocker(EventServerPlugin):
+    plugin_name = "rejector"
+    plugin_type = EventServerPlugin.INPUT_BLOCKER
+
+    def process(self, app_id, channel_id, event, context):
+        if event.event == "forbidden":
+            raise ValueError("blocked by policy")
+
+    def handle_rest(self, app_id, channel_id, args):
+        return {"app": app_id, "args": list(args)}
+
+
+class TestPlugins:
+    def _api(self, mem_storage):
+        apps = mem_storage.get_meta_data_apps()
+        app_id = apps.insert(App(id=0, name="plugapp"))
+        mem_storage.get_meta_data_access_keys().insert(
+            AccessKey(key="pk", appid=app_id)
+        )
+        mem_storage.get_l_events().init(app_id)
+        ctx = EventServerPluginContext([RejectingBlocker()])
+        return EventAPI(storage=mem_storage, plugin_context=ctx)
+
+    def test_plugins_json(self, mem_storage):
+        api = self._api(mem_storage)
+        status, body = api.handle("GET", "/plugins.json")
+        assert status == 200
+        assert "rejector" in body["plugins"]["inputblockers"]
+
+    def test_blocker_rejects(self, mem_storage):
+        api = self._api(mem_storage)
+        bad = dict(EVENT, event="forbidden")
+        status, body = api.handle(
+            "POST", "/events.json", {"accessKey": "pk"},
+            json.dumps(bad).encode(),
+        )
+        assert status == 403
+        status, _ = api.handle(
+            "POST", "/events.json", {"accessKey": "pk"},
+            json.dumps(EVENT).encode(),
+        )
+        assert status == 201
+
+    def test_plugin_rest(self, mem_storage):
+        api = self._api(mem_storage)
+        status, body = api.handle(
+            "GET", "/plugins/inputblocker/rejector/a/b", {"accessKey": "pk"}
+        )
+        assert status == 200
+        assert body["args"] == ["a", "b"]
+
+
+SEGMENT_TRACK = {
+    "type": "track",
+    "userId": "user123",
+    "event": "Signed Up",
+    "timestamp": "2026-07-01T12:00:00.000Z",
+    "sendAt": "2026-07-01T12:00:01.000Z",
+    "properties": {"plan": "pro"},
+}
+
+
+class TestSegmentIOConnector:
+    def test_track(self):
+        event = to_event(SegmentIOConnector(), SEGMENT_TRACK)
+        assert event.event == "track"
+        assert event.entity_type == "user"
+        assert event.entity_id == "user123"
+        assert event.properties["properties"] == {"plan": "pro"}
+        assert event.properties["event"] == "Signed Up"
+
+    def test_identify_with_anonymous_id(self):
+        event = to_event(
+            SegmentIOConnector(),
+            {
+                "type": "identify",
+                "anonymousId": "anon9",
+                "timestamp": "2026-07-01T12:00:00Z",
+                "traits": {"email": "a@b.c"},
+            },
+        )
+        assert event.entity_id == "anon9"
+        assert event.properties["traits"] == {"email": "a@b.c"}
+
+    def test_context_merged(self):
+        data = dict(SEGMENT_TRACK, context={"ip": "10.0.0.1"})
+        event = to_event(SegmentIOConnector(), data)
+        assert event.properties["context"] == {"ip": "10.0.0.1"}
+
+    def test_unknown_type_raises(self):
+        with pytest.raises(ConnectorException):
+            SegmentIOConnector().to_event_json({"type": "nonsense", "userId": "u"})
+
+    def test_missing_user_raises(self):
+        with pytest.raises(ConnectorException):
+            SegmentIOConnector().to_event_json(
+                {"type": "track", "event": "x", "timestamp": "2026-01-01T00:00:00Z"}
+            )
+
+
+MAILCHIMP_SUBSCRIBE = {
+    "type": "subscribe",
+    "fired_at": "2026-03-26 21:35:57",
+    "data[id]": "8a25ff1d98",
+    "data[list_id]": "a6b5da1054",
+    "data[email]": "api@example.com",
+    "data[email_type]": "html",
+    "data[merges][EMAIL]": "api@example.com",
+    "data[merges][FNAME]": "Jo",
+    "data[merges][LNAME]": "Doe",
+    "data[ip_opt]": "10.20.10.30",
+    "data[ip_signup]": "10.20.10.30",
+}
+
+
+class TestMailChimpConnector:
+    def test_subscribe(self):
+        event = to_event(MailChimpConnector(), MAILCHIMP_SUBSCRIBE)
+        assert event.event == "subscribe"
+        assert (event.entity_type, event.entity_id) == ("user", "8a25ff1d98")
+        assert (event.target_entity_type, event.target_entity_id) == (
+            "list",
+            "a6b5da1054",
+        )
+        assert event.properties["merges"]["FNAME"] == "Jo"
+        assert event.event_time.year == 2026
+
+    def test_upemail(self):
+        event = to_event(
+            MailChimpConnector(),
+            {
+                "type": "upemail",
+                "fired_at": "2026-03-26 22:15:09",
+                "data[list_id]": "a6b5da1054",
+                "data[new_id]": "51da8c3259",
+                "data[new_email]": "new@example.com",
+                "data[old_email]": "old@example.com",
+            },
+        )
+        assert event.event == "upemail"
+        assert event.entity_id == "51da8c3259"
+        assert event.properties["old_email"] == "old@example.com"
+
+    def test_cleaned_has_no_target(self):
+        event = to_event(
+            MailChimpConnector(),
+            {
+                "type": "cleaned",
+                "fired_at": "2026-03-26 22:01:00",
+                "data[list_id]": "a6b5da1054",
+                "data[campaign_id]": "4fjk2ma9xd",
+                "data[reason]": "hard",
+                "data[email]": "x@example.com",
+            },
+        )
+        assert event.entity_type == "list"
+        assert event.target_entity_type is None
+
+    def test_missing_type_raises(self):
+        with pytest.raises(ConnectorException):
+            MailChimpConnector().to_event_json({"fired_at": "2026-01-01 00:00:00"})
+
+    def test_unknown_type_raises(self):
+        with pytest.raises(ConnectorException):
+            MailChimpConnector().to_event_json(
+                {"type": "whatever", "fired_at": "2026-01-01 00:00:00"}
+            )
+
+
+class TestWebhookRoutes:
+    def test_json_webhook_roundtrip(self, api):
+        status, body = api.handle(
+            "POST",
+            "/webhooks/segmentio.json",
+            {"accessKey": "secret"},
+            json.dumps(SEGMENT_TRACK).encode(),
+        )
+        assert status == 201
+        status, events = api.handle(
+            "GET", "/events.json", {"accessKey": "secret"}
+        )
+        assert events[0]["event"] == "track"
+
+    def test_form_webhook_roundtrip(self, api):
+        status, body = api.handle(
+            "POST",
+            "/webhooks/mailchimp",
+            {"accessKey": "secret"},
+            form=MAILCHIMP_SUBSCRIBE,
+        )
+        assert status == 201
+
+    def test_unknown_connector_404(self, api):
+        status, body = api.handle(
+            "POST", "/webhooks/unknown.json", {"accessKey": "secret"}, b"{}"
+        )
+        assert status == 404
+        assert "not supported" in body["message"]
+
+    def test_get_checks_existence(self, api):
+        assert api.handle(
+            "GET", "/webhooks/segmentio.json", {"accessKey": "secret"}
+        )[0] == 200
+        assert api.handle(
+            "GET", "/webhooks/mailchimp", {"accessKey": "secret"}
+        )[0] == 200
+
+
+class TestHTTPServer:
+    """One end-to-end socket test over the stdlib server wrapper."""
+
+    def test_post_and_get_over_http(self, mem_storage):
+        apps = mem_storage.get_meta_data_apps()
+        app_id = apps.insert(App(id=0, name="httpapp"))
+        mem_storage.get_meta_data_access_keys().insert(
+            AccessKey(key="hk", appid=app_id)
+        )
+        mem_storage.get_l_events().init(app_id)
+        server = EventServer(
+            storage=mem_storage, config=EventServerConfig(port=0)
+        ).start()
+        try:
+            base = f"http://localhost:{server.port}"
+            req = urllib.request.Request(
+                f"{base}/events.json?accessKey=hk",
+                data=json.dumps(EVENT).encode(),
+                headers={"Content-Type": "application/json"},
+                method="POST",
+            )
+            with urllib.request.urlopen(req) as resp:
+                assert resp.status == 201
+                eid = json.loads(resp.read())["eventId"]
+            with urllib.request.urlopen(
+                f"{base}/events/{eid}.json?accessKey=hk"
+            ) as resp:
+                assert json.loads(resp.read())["entityId"] == "u1"
+            with urllib.request.urlopen(base) as resp:
+                assert json.loads(resp.read()) == {"status": "alive"}
+        finally:
+            server.shutdown()
