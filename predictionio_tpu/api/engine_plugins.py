@@ -1,0 +1,126 @@
+"""Engine-server plugin framework.
+
+Parity with the reference engine-server plugins
+(core/src/main/scala/io/prediction/workflow/EngineServerPlugin.scala:22-40,
+EngineServerPluginContext.scala:42-74, EngineServerPluginsActor.scala:28-46):
+*output blockers* run synchronously over the outgoing prediction JSON and
+may transform or replace it; *output sniffers* observe (engine instance,
+query, prediction) triples asynchronously.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+from typing import Any, Dict, List, Optional, Sequence
+
+logger = logging.getLogger(__name__)
+
+
+class EngineServerPlugin:
+    """Base plugin (reference EngineServerPlugin.scala:22-40)."""
+
+    OUTPUT_BLOCKER = "outputblocker"
+    OUTPUT_SNIFFER = "outputsniffer"
+
+    plugin_name: str = "plugin"
+    plugin_description: str = ""
+    plugin_type: str = OUTPUT_SNIFFER
+
+    def start(self, context: "EngineServerPluginContext") -> None:
+        """Called once when the server starts."""
+
+    def process(
+        self, engine_instance, query_json: Any, result_json: Any, context
+    ) -> Any:
+        """Blockers return the (possibly transformed) result JSON;
+        sniffers' return value is ignored."""
+        return result_json
+
+    def handle_rest(self, args: Sequence[str]) -> dict:
+        return {}
+
+
+class EngineServerPluginContext:
+    """Registered plugins split by type, with per-plugin params from the
+    ``plugins`` section of engine.json (reference
+    EngineServerPluginContext.scala:42-74)."""
+
+    def __init__(
+        self,
+        plugins: Sequence[EngineServerPlugin] = (),
+        plugin_params: Optional[Dict[str, dict]] = None,
+    ):
+        self.output_blockers: Dict[str, EngineServerPlugin] = {}
+        self.output_sniffers: Dict[str, EngineServerPlugin] = {}
+        self.plugin_params: Dict[str, dict] = dict(plugin_params or {})
+        for p in plugins:
+            self.register(p)
+        self._queue: "queue.Queue" = queue.Queue()
+        self._worker: Optional[threading.Thread] = None
+
+    @classmethod
+    def discover(cls, plugin_params: Optional[Dict[str, dict]] = None):
+        plugins: List[EngineServerPlugin] = []
+        for sub in EngineServerPlugin.__subclasses__():
+            try:
+                plugins.append(sub())
+            except Exception:
+                logger.exception("plugin %s failed to instantiate", sub)
+        ctx = cls(plugins, plugin_params)
+        for p in plugins:
+            p.start(ctx)
+        return ctx
+
+    def register(self, plugin: EngineServerPlugin) -> None:
+        if plugin.plugin_type == EngineServerPlugin.OUTPUT_BLOCKER:
+            self.output_blockers[plugin.plugin_name] = plugin
+        else:
+            self.output_sniffers[plugin.plugin_name] = plugin
+
+    def describe(self) -> dict:
+        """GET /plugins.json payload (reference CreateServer.scala:647-668)."""
+
+        def block(plugins: Dict[str, EngineServerPlugin]) -> dict:
+            return {
+                name: {
+                    "name": p.plugin_name,
+                    "description": p.plugin_description,
+                    "class": type(p).__module__ + "." + type(p).__qualname__,
+                    "params": self.plugin_params.get(p.plugin_name, {}),
+                }
+                for name, p in plugins.items()
+            }
+
+        return {
+            "plugins": {
+                "outputblockers": block(self.output_blockers),
+                "outputsniffers": block(self.output_sniffers),
+            }
+        }
+
+    def run_blockers(self, engine_instance, query_json, result_json) -> Any:
+        for p in self.output_blockers.values():
+            result_json = p.process(engine_instance, query_json, result_json, self)
+        return result_json
+
+    def notify_sniffers(self, engine_instance, query_json, result_json) -> None:
+        if not self.output_sniffers:
+            return
+        self._ensure_worker()
+        self._queue.put((engine_instance, query_json, result_json))
+
+    def _ensure_worker(self) -> None:
+        if self._worker is None or not self._worker.is_alive():
+            self._worker = threading.Thread(target=self._drain, daemon=True)
+            self._worker.start()
+
+    def _drain(self) -> None:
+        while True:
+            engine_instance, query_json, result_json = self._queue.get()
+            for p in self.output_sniffers.values():
+                try:
+                    p.process(engine_instance, query_json, result_json, self)
+                except Exception:
+                    logger.exception("sniffer %s failed", p.plugin_name)

@@ -1,0 +1,541 @@
+"""The engine (query) server: deployed-model REST serving.
+
+Capability parity with the reference CreateServer
+(core/src/main/scala/io/prediction/workflow/CreateServer.scala):
+
+  GET  /               -> HTML status page           (:444-471)
+  GET  /status.json    -> the same data as JSON (addition)
+  POST /queries.json   -> the serving hot path        (:473-624)
+  GET  /reload         -> hot-swap to latest trained instance (:626-632)
+  GET  /stop           -> undeploy                    (:634-642)
+  GET  /plugins.json   -> plugin descriptions         (:647-668)
+  GET  /plugins/<type>/<name>/... -> plugin REST      (:670-691)
+
+Deploy path parity: load the EngineInstance + its pickled models from
+MODELDATA, ``engine.prepare_deploy`` (re-train sharded models / resolve
+PersistentModel manifests), instantiate algorithms + serving via doer
+(reference createServerActorWithEngine :197-250). The feedback loop posts
+``predict`` events (entityType ``pio_pr``, fresh 64-char prId) back to the
+Event Server (:509-579), and per-request bookkeeping tracks
+requestCount / avg / last serving seconds (:586-593).
+
+TPU-first divergence (deliberate): where the reference predicts per
+request, sequentially per algorithm (:497-500, "TODO: Parallelize"),
+queries here flow through a **micro-batching executor** — concurrent
+requests are coalesced for up to ``batch_window_ms`` and served as ONE
+batched device predict (`BaseAlgorithm.batch_predict`, e.g. a single
+[B, k] x [k, n_items] MXU matmul + top_k for the recommendation engine),
+so throughput scales with batch size instead of request count.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import datetime as _dt
+import html
+import json
+import logging
+import queue
+import secrets
+import string
+import threading
+import time
+import urllib.parse
+import urllib.request
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from predictionio_tpu.api.engine_plugins import (
+    EngineServerPlugin,
+    EngineServerPluginContext,
+)
+from predictionio_tpu.api.http import JsonHTTPServer
+from predictionio_tpu.controller.engine import Engine, EngineParams
+from predictionio_tpu.data.storage import Storage, get_storage
+from predictionio_tpu.utils.serialize import loads_model
+from predictionio_tpu.workflow.context import WorkflowContext
+from predictionio_tpu.workflow.workflow_params import WorkflowParams
+
+logger = logging.getLogger(__name__)
+
+_ALPHANUMERIC = string.ascii_letters + string.digits
+
+
+def _gen_pr_id() -> str:
+    """64-char alphanumeric prId (reference CreateServer.scala:525)."""
+    return "".join(secrets.choice(_ALPHANUMERIC) for _ in range(64))
+
+
+@dataclasses.dataclass
+class ServerConfig:
+    """Reference ServerConfig (CreateServer.scala:80-96)."""
+
+    ip: str = "localhost"
+    port: int = 8000
+    engine_instance_id: Optional[str] = None
+    feedback: bool = False
+    event_server_ip: str = "localhost"
+    event_server_port: int = 7070
+    access_key: Optional[str] = None
+    batch: str = ""
+    # micro-batching knobs (TPU addition)
+    batch_window_ms: float = 2.0
+    max_batch: int = 128
+
+    def __post_init__(self):
+        if self.feedback and not self.access_key:
+            raise ValueError(
+                "feedback loop requires access_key "
+                "(reference CreateServer.scala:139-143)"
+            )
+
+
+class DeployedEngine:
+    """Immutable serving state for one engine instance: instantiated
+    algorithms + serving + deployable models."""
+
+    def __init__(
+        self,
+        engine: Engine,
+        engine_params: EngineParams,
+        engine_instance,
+        models: List[Any],
+    ):
+        self.engine = engine
+        self.engine_params = engine_params
+        self.engine_instance = engine_instance
+        _, _, self.algorithms, self.serving = engine.make_components(engine_params)
+        self.models = models
+        if len(self.models) != len(self.algorithms):
+            raise ValueError(
+                f"{len(self.models)} models for {len(self.algorithms)} algorithms"
+            )
+
+    @classmethod
+    def from_storage(
+        cls,
+        engine: Engine,
+        storage: Optional[Storage] = None,
+        engine_instance_id: Optional[str] = None,
+        engine_id: Optional[str] = None,
+        engine_version: Optional[str] = None,
+        engine_variant: Optional[str] = None,
+        ctx: Optional[WorkflowContext] = None,
+        workflow_params: Optional[WorkflowParams] = None,
+    ) -> "DeployedEngine":
+        """Reference createServerActorWithEngine (CreateServer.scala:197-250):
+        resolve the instance (given id, or latest COMPLETED — scoped to
+        (engine_id, engine_version, engine_variant) when given, as the
+        reference Console.deploy does via getLatestCompleted), deserialize
+        its models, prepare_deploy."""
+        storage = storage or get_storage()
+        ctx = ctx or WorkflowContext(mode="Serving", storage=storage)
+        instances = storage.get_meta_data_engine_instances()
+        if engine_instance_id is not None:
+            instance = instances.get(engine_instance_id)
+            if instance is None:
+                raise ValueError(
+                    f"engine instance {engine_instance_id!r} does not exist"
+                )
+        elif engine_id is not None:
+            instance = instances.get_latest_completed(
+                engine_id, engine_version or "", engine_variant or ""
+            )
+            if instance is None:
+                raise ValueError(
+                    f"no COMPLETED engine instance for engine {engine_id!r} "
+                    f"version {engine_version!r} variant {engine_variant!r}; "
+                    "run train first"
+                )
+        else:
+            completed = [
+                i for i in instances.get_all() if i.status == "COMPLETED"
+            ]
+            if not completed:
+                raise ValueError(
+                    "no COMPLETED engine instance found; run train first"
+                )
+            instance = max(completed, key=lambda i: i.start_time)
+        engine_params = engine.engine_instance_to_engine_params(instance)
+        blob = storage.get_model_data_models().get(instance.id)
+        if blob is None:
+            raise ValueError(
+                f"no persisted models for engine instance {instance.id!r}"
+            )
+        persisted = loads_model(blob.models)
+        models = engine.prepare_deploy(
+            ctx,
+            engine_params,
+            instance.id,
+            persisted,
+            workflow_params or WorkflowParams(),
+        )
+        return cls(engine, engine_params, instance, models)
+
+    # --- the serving pipeline over one coalesced batch ---
+
+    def serve_batch(self, queries: Sequence[Any]) -> List[Any]:
+        """supplement each -> ONE batch_predict per algorithm -> serve each
+        with its original query (reference Engine.scala:769-810 eval path
+        applies the same supplement/batch/serve order)."""
+        supplemented = [self.serving.supplement(q) for q in queries]
+        indexed = list(enumerate(supplemented))
+        per_algo: List[Dict[int, Any]] = [
+            dict(algo.batch_predict(model, indexed))
+            for algo, model in zip(self.algorithms, self.models)
+        ]
+        return [
+            self.serving.serve(q, [pa[i] for pa in per_algo])
+            for i, q in enumerate(queries)
+        ]
+
+
+class _BatchingExecutor:
+    """Coalesces concurrent requests into device-sized batches.
+
+    Request threads enqueue (query, slot) and block; one collector thread
+    drains the queue — waiting up to window_ms after the first arrival —
+    and runs the whole batch through DeployedEngine.serve_batch. One
+    in-flight batch at a time keeps the device queue shallow (latency)
+    while the next batch accumulates behind it (throughput).
+    """
+
+    def __init__(self, window_ms: float, max_batch: int):
+        self.window_ms = window_ms
+        self.max_batch = max_batch
+        self._queue: "queue.Queue" = queue.Queue()
+        self._worker: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+
+    def submit(self, deployed: DeployedEngine, query: Any) -> Any:
+        slot: Dict[str, Any] = {"done": threading.Event()}
+        self._ensure_worker()
+        self._queue.put((deployed, query, slot))
+        slot["done"].wait()
+        if "error" in slot:
+            raise slot["error"]
+        return slot["result"]
+
+    def _ensure_worker(self) -> None:
+        with self._lock:
+            if self._worker is None or not self._worker.is_alive():
+                self._worker = threading.Thread(target=self._run, daemon=True)
+                self._worker.start()
+
+    def _run(self) -> None:
+        while True:
+            deployed, query, slot = self._queue.get()
+            batch = [(deployed, query, slot)]
+            deadline = time.monotonic() + self.window_ms / 1000.0
+            while len(batch) < self.max_batch:
+                timeout = deadline - time.monotonic()
+                if timeout <= 0:
+                    break
+                try:
+                    batch.append(self._queue.get(timeout=timeout))
+                except queue.Empty:
+                    break
+            # group by deployed engine (a reload may be in flight)
+            groups: Dict[int, List[Tuple[DeployedEngine, Any, dict]]] = {}
+            for item in batch:
+                groups.setdefault(id(item[0]), []).append(item)
+            for items in groups.values():
+                dep = items[0][0]
+                try:
+                    results = dep.serve_batch([q for _, q, _ in items])
+                    for (_, _, s), r in zip(items, results):
+                        s["result"] = r
+                        s["done"].set()
+                except Exception as e:  # fail the whole group
+                    for _, _, s in items:
+                        s["error"] = e
+                        s["done"].set()
+
+
+class QueryAPI:
+    """Transport-independent request core for the engine server."""
+
+    def __init__(
+        self,
+        deployed: DeployedEngine,
+        config: Optional[ServerConfig] = None,
+        plugin_context: Optional[EngineServerPluginContext] = None,
+        reload_fn=None,
+        stop_fn=None,
+    ):
+        self.deployed = deployed
+        self.config = config or ServerConfig()
+        self.plugin_context = plugin_context or EngineServerPluginContext()
+        self._reload_fn = reload_fn
+        self._stop_fn = stop_fn
+        self._executor = _BatchingExecutor(
+            self.config.batch_window_ms, self.config.max_batch
+        )
+        self.server_start_time = _dt.datetime.now(_dt.timezone.utc)
+        self.request_count = 0
+        self.avg_serving_sec = 0.0
+        self.last_serving_sec = 0.0
+        self._stats_lock = threading.Lock()
+
+    # --- dispatch ---
+
+    def handle(
+        self,
+        method: str,
+        path: str,
+        query: Optional[Dict[str, str]] = None,
+        body: Optional[bytes] = None,
+    ) -> Tuple[int, Any, str]:
+        """Returns (status, payload, content_type)."""
+        try:
+            return self._route(method, path, query or {}, body)
+        except Exception as e:
+            logger.exception("internal error handling %s %s", method, path)
+            return 500, {"message": str(e)}, "application/json"
+
+    def _route(self, method, path, query, body) -> Tuple[int, Any, str]:
+        parts = [p for p in path.strip("/").split("/") if p]
+        if not parts and method == "GET":
+            return 200, self._status_html(), "text/html"
+        if path == "/status.json" and method == "GET":
+            return 200, self._status_json(), "application/json"
+        if path == "/queries.json" and method == "POST":
+            return self._handle_query(body)
+        if path == "/reload" and method == "GET":
+            if self._reload_fn is not None:
+                threading.Thread(target=self._reload_fn, daemon=True).start()
+            return 200, "Reloading...", "text/plain"
+        if path == "/stop" and method == "GET":
+            if self._stop_fn is not None:
+                t = threading.Timer(1.0, self._stop_fn)
+                t.daemon = True
+                t.start()
+            return 200, "Shutting down...", "text/plain"
+        if path == "/plugins.json" and method == "GET":
+            return 200, self.plugin_context.describe(), "application/json"
+        if parts and parts[0] == "plugins" and len(parts) >= 3 and method == "GET":
+            plugin_type, plugin_name, args = parts[1], parts[2], parts[3:]
+            table = (
+                self.plugin_context.output_blockers
+                if plugin_type == EngineServerPlugin.OUTPUT_BLOCKER
+                else self.plugin_context.output_sniffers
+            )
+            if plugin_name not in table:
+                return 404, {"message": f"Plugin {plugin_name} not found."}, "application/json"
+            return 200, table[plugin_name].handle_rest(args), "application/json"
+        return 404, {"message": "Not Found"}, "application/json"
+
+    # --- the hot path (reference CreateServer.scala:473-624) ---
+
+    def _handle_query(self, body: Optional[bytes]) -> Tuple[int, Any, str]:
+        serving_start = time.perf_counter()
+        deployed = self.deployed  # snapshot against concurrent reload
+        algorithms = deployed.algorithms
+        query_time = _dt.datetime.now(_dt.timezone.utc)
+        try:
+            query_json = json.loads((body or b"").decode("utf-8"))
+            query = algorithms[0].query_from_json(query_json)
+        except Exception as e:
+            logger.error("query %r is invalid: %s", body, e)
+            return 400, {"message": str(e)}, "application/json"
+
+        prediction = self._executor.submit(deployed, query)
+        prediction_json = algorithms[0].result_to_json(prediction)
+
+        if self.config.feedback:
+            prediction_json = self._feedback(
+                deployed, query, query_json, prediction, prediction_json,
+                query_time,
+            )
+
+        prediction_json = self.plugin_context.run_blockers(
+            deployed.engine_instance, query_json, prediction_json
+        )
+        self.plugin_context.notify_sniffers(
+            deployed.engine_instance, query_json, prediction_json
+        )
+
+        elapsed = time.perf_counter() - serving_start
+        with self._stats_lock:
+            self.last_serving_sec = elapsed
+            self.avg_serving_sec = (
+                self.avg_serving_sec * self.request_count + elapsed
+            ) / (self.request_count + 1)
+            self.request_count += 1
+        return 200, prediction_json, "application/json"
+
+    # --- feedback loop (reference CreateServer.scala:509-579) ---
+
+    def _feedback(
+        self, deployed, query, query_json, prediction, prediction_json,
+        query_time,
+    ):
+        org = getattr(prediction, "pr_id", None)
+        new_pr_id = org if org else _gen_pr_id()
+        data = {
+            "event": "predict",
+            "eventTime": query_time.isoformat().replace("+00:00", "Z"),
+            "entityType": "pio_pr",
+            "entityId": new_pr_id,
+            "properties": {
+                "engineInstanceId": deployed.engine_instance.id,
+                "query": query_json,
+                "prediction": prediction_json,
+            },
+        }
+        query_pr_id = getattr(query, "pr_id", None)
+        if query_pr_id is not None:
+            data["prId"] = query_pr_id
+
+        url = (
+            f"http://{self.config.event_server_ip}:"
+            f"{self.config.event_server_port}/events.json?"
+            + urllib.parse.urlencode({"accessKey": self.config.access_key})
+        )
+
+        def post():
+            try:
+                req = urllib.request.Request(
+                    url,
+                    data=json.dumps(data).encode("utf-8"),
+                    headers={"Content-Type": "application/json"},
+                    method="POST",
+                )
+                with urllib.request.urlopen(req, timeout=10) as resp:
+                    if resp.status != 201:
+                        logger.error(
+                            "Feedback event failed. Status code: %d. Data: %s",
+                            resp.status, json.dumps(data),
+                        )
+            except Exception as e:
+                logger.error("Feedback event failed: %s", e)
+
+        threading.Thread(target=post, daemon=True).start()
+
+        # inject the fresh prId into the response if the result carries one
+        if hasattr(prediction, "pr_id") and isinstance(prediction_json, dict):
+            prediction_json = dict(prediction_json, prId=new_pr_id)
+        return prediction_json
+
+    # --- status page (reference CreateServer.scala:444-471 html.index) ---
+
+    def _status_json(self) -> dict:
+        inst = self.deployed.engine_instance
+        with self._stats_lock:
+            return {
+                "status": "alive",
+                "engineInstanceId": inst.id,
+                "engineFactory": inst.engine_factory,
+                "startTime": self.server_start_time.isoformat(),
+                "algorithms": [type(a).__name__ for a in self.deployed.algorithms],
+                "algorithmsParams": [
+                    repr(a.params) for a in self.deployed.algorithms
+                ],
+                "serving": type(self.deployed.serving).__name__,
+                "feedback": self.config.feedback,
+                "eventServerIp": self.config.event_server_ip,
+                "eventServerPort": self.config.event_server_port,
+                "requestCount": self.request_count,
+                "avgServingSec": self.avg_serving_sec,
+                "lastServingSec": self.last_serving_sec,
+            }
+
+    def _status_html(self) -> str:
+        s = self._status_json()
+        rows = "".join(
+            f"<tr><th>{html.escape(str(k))}</th>"
+            f"<td>{html.escape(json.dumps(v))}</td></tr>"
+            for k, v in s.items()
+        )
+        return (
+            "<!DOCTYPE html><html><head><title>"
+            f"Engine Server at {self.config.ip}:{self.config.port}"
+            "</title></head><body><h1>PredictionIO-TPU Engine Server</h1>"
+            f"<table>{rows}</table></body></html>"
+        )
+
+
+class EngineServer(JsonHTTPServer):
+    """The MasterActor equivalent (reference CreateServer.scala:262-384):
+    binds the HTTP server, hot-swaps serving state on /reload, undeploys on
+    /stop."""
+
+    def __init__(
+        self,
+        engine: Engine,
+        config: Optional[ServerConfig] = None,
+        storage: Optional[Storage] = None,
+        plugin_context: Optional[EngineServerPluginContext] = None,
+        deployed: Optional[DeployedEngine] = None,
+    ):
+        self.engine = engine
+        self.config = config or ServerConfig()
+        self.storage = storage or get_storage()
+        if deployed is None:
+            deployed = DeployedEngine.from_storage(
+                engine, self.storage, self.config.engine_instance_id
+            )
+        self.api = QueryAPI(
+            deployed,
+            self.config,
+            plugin_context,
+            reload_fn=self.reload,
+            stop_fn=self.shutdown,
+        )
+
+        def handle(method, path, query, body, form=None):
+            return self.api.handle(method, path, query, body)
+
+        super().__init__(
+            handle, self.config.ip, self.config.port, "Engine Server"
+        )
+
+    def reload(self) -> None:
+        """Swap in the latest completed instance of the SAME engine
+        (reference MasterActor ReloadServer, CreateServer.scala:322-343).
+        Queries in flight keep the old DeployedEngine snapshot."""
+        try:
+            current = self.api.deployed.engine_instance
+            fresh = DeployedEngine.from_storage(
+                self.engine,
+                self.storage,
+                engine_id=current.engine_id,
+                engine_version=current.engine_version,
+                engine_variant=current.engine_variant,
+            )
+            self.api.deployed = fresh
+            logger.info(
+                "reloaded engine instance %s", fresh.engine_instance.id
+            )
+        except Exception:
+            logger.exception("reload failed; keeping current instance")
+
+    def start(self) -> "EngineServer":
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, daemon=True
+        )
+        self._thread.start()
+        logger.info(
+            "Engine Server listening on %s:%d", self.config.ip, self.port
+        )
+        return self
+
+    def serve_forever(self) -> None:
+        logger.info(
+            "Engine Server listening on %s:%d", self.config.ip, self.port
+        )
+        self.httpd.serve_forever()
+
+    def shutdown(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        if self._thread and self._thread is not threading.current_thread():
+            self._thread.join(timeout=5)
+
+
+def create_server(
+    engine: Engine,
+    config: Optional[ServerConfig] = None,
+    storage: Optional[Storage] = None,
+) -> EngineServer:
+    """Reference CreateServer.main (CreateServer.scala:110-195)."""
+    return EngineServer(engine, config, storage)

@@ -30,10 +30,10 @@ from __future__ import annotations
 import dataclasses
 import json
 import logging
-import threading
 import urllib.parse
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Optional, Tuple
+
+from predictionio_tpu.api.http import JsonHTTPServer
 
 from predictionio_tpu.data.event import (
     Event,
@@ -68,10 +68,6 @@ class EventServerConfig:
     port: int = 7070
     plugins: str = "plugins"
     stats: bool = False
-
-
-class Response(Tuple[int, Any]):
-    pass
 
 
 def _message(status: int, message: str) -> Tuple[int, dict]:
@@ -312,43 +308,7 @@ class EventAPI:
         return self._insert(app_id, channel_id, event)
 
 
-class _Handler(BaseHTTPRequestHandler):
-    api: EventAPI  # set by server factory
-
-    def _dispatch(self, method: str) -> None:
-        parsed = urllib.parse.urlsplit(self.path)
-        query = dict(urllib.parse.parse_qsl(parsed.query))
-        length = int(self.headers.get("Content-Length") or 0)
-        body = self.rfile.read(length) if length else b""
-        form = None
-        ctype = (self.headers.get("Content-Type") or "").split(";")[0].strip()
-        if ctype == "application/x-www-form-urlencoded":
-            form = dict(urllib.parse.parse_qsl(body.decode("utf-8")))
-            body = b""
-        status, payload = self.api.handle(
-            method, parsed.path, query, body, form
-        )
-        data = json.dumps(payload).encode("utf-8")
-        self.send_response(status)
-        self.send_header("Content-Type", "application/json; charset=utf-8")
-        self.send_header("Content-Length", str(len(data)))
-        self.end_headers()
-        self.wfile.write(data)
-
-    def do_GET(self):  # noqa: N802
-        self._dispatch("GET")
-
-    def do_POST(self):  # noqa: N802
-        self._dispatch("POST")
-
-    def do_DELETE(self):  # noqa: N802
-        self._dispatch("DELETE")
-
-    def log_message(self, fmt, *args):  # route access logs to logging
-        logger.debug("%s - %s", self.address_string(), fmt % args)
-
-
-class EventServer:
+class EventServer(JsonHTTPServer):
     """HTTP wrapper (reference EventServerActor + Run, EventServer.scala:471-531)."""
 
     def __init__(
@@ -359,33 +319,9 @@ class EventServer:
     ):
         self.config = config or EventServerConfig()
         self.api = EventAPI(storage, self.config, plugin_context)
-        handler = type("BoundHandler", (_Handler,), {"api": self.api})
-        self.httpd = ThreadingHTTPServer(
-            (self.config.ip, self.config.port), handler
+        super().__init__(
+            self.api.handle, self.config.ip, self.config.port, "Event Server"
         )
-        self._thread: Optional[threading.Thread] = None
-
-    @property
-    def port(self) -> int:
-        return self.httpd.server_address[1]
-
-    def start(self) -> "EventServer":
-        self._thread = threading.Thread(
-            target=self.httpd.serve_forever, daemon=True
-        )
-        self._thread.start()
-        logger.info("Event Server listening on %s:%d", self.config.ip, self.port)
-        return self
-
-    def serve_forever(self) -> None:
-        logger.info("Event Server listening on %s:%d", self.config.ip, self.port)
-        self.httpd.serve_forever()
-
-    def shutdown(self) -> None:
-        self.httpd.shutdown()
-        self.httpd.server_close()
-        if self._thread:
-            self._thread.join(timeout=5)
 
 
 def create_event_server(

@@ -1,0 +1,99 @@
+"""Shared JSON-over-HTTP server adapter for the REST frontends.
+
+Both the Event Server and the engine (query) server are a pure request
+core — ``handle(method, path, query, body, form)`` returning
+``(status, payload)`` or ``(status, payload, content_type)`` — wrapped by
+this stdlib ThreadingHTTPServer adapter. The adapter owns transport
+concerns: URL/query parsing, Content-Length body reads, form decoding,
+JSON rendering, the background serve thread, and shutdown (including
+shutdown initiated from a handler thread, as /stop does).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Optional, Tuple
+
+logger = logging.getLogger(__name__)
+
+# (method, path, query, body, form) -> (status, payload[, content_type])
+HandleFn = Callable[..., Tuple]
+
+
+class _Handler(BaseHTTPRequestHandler):
+    handle_fn: HandleFn  # bound by JsonHTTPServer
+
+    def _dispatch(self, method: str) -> None:
+        parsed = urllib.parse.urlsplit(self.path)
+        query = dict(urllib.parse.parse_qsl(parsed.query))
+        try:
+            length = int(self.headers.get("Content-Length") or 0)
+        except ValueError:
+            length = 0
+        body = self.rfile.read(length) if length > 0 else b""
+        form = None
+        ctype = (self.headers.get("Content-Type") or "").split(";")[0].strip()
+        if ctype == "application/x-www-form-urlencoded":
+            form = dict(urllib.parse.parse_qsl(body.decode("utf-8")))
+            body = b""
+        result = self.handle_fn(method, parsed.path, query, body, form)
+        status, payload = result[0], result[1]
+        out_type = result[2] if len(result) > 2 else "application/json"
+        if out_type == "application/json":
+            data = json.dumps(payload).encode("utf-8")
+        else:
+            data = str(payload).encode("utf-8")
+        self.send_response(status)
+        self.send_header("Content-Type", f"{out_type}; charset=utf-8")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_GET(self):  # noqa: N802
+        self._dispatch("GET")
+
+    def do_POST(self):  # noqa: N802
+        self._dispatch("POST")
+
+    def do_DELETE(self):  # noqa: N802
+        self._dispatch("DELETE")
+
+    def log_message(self, fmt, *args):  # route access logs through logging
+        logger.debug("%s - %s", self.address_string(), fmt % args)
+
+
+class JsonHTTPServer:
+    """Threaded HTTP server around a request-core callable."""
+
+    def __init__(self, handle_fn: HandleFn, ip: str, port: int, name: str):
+        self.name = name
+        self.ip = ip
+        handler = type("BoundHandler", (_Handler,), {"handle_fn": staticmethod(handle_fn)})
+        self.httpd = ThreadingHTTPServer((ip, port), handler)
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self.httpd.server_address[1]
+
+    def start(self) -> "JsonHTTPServer":
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, daemon=True
+        )
+        self._thread.start()
+        logger.info("%s listening on %s:%d", self.name, self.ip, self.port)
+        return self
+
+    def serve_forever(self) -> None:
+        logger.info("%s listening on %s:%d", self.name, self.ip, self.port)
+        self.httpd.serve_forever()
+
+    def shutdown(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        if self._thread and self._thread is not threading.current_thread():
+            self._thread.join(timeout=5)

@@ -141,6 +141,9 @@ class Engine(BaseEngine):
 
     def _lookup(self, class_map: Dict[str, type], name: str, slot: str) -> type:
         if name not in class_map:
+            if name == "" and len(class_map) == 1:
+                # an unnamed params block resolves to the slot's only class
+                return next(iter(class_map.values()))
             raise KeyError(
                 f"{slot} class with name {name!r} is not defined; "
                 f"available: {sorted(class_map)}"
@@ -328,6 +331,19 @@ class Engine(BaseEngine):
                 return name, _DictParams(dict(raw))
             return name, EmptyParams()
         return name, params_from_json(raw, params_cls)
+
+    def engine_instance_to_engine_params(self, instance) -> EngineParams:
+        """Rebuild EngineParams from the params JSONs stored on a trained
+        EngineInstance record (reference engineInstanceToEngineParams,
+        Engine.scala:418-488)."""
+        return self.jvalue_to_engine_params(
+            {
+                "datasource": json.loads(instance.data_source_params or "null"),
+                "preparator": json.loads(instance.preparator_params or "null"),
+                "algorithms": json.loads(instance.algorithms_params or "[]"),
+                "serving": json.loads(instance.serving_params or "null"),
+            }
+        )
 
     def jvalue_to_engine_params(self, json_obj: Mapping[str, Any]) -> EngineParams:
         algo_blocks = json_obj.get("algorithms") or []

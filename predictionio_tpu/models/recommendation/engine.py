@@ -318,6 +318,15 @@ class ALSAlgorithm(BaseAlgorithm):
     def batch_predict(self, model: ALSModel, queries) -> List[Tuple[int, PredictedResult]]:
         return model.recommend_many(queries)
 
+    def result_to_json(self, result: PredictedResult):
+        # reference wire format (Engine.scala PredictedResult(itemScores))
+        return {
+            "itemScores": [
+                {"item": s.item, "score": s.score}
+                for s in result.item_scores
+            ]
+        }
+
 
 class Serving(FirstServing):
     """First-algorithm serving (reference Serving.scala)."""

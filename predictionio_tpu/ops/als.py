@@ -377,8 +377,7 @@ class ServingFactors:
         """Top-N for explicit query factor rows [B, k]."""
         q = jax.device_put(np.asarray(user_rows, np.float32))
         packed = np.asarray(_topn_packed(q, self._if_dev, n))
-        idx = np.ascontiguousarray(packed[:, n:]).view(np.int32)
-        return packed[:, :n], idx
+        return packed[:, :n], _unpack_indices(packed, n)
 
     def topn_by_user(self, user_ids: Sequence[int], n: int):
         """Top-N for known user indices (gathers rows host-side; the row
@@ -399,4 +398,9 @@ def recommend_batch(
             n,
         )
     )
-    return packed[:, :n], packed[:, n:].astype(np.int32)
+    return packed[:, :n], _unpack_indices(packed, n)
+
+
+def _unpack_indices(packed: np.ndarray, n: int) -> np.ndarray:
+    """Recover int32 indices from their raw bits in the packed buffer."""
+    return np.ascontiguousarray(packed[:, n:]).view(np.int32)

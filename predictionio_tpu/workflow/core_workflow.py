@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import dataclasses
 import datetime as _dt
+import json
 import logging
 import traceback
 from typing import List, Optional, Sequence
@@ -63,8 +64,18 @@ class CoreWorkflow:
         )
         storage = ctx.storage
         instances = storage.get_meta_data_engine_instances()
+        # record the resolved params on the instance so deploy can
+        # reconstruct EngineParams (reference CreateWorkflow.scala:213-242)
+        params_json = engine_params.to_json()
         instance_id = instances.insert(
-            dataclasses.replace(engine_instance, status=STATUS_INIT)
+            dataclasses.replace(
+                engine_instance,
+                status=STATUS_INIT,
+                data_source_params=json.dumps(params_json["datasource"]),
+                preparator_params=json.dumps(params_json["preparator"]),
+                algorithms_params=json.dumps(params_json["algorithms"]),
+                serving_params=json.dumps(params_json["serving"]),
+            )
         )
         logger.info("run_train: engine instance %s created", instance_id)
         try:

@@ -213,3 +213,9 @@ class TestServingOps:
         assert (np.diff(scores, axis=1) <= 1e-6).all()
         full = model.user_factors[:5] @ model.item_factors.T
         np.testing.assert_allclose(scores[:, 0], full.max(axis=1), rtol=1e-5)
+        # indices decode to the true argmax ordering (regression: packed
+        # int32 bits must be viewed, not float-cast)
+        np.testing.assert_array_equal(idx[:, 0], full.argmax(axis=1))
+        np.testing.assert_array_equal(
+            idx, np.argsort(-full, axis=1, kind="stable")[:, :7]
+        )

@@ -1,0 +1,316 @@
+"""Engine (query) server tests: deploy path, serving hot path with
+micro-batching, feedback loop, reload, plugins, bookkeeping."""
+
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from predictionio_tpu.api.engine_plugins import (
+    EngineServerPlugin,
+    EngineServerPluginContext,
+)
+from predictionio_tpu.api.engine_server import (
+    DeployedEngine,
+    EngineServer,
+    QueryAPI,
+    ServerConfig,
+)
+from predictionio_tpu.api.event_server import EventServer, EventServerConfig
+from predictionio_tpu.controller.engine import Engine, EngineParams
+from predictionio_tpu.data.storage.base import (
+    STATUS_COMPLETED,
+    AccessKey,
+    App,
+    EngineInstance,
+)
+from predictionio_tpu.workflow.context import WorkflowContext
+from predictionio_tpu.workflow.core_workflow import CoreWorkflow
+
+from tests import fake_engine as fe
+
+
+def make_engine() -> Engine:
+    return Engine(
+        data_source_classes=fe.DataSource0,
+        preparator_classes=fe.Preparator0,
+        algorithm_classes={"a0": fe.Algo0, "a1": fe.Algo1},
+        serving_classes=fe.Serving0,
+    )
+
+
+def make_params() -> EngineParams:
+    return EngineParams(
+        data_source_params=("", fe.DSParams(id=7)),
+        preparator_params=("", fe.PrepParams(offset=1)),
+        algorithm_params_list=(
+            ("a0", fe.AlgoParams(id=1)),
+            ("a1", fe.AlgoParams(id=2)),
+        ),
+        serving_params=("", fe.Params()),
+    )
+
+
+def train_instance(storage) -> str:
+    import datetime as dt
+
+    now = dt.datetime.now(dt.timezone.utc)
+    ctx = WorkflowContext(mode="training", storage=storage)
+    iid = CoreWorkflow.run_train(
+        make_engine(),
+        make_params(),
+        EngineInstance(
+            id="", status="", start_time=now, end_time=now,
+            engine_id="fake", engine_version="1", engine_variant="engine.json",
+            engine_factory="tests.fake_engine",
+        ),
+        ctx=ctx,
+    )
+    assert iid
+    return iid
+
+
+class TestDeploy:
+    def test_from_storage_latest_completed(self, mem_storage):
+        fe.reset_counters()
+        train_instance(mem_storage)
+        iid2 = train_instance(mem_storage)
+        dep = DeployedEngine.from_storage(make_engine(), mem_storage)
+        assert dep.engine_instance.id == iid2
+        assert len(dep.algorithms) == 2
+        # params were reconstructed from the stored instance record
+        assert dep.engine_params.algorithm_params_list[0][1].id == 1
+
+    def test_from_storage_by_id(self, mem_storage):
+        fe.reset_counters()
+        iid1 = train_instance(mem_storage)
+        train_instance(mem_storage)
+        dep = DeployedEngine.from_storage(
+            make_engine(), mem_storage, engine_instance_id=iid1
+        )
+        assert dep.engine_instance.id == iid1
+
+    def test_no_completed_instance_raises(self, mem_storage):
+        with pytest.raises(ValueError, match="no COMPLETED"):
+            DeployedEngine.from_storage(make_engine(), mem_storage)
+
+    def test_serve_batch_merges_algorithms(self, mem_storage):
+        fe.reset_counters()
+        train_instance(mem_storage)
+        dep = DeployedEngine.from_storage(make_engine(), mem_storage)
+        results = dep.serve_batch([fe.Query(3), fe.Query(4)])
+        # both algorithms contribute: pd_id = ds(7) + offset(1) = 8
+        assert results[0].models == ((1, 8), (2, 8))
+        assert results[0].qx == 3 and results[1].qx == 4
+
+
+@pytest.fixture()
+def query_api(mem_storage):
+    fe.reset_counters()
+    train_instance(mem_storage)
+    dep = DeployedEngine.from_storage(make_engine(), mem_storage)
+    return QueryAPI(dep, ServerConfig(batch_window_ms=1.0))
+
+
+class TestQueryAPI:
+    def test_query_hot_path(self, query_api):
+        status, body, ctype = query_api.handle(
+            "POST", "/queries.json", body=json.dumps({"qx": 5}).encode()
+        )
+        assert status == 200
+        assert body["qx"] == 5
+        assert ctype == "application/json"
+
+    def test_invalid_query_400(self, query_api):
+        status, _, _ = query_api.handle(
+            "POST", "/queries.json", body=b"not json"
+        )
+        assert status == 400
+
+    def test_bookkeeping(self, query_api):
+        for qx in range(3):
+            query_api.handle(
+                "POST", "/queries.json", body=json.dumps({"qx": qx}).encode()
+            )
+        status, s, _ = query_api.handle("GET", "/status.json")
+        assert s["requestCount"] == 3
+        assert s["avgServingSec"] > 0
+        assert s["algorithms"] == ["Algo0", "Algo1"]
+
+    def test_status_html(self, query_api):
+        status, page, ctype = query_api.handle("GET", "/")
+        assert status == 200 and ctype == "text/html"
+        assert "Engine Server" in page
+
+    def test_concurrent_queries_coalesce(self, query_api):
+        """Concurrent requests ride one micro-batch (thus share a single
+        serve_batch call) and all get correct per-query results."""
+        calls = []
+        orig = query_api.deployed.serve_batch
+
+        def counting(queries):
+            calls.append(len(queries))
+            return orig(queries)
+
+        query_api.deployed.serve_batch = counting
+        query_api.config.batch_window_ms = 50.0
+        query_api._executor.window_ms = 50.0
+
+        results = {}
+
+        def do(qx):
+            _, body, _ = query_api.handle(
+                "POST", "/queries.json", body=json.dumps({"qx": qx}).encode()
+            )
+            results[qx] = body
+
+        threads = [
+            threading.Thread(target=do, args=(qx,)) for qx in range(8)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert sorted(results) == list(range(8))
+        for qx, body in results.items():
+            assert body["qx"] == qx
+        assert max(calls) > 1  # at least one coalesced batch
+        assert sum(calls) == 8
+
+
+class UpperBlocker(EngineServerPlugin):
+    plugin_name = "upper"
+    plugin_type = EngineServerPlugin.OUTPUT_BLOCKER
+
+    def process(self, engine_instance, query_json, result_json, context):
+        return dict(result_json, blocked=True)
+
+    def handle_rest(self, args):
+        return {"args": list(args)}
+
+
+class TestEnginePlugins:
+    def test_output_blocker_transforms_response(self, mem_storage):
+        fe.reset_counters()
+        train_instance(mem_storage)
+        dep = DeployedEngine.from_storage(make_engine(), mem_storage)
+        api = QueryAPI(
+            dep,
+            ServerConfig(),
+            plugin_context=EngineServerPluginContext([UpperBlocker()]),
+        )
+        _, body, _ = api.handle(
+            "POST", "/queries.json", body=json.dumps({"qx": 1}).encode()
+        )
+        assert body["blocked"] is True
+
+    def test_plugins_json_and_rest(self, mem_storage):
+        fe.reset_counters()
+        train_instance(mem_storage)
+        dep = DeployedEngine.from_storage(make_engine(), mem_storage)
+        api = QueryAPI(
+            dep,
+            ServerConfig(),
+            plugin_context=EngineServerPluginContext([UpperBlocker()]),
+        )
+        _, body, _ = api.handle("GET", "/plugins.json")
+        assert "upper" in body["plugins"]["outputblockers"]
+        _, body, _ = api.handle("GET", "/plugins/outputblocker/upper/x")
+        assert body["args"] == ["x"]
+
+
+class TestFeedbackLoop:
+    def test_feedback_posts_predict_event(self, mem_storage):
+        fe.reset_counters()
+        train_instance(mem_storage)
+
+        # a live event server to receive the feedback
+        apps = mem_storage.get_meta_data_apps()
+        app_id = apps.insert(App(id=0, name="fbapp"))
+        mem_storage.get_meta_data_access_keys().insert(
+            AccessKey(key="fbkey", appid=app_id)
+        )
+        mem_storage.get_l_events().init(app_id)
+        es = EventServer(
+            storage=mem_storage, config=EventServerConfig(port=0)
+        ).start()
+        try:
+            dep = DeployedEngine.from_storage(make_engine(), mem_storage)
+            api = QueryAPI(
+                dep,
+                ServerConfig(
+                    feedback=True,
+                    access_key="fbkey",
+                    event_server_port=es.port,
+                ),
+            )
+            status, _, _ = api.handle(
+                "POST", "/queries.json", body=json.dumps({"qx": 2}).encode()
+            )
+            assert status == 200
+            # feedback posts async; poll for it
+            deadline = time.time() + 5
+            events = []
+            while time.time() < deadline:
+                events = list(
+                    mem_storage.get_l_events().find(
+                        app_id=app_id, event_names=["predict"]
+                    )
+                )
+                if events:
+                    break
+                time.sleep(0.05)
+            assert len(events) == 1
+            e = events[0]
+            assert e.entity_type == "pio_pr"
+            assert len(e.entity_id) == 64
+            props = e.properties
+            assert props["query"] == {"qx": 2}
+            assert props["engineInstanceId"] == dep.engine_instance.id
+        finally:
+            es.shutdown()
+
+    def test_feedback_requires_access_key(self):
+        with pytest.raises(ValueError, match="access_key"):
+            ServerConfig(feedback=True)
+
+
+class TestReloadAndHTTP:
+    def test_http_roundtrip_and_reload(self, mem_storage):
+        fe.reset_counters()
+        train_instance(mem_storage)
+        server = EngineServer(
+            make_engine(), ServerConfig(port=0), storage=mem_storage
+        ).start()
+        try:
+            base = f"http://localhost:{server.port}"
+            first_id = server.api.deployed.engine_instance.id
+
+            req = urllib.request.Request(
+                f"{base}/queries.json",
+                data=json.dumps({"qx": 9}).encode(),
+                headers={"Content-Type": "application/json"},
+                method="POST",
+            )
+            with urllib.request.urlopen(req) as resp:
+                assert resp.status == 200
+                assert json.loads(resp.read())["qx"] == 9
+
+            # train a newer instance, then hot-reload
+            second_id = train_instance(mem_storage)
+            assert second_id != first_id
+            with urllib.request.urlopen(f"{base}/reload") as resp:
+                assert b"Reloading" in resp.read()
+            deadline = time.time() + 5
+            while time.time() < deadline:
+                if server.api.deployed.engine_instance.id == second_id:
+                    break
+                time.sleep(0.05)
+            assert server.api.deployed.engine_instance.id == second_id
+
+            with urllib.request.urlopen(f"{base}/status.json") as resp:
+                assert json.loads(resp.read())["engineInstanceId"] == second_id
+        finally:
+            server.shutdown()
