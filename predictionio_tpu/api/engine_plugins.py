@@ -11,9 +11,9 @@ query, prediction) triples asynchronously.
 from __future__ import annotations
 
 import logging
-import queue
-import threading
 from typing import Any, Dict, List, Optional, Sequence
+
+from predictionio_tpu.api.plugin_base import AsyncNotifier, describe_plugins
 
 logger = logging.getLogger(__name__)
 
@@ -57,8 +57,7 @@ class EngineServerPluginContext:
         self.plugin_params: Dict[str, dict] = dict(plugin_params or {})
         for p in plugins:
             self.register(p)
-        self._queue: "queue.Queue" = queue.Queue()
-        self._worker: Optional[threading.Thread] = None
+        self._notifier = AsyncNotifier(self._deliver)
 
     @classmethod
     def discover(cls, plugin_params: Optional[Dict[str, dict]] = None):
@@ -81,22 +80,14 @@ class EngineServerPluginContext:
 
     def describe(self) -> dict:
         """GET /plugins.json payload (reference CreateServer.scala:647-668)."""
-
-        def block(plugins: Dict[str, EngineServerPlugin]) -> dict:
-            return {
-                name: {
-                    "name": p.plugin_name,
-                    "description": p.plugin_description,
-                    "class": type(p).__module__ + "." + type(p).__qualname__,
-                    "params": self.plugin_params.get(p.plugin_name, {}),
-                }
-                for name, p in plugins.items()
-            }
-
         return {
             "plugins": {
-                "outputblockers": block(self.output_blockers),
-                "outputsniffers": block(self.output_sniffers),
+                "outputblockers": describe_plugins(
+                    self.output_blockers, self.plugin_params
+                ),
+                "outputsniffers": describe_plugins(
+                    self.output_sniffers, self.plugin_params
+                ),
             }
         }
 
@@ -108,19 +99,12 @@ class EngineServerPluginContext:
     def notify_sniffers(self, engine_instance, query_json, result_json) -> None:
         if not self.output_sniffers:
             return
-        self._ensure_worker()
-        self._queue.put((engine_instance, query_json, result_json))
+        self._notifier.put((engine_instance, query_json, result_json))
 
-    def _ensure_worker(self) -> None:
-        if self._worker is None or not self._worker.is_alive():
-            self._worker = threading.Thread(target=self._drain, daemon=True)
-            self._worker.start()
-
-    def _drain(self) -> None:
-        while True:
-            engine_instance, query_json, result_json = self._queue.get()
-            for p in self.output_sniffers.values():
-                try:
-                    p.process(engine_instance, query_json, result_json, self)
-                except Exception:
-                    logger.exception("sniffer %s failed", p.plugin_name)
+    def _deliver(self, item: tuple) -> None:
+        engine_instance, query_json, result_json = item
+        for p in self.output_sniffers.values():
+            try:
+                p.process(engine_instance, query_json, result_json, self)
+            except Exception:
+                logger.exception("sniffer %s failed", p.plugin_name)

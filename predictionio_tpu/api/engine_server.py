@@ -245,9 +245,16 @@ class _BatchingExecutor:
                     for (_, _, s), r in zip(items, results):
                         s["result"] = r
                         s["done"].set()
-                except Exception as e:  # fail the whole group
-                    for _, _, s in items:
-                        s["error"] = e
+                except Exception:
+                    # isolate the failure: retry each query alone so one
+                    # bad query can't 500 its batchmates (the reference
+                    # serves per-request and has this isolation for free)
+                    for _, q, s in items:
+                        try:
+                            [r] = dep.serve_batch([q])
+                            s["result"] = r
+                        except Exception as e:
+                            s["error"] = e
                         s["done"].set()
 
 
@@ -275,6 +282,38 @@ class QueryAPI:
         self.avg_serving_sec = 0.0
         self.last_serving_sec = 0.0
         self._stats_lock = threading.Lock()
+        # feedback posts drain on ONE daemon worker (not a thread per
+        # request — that would throttle the micro-batched hot path)
+        self._feedback_queue: "queue.Queue" = queue.Queue()
+        self._feedback_worker: Optional[threading.Thread] = None
+        self._feedback_lock = threading.Lock()
+
+    def _ensure_feedback_worker(self) -> None:
+        with self._feedback_lock:
+            if self._feedback_worker is None or not self._feedback_worker.is_alive():
+                self._feedback_worker = threading.Thread(
+                    target=self._drain_feedback, daemon=True
+                )
+                self._feedback_worker.start()
+
+    def _drain_feedback(self) -> None:
+        while True:
+            url, data = self._feedback_queue.get()
+            try:
+                req = urllib.request.Request(
+                    url,
+                    data=json.dumps(data).encode("utf-8"),
+                    headers={"Content-Type": "application/json"},
+                    method="POST",
+                )
+                with urllib.request.urlopen(req, timeout=10) as resp:
+                    if resp.status != 201:
+                        logger.error(
+                            "Feedback event failed. Status code: %d. Data: %s",
+                            resp.status, json.dumps(data),
+                        )
+            except Exception as e:
+                logger.error("Feedback event failed: %s", e)
 
     # --- dispatch ---
 
@@ -391,25 +430,8 @@ class QueryAPI:
             f"{self.config.event_server_port}/events.json?"
             + urllib.parse.urlencode({"accessKey": self.config.access_key})
         )
-
-        def post():
-            try:
-                req = urllib.request.Request(
-                    url,
-                    data=json.dumps(data).encode("utf-8"),
-                    headers={"Content-Type": "application/json"},
-                    method="POST",
-                )
-                with urllib.request.urlopen(req, timeout=10) as resp:
-                    if resp.status != 201:
-                        logger.error(
-                            "Feedback event failed. Status code: %d. Data: %s",
-                            resp.status, json.dumps(data),
-                        )
-            except Exception as e:
-                logger.error("Feedback event failed: %s", e)
-
-        threading.Thread(target=post, daemon=True).start()
+        self._feedback_queue.put((url, data))
+        self._ensure_feedback_worker()
 
         # inject the fresh prId into the response if the result carries one
         if hasattr(prediction, "pr_id") and isinstance(prediction_json, dict):
@@ -509,33 +531,18 @@ class EngineServer(JsonHTTPServer):
         except Exception:
             logger.exception("reload failed; keeping current instance")
 
-    def start(self) -> "EngineServer":
-        self._thread = threading.Thread(
-            target=self.httpd.serve_forever, daemon=True
-        )
-        self._thread.start()
-        logger.info(
-            "Engine Server listening on %s:%d", self.config.ip, self.port
-        )
-        return self
-
-    def serve_forever(self) -> None:
-        logger.info(
-            "Engine Server listening on %s:%d", self.config.ip, self.port
-        )
-        self.httpd.serve_forever()
-
-    def shutdown(self) -> None:
-        self.httpd.shutdown()
-        self.httpd.server_close()
-        if self._thread and self._thread is not threading.current_thread():
-            self._thread.join(timeout=5)
-
 
 def create_server(
     engine: Engine,
     config: Optional[ServerConfig] = None,
     storage: Optional[Storage] = None,
 ) -> EngineServer:
-    """Reference CreateServer.main (CreateServer.scala:110-195)."""
-    return EngineServer(engine, config, storage)
+    """Reference CreateServer.main (CreateServer.scala:110-195). Plugins
+    are auto-discovered at launch (the reference's ServiceLoader pass,
+    EngineServerPluginContext.scala:42-74)."""
+    return EngineServer(
+        engine,
+        config,
+        storage,
+        plugin_context=EngineServerPluginContext.discover(),
+    )

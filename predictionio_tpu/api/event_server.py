@@ -328,5 +328,11 @@ def create_event_server(
     config: Optional[EventServerConfig] = None,
     storage: Optional[Storage] = None,
 ) -> EventServer:
-    """Reference EventServer.createEventServer (EventServer.scala:502-522)."""
-    return EventServer(storage=storage, config=config)
+    """Reference EventServer.createEventServer (EventServer.scala:502-522).
+    Plugins are auto-discovered at launch (the reference's ServiceLoader
+    pass, EventServerPluginContext.scala:26-49)."""
+    return EventServer(
+        storage=storage,
+        config=config,
+        plugin_context=EventServerPluginContext.discover(),
+    )

@@ -1,0 +1,58 @@
+"""Shared machinery for the two plugin frameworks (event server and
+engine server): the async sniffer drain worker and plugin description
+rendering. Both contexts split plugins into a synchronous "blocker" table
+and an async "sniffer" table; only the process() arity differs.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+from typing import Callable, Dict, Optional
+
+logger = logging.getLogger(__name__)
+
+
+class AsyncNotifier:
+    """A single locked daemon worker draining notifications to a callback
+    (the reference's PluginsActor mailbox)."""
+
+    def __init__(self, deliver: Callable[[tuple], None]):
+        self._deliver = deliver
+        self._queue: "queue.Queue" = queue.Queue()
+        self._worker: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+
+    def put(self, item: tuple) -> None:
+        with self._lock:
+            if self._worker is None or not self._worker.is_alive():
+                self._worker = threading.Thread(target=self._drain, daemon=True)
+                self._worker.start()
+        self._queue.put(item)
+
+    def _drain(self) -> None:
+        while True:
+            item = self._queue.get()
+            try:
+                self._deliver(item)
+            except Exception:
+                logger.exception("plugin notification delivery failed")
+
+
+def describe_plugins(
+    plugins: Dict[str, object],
+    params: Optional[Dict[str, dict]] = None,
+) -> Dict[str, dict]:
+    """Render a plugin table for /plugins.json."""
+    out = {}
+    for name, p in plugins.items():
+        entry = {
+            "name": p.plugin_name,
+            "description": p.plugin_description,
+            "class": type(p).__module__ + "." + type(p).__qualname__,
+        }
+        if params is not None:
+            entry["params"] = params.get(p.plugin_name, {})
+        out[name] = entry
+    return out

@@ -14,10 +14,9 @@ over subclass registries).
 from __future__ import annotations
 
 import logging
-import queue
-import threading
 from typing import Dict, List, Optional, Sequence
 
+from predictionio_tpu.api.plugin_base import AsyncNotifier, describe_plugins
 from predictionio_tpu.data.event import Event
 
 logger = logging.getLogger(__name__)
@@ -54,8 +53,7 @@ class EventServerPluginContext:
         self.input_sniffers: Dict[str, EventServerPlugin] = {}
         for p in plugins:
             self.register(p)
-        self._queue: "queue.Queue" = queue.Queue()
-        self._worker: Optional[threading.Thread] = None
+        self._notifier = AsyncNotifier(self._deliver)
 
     @classmethod
     def discover(cls) -> "EventServerPluginContext":
@@ -77,21 +75,10 @@ class EventServerPluginContext:
 
     def describe(self) -> dict:
         """GET /plugins.json payload (reference EventServer.scala:122-143)."""
-
-        def block(plugins: Dict[str, EventServerPlugin]) -> dict:
-            return {
-                name: {
-                    "name": p.plugin_name,
-                    "description": p.plugin_description,
-                    "class": type(p).__module__ + "." + type(p).__qualname__,
-                }
-                for name, p in plugins.items()
-            }
-
         return {
             "plugins": {
-                "inputblockers": block(self.input_blockers),
-                "inputsniffers": block(self.input_sniffers),
+                "inputblockers": describe_plugins(self.input_blockers),
+                "inputsniffers": describe_plugins(self.input_sniffers),
             }
         }
 
@@ -108,19 +95,12 @@ class EventServerPluginContext:
     ) -> None:
         if not self.input_sniffers:
             return
-        self._ensure_worker()
-        self._queue.put((app_id, channel_id, event))
+        self._notifier.put((app_id, channel_id, event))
 
-    def _ensure_worker(self) -> None:
-        if self._worker is None or not self._worker.is_alive():
-            self._worker = threading.Thread(target=self._drain, daemon=True)
-            self._worker.start()
-
-    def _drain(self) -> None:
-        while True:
-            app_id, channel_id, event = self._queue.get()
-            for p in self.input_sniffers.values():
-                try:
-                    p.process(app_id, channel_id, event, self)
-                except Exception:
-                    logger.exception("sniffer %s failed", p.plugin_name)
+    def _deliver(self, item: tuple) -> None:
+        app_id, channel_id, event = item
+        for p in self.input_sniffers.values():
+            try:
+                p.process(app_id, channel_id, event, self)
+            except Exception:
+                logger.exception("sniffer %s failed", p.plugin_name)

@@ -376,9 +376,13 @@ class Engine(BaseEngine):
 @dataclasses.dataclass(frozen=True)
 class _DictParams(Params):
     """Fallback params wrapper for components that declare no params_class
-    but receive a JSON params block."""
+    but receive a JSON params block. Serializes back to the raw dict so
+    train-store-deploy round trips don't double-wrap."""
 
     values: Any = dataclasses.field(default_factory=dict)
+
+    def to_json(self):
+        return dict(self.values)
 
 
 class SimpleEngine(Engine):

@@ -113,7 +113,12 @@ def params_from_json(obj: Optional[Mapping[str, Any]], params_cls: Type[T]) -> T
 
 def params_to_json(params: Params) -> Dict[str, Any]:
     """Serialize a Params dataclass to a JSON-compatible dict
-    (reference JsonExtractor.paramToJson:83-110)."""
+    (reference JsonExtractor.paramToJson:83-110). A Params subclass may
+    override ``to_json()`` to control its wire form (e.g. the raw-dict
+    fallback wrapper must round-trip transparently)."""
+    custom = getattr(params, "to_json", None)
+    if callable(custom):
+        return custom()
     if not dataclasses.is_dataclass(params):
         raise ParamsError(f"{type(params).__name__} is not a dataclass")
     out = dataclasses.asdict(params)

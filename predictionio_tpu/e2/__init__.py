@@ -1,0 +1,21 @@
+"""e2 — reusable algorithm/evaluation library.
+
+Capability parity with the reference ``e2`` module (e2/src/main/scala/io/
+prediction/e2/): CategoricalNaiveBayes, MarkovChain, PropertiesToBinary,
+and k-fold ``split_data``. Where the reference runs these as Spark RDD
+programs, counts and predictions here are dense-array JAX programs
+(segment-sum count reductions, gather-based scoring, scatter-add
+transition mixing) that XLA tiles onto the device.
+"""
+
+from predictionio_tpu.e2.naive_bayes import (  # noqa: F401
+    CategoricalNaiveBayes,
+    CategoricalNaiveBayesModel,
+    LabeledPoint,
+)
+from predictionio_tpu.e2.markov_chain import (  # noqa: F401
+    MarkovChain,
+    MarkovChainModel,
+)
+from predictionio_tpu.e2.properties import PropertiesToBinary  # noqa: F401
+from predictionio_tpu.e2.evaluation import split_data  # noqa: F401

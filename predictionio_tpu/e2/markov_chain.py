@@ -1,0 +1,92 @@
+"""Markov chain model over state-transition tallies.
+
+Capability parity with the reference MarkovChain
+(e2/src/main/scala/io/prediction/e2/engine/MarkovChain.scala:25-89):
+``train`` takes a sparse tally of transitions (a coordinate matrix), keeps
+the top-N transitions per source state normalized by the source's total
+tally, and ``predict`` propagates a current-state probability vector one
+step (current @ P over the kept transitions).
+
+TPU-first design: the kept transitions live as dense [n_states, top_n]
+(target-index, probability) arrays — a static shape XLA can tile — and
+predict is one scatter-add device program instead of a per-row RDD map +
+driver-side column sums.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Dict, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass
+class MarkovChainModel:
+    """Top-N normalized transitions (reference MarkovChainModel :63-89)."""
+
+    n_states: int
+    n: int  # top-N kept per state
+    targets: np.ndarray  # [n_states, n] int32 (self-loop padding w/ 0 prob)
+    probs: np.ndarray  # [n_states, n] float32
+
+    def transition_map(self) -> Dict[int, List[Tuple[int, float]]]:
+        """Per-state kept transitions as {state: [(target, prob)]}, sorted
+        by target index (the reference's SparseVector view)."""
+        out: Dict[int, List[Tuple[int, float]]] = {}
+        for i in range(self.n_states):
+            entries = [
+                (int(t), float(p))
+                for t, p in zip(self.targets[i], self.probs[i])
+                if p > 0.0
+            ]
+            if entries:
+                out[i] = sorted(entries)
+        return out
+
+    def predict(self, current_state: Sequence[float]) -> List[float]:
+        """Probabilities of the next state (reference predict :68-88)."""
+        cur = jnp.asarray(np.asarray(current_state, np.float32))
+        out = _step(
+            cur, jnp.asarray(self.targets), jnp.asarray(self.probs),
+            self.n_states,
+        )
+        return [float(x) for x in np.asarray(out)]
+
+
+@functools.partial(jax.jit, static_argnames=("n_states",))
+def _step(cur, targets, probs, n_states):
+    # next[j] = sum_i cur[i] * P[i, j] over kept transitions
+    contrib = probs * cur[:, None]  # [n_states, n]
+    return jnp.zeros(n_states, jnp.float32).at[targets].add(contrib)
+
+
+class MarkovChain:
+    """Trainer (reference object MarkovChain :25-62)."""
+
+    @staticmethod
+    def train(
+        entries: Sequence[Tuple[int, int, float]], n_states: int, top_n: int
+    ) -> MarkovChainModel:
+        """``entries`` is the transition tally as (from, to, count) triples
+        (the reference's CoordinateMatrix entries)."""
+        tally: Dict[int, Dict[int, float]] = {}
+        for i, j, v in entries:
+            row = tally.setdefault(int(i), {})
+            row[int(j)] = row.get(int(j), 0.0) + float(v)
+
+        targets = np.zeros((n_states, top_n), np.int32)
+        probs = np.zeros((n_states, top_n), np.float32)
+        for i, row in tally.items():
+            total = sum(row.values())
+            top = sorted(row.items(), key=lambda kv: -kv[1])[:top_n]
+            top.sort(key=lambda kv: kv[0])  # reference sorts kept by index
+            for k, (j, v) in enumerate(top):
+                targets[i, k] = j
+                probs[i, k] = v / total
+        return MarkovChainModel(
+            n_states=n_states, n=top_n, targets=targets, probs=probs
+        )

@@ -1,0 +1,4 @@
+from predictionio_tpu.models.classification.engine import (  # noqa: F401
+    ClassificationEngineFactory,
+    classification_engine,
+)

@@ -1,0 +1,284 @@
+"""Classification engine: NaiveBayes (+ logistic regression) over $set
+user properties.
+
+Reference mapping (examples/scala-parallel-classification/add-algorithm/
+src/main/scala/):
+- Query(features)/PredictedResult(label)      <- Engine.scala
+- DataSource: aggregateProperties over "user" entities requiring
+  plan/attr0/attr1/attr2 -> labeled points     <- DataSource.scala:31-65
+- NaiveBayesAlgorithm (MLlib NaiveBayes.train -> ops.naive_bayes)
+                                               <- NaiveBayesAlgorithm.scala:24-44
+- a second algorithm in the same engine (the template's point is the
+  multi-algorithm map; the reference adds RandomForest, here a
+  TPU-friendly logistic regression trained with full-batch gradient
+  descent)                                     <- RandomForestAlgorithm.scala
+- Serving: first prediction                    <- Serving.scala
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from predictionio_tpu.controller import (
+    BaseAlgorithm,
+    BaseDataSource,
+    BasePreparator,
+    EngineFactory,
+    FirstServing,
+    Params,
+    SanityCheck,
+)
+from predictionio_tpu.controller.engine import Engine
+from predictionio_tpu.data.store import PEventStore
+from predictionio_tpu.e2 import split_data
+from predictionio_tpu.ops.naive_bayes import (
+    NaiveBayesModelArrays,
+    predict_naive_bayes,
+    train_naive_bayes,
+)
+
+logger = logging.getLogger(__name__)
+
+ATTRS = ("attr0", "attr1", "attr2")
+
+
+@dataclasses.dataclass(frozen=True)
+class Query:
+    features: Tuple[float, ...]
+
+    def __post_init__(self):
+        object.__setattr__(
+            self, "features", tuple(float(f) for f in self.features)
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class PredictedResult:
+    label: float
+
+
+@dataclasses.dataclass(frozen=True)
+class ActualResult:
+    label: float
+
+
+@dataclasses.dataclass
+class LabeledPoint:
+    label: float
+    features: np.ndarray
+
+
+@dataclasses.dataclass
+class TrainingData(SanityCheck):
+    labels: np.ndarray  # [n]
+    features: np.ndarray  # [n, F]
+
+    def sanity_check(self) -> None:
+        if len(self.labels) == 0:
+            raise ValueError(
+                "no labeled points — are user $set events with "
+                f"plan/{'/'.join(ATTRS)} present?"
+            )
+
+
+@dataclasses.dataclass
+class PreparedData:
+    td: TrainingData
+
+
+@dataclasses.dataclass(frozen=True)
+class DataSourceParams(Params):
+    app_name: str = "default"
+    channel_name: Optional[str] = None
+    eval_k: Optional[int] = None
+
+
+class DataSource(BaseDataSource):
+    """Aggregates user $set properties into labeled points
+    (reference DataSource.scala:31-65: required plan + attr0..attr2)."""
+
+    params_class = DataSourceParams
+
+    def _read_points(self, ctx) -> TrainingData:
+        store = PEventStore(ctx.storage)
+        props = store.aggregate_properties(
+            self.params.app_name,
+            entity_type="user",
+            channel_name=self.params.channel_name,
+            required=["plan", *ATTRS],
+        )
+        labels = np.asarray(
+            [float(p.get("plan")) for p in props.values()], np.float32
+        )
+        features = np.asarray(
+            [[float(p.get(a)) for a in ATTRS] for p in props.values()],
+            np.float32,
+        ).reshape(len(labels), len(ATTRS))
+        logger.info("DataSource: %d labeled points", len(labels))
+        return TrainingData(labels=labels, features=features)
+
+    def read_training(self, ctx) -> TrainingData:
+        return self._read_points(ctx)
+
+    def read_eval(self, ctx):
+        if not self.params.eval_k:
+            return []
+        td = self._read_points(ctx)
+        points = [
+            LabeledPoint(float(l), f) for l, f in zip(td.labels, td.features)
+        ]
+        return split_data(
+            self.params.eval_k,
+            points,
+            None,
+            training_data_creator=lambda pts: TrainingData(
+                labels=np.asarray([p.label for p in pts], np.float32),
+                features=(
+                    np.stack([p.features for p in pts])
+                    if pts
+                    else np.zeros((0, len(ATTRS)), np.float32)
+                ),
+            ),
+            query_creator=lambda p: Query(features=tuple(p.features)),
+            actual_creator=lambda p: ActualResult(label=p.label),
+        )
+
+
+class Preparator(BasePreparator):
+    def prepare(self, ctx, td: TrainingData) -> PreparedData:
+        return PreparedData(td=td)
+
+
+@dataclasses.dataclass(frozen=True)
+class NaiveBayesAlgorithmParams(Params):
+    lambda_: float = 1.0
+
+
+class NaiveBayesAlgorithm(BaseAlgorithm):
+    """Multinomial NB (reference NaiveBayesAlgorithm.scala:24-44 ->
+    ops.naive_bayes kernel)."""
+
+    params_class = NaiveBayesAlgorithmParams
+    query_class = Query
+
+    def train(self, ctx, pd: PreparedData) -> NaiveBayesModelArrays:
+        return train_naive_bayes(
+            pd.td.features, pd.td.labels, lam=self.params.lambda_
+        )
+
+    def predict(self, model: NaiveBayesModelArrays, query: Query) -> PredictedResult:
+        [(_, p)] = self.batch_predict(model, [(0, query)])
+        return p
+
+    def batch_predict(self, model, queries) -> List[Tuple[int, PredictedResult]]:
+        X = np.asarray([q.features for _, q in queries], np.float32)
+        labels = predict_naive_bayes(model, X)
+        return [
+            (i, PredictedResult(label=float(l)))
+            for (i, _), l in zip(queries, labels)
+        ]
+
+
+@dataclasses.dataclass(frozen=True)
+class LogisticRegressionAlgorithmParams(Params):
+    learning_rate: float = 0.1
+    iterations: int = 200
+    l2: float = 0.0
+    seed: int = 0
+
+
+@dataclasses.dataclass
+class LogisticRegressionModel:
+    weights: np.ndarray  # [C, F]
+    bias: np.ndarray  # [C]
+    labels: np.ndarray  # [C]
+
+
+class LogisticRegressionAlgorithm(BaseAlgorithm):
+    """Softmax regression trained by full-batch gradient descent under
+    jax.jit (lax.scan over iterations) — the engine's second algorithm,
+    playing the reference add-algorithm slot (RandomForestAlgorithm.scala)
+    with a TPU-friendly model."""
+
+    params_class = LogisticRegressionAlgorithmParams
+    query_class = Query
+
+    def train(self, ctx, pd: PreparedData) -> LogisticRegressionModel:
+        import jax
+        import jax.numpy as jnp
+
+        td = pd.td
+        classes, y = np.unique(td.labels, return_inverse=True)
+        C, F = len(classes), td.features.shape[1]
+        X = jnp.asarray(td.features)
+        Y = jax.nn.one_hot(jnp.asarray(y), C)
+        p = self.params
+
+        def loss(params):
+            W, b = params
+            logits = X @ W.T + b
+            logp = jax.nn.log_softmax(logits)
+            return -(Y * logp).sum(axis=1).mean() + p.l2 * (W ** 2).sum()
+
+        @jax.jit
+        def fit():
+            import jax.lax as lax
+
+            W0 = jnp.zeros((C, F), jnp.float32)
+            b0 = jnp.zeros((C,), jnp.float32)
+            grad = jax.grad(loss)
+
+            def step(params, _):
+                g = grad(params)
+                return (
+                    params[0] - p.learning_rate * g[0],
+                    params[1] - p.learning_rate * g[1],
+                ), None
+
+            params, _ = lax.scan(step, (W0, b0), None, length=p.iterations)
+            return params
+
+        W, b = fit()
+        return LogisticRegressionModel(
+            weights=np.asarray(W), bias=np.asarray(b), labels=classes
+        )
+
+    def predict(self, model: LogisticRegressionModel, query: Query) -> PredictedResult:
+        [(_, p)] = self.batch_predict(model, [(0, query)])
+        return p
+
+    def batch_predict(self, model, queries) -> List[Tuple[int, PredictedResult]]:
+        X = np.asarray([q.features for _, q in queries], np.float32)
+        scores = X @ model.weights.T + model.bias
+        best = scores.argmax(axis=1)
+        return [
+            (i, PredictedResult(label=float(model.labels[b])))
+            for (i, _), b in zip(queries, best)
+        ]
+
+
+class Serving(FirstServing):
+    pass
+
+
+def classification_engine() -> Engine:
+    """Reference ClassificationEngine factory (Engine.scala: naive +
+    randomforest algorithm map)."""
+    return Engine(
+        data_source_classes=DataSource,
+        preparator_classes=Preparator,
+        algorithm_classes={
+            "naive": NaiveBayesAlgorithm,
+            "logisticregression": LogisticRegressionAlgorithm,
+        },
+        serving_classes=Serving,
+    )
+
+
+class ClassificationEngineFactory(EngineFactory):
+    def apply(self) -> Engine:
+        return classification_engine()

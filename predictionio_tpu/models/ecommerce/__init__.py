@@ -1,0 +1,4 @@
+from predictionio_tpu.models.ecommerce.engine import (  # noqa: F401
+    ECommerceEngineFactory,
+    ecommerce_engine,
+)

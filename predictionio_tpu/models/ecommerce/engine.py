@@ -1,0 +1,452 @@
+"""E-commerce recommendation engine: ALS + business rules at predict time.
+
+Reference mapping (examples/scala-parallel-ecommercerecommendation/
+train-with-rate-event/src/main/scala/):
+- Query(user, num, categories?, whiteList?, blackList?) /
+  PredictedResult(itemScores)                   <- Engine.scala
+- DataSource: $set users/items + rate/buy/view events <- DataSource.scala
+- ALSAlgorithm: explicit ALS over latest-rating-per-pair; predict for a
+  known user = userVector . itemFactors with candidacy filtering; for an
+  unknown user = cosine similarity against the user's recently viewed
+  items (read from LEventStore at predict time); the effective blacklist
+  merges the query's blackList, the user's seen items (when unseenOnly),
+  and the live "unavailableItems" constraint entity
+                                                <- ALSAlgorithm.scala
+- Serving: first prediction                     <- Serving.scala
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+import numpy as np
+
+from predictionio_tpu.controller import (
+    BaseAlgorithm,
+    BaseDataSource,
+    BasePreparator,
+    EngineFactory,
+    FirstServing,
+    Params,
+    SanityCheck,
+)
+from predictionio_tpu.controller.engine import Engine
+from predictionio_tpu.data.bimap import BiMap
+from predictionio_tpu.data.store import LEventStore, PEventStore
+from predictionio_tpu.ops.als import ALSConfig, train_als
+from predictionio_tpu.ops.similarity import SimilarityScorer
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass(frozen=True)
+class Query:
+    user: str
+    num: int = 10
+    categories: Optional[Tuple[str, ...]] = None
+    white_list: Optional[Tuple[str, ...]] = None
+    black_list: Optional[Tuple[str, ...]] = None
+
+    def __post_init__(self):
+        for f in ("categories", "white_list", "black_list"):
+            v = getattr(self, f)
+            if v is not None:
+                object.__setattr__(self, f, tuple(v))
+
+
+@dataclasses.dataclass(frozen=True)
+class ItemScore:
+    item: str
+    score: float
+
+
+@dataclasses.dataclass(frozen=True)
+class PredictedResult:
+    item_scores: Tuple[ItemScore, ...] = ()
+
+    def __post_init__(self):
+        object.__setattr__(
+            self,
+            "item_scores",
+            tuple(
+                s if isinstance(s, ItemScore) else ItemScore(**s)
+                for s in self.item_scores
+            ),
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class Item:
+    categories: Tuple[str, ...] = ()
+
+
+@dataclasses.dataclass
+class RateEvent:
+    user: str
+    item: str
+    rating: float
+    t: float
+
+
+@dataclasses.dataclass
+class TrainingData(SanityCheck):
+    users: Dict[str, dict]
+    items: Dict[str, Item]
+    rate_events: List[RateEvent]
+
+    def sanity_check(self) -> None:
+        if not self.items:
+            raise ValueError("items is empty — are item $set events present?")
+        if not self.rate_events:
+            raise ValueError(
+                "rateEvents is empty — are rate/buy events present?"
+            )
+
+
+@dataclasses.dataclass
+class PreparedData:
+    td: TrainingData
+
+
+@dataclasses.dataclass(frozen=True)
+class DataSourceParams(Params):
+    app_name: str = "default"
+    channel_name: Optional[str] = None
+
+
+class DataSource(BaseDataSource):
+    params_class = DataSourceParams
+
+    def read_training(self, ctx) -> TrainingData:
+        store = PEventStore(ctx.storage)
+        p = self.params
+        users = {
+            eid: dict(props)
+            for eid, props in store.aggregate_properties(
+                p.app_name, entity_type="user", channel_name=p.channel_name
+            ).items()
+        }
+        items = {
+            eid: Item(categories=tuple(props.get_or_else("categories", [])))
+            for eid, props in store.aggregate_properties(
+                p.app_name, entity_type="item", channel_name=p.channel_name
+            ).items()
+        }
+        rates = [
+            RateEvent(
+                user=e.entity_id,
+                item=e.target_entity_id,
+                rating=(
+                    4.0
+                    if e.event == "buy"
+                    else float(e.properties.get_or_else("rating", 1.0))
+                ),
+                t=e.event_time.timestamp(),
+            )
+            for e in store.find(
+                p.app_name,
+                channel_name=p.channel_name,
+                entity_type="user",
+                event_names=["rate", "buy"],
+                target_entity_type="item",
+            )
+        ]
+        logger.info(
+            "DataSource: %d users, %d items, %d rate events",
+            len(users), len(items), len(rates),
+        )
+        return TrainingData(users=users, items=items, rate_events=rates)
+
+
+class Preparator(BasePreparator):
+    def prepare(self, ctx, td: TrainingData) -> PreparedData:
+        return PreparedData(td=td)
+
+
+@dataclasses.dataclass(frozen=True)
+class ECommAlgorithmParams(Params):
+    app_name: str = "default"
+    unseen_only: bool = False
+    seen_events: Tuple[str, ...] = ("buy", "view")
+    similar_events: Tuple[str, ...] = ("view",)
+    rank: int = 10
+    num_iterations: int = 20
+    lambda_: float = 0.01
+    seed: Optional[int] = None
+
+
+@dataclasses.dataclass
+class ECommModel:
+    user_factors: np.ndarray  # [n_users, k]
+    item_factors: np.ndarray  # [n_items, k]
+    user_index: BiMap
+    item_index: BiMap
+    items: Dict[int, Item]
+    _scorer: Optional[SimilarityScorer] = dataclasses.field(
+        default=None, repr=False, compare=False
+    )
+    _inv_item: Optional[BiMap] = dataclasses.field(
+        default=None, repr=False, compare=False
+    )
+
+    def __getstate__(self):
+        state = self.__dict__.copy()
+        state["_scorer"] = None
+        state["_inv_item"] = None
+        return state
+
+    @property
+    def scorer(self) -> SimilarityScorer:
+        if self._scorer is None:
+            self._scorer = SimilarityScorer(self.item_factors)
+        return self._scorer
+
+    @property
+    def inv_item(self) -> BiMap:
+        if self._inv_item is None:
+            self._inv_item = self.item_index.inverse()
+        return self._inv_item
+
+
+class ECommAlgorithm(BaseAlgorithm):
+    """Explicit ALS + predict-time business rules (reference
+    ALSAlgorithm.scala of the train-with-rate-event variant)."""
+
+    params_class = ECommAlgorithmParams
+    query_class = Query
+
+    def train(self, ctx, pd: PreparedData) -> ECommModel:
+        td = pd.td
+        p = self.params
+        user_index = BiMap.string_int(
+            set(td.users.keys()) | {r.user for r in td.rate_events}
+        )
+        item_index = BiMap.string_int(td.items.keys())
+        # latest rating per (user, item) wins (reference reduceByKey by t)
+        latest: Dict[Tuple[int, int], Tuple[float, float]] = {}
+        for r in td.rate_events:
+            if r.item not in item_index:
+                logger.info("item %s has no $set event; skipping", r.item)
+                continue
+            key = (user_index[r.user], item_index[r.item])
+            if key not in latest or r.t >= latest[key][0]:
+                latest[key] = (r.t, r.rating)
+        if not latest:
+            raise ValueError("no valid ratings after index mapping")
+        triples = [(u, i, v) for (u, i), (_, v) in latest.items()]
+        u, i, r = (np.asarray(x) for x in zip(*triples))
+        arrays = train_als(
+            u.astype(np.int32),
+            i.astype(np.int32),
+            r.astype(np.float32),
+            n_users=len(user_index),
+            n_items=len(item_index),
+            config=ALSConfig(
+                rank=p.rank,
+                iterations=p.num_iterations,
+                reg=p.lambda_,
+                implicit_prefs=False,
+                seed=p.seed if p.seed is not None else 0,
+            ),
+            mesh=ctx.mesh if ctx is not None else None,
+        )
+        return ECommModel(
+            user_factors=arrays.user_factors,
+            item_factors=arrays.item_factors,
+            user_index=user_index,
+            item_index=item_index,
+            items={item_index[k]: v for k, v in td.items.items()},
+        )
+
+    # --- predict-time business rules ---
+
+    def _seen_items(self, query: Query) -> Set[str]:
+        if not self.params.unseen_only:
+            return set()
+        try:
+            events = LEventStore().find_by_entity(
+                app_name=self.params.app_name,
+                entity_type="user",
+                entity_id=query.user,
+                event_names=list(self.params.seen_events),
+                target_entity_type="item",
+            )
+            return {
+                e.target_entity_id for e in events if e.target_entity_id
+            }
+        except Exception as e:
+            logger.error("Error when reading seen events: %s", e)
+            return set()
+
+    def _unavailable_items(self) -> Set[str]:
+        """Latest $set on the 'constraint'/'unavailableItems' entity
+        (reference :considers the single latest event)."""
+        try:
+            events = list(
+                LEventStore().find_by_entity(
+                    app_name=self.params.app_name,
+                    entity_type="constraint",
+                    entity_id="unavailableItems",
+                    event_names=["$set"],
+                    limit=1,
+                    latest=True,
+                )
+            )
+            if events:
+                return set(events[0].properties.get_or_else("items", []))
+        except Exception as e:
+            logger.error("Error when reading unavailableItems: %s", e)
+        return set()
+
+    def _candidate_mask(
+        self, model: ECommModel, query: Query, black_list: Set[str]
+    ) -> np.ndarray:
+        n = model.item_factors.shape[0]
+        mask = np.ones(n, bool)
+        if query.white_list is not None:
+            wl = np.zeros(n, bool)
+            wl[[
+                model.item_index[i]
+                for i in query.white_list
+                if i in model.item_index
+            ]] = True
+            mask &= wl
+        mask[[
+            model.item_index[i] for i in black_list if i in model.item_index
+        ]] = False
+        if query.categories is not None:
+            cats = set(query.categories)
+            for idx in np.nonzero(mask)[0]:
+                item = model.items.get(int(idx))
+                if item is None or not cats.intersection(item.categories):
+                    mask[idx] = False
+        return mask
+
+    def predict(self, model: ECommModel, query: Query) -> PredictedResult:
+        black_list = set(query.black_list or ())
+        black_list |= self._seen_items(query)
+        black_list |= self._unavailable_items()
+        mask = self._candidate_mask(model, query, black_list)
+
+        user_idx = model.user_index.get(query.user)
+        if user_idx is not None and np.any(model.user_factors[user_idx]):
+            uf = model.user_factors[user_idx]
+            scores = model.item_factors @ uf  # [n_items]
+        else:
+            logger.info("no userFeature found for user %s", query.user)
+            scores = self._similar_to_recent(model, query)
+            if scores is None:
+                return PredictedResult()
+
+        scores = np.where(mask & (scores > 0), scores, -np.inf)
+        num = min(query.num, int((scores > -np.inf).sum()))
+        if num <= 0:
+            return PredictedResult()
+        top = np.argpartition(-scores, num - 1)[:num]
+        top = top[np.argsort(-scores[top])]
+        return PredictedResult(
+            item_scores=tuple(
+                ItemScore(item=model.inv_item[int(i)], score=float(scores[i]))
+                for i in top
+            )
+        )
+
+    def _similar_to_recent(
+        self, model: ECommModel, query: Query
+    ) -> Optional[np.ndarray]:
+        """Unknown user: cosine-sum against the 10 most recent similar-event
+        items (reference predictNewUser)."""
+        try:
+            recent = list(
+                LEventStore().find_by_entity(
+                    app_name=self.params.app_name,
+                    entity_type="user",
+                    entity_id=query.user,
+                    event_names=list(self.params.similar_events),
+                    target_entity_type="item",
+                    limit=10,
+                    latest=True,
+                )
+            )
+        except Exception as e:
+            logger.error("Error when reading recent events: %s", e)
+            return None
+        recent_idx = [
+            model.item_index[e.target_entity_id]
+            for e in recent
+            if e.target_entity_id in model.item_index
+        ]
+        if not recent_idx:
+            return None
+        return model.scorer.cosine_sum(model.scorer.normed[recent_idx])
+
+    def batch_predict(self, model, queries) -> List[Tuple[int, PredictedResult]]:
+        """Known users score as ONE [B, k] x [k, n_items] matmul; unknown
+        users fall back to the per-query similar-items path."""
+        known = [
+            (qi, model.user_index[q.user])
+            for qi, q in queries
+            if model.user_index.get(q.user) is not None
+            and np.any(model.user_factors[model.user_index[q.user]])
+        ]
+        out: List[Tuple[int, PredictedResult]] = []
+        if known:
+            U = model.user_factors[[u for _, u in known]]
+            all_scores = U @ model.item_factors.T  # [B, n_items]
+            by_qi = {qi: all_scores[row] for row, (qi, _) in enumerate(known)}
+        else:
+            by_qi = {}
+        for qi, q in queries:
+            if qi in by_qi:
+                out.append((qi, self._finish(model, q, by_qi[qi])))
+            else:
+                out.append((qi, self.predict(model, q)))
+        return out
+
+    def _finish(
+        self, model: ECommModel, query: Query, scores: np.ndarray
+    ) -> PredictedResult:
+        black_list = set(query.black_list or ())
+        black_list |= self._seen_items(query)
+        black_list |= self._unavailable_items()
+        mask = self._candidate_mask(model, query, black_list)
+        scores = np.where(mask & (scores > 0), scores, -np.inf)
+        num = min(query.num, int((scores > -np.inf).sum()))
+        if num <= 0:
+            return PredictedResult()
+        top = np.argpartition(-scores, num - 1)[:num]
+        top = top[np.argsort(-scores[top])]
+        return PredictedResult(
+            item_scores=tuple(
+                ItemScore(item=model.inv_item[int(i)], score=float(scores[i]))
+                for i in top
+            )
+        )
+
+    def result_to_json(self, result: PredictedResult):
+        return {
+            "itemScores": [
+                {"item": s.item, "score": s.score}
+                for s in result.item_scores
+            ]
+        }
+
+
+class Serving(FirstServing):
+    pass
+
+
+def ecommerce_engine() -> Engine:
+    return Engine(
+        data_source_classes=DataSource,
+        preparator_classes=Preparator,
+        algorithm_classes={"ecomm": ECommAlgorithm},
+        serving_classes=Serving,
+    )
+
+
+class ECommerceEngineFactory(EngineFactory):
+    def apply(self) -> Engine:
+        return ecommerce_engine()

@@ -1,0 +1,4 @@
+from predictionio_tpu.models.similarproduct.engine import (  # noqa: F401
+    SimilarProductEngineFactory,
+    similarproduct_engine,
+)

@@ -1,0 +1,387 @@
+"""Similar-product engine: ALS item factors + cosine top-N.
+
+Reference mapping (examples/scala-parallel-similarproduct/multi/src/main/scala/):
+- Query(items, num, categories?, whiteList?, blackList?) /
+  PredictedResult(itemScores)                  <- Engine.scala
+- DataSource: $set users/items + view events   <- DataSource.scala
+- Preparator pass-through                      <- Preparator.scala
+- ALSAlgorithm: implicit ALS over deduplicated view counts; predict =
+  sum-of-cosines of candidate item factors against the query items'
+  factors, filtered by candidacy rules          <- ALSAlgorithm.scala
+- LikeAlgorithm (the "multi" variant's second algorithm): same ALS but
+  over like/dislike events, like=+1 dislike=-1, latest event wins
+                                               <- LikeAlgorithm.scala
+- Serving sums scores per item across algorithms <- Serving.scala
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from predictionio_tpu.controller import (
+    BaseAlgorithm,
+    BaseDataSource,
+    BasePreparator,
+    BaseServing,
+    EngineFactory,
+    Params,
+    SanityCheck,
+)
+from predictionio_tpu.controller.engine import Engine
+from predictionio_tpu.data.bimap import BiMap
+from predictionio_tpu.data.store import PEventStore
+from predictionio_tpu.ops.als import ALSConfig, train_als
+from predictionio_tpu.ops.similarity import SimilarityScorer, normalize_rows
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass(frozen=True)
+class Query:
+    items: Tuple[str, ...]
+    num: int = 10
+    categories: Optional[Tuple[str, ...]] = None
+    white_list: Optional[Tuple[str, ...]] = None
+    black_list: Optional[Tuple[str, ...]] = None
+
+    def __post_init__(self):
+        object.__setattr__(self, "items", tuple(self.items))
+        for f in ("categories", "white_list", "black_list"):
+            v = getattr(self, f)
+            if v is not None:
+                object.__setattr__(self, f, tuple(v))
+
+
+@dataclasses.dataclass(frozen=True)
+class ItemScore:
+    item: str
+    score: float
+
+
+@dataclasses.dataclass(frozen=True)
+class PredictedResult:
+    item_scores: Tuple[ItemScore, ...] = ()
+
+    def __post_init__(self):
+        object.__setattr__(
+            self,
+            "item_scores",
+            tuple(
+                s if isinstance(s, ItemScore) else ItemScore(**s)
+                for s in self.item_scores
+            ),
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class Item:
+    categories: Tuple[str, ...] = ()
+
+
+@dataclasses.dataclass
+class ViewEvent:
+    user: str
+    item: str
+    t: float
+
+
+@dataclasses.dataclass
+class LikeEvent:
+    user: str
+    item: str
+    t: float
+    like: bool  # like=True, dislike=False
+
+
+@dataclasses.dataclass
+class TrainingData(SanityCheck):
+    users: Dict[str, dict]
+    items: Dict[str, Item]
+    view_events: List[ViewEvent]
+    like_events: List[LikeEvent] = dataclasses.field(default_factory=list)
+
+    def sanity_check(self) -> None:
+        if not self.items:
+            raise ValueError("items is empty — are item $set events present?")
+        if not self.view_events and not self.like_events:
+            raise ValueError("viewEvents is empty — are view events present?")
+
+
+@dataclasses.dataclass
+class PreparedData:
+    td: TrainingData
+
+
+@dataclasses.dataclass(frozen=True)
+class DataSourceParams(Params):
+    app_name: str = "default"
+    channel_name: Optional[str] = None
+
+
+class DataSource(BaseDataSource):
+    """$set users/items + user-view->item events (reference DataSource.scala)."""
+
+    params_class = DataSourceParams
+
+    def read_training(self, ctx) -> TrainingData:
+        store = PEventStore(ctx.storage)
+        p = self.params
+        users = {
+            eid: dict(props)
+            for eid, props in store.aggregate_properties(
+                p.app_name, entity_type="user", channel_name=p.channel_name
+            ).items()
+        }
+        items = {
+            eid: Item(categories=tuple(props.get_or_else("categories", [])))
+            for eid, props in store.aggregate_properties(
+                p.app_name, entity_type="item", channel_name=p.channel_name
+            ).items()
+        }
+        views = [
+            ViewEvent(
+                user=e.entity_id,
+                item=e.target_entity_id,
+                t=e.event_time.timestamp(),
+            )
+            for e in store.find(
+                p.app_name,
+                channel_name=p.channel_name,
+                entity_type="user",
+                event_names=["view"],
+                target_entity_type="item",
+            )
+        ]
+        likes = [
+            LikeEvent(
+                user=e.entity_id,
+                item=e.target_entity_id,
+                t=e.event_time.timestamp(),
+                like=e.event == "like",
+            )
+            for e in store.find(
+                p.app_name,
+                channel_name=p.channel_name,
+                entity_type="user",
+                event_names=["like", "dislike"],
+                target_entity_type="item",
+            )
+        ]
+        logger.info(
+            "DataSource: %d users, %d items, %d views, %d likes",
+            len(users), len(items), len(views), len(likes),
+        )
+        return TrainingData(
+            users=users, items=items, view_events=views, like_events=likes
+        )
+
+
+class Preparator(BasePreparator):
+    def prepare(self, ctx, td: TrainingData) -> PreparedData:
+        return PreparedData(td=td)
+
+
+@dataclasses.dataclass(frozen=True)
+class ALSAlgorithmParams(Params):
+    rank: int = 10
+    num_iterations: int = 20
+    lambda_: float = 0.01
+    seed: Optional[int] = None
+
+
+@dataclasses.dataclass
+class SPModel:
+    """Item factors + metadata for similarity serving. The normalized
+    factor matrix lives on device via a lazily-built SimilarityScorer."""
+
+    item_factors: np.ndarray  # [n_items, k]
+    item_index: BiMap
+    items: Dict[int, Item]  # dense index -> metadata
+    _scorer: Optional[SimilarityScorer] = dataclasses.field(
+        default=None, repr=False, compare=False
+    )
+    _inv_index: Optional[BiMap] = dataclasses.field(
+        default=None, repr=False, compare=False
+    )
+
+    def __getstate__(self):
+        state = self.__dict__.copy()
+        state["_scorer"] = None
+        state["_inv_index"] = None
+        return state
+
+    @property
+    def scorer(self) -> SimilarityScorer:
+        if self._scorer is None:
+            self._scorer = SimilarityScorer(self.item_factors)
+        return self._scorer
+
+    @property
+    def inv_index(self) -> BiMap:
+        if self._inv_index is None:
+            self._inv_index = self.item_index.inverse()
+        return self._inv_index
+
+    def similar(self, query: Query) -> PredictedResult:
+        """Reference ALSAlgorithm.predict: sum-of-cosines scoring with
+        candidacy filtering and top-num selection."""
+        query_idx = [
+            self.item_index[i] for i in query.items if i in self.item_index
+        ]
+        if not query_idx:
+            logger.info("no item factors for query items %s", query.items)
+            return PredictedResult()
+        scores = self.scorer.cosine_sum(self.scorer.normed[query_idx])
+
+        mask = scores > 0
+        mask[query_idx] = False  # exclude the query items themselves
+        if query.white_list is not None:
+            wl = np.zeros_like(mask)
+            wl[[
+                self.item_index[i]
+                for i in query.white_list
+                if i in self.item_index
+            ]] = True
+            mask &= wl
+        if query.black_list is not None:
+            mask[[
+                self.item_index[i]
+                for i in query.black_list
+                if i in self.item_index
+            ]] = False
+        if query.categories is not None:
+            cats = set(query.categories)
+            for idx in np.nonzero(mask)[0]:
+                item = self.items.get(int(idx))
+                if item is None or not cats.intersection(item.categories):
+                    mask[idx] = False
+
+        scores = np.where(mask, scores, -np.inf)
+        num = min(query.num, int(mask.sum()))
+        if num <= 0:
+            return PredictedResult()
+        top = np.argpartition(-scores, num - 1)[:num]
+        top = top[np.argsort(-scores[top])]
+        return PredictedResult(
+            item_scores=tuple(
+                ItemScore(item=self.inv_index[int(i)], score=float(scores[i]))
+                for i in top
+            )
+        )
+
+
+class ALSAlgorithm(BaseAlgorithm):
+    """Implicit ALS over deduplicated view counts (reference
+    ALSAlgorithm.scala train: reduceByKey count -> ALS.trainImplicit)."""
+
+    params_class = ALSAlgorithmParams
+    query_class = Query
+
+    def _ratings(self, td: TrainingData):
+        """(user, item) -> value triples. Overridden by LikeAlgorithm."""
+        counts: Dict[Tuple[str, str], float] = {}
+        for v in td.view_events:
+            key = (v.user, v.item)
+            counts[key] = counts.get(key, 0.0) + 1.0
+        return counts
+
+    def train(self, ctx, pd: PreparedData) -> SPModel:
+        td = pd.td
+        item_index = BiMap.string_int(td.items.keys())
+        user_index = BiMap.string_int(
+            set(td.users.keys())
+            | {v.user for v in td.view_events}
+            | {e.user for e in td.like_events}
+        )
+        triples = [
+            (user_index[u], item_index[i], val)
+            for (u, i), val in self._ratings(td).items()
+            if i in item_index
+        ]
+        if not triples:
+            raise ValueError(
+                "no valid (user, item) events after index mapping"
+            )
+        u, i, r = (np.asarray(x) for x in zip(*triples))
+        p = self.params
+        arrays = train_als(
+            u.astype(np.int32),
+            i.astype(np.int32),
+            r.astype(np.float32),
+            n_users=len(user_index),
+            n_items=len(item_index),
+            config=ALSConfig(
+                rank=p.rank,
+                iterations=p.num_iterations,
+                reg=p.lambda_,
+                implicit_prefs=True,
+                seed=p.seed if p.seed is not None else 0,
+            ),
+            mesh=ctx.mesh if ctx is not None else None,
+        )
+        return SPModel(
+            item_factors=arrays.item_factors,
+            item_index=item_index,
+            items={item_index[i]: item for i, item in td.items.items()},
+        )
+
+    def predict(self, model: SPModel, query: Query) -> PredictedResult:
+        return model.similar(query)
+
+    def result_to_json(self, result: PredictedResult):
+        return {
+            "itemScores": [
+                {"item": s.item, "score": s.score}
+                for s in result.item_scores
+            ]
+        }
+
+
+class LikeAlgorithm(ALSAlgorithm):
+    """The multi-variant's second algorithm (reference LikeAlgorithm.scala):
+    like/dislike events, like=+1 dislike=-1, LATEST event per (user, item)
+    wins; same implicit ALS and cosine predict."""
+
+    def _ratings(self, td: TrainingData):
+        latest: Dict[Tuple[str, str], Tuple[float, float]] = {}
+        for e in td.like_events:
+            key = (e.user, e.item)
+            value = 1.0 if e.like else -1.0
+            if key not in latest or e.t >= latest[key][0]:
+                latest[key] = (e.t, value)
+        return {k: val for k, (_, val) in latest.items()}
+
+
+class Serving(BaseServing):
+    """Sums scores per item across algorithms (reference multi/Serving.scala
+    combines standard + like predictions by summed score)."""
+
+    def serve(self, query: Query, predictions: Sequence[PredictedResult]) -> PredictedResult:
+        combined: Dict[str, float] = {}
+        for p in predictions:
+            for s in p.item_scores:
+                combined[s.item] = combined.get(s.item, 0.0) + s.score
+        top = sorted(combined.items(), key=lambda kv: -kv[1])[: query.num]
+        return PredictedResult(
+            item_scores=tuple(
+                ItemScore(item=i, score=sc) for i, sc in top
+            )
+        )
+
+
+def similarproduct_engine() -> Engine:
+    return Engine(
+        data_source_classes=DataSource,
+        preparator_classes=Preparator,
+        algorithm_classes={"als": ALSAlgorithm, "likealgo": LikeAlgorithm},
+        serving_classes=Serving,
+    )
+
+
+class SimilarProductEngineFactory(EngineFactory):
+    def apply(self) -> Engine:
+        return similarproduct_engine()

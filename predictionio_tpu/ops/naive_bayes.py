@@ -1,0 +1,100 @@
+"""Multinomial Naive Bayes on device.
+
+The kernel behind the classification engine template (reference
+examples/scala-parallel-classification/add-algorithm/src/main/scala/
+NaiveBayesAlgorithm.scala:24-44, which delegates to MLlib
+``NaiveBayes.train(points, lambda)``). Semantics match MLlib multinomial NB:
+
+  pi[c]       = log(n_c + lambda) - log(n + lambda * C)
+  theta[c][j] = log(S[c][j] + lambda) - log(sum_j S[c][j] + lambda * F)
+
+where S[c][j] is the sum of feature j over class-c points.
+
+TPU-first design: the per-class feature sums are ONE [C, n] x [n, F]
+matmul (one-hot labels against the feature matrix — MXU work, not a
+combineByKey shuffle), and batch prediction is scores = X @ theta.T + pi,
+again a single matmul. All shapes static; float32 accumulation.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass
+class NaiveBayesModelArrays:
+    """log class priors [C] and log feature likelihoods [C, F]."""
+
+    pi: np.ndarray
+    theta: np.ndarray
+    labels: np.ndarray  # [C] the class label values (e.g. 0.0, 1.0, 2.0)
+
+    @property
+    def n_classes(self) -> int:
+        return self.pi.shape[0]
+
+
+@functools.partial(jax.jit, static_argnames=("n_classes",))
+def _fit(features, label_idx, lam, n_classes):
+    n = features.shape[0]
+    one_hot = jnp.asarray(
+        label_idx[None, :] == jnp.arange(n_classes)[:, None], jnp.float32
+    )  # [C, n]
+    class_counts = one_hot.sum(axis=1)  # [C]
+    sums = jnp.dot(one_hot, features, preferred_element_type=jnp.float32)  # [C, F]
+    pi = jnp.log(class_counts + lam) - jnp.log(
+        jnp.float32(n) + lam * n_classes
+    )
+    theta = jnp.log(sums + lam) - jnp.log(
+        sums.sum(axis=1, keepdims=True) + lam * features.shape[1]
+    )
+    return pi, theta
+
+
+@jax.jit
+def _scores(features, pi, theta):
+    return (
+        jnp.dot(features, theta.T, preferred_element_type=jnp.float32)
+        + pi[None, :]
+    )
+
+
+def train_naive_bayes(
+    features: np.ndarray, labels: np.ndarray, lam: float = 1.0
+) -> NaiveBayesModelArrays:
+    """Train on [n, F] nonnegative features with arbitrary scalar labels."""
+    features = np.asarray(features, np.float32)
+    labels = np.asarray(labels)
+    if features.ndim != 2 or len(features) != len(labels):
+        raise ValueError("features must be [n, F] aligned with labels [n]")
+    if len(labels) == 0:
+        raise ValueError("cannot train on an empty dataset")
+    if (features < 0).any():
+        raise ValueError("multinomial NB requires nonnegative features")
+    classes, label_idx = np.unique(labels, return_inverse=True)
+    pi, theta = _fit(
+        jnp.asarray(features),
+        jnp.asarray(label_idx.astype(np.int32)),
+        jnp.float32(lam),
+        n_classes=len(classes),
+    )
+    return NaiveBayesModelArrays(
+        pi=np.asarray(pi), theta=np.asarray(theta), labels=classes
+    )
+
+
+def predict_naive_bayes(
+    model: NaiveBayesModelArrays, features: np.ndarray
+) -> np.ndarray:
+    """Predicted label for each row of [B, F] (batch = one matmul)."""
+    features = np.atleast_2d(np.asarray(features, np.float32))
+    scores = _scores(
+        jnp.asarray(features), jnp.asarray(model.pi), jnp.asarray(model.theta)
+    )
+    return model.labels[np.asarray(jnp.argmax(scores, axis=1))]

@@ -1,0 +1,50 @@
+"""Cosine-similarity scoring over factor matrices.
+
+The kernel behind the similarproduct template (reference
+examples/scala-parallel-similarproduct/multi/src/main/scala/
+ALSAlgorithm.scala predict: per-candidate ``sum over query items of
+cosine(queryFactor, candidateFactor)``, computed there as an RDD
+mapValues over every product). Here the factor matrix is L2-normalized
+once at model build, so a whole query batch scores as ONE [Q, k] x [k, N]
+MXU matmul summed over the query axis.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def normalize_rows(factors: np.ndarray) -> np.ndarray:
+    """L2-normalize rows; zero rows stay zero (cosine with a zero vector
+    is 0 in the reference's cosine helper)."""
+    f = np.asarray(factors, np.float32)
+    norms = np.linalg.norm(f, axis=1, keepdims=True)
+    return np.where(norms > 0, f / np.where(norms == 0, 1, norms), 0.0)
+
+
+@jax.jit
+def _cosine_sum(query_normed, all_normed):
+    # [Q, k] x [k, N] -> sum over Q -> [N]
+    sims = jnp.dot(query_normed, all_normed.T, preferred_element_type=jnp.float32)
+    return sims.sum(axis=0)
+
+
+class SimilarityScorer:
+    """Device-resident normalized factors; each call ships only the query
+    rows up and one score vector down."""
+
+    def __init__(self, factors: np.ndarray):
+        self.normed = normalize_rows(factors)
+        self._dev = jax.device_put(jnp.asarray(self.normed))
+
+    @property
+    def n(self) -> int:
+        return self.normed.shape[0]
+
+    def cosine_sum(self, query_rows: np.ndarray) -> np.ndarray:
+        """Sum of cosine similarities of every row of the matrix against
+        the (already-normalized) query rows: [N] scores."""
+        q = jnp.asarray(np.atleast_2d(np.asarray(query_rows, np.float32)))
+        return np.asarray(_cosine_sum(q, self._dev))

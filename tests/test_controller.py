@@ -233,6 +233,26 @@ class TestEngineJson:
         ep = engine.jvalue_to_engine_params({})
         assert len(ep.algorithm_params_list) == 1
 
+    def test_dict_params_round_trip(self):
+        # regression: components without params_class must not double-wrap
+        # params across to_json -> jvalue_to_engine_params (the
+        # train-store-deploy path)
+        engine = make_engine()
+        variant = {
+            "datasource": {"params": {"custom": 1, "nested": {"x": [1, 2]}}},
+            "algorithms": [
+                {"name": "a0", "params": {"id": 5}},
+                {"name": "a1", "params": {"id": 6}},
+            ],
+        }
+        ep = engine.jvalue_to_engine_params(variant)
+        assert ep.data_source_params[1].values == {
+            "custom": 1,
+            "nested": {"x": [1, 2]},
+        }
+        ep2 = engine.jvalue_to_engine_params(ep.to_json())
+        assert ep2 == ep
+
 
 class TestMetrics:
     def _eval_data(self, hits, total):

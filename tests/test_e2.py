@@ -1,0 +1,190 @@
+"""e2 library tests — the analog of the reference's e2 test suites
+(CategoricalNaiveBayesTest, MarkovChainTest, PropertiesToBinaryTest,
+CrossValidationTest)."""
+
+import math
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.e2 import (
+    CategoricalNaiveBayes,
+    LabeledPoint,
+    MarkovChain,
+    PropertiesToBinary,
+    split_data,
+)
+
+# fruit-ish dataset: label depends strongly on the first feature
+POINTS = [
+    LabeledPoint("yes", ("sunny", "hot")),
+    LabeledPoint("yes", ("sunny", "mild")),
+    LabeledPoint("yes", ("overcast", "hot")),
+    LabeledPoint("no", ("rainy", "mild")),
+    LabeledPoint("no", ("rainy", "cool")),
+    LabeledPoint("no", ("sunny", "cool")),
+]
+
+
+class TestCategoricalNaiveBayes:
+    def test_priors(self):
+        model = CategoricalNaiveBayes.train(POINTS)
+        assert model.priors["yes"] == pytest.approx(math.log(3 / 6))
+        assert model.priors["no"] == pytest.approx(math.log(3 / 6))
+
+    def test_likelihoods(self):
+        model = CategoricalNaiveBayes.train(POINTS)
+        ll = model.likelihoods
+        # P(sunny | yes) = 2/3, P(hot | yes) = 2/3, P(rainy | no) = 2/3
+        assert ll["yes"][0]["sunny"] == pytest.approx(math.log(2 / 3))
+        assert ll["yes"][1]["hot"] == pytest.approx(math.log(2 / 3))
+        assert ll["no"][0]["rainy"] == pytest.approx(math.log(2 / 3))
+        # value never seen under the label is absent from the map view
+        assert "rainy" not in ll["yes"][0]
+
+    def test_log_score(self):
+        model = CategoricalNaiveBayes.train(POINTS)
+        score = model.log_score(LabeledPoint("yes", ("sunny", "hot")))
+        expected = math.log(1 / 2) + math.log(2 / 3) + math.log(2 / 3)
+        assert score == pytest.approx(expected)
+
+    def test_log_score_unknown_label_is_none(self):
+        model = CategoricalNaiveBayes.train(POINTS)
+        assert model.log_score(LabeledPoint("maybe", ("sunny", "hot"))) is None
+
+    def test_log_score_unseen_value_default_neg_inf(self):
+        model = CategoricalNaiveBayes.train(POINTS)
+        assert model.log_score(
+            LabeledPoint("yes", ("rainy", "hot"))
+        ) == float("-inf")
+
+    def test_log_score_custom_default_likelihood(self):
+        model = CategoricalNaiveBayes.train(POINTS)
+        # default = min of the present likelihoods for that (label, slot)
+        score = model.log_score(
+            LabeledPoint("yes", ("rainy", "hot")),
+            default_likelihood=lambda ls: min(ls) if ls else float("-inf"),
+        )
+        expected = (
+            math.log(1 / 2) + math.log(1 / 3) + math.log(2 / 3)
+        )  # min present likelihood in slot 0 under yes is 1/3 (overcast)
+        assert score == pytest.approx(expected, rel=1e-5)
+
+    def test_predict(self):
+        model = CategoricalNaiveBayes.train(POINTS)
+        assert model.predict(("sunny", "hot")) == "yes"
+        assert model.predict(("rainy", "cool")) == "no"
+
+    def test_predict_batch_matches_scalar(self):
+        model = CategoricalNaiveBayes.train(POINTS)
+        feats = [("sunny", "hot"), ("rainy", "cool"), ("overcast", "mild")]
+        batch = model.predict_batch(feats)
+        assert batch == [model.predict(f) for f in feats]
+
+    def test_mismatched_feature_count_raises(self):
+        with pytest.raises(ValueError):
+            CategoricalNaiveBayes.train(
+                [LabeledPoint("a", ("x",)), LabeledPoint("b", ("x", "y"))]
+            )
+
+    def test_empty_raises(self):
+        with pytest.raises(ValueError):
+            CategoricalNaiveBayes.train([])
+
+
+class TestMarkovChain:
+    # tallies: state 0 -> {1: 3, 2: 1}; state 1 -> {0: 2}; state 2 absorbing
+    ENTRIES = [(0, 1, 3.0), (0, 2, 1.0), (1, 0, 2.0)]
+
+    def test_transition_normalization(self):
+        model = MarkovChain.train(self.ENTRIES, n_states=3, top_n=2)
+        t = model.transition_map()
+        assert t[0] == [(1, pytest.approx(0.75)), (2, pytest.approx(0.25))]
+        assert t[1] == [(0, pytest.approx(1.0))]
+        assert 2 not in t
+
+    def test_top_n_truncation(self):
+        entries = [(0, 1, 5.0), (0, 2, 3.0), (0, 0, 2.0)]
+        model = MarkovChain.train(entries, n_states=3, top_n=2)
+        t = model.transition_map()
+        # keeps the two largest tallies (1:5, 2:3), normalized by the FULL
+        # row total (reference divides by total before take(topN))
+        assert t[0] == [(1, pytest.approx(0.5)), (2, pytest.approx(0.3))]
+
+    def test_predict_propagates(self):
+        model = MarkovChain.train(self.ENTRIES, n_states=3, top_n=2)
+        nxt = model.predict([1.0, 0.0, 0.0])
+        assert nxt == [
+            pytest.approx(0.0),
+            pytest.approx(0.75),
+            pytest.approx(0.25),
+        ]
+
+    def test_predict_mixes_states(self):
+        model = MarkovChain.train(self.ENTRIES, n_states=3, top_n=2)
+        nxt = model.predict([0.5, 0.5, 0.0])
+        assert nxt[0] == pytest.approx(0.5)  # from state 1
+        assert nxt[1] == pytest.approx(0.375)
+        assert nxt[2] == pytest.approx(0.125)
+
+
+class TestPropertiesToBinary:
+    MAPS = [
+        {"color": "red", "size": "big", "noise": "x"},
+        {"color": "blue", "size": "big"},
+        {"color": "red"},
+    ]
+
+    def test_fit_indexes_whitelisted_pairs(self):
+        enc = PropertiesToBinary.fit(self.MAPS, {"color", "size"})
+        assert enc.num_features == 3  # (color,red) (size,big) (color,blue)
+        assert ("noise", "x") not in enc.property_map
+
+    def test_to_binary(self):
+        enc = PropertiesToBinary.fit(self.MAPS, {"color", "size"})
+        v = enc.to_binary([("color", "red"), ("size", "big")])
+        assert v.shape == (3,)
+        assert v.sum() == 2.0
+        # unknown pairs are ignored
+        v2 = enc.to_binary([("color", "green")])
+        assert v2.sum() == 0.0
+
+    def test_batch(self):
+        enc = PropertiesToBinary.fit(self.MAPS, {"color", "size"})
+        batch = enc.to_binary_batch(self.MAPS)
+        assert batch.shape == (3, 3)
+        np.testing.assert_array_equal(
+            batch.sum(axis=1), [2.0, 2.0, 1.0]
+        )  # noise dropped from row 0
+
+
+class TestSplitData:
+    def test_folds_partition_dataset(self):
+        data = list(range(10))
+        folds = split_data(
+            3, data, "info",
+            training_data_creator=list,
+            query_creator=lambda d: ("q", d),
+            actual_creator=lambda d: ("a", d),
+        )
+        assert len(folds) == 3
+        for fold_idx, (td, ei, qa) in enumerate(folds):
+            assert ei == "info"
+            test_points = [q[1] for q, _ in qa]
+            # membership: idx % k == fold -> test
+            assert test_points == [d for d in data if d % 3 == fold_idx]
+            assert sorted(td + test_points) == data
+            for (qt, qd), (at, ad) in qa:
+                assert (qt, at) == ("q", "a") and qd == ad
+
+    def test_k1_puts_everything_in_test(self):
+        folds = split_data(
+            1, [1, 2, 3], None, list, lambda d: d, lambda d: d
+        )
+        td, _, qa = folds[0]
+        assert td == []
+        assert [q for q, _ in qa] == [1, 2, 3]
+
+    def test_invalid_k_raises(self):
+        with pytest.raises(ValueError):
+            split_data(0, [1], None, list, lambda d: d, lambda d: d)

@@ -1,0 +1,366 @@
+"""Tests for the classification, similarproduct, and e-commerce engine
+templates, plus the multinomial NB kernel (MLlib-parity math)."""
+
+import datetime as dt
+import math
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.data.event import DataMap, Event
+from predictionio_tpu.data.storage.base import App
+from predictionio_tpu.ops.naive_bayes import (
+    predict_naive_bayes,
+    train_naive_bayes,
+)
+from predictionio_tpu.workflow.context import WorkflowContext
+
+
+def make_app(storage, name="tpl"):
+    app_id = storage.get_meta_data_apps().insert(App(id=0, name=name))
+    storage.get_l_events().init(app_id)
+    return app_id
+
+
+def put(storage, app_id, event, entity_type, entity_id, target=None,
+        props=None, t=None):
+    e = Event(
+        event=event,
+        entity_type=entity_type,
+        entity_id=entity_id,
+        target_entity_type="item" if target else None,
+        target_entity_id=target,
+        properties=DataMap(props or {}),
+        event_time=t or dt.datetime.now(dt.timezone.utc),
+    )
+    storage.get_l_events().insert(e, app_id)
+
+
+class TestNaiveBayesKernel:
+    def test_matches_hand_computed_mllib_formula(self):
+        X = np.array([[1.0, 0.0], [2.0, 0.0], [0.0, 1.0]], np.float32)
+        y = np.array([0.0, 0.0, 1.0])
+        m = train_naive_bayes(X, y, lam=1.0)
+        # pi[0] = log(2+1) - log(3+1*2); pi[1] = log(1+1) - log(5)
+        assert m.pi[0] == pytest.approx(math.log(3) - math.log(5), rel=1e-5)
+        assert m.pi[1] == pytest.approx(math.log(2) - math.log(5), rel=1e-5)
+        # theta[0] = log([3+1, 0+1]) - log(3 + 2)
+        assert m.theta[0, 0] == pytest.approx(
+            math.log(4) - math.log(5), rel=1e-5
+        )
+        assert m.theta[0, 1] == pytest.approx(
+            math.log(1) - math.log(5), rel=1e-5
+        )
+
+    def test_predict_recovers_separable_classes(self):
+        rng = np.random.default_rng(0)
+        n = 200
+        y = rng.integers(0, 3, n).astype(np.float64)
+        X = np.zeros((n, 3), np.float32)
+        X[np.arange(n), y.astype(int)] = 5.0
+        X += rng.uniform(0, 0.5, X.shape).astype(np.float32)
+        m = train_naive_bayes(X, y, lam=1.0)
+        pred = predict_naive_bayes(m, X)
+        assert (pred == y).mean() > 0.95
+
+    def test_rejects_negative_features(self):
+        with pytest.raises(ValueError):
+            train_naive_bayes(np.array([[-1.0]]), np.array([0.0]))
+
+
+@pytest.fixture()
+def classification_setup(mem_storage):
+    app_id = make_app(mem_storage, "clsapp")
+    rng = np.random.default_rng(1)
+    for uid in range(60):
+        plan = float(uid % 2)
+        base = 4.0 if plan == 1.0 else 0.5
+        put(
+            mem_storage, app_id, "$set", "user", f"u{uid}",
+            props={
+                "plan": plan,
+                "attr0": base + float(rng.uniform(0, 1)),
+                "attr1": float(rng.uniform(0, 1)),
+                "attr2": (0.5 if plan == 1.0 else 3.0) + float(rng.uniform(0, 1)),
+            },
+        )
+    return mem_storage
+
+
+class TestClassificationTemplate:
+    def _train(self, storage, algo="naive"):
+        from predictionio_tpu.models.classification.engine import (
+            classification_engine,
+        )
+
+        engine = classification_engine()
+        params = engine.jvalue_to_engine_params(
+            {
+                "datasource": {"params": {"app_name": "clsapp"}},
+                "algorithms": [{"name": algo, "params": {}}],
+            }
+        )
+        ctx = WorkflowContext(mode="training", storage=storage)
+        models = engine.train(ctx, params, None)
+        _, _, algorithms, _ = engine.make_components(params)
+        return algorithms[0], models[0]
+
+    def test_naive_bayes_pipeline(self, classification_setup):
+        from predictionio_tpu.models.classification.engine import Query
+
+        algo, model = self._train(classification_setup, "naive")
+        high = algo.predict(model, Query(features=(5.0, 0.5, 0.5)))
+        low = algo.predict(model, Query(features=(0.5, 0.5, 3.5)))
+        assert high.label == 1.0
+        assert low.label == 0.0
+
+    def test_logistic_regression_pipeline(self, classification_setup):
+        from predictionio_tpu.models.classification.engine import Query
+
+        algo, model = self._train(classification_setup, "logisticregression")
+        high = algo.predict(model, Query(features=(5.0, 0.5, 0.5)))
+        low = algo.predict(model, Query(features=(0.5, 0.5, 3.5)))
+        assert high.label == 1.0
+        assert low.label == 0.0
+
+    def test_eval_split(self, classification_setup):
+        from predictionio_tpu.models.classification.engine import (
+            DataSource,
+            DataSourceParams,
+        )
+
+        ds = DataSource(DataSourceParams(app_name="clsapp", eval_k=3))
+        ctx = WorkflowContext(mode="evaluation", storage=classification_setup)
+        folds = ds.read_eval(ctx)
+        assert len(folds) == 3
+        total_test = sum(len(qa) for _, _, qa in folds)
+        assert total_test == 60
+
+
+@pytest.fixture()
+def similarproduct_setup(mem_storage):
+    app_id = make_app(mem_storage, "spapp")
+    # two clusters of co-viewed items
+    for i in range(8):
+        cats = ["electronics"] if i < 4 else ["books"]
+        put(mem_storage, app_id, "$set", "item", f"i{i}",
+            props={"categories": cats})
+    rng = np.random.default_rng(2)
+    for uid in range(30):
+        put(mem_storage, app_id, "$set", "user", f"u{uid}", props={})
+        cluster = uid % 2
+        base = 0 if cluster == 0 else 4
+        for _ in range(6):
+            item = base + int(rng.integers(0, 4))
+            put(mem_storage, app_id, "view", "user", f"u{uid}",
+                target=f"i{item}")
+    return mem_storage
+
+
+class TestSimilarProductTemplate:
+    def _model(self, storage):
+        from predictionio_tpu.models.similarproduct.engine import (
+            ALSAlgorithm,
+            ALSAlgorithmParams,
+            DataSource,
+            DataSourceParams,
+            Preparator,
+        )
+
+        ctx = WorkflowContext(mode="training", storage=storage)
+        td = DataSource(DataSourceParams(app_name="spapp")).read_training(ctx)
+        pd = Preparator().prepare(ctx, td)
+        algo = ALSAlgorithm(
+            ALSAlgorithmParams(rank=8, num_iterations=10, seed=5)
+        )
+        return algo, algo.train(ctx, pd)
+
+    def test_similar_items_come_from_same_cluster(self, similarproduct_setup):
+        from predictionio_tpu.models.similarproduct.engine import Query
+
+        algo, model = self._model(similarproduct_setup)
+        result = algo.predict(model, Query(items=("i0",), num=3))
+        assert len(result.item_scores) == 3
+        got = {s.item for s in result.item_scores}
+        assert "i0" not in got  # query item excluded
+        # cluster 0 items should dominate
+        assert len(got & {"i1", "i2", "i3"}) >= 2
+
+    def test_black_and_white_lists(self, similarproduct_setup):
+        from predictionio_tpu.models.similarproduct.engine import Query
+
+        algo, model = self._model(similarproduct_setup)
+        result = algo.predict(
+            model, Query(items=("i0",), num=5, black_list=("i1",))
+        )
+        assert all(s.item != "i1" for s in result.item_scores)
+        result = algo.predict(
+            model, Query(items=("i0",), num=5, white_list=("i2", "i3"))
+        )
+        assert {s.item for s in result.item_scores} <= {"i2", "i3"}
+
+    def test_category_filter(self, similarproduct_setup):
+        from predictionio_tpu.models.similarproduct.engine import Query
+
+        algo, model = self._model(similarproduct_setup)
+        result = algo.predict(
+            model, Query(items=("i0",), num=8, categories=("books",))
+        )
+        assert all(
+            s.item in {"i4", "i5", "i6", "i7"} for s in result.item_scores
+        )
+
+    def test_unknown_query_items_empty_result(self, similarproduct_setup):
+        from predictionio_tpu.models.similarproduct.engine import Query
+
+        algo, model = self._model(similarproduct_setup)
+        assert algo.predict(model, Query(items=("zzz",))).item_scores == ()
+
+    def test_serving_sums_across_algorithms(self):
+        from predictionio_tpu.models.similarproduct.engine import (
+            ItemScore,
+            PredictedResult,
+            Query,
+            Serving,
+        )
+
+        serving = Serving()
+        merged = serving.serve(
+            Query(items=("x",), num=2),
+            [
+                PredictedResult(
+                    item_scores=(
+                        ItemScore("a", 1.0),
+                        ItemScore("b", 0.5),
+                    )
+                ),
+                PredictedResult(
+                    item_scores=(
+                        ItemScore("b", 0.9),
+                        ItemScore("c", 0.2),
+                    )
+                ),
+            ],
+        )
+        assert merged.item_scores[0] == ItemScore("b", 1.4)
+        assert merged.item_scores[1] == ItemScore("a", 1.0)
+
+
+@pytest.fixture()
+def ecommerce_setup(mem_storage):
+    app_id = make_app(mem_storage, "ecapp")
+    for i in range(6):
+        cats = ["electronics"] if i < 3 else ["books"]
+        put(mem_storage, app_id, "$set", "item", f"i{i}",
+            props={"categories": cats})
+    rng = np.random.default_rng(3)
+    t0 = dt.datetime(2026, 7, 1, tzinfo=dt.timezone.utc)
+    for uid in range(20):
+        put(mem_storage, app_id, "$set", "user", f"u{uid}", props={})
+        pref = 0 if uid % 2 == 0 else 3
+        for k in range(4):
+            item = pref + int(rng.integers(0, 3))
+            put(
+                mem_storage, app_id, "rate", "user", f"u{uid}",
+                target=f"i{item}",
+                props={"rating": float(rng.integers(3, 6))},
+                t=t0 + dt.timedelta(minutes=k),
+            )
+    return mem_storage, app_id, t0
+
+
+class TestECommerceTemplate:
+    def _model(self, storage, **param_overrides):
+        from predictionio_tpu.models.ecommerce.engine import (
+            DataSource,
+            DataSourceParams,
+            ECommAlgorithm,
+            ECommAlgorithmParams,
+            Preparator,
+        )
+
+        ctx = WorkflowContext(mode="training", storage=storage)
+        td = DataSource(DataSourceParams(app_name="ecapp")).read_training(ctx)
+        pd = Preparator().prepare(ctx, td)
+        algo = ECommAlgorithm(
+            ECommAlgorithmParams(
+                app_name="ecapp", rank=8, num_iterations=10, seed=4,
+                **param_overrides,
+            )
+        )
+        return algo, algo.train(ctx, pd)
+
+    def test_known_user_predictions(self, ecommerce_setup):
+        from predictionio_tpu.models.ecommerce.engine import Query
+
+        storage, _, _ = ecommerce_setup
+        algo, model = self._model(storage)
+        result = algo.predict(model, Query(user="u0", num=3))
+        assert len(result.item_scores) > 0
+        assert all(s.score > 0 for s in result.item_scores)
+
+    def test_unseen_only_filters_rated_items(self, ecommerce_setup):
+        from predictionio_tpu.models.ecommerce.engine import Query
+
+        storage, app_id, _ = ecommerce_setup
+        algo, model = self._model(
+            storage, unseen_only=True, seen_events=("rate",)
+        )
+        seen = {
+            e.target_entity_id
+            for e in storage.get_l_events().find(
+                app_id=app_id, entity_id="u0", event_names=["rate"]
+            )
+        }
+        result = algo.predict(model, Query(user="u0", num=6))
+        assert all(s.item not in seen for s in result.item_scores)
+
+    def test_unavailable_items_constraint(self, ecommerce_setup):
+        from predictionio_tpu.models.ecommerce.engine import Query
+
+        storage, app_id, _ = ecommerce_setup
+        algo, model = self._model(storage)
+        baseline = algo.predict(model, Query(user="u0", num=3))
+        banned = baseline.item_scores[0].item
+        put(
+            storage, app_id, "$set", "constraint", "unavailableItems",
+            props={"items": [banned]},
+        )
+        result = algo.predict(model, Query(user="u0", num=3))
+        assert all(s.item != banned for s in result.item_scores)
+
+    def test_unknown_user_falls_back_to_recent_views(self, ecommerce_setup):
+        from predictionio_tpu.models.ecommerce.engine import Query
+
+        storage, app_id, t0 = ecommerce_setup
+        # a brand-new user with only view events (not in training)
+        put(storage, app_id, "view", "user", "newbie", target="i0", t=t0)
+        algo, model = self._model(storage)
+        result = algo.predict(model, Query(user="newbie", num=3))
+        assert len(result.item_scores) > 0
+        assert all(s.item != "i0" or s.score > 0 for s in result.item_scores)
+
+    def test_unknown_user_no_history_empty(self, ecommerce_setup):
+        from predictionio_tpu.models.ecommerce.engine import Query
+
+        storage, _, _ = ecommerce_setup
+        algo, model = self._model(storage)
+        assert algo.predict(model, Query(user="ghost")).item_scores == ()
+
+    def test_batch_predict_matches_scalar(self, ecommerce_setup):
+        from predictionio_tpu.models.ecommerce.engine import Query
+
+        storage, _, _ = ecommerce_setup
+        algo, model = self._model(storage)
+        queries = [(i, Query(user=f"u{i}", num=3)) for i in range(4)]
+        batch = dict(algo.batch_predict(model, queries))
+        for i, q in queries:
+            scalar = algo.predict(model, q)
+            assert [s.item for s in batch[i].item_scores] == [
+                s.item for s in scalar.item_scores
+            ]
+            np.testing.assert_allclose(
+                [s.score for s in batch[i].item_scores],
+                [s.score for s in scalar.item_scores],
+                rtol=1e-5,
+            )
