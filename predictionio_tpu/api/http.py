@@ -35,17 +35,23 @@ class _Handler(BaseHTTPRequestHandler):
         except ValueError:
             length = 0
         body = self.rfile.read(length) if length > 0 else b""
+        # form-encoded bodies are parsed as a convenience, but the raw body
+        # is kept too: clients (curl -d) often post JSON without setting
+        # Content-Type, which defaults to form-urlencoded
         form = None
         ctype = (self.headers.get("Content-Type") or "").split(";")[0].strip()
         if ctype == "application/x-www-form-urlencoded":
-            form = dict(urllib.parse.parse_qsl(body.decode("utf-8")))
-            body = b""
+            try:
+                form = dict(urllib.parse.parse_qsl(body.decode("utf-8")))
+            except UnicodeDecodeError:
+                form = {}
         result = self.handle_fn(method, parsed.path, query, body, form)
         status, payload = result[0], result[1]
         out_type = result[2] if len(result) > 2 else "application/json"
-        if out_type == "application/json":
+        if out_type == "application/json" and not isinstance(payload, str):
             data = json.dumps(payload).encode("utf-8")
         else:
+            # str payloads are sent verbatim (pre-rendered JSON, HTML, text)
             data = str(payload).encode("utf-8")
         self.send_response(status)
         self.send_header("Content-Type", f"{out_type}; charset=utf-8")

@@ -75,6 +75,11 @@ class MarkovChain:
         (the reference's CoordinateMatrix entries)."""
         tally: Dict[int, Dict[int, float]] = {}
         for i, j, v in entries:
+            if not (0 <= int(i) < n_states and 0 <= int(j) < n_states):
+                raise ValueError(
+                    f"transition ({i} -> {j}) out of range for "
+                    f"{n_states} states"
+                )
             row = tally.setdefault(int(i), {})
             row[int(j)] = row.get(int(j), 0.0) + float(v)
 

@@ -19,6 +19,7 @@ per-point Scala loop.
 from __future__ import annotations
 
 import dataclasses
+import functools
 import math
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -42,10 +43,10 @@ class LabeledPoint:
         object.__setattr__(self, "features", tuple(self.features))
 
 
-@jax.jit
-def _count_flat(keys, n_keys_arr):
+@functools.partial(jax.jit, static_argnames=("n_keys",))
+def _count_flat(keys, n_keys):
     # scatter-add of ones over flattened (slot, label, value) keys
-    return jnp.zeros(n_keys_arr.shape[0], jnp.float32).at[keys].add(1.0)
+    return jnp.zeros(n_keys, jnp.float32).at[keys].add(1.0)
 
 
 @dataclasses.dataclass
@@ -180,7 +181,7 @@ class CategoricalNaiveBayes:
             flat_keys[pos : pos + len(points)] = (s * L + labels) * V + values
             pos += len(points)
         counts = np.asarray(
-            _count_flat(jnp.asarray(flat_keys), jnp.zeros(S * L * V))
+            _count_flat(jnp.asarray(flat_keys), S * L * V)
         ).reshape(S, L, V)
 
         label_counts = np.bincount(labels, minlength=L).astype(np.float64)

@@ -325,11 +325,11 @@ class ECommAlgorithm(BaseAlgorithm):
         return mask
 
     def predict(self, model: ECommModel, query: Query) -> PredictedResult:
-        black_list = set(query.black_list or ())
-        black_list |= self._seen_items(query)
-        black_list |= self._unavailable_items()
-        mask = self._candidate_mask(model, query, black_list)
+        return self._predict_one(model, query, self._unavailable_items())
 
+    def _predict_one(
+        self, model: ECommModel, query: Query, unavailable: Set[str]
+    ) -> PredictedResult:
         user_idx = model.user_index.get(query.user)
         if user_idx is not None and np.any(model.user_factors[user_idx]):
             uf = model.user_factors[user_idx]
@@ -339,19 +339,7 @@ class ECommAlgorithm(BaseAlgorithm):
             scores = self._similar_to_recent(model, query)
             if scores is None:
                 return PredictedResult()
-
-        scores = np.where(mask & (scores > 0), scores, -np.inf)
-        num = min(query.num, int((scores > -np.inf).sum()))
-        if num <= 0:
-            return PredictedResult()
-        top = np.argpartition(-scores, num - 1)[:num]
-        top = top[np.argsort(-scores[top])]
-        return PredictedResult(
-            item_scores=tuple(
-                ItemScore(item=model.inv_item[int(i)], score=float(scores[i]))
-                for i in top
-            )
-        )
+        return self._finish(model, query, scores, unavailable)
 
     def _similar_to_recent(
         self, model: ECommModel, query: Query
@@ -384,7 +372,9 @@ class ECommAlgorithm(BaseAlgorithm):
 
     def batch_predict(self, model, queries) -> List[Tuple[int, PredictedResult]]:
         """Known users score as ONE [B, k] x [k, n_items] matmul; unknown
-        users fall back to the per-query similar-items path."""
+        users fall back to the per-query similar-items path. The
+        query-independent unavailableItems constraint reads once per batch."""
+        unavailable = self._unavailable_items()
         known = [
             (qi, model.user_index[q.user])
             for qi, q in queries
@@ -400,17 +390,23 @@ class ECommAlgorithm(BaseAlgorithm):
             by_qi = {}
         for qi, q in queries:
             if qi in by_qi:
-                out.append((qi, self._finish(model, q, by_qi[qi])))
+                out.append(
+                    (qi, self._finish(model, q, by_qi[qi], unavailable))
+                )
             else:
-                out.append((qi, self.predict(model, q)))
+                out.append((qi, self._predict_one(model, q, unavailable)))
         return out
 
     def _finish(
-        self, model: ECommModel, query: Query, scores: np.ndarray
+        self,
+        model: ECommModel,
+        query: Query,
+        scores: np.ndarray,
+        unavailable: Set[str],
     ) -> PredictedResult:
         black_list = set(query.black_list or ())
         black_list |= self._seen_items(query)
-        black_list |= self._unavailable_items()
+        black_list |= unavailable
         mask = self._candidate_mask(model, query, black_list)
         scores = np.where(mask & (scores > 0), scores, -np.inf)
         num = min(query.num, int((scores > -np.inf).sum()))

@@ -1,0 +1,10 @@
+"""Tools layer: the ``pio`` console, admin commands, admin REST server,
+evaluation dashboard, and event export/import.
+
+Capability parity with the reference ``tools`` module
+(tools/src/main/scala/io/prediction/tools/): where the reference launches
+every workload through spark-submit subprocesses (Runner.scala:36), the
+single-controller runtime runs train/eval/deploy in process — the process
+boundary collapses to a function call, and the ``pio`` entry point is
+``python -m predictionio_tpu.tools.cli``.
+"""

@@ -1,0 +1,115 @@
+"""Admin REST server on :7071.
+
+Capability parity with the reference AdminAPI
+(tools/src/main/scala/io/prediction/tools/admin/AdminAPI.scala:66-141):
+
+  GET    /                     -> {"status": "alive"}
+  GET    /cmd/app              -> list apps
+  POST   /cmd/app              -> create app {"name", "id"?, "description"?}
+  DELETE /cmd/app/<name>       -> delete app
+  DELETE /cmd/app/<name>/data  -> wipe app event data
+
+Backed by the shared CommandClient (the reference's CommandClient.scala).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from typing import Optional, Tuple
+
+from predictionio_tpu.api.http import JsonHTTPServer
+from predictionio_tpu.data.storage import Storage, get_storage
+from predictionio_tpu.tools.commands import (
+    AppDescription,
+    CommandClient,
+    CommandError,
+)
+
+logger = logging.getLogger(__name__)
+
+
+def _describe(d: AppDescription) -> dict:
+    return {
+        "name": d.app.name,
+        "id": d.app.id,
+        "description": d.app.description or "",
+        "accessKeys": [
+            {"key": k.key, "events": list(k.events)} for k in d.access_keys
+        ],
+        "channels": [{"name": c.name, "id": c.id} for c in d.channels],
+    }
+
+
+class AdminAPI:
+    def __init__(self, storage: Optional[Storage] = None):
+        self.client = CommandClient(storage or get_storage())
+
+    def handle(self, method, path, query=None, body=None, form=None) -> Tuple[int, dict]:
+        try:
+            return self._route(method, path, body)
+        except CommandError as e:
+            return 400, {"status": 1, "message": str(e)}
+        except Exception as e:
+            logger.exception("admin error on %s %s", method, path)
+            return 500, {"status": 1, "message": str(e)}
+
+    def _route(self, method, path, body) -> Tuple[int, dict]:
+        parts = [p for p in path.strip("/").split("/") if p]
+        if not parts:
+            if method == "GET":
+                return 200, {"status": "alive"}
+            return 405, {"message": "Method not allowed."}
+        if parts[0] != "cmd" or len(parts) < 2 or parts[1] != "app":
+            return 404, {"message": "Not Found"}
+
+        if len(parts) == 2:
+            if method == "GET":
+                return 200, {
+                    "status": 0,
+                    "apps": [_describe(d) for d in self.client.app_list()],
+                }
+            if method == "POST":
+                try:
+                    payload = json.loads((body or b"{}").decode("utf-8"))
+                except json.JSONDecodeError as e:
+                    return 400, {"status": 1, "message": str(e)}
+                if "name" not in payload:
+                    return 400, {"status": 1, "message": "name is required"}
+                d = self.client.app_new(
+                    payload["name"],
+                    app_id=int(payload.get("id") or 0),
+                    description=payload.get("description"),
+                )
+                return 200, {"status": 0, **_describe(d)}
+            return 405, {"message": "Method not allowed."}
+
+        app_name = parts[2]
+        if len(parts) == 3 and method == "DELETE":
+            self.client.app_delete(app_name)
+            return 200, {"status": 0, "message": f"App {app_name} deleted."}
+        if len(parts) == 4 and parts[3] == "data" and method == "DELETE":
+            self.client.app_data_delete(app_name)
+            return 200, {
+                "status": 0,
+                "message": f"Data of app {app_name} deleted.",
+            }
+        return 404, {"message": "Not Found"}
+
+
+class AdminServer(JsonHTTPServer):
+    def __init__(
+        self,
+        ip: str = "localhost",
+        port: int = 7071,
+        storage: Optional[Storage] = None,
+    ):
+        self.api = AdminAPI(storage)
+        super().__init__(self.api.handle, ip, port, "Admin Server")
+
+
+def create_admin_server(
+    ip: str = "localhost", port: int = 7071, storage: Optional[Storage] = None
+) -> AdminServer:
+    """Reference AdminServer.createAdminServer (AdminAPI.scala:128-141)."""
+    return AdminServer(ip=ip, port=port, storage=storage)

@@ -1,0 +1,480 @@
+"""The ``pio`` console: python -m predictionio_tpu.tools.cli <command>.
+
+Capability parity with the reference pio CLI
+(tools/src/main/scala/io/prediction/tools/console/Console.scala:130-1292):
+
+  app new|list|show|delete|data-delete|channel-new|channel-delete
+  accesskey new|list|delete
+  build                        register the engine manifest
+  train                        run the training workflow
+  eval                         run an Evaluation (+ params generator)
+  deploy                       start the engine query server
+  undeploy                     stop a deployed server (HTTP /stop)
+  eventserver                  start the Event Server
+  adminserver                  start the admin REST server
+  dashboard                    start the evaluation dashboard
+  export | import              events <-> JSON-lines files
+  status                       check storage configuration
+  version
+
+Where the reference shells out to spark-submit (RunWorkflow.scala:32,
+RunServer.scala:29), commands here run in process: training is a direct
+CoreWorkflow call on the JAX runtime, deploy binds the query server in
+the foreground. Engines are resolved from the ``engineFactory`` class
+path in engine.json (the reference reflects the same field,
+WorkflowUtils.scala:63-119).
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import datetime as _dt
+import importlib
+import json
+import logging
+import sys
+import urllib.request
+from typing import Any, List, Optional
+
+from predictionio_tpu import __version__
+from predictionio_tpu.tools.commands import CommandClient, CommandError
+
+logger = logging.getLogger(__name__)
+
+
+# --- reflection (reference WorkflowUtils.getEngine / getEvaluation) ---
+
+
+def resolve_attr(class_path: str) -> Any:
+    """Resolve 'pkg.module.Attr' (or 'pkg.module' exposing a single
+    EngineFactory subclass / an ``engine_factory`` callable)."""
+    if "." in class_path:
+        module_path, _, attr = class_path.rpartition(".")
+        try:
+            module = importlib.import_module(module_path)
+            return getattr(module, attr)
+        except (ImportError, AttributeError):
+            pass
+    module = importlib.import_module(class_path)
+    for name in ("engine_factory", "EngineFactory"):
+        if hasattr(module, name):
+            return getattr(module, name)
+    raise ImportError(f"cannot resolve {class_path!r}")
+
+
+def resolve_engine_factory(class_path: str):
+    obj = resolve_attr(class_path)
+    return obj() if isinstance(obj, type) else obj
+
+
+def load_variant(path: str) -> dict:
+    with open(path) as f:
+        return json.load(f)
+
+
+def engine_from_variant(variant: dict):
+    factory_path = variant.get("engineFactory")
+    if not factory_path:
+        raise CommandError(
+            "engine.json must define 'engineFactory' "
+            "(a predictionio_tpu EngineFactory class path)"
+        )
+    factory = resolve_engine_factory(factory_path)
+    return factory.apply(), factory_path
+
+
+# --- command handlers ---
+
+
+def cmd_build(args) -> int:
+    """Register the engine manifest (reference Console.build:811 +
+    RegisterEngine.scala:33-136 — minus the sbt compile, which Python
+    doesn't need)."""
+    from predictionio_tpu.data.storage import get_storage
+    from predictionio_tpu.data.storage.base import EngineManifest
+
+    variant = load_variant(args.variant)
+    engine, factory_path = engine_from_variant(variant)  # validates
+    manifest = EngineManifest(
+        id=variant.get("id", factory_path),
+        version=variant.get("version", "0.1.0"),
+        name=variant.get("description", factory_path),
+        engine_factory=factory_path,
+        files=(args.variant,),
+    )
+    get_storage().get_meta_data_engine_manifests().update(manifest, upsert=True)
+    print(f"Registered engine {manifest.id} {manifest.version}")
+    return 0
+
+
+def cmd_train(args) -> int:
+    """Reference Console.train:846 -> CreateWorkflow -> CoreWorkflow."""
+    from predictionio_tpu.data.storage.base import EngineInstance
+    from predictionio_tpu.workflow.core_workflow import CoreWorkflow
+    from predictionio_tpu.workflow.workflow_params import WorkflowParams
+
+    variant = load_variant(args.variant)
+    engine, factory_path = engine_from_variant(variant)
+    engine_params = engine.jvalue_to_engine_params(variant)
+    now = _dt.datetime.now(_dt.timezone.utc)
+    instance = EngineInstance(
+        id="",
+        status="",
+        start_time=now,
+        end_time=now,
+        engine_id=variant.get("id", factory_path),
+        engine_version=variant.get("version", "0.1.0"),
+        engine_variant=args.variant,
+        engine_factory=factory_path,
+        batch=args.batch,
+    )
+    workflow_params = WorkflowParams(
+        batch=args.batch,
+        verbose=args.verbose,
+        skip_sanity_check=args.skip_sanity_check,
+        stop_after_read=args.stop_after_read,
+        stop_after_prepare=args.stop_after_prepare,
+    )
+    instance_id = CoreWorkflow.run_train(
+        engine, engine_params, instance, workflow_params=workflow_params
+    )
+    if instance_id is None:
+        print("Training interrupted by stop-after flag.")
+        return 0
+    print(f"Training completed. Engine instance: {instance_id}")
+    return 0
+
+
+def cmd_eval(args) -> int:
+    """Reference Console eval -> Workflow.runEvaluation."""
+    from predictionio_tpu.workflow.core_workflow import CoreWorkflow
+
+    evaluation_cls = resolve_attr(args.evaluation_class)
+    evaluation = (
+        evaluation_cls() if isinstance(evaluation_cls, type) else evaluation_cls
+    )
+    if args.engine_params_generator_class:
+        epg_cls = resolve_attr(args.engine_params_generator_class)
+        epg = epg_cls() if isinstance(epg_cls, type) else epg_cls
+        params_list = list(epg.engine_params_list)
+    else:
+        params_list = list(evaluation.engine_params_list)
+    result = CoreWorkflow.run_evaluation(evaluation, params_list)
+    print(result.to_one_liner())
+    return 0
+
+
+def cmd_deploy(args) -> int:
+    """Reference Console.deploy:869 -> CreateServer."""
+    from predictionio_tpu.api.engine_server import ServerConfig, create_server
+
+    variant = load_variant(args.variant)
+    engine, _ = engine_from_variant(variant)
+    config = ServerConfig(
+        ip=args.ip,
+        port=args.port,
+        engine_instance_id=args.engine_instance_id,
+        feedback=args.feedback,
+        event_server_ip=args.event_server_ip,
+        event_server_port=args.event_server_port,
+        access_key=args.accesskey,
+    )
+    server = create_server(engine, config)
+    print(f"Engine server serving on {args.ip}:{server.port}")
+    server.serve_forever()
+    return 0
+
+
+def cmd_undeploy(args) -> int:
+    """Reference Console.undeploy:934 — HTTP GET /stop."""
+    url = f"http://{args.ip}:{args.port}/stop"
+    try:
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            print(resp.read().decode())
+        return 0
+    except Exception as e:
+        print(f"Undeploy failed: {e}", file=sys.stderr)
+        return 1
+
+
+def cmd_eventserver(args) -> int:
+    from predictionio_tpu.api.event_server import (
+        EventServerConfig,
+        create_event_server,
+    )
+
+    server = create_event_server(
+        EventServerConfig(ip=args.ip, port=args.port, stats=args.stats)
+    )
+    print(f"Event server serving on {args.ip}:{server.port}")
+    server.serve_forever()
+    return 0
+
+
+def cmd_adminserver(args) -> int:
+    from predictionio_tpu.tools.admin_server import create_admin_server
+
+    server = create_admin_server(ip=args.ip, port=args.port)
+    print(f"Admin server serving on {args.ip}:{server.port}")
+    server.serve_forever()
+    return 0
+
+
+def cmd_dashboard(args) -> int:
+    from predictionio_tpu.tools.dashboard import create_dashboard
+
+    server = create_dashboard(ip=args.ip, port=args.port)
+    print(f"Dashboard serving on {args.ip}:{server.port}")
+    server.serve_forever()
+    return 0
+
+
+def cmd_export(args) -> int:
+    from predictionio_tpu.tools.export_import import events_to_file
+
+    n = events_to_file(args.app_name, args.output, args.channel)
+    print(f"Exported {n} events to {args.output}")
+    return 0
+
+
+def cmd_import(args) -> int:
+    from predictionio_tpu.tools.export_import import file_to_events
+
+    n = file_to_events(args.app_name, args.input, args.channel)
+    print(f"Imported {n} events")
+    return 0
+
+
+def cmd_status(args) -> int:
+    """Reference Console.status:1066 — storage config + smoke test."""
+    from predictionio_tpu.data.storage import get_storage
+
+    storage = get_storage()
+    print(f"PredictionIO-TPU {__version__}")
+    print("Storage repositories:")
+    for repo, conf in sorted(storage.repositories().items()):
+        print(f"  {repo}: source={conf.get('SOURCE')} name={conf.get('NAME')}")
+    print("Storage sources:")
+    for source, conf in sorted(storage.sources().items()):
+        print(f"  {source}: type={conf.get('TYPE')}")
+    try:
+        import jax
+
+        print(f"JAX devices: {jax.devices()}")
+    except Exception as e:  # status must not hard-fail on device probing
+        print(f"JAX devices unavailable: {e}")
+    if storage.verify_all_data_objects():
+        print("Storage verification OK. Your system is all ready to go.")
+        return 0
+    print("Storage verification FAILED.", file=sys.stderr)
+    return 1
+
+
+def _app_description_lines(d) -> List[str]:
+    out = [
+        f"  App Name: {d.app.name}",
+        f"    App ID: {d.app.id}",
+        f"    Description: {d.app.description or ''}",
+    ]
+    for k in d.access_keys:
+        allowed = ",".join(k.events) if k.events else "(all)"
+        out.append(f"    Access Key: {k.key} | {allowed}")
+    for c in d.channels:
+        out.append(f"    Channel: {c.name} (id {c.id})")
+    return out
+
+
+def cmd_app(args) -> int:
+    client = CommandClient()
+    if args.app_command == "new":
+        d = client.app_new(
+            args.name,
+            app_id=args.id or 0,
+            description=args.description,
+            access_key=args.access_key or "",
+        )
+        print("App created:")
+    elif args.app_command == "list":
+        for d in client.app_list():
+            print("\n".join(_app_description_lines(d)))
+        return 0
+    elif args.app_command == "show":
+        d = client.app_show(args.name)
+    elif args.app_command == "delete":
+        client.app_delete(args.name)
+        print(f"App {args.name} deleted.")
+        return 0
+    elif args.app_command == "data-delete":
+        client.app_data_delete(
+            args.name, channel=args.channel, all_channels=args.all
+        )
+        print(f"Data of app {args.name} deleted.")
+        return 0
+    elif args.app_command == "channel-new":
+        c = client.channel_new(args.name, args.channel)
+        print(f"Channel {c.name} created (id {c.id}).")
+        return 0
+    elif args.app_command == "channel-delete":
+        client.channel_delete(args.name, args.channel)
+        print(f"Channel {args.channel} deleted.")
+        return 0
+    else:
+        raise CommandError(f"unknown app command {args.app_command!r}")
+    print("\n".join(_app_description_lines(d)))
+    return 0
+
+
+def cmd_accesskey(args) -> int:
+    client = CommandClient()
+    if args.ak_command == "new":
+        k = client.access_key_new(
+            args.app_name, key=args.key or "", events=tuple(args.event or ())
+        )
+        print(f"Created new access key: {k.key}")
+    elif args.ak_command == "list":
+        for k in client.access_key_list(args.app_name):
+            allowed = ",".join(k.events) if k.events else "(all)"
+            print(f"{k.key} | app {k.appid} | {allowed}")
+    elif args.ak_command == "delete":
+        client.access_key_delete(args.key)
+        print(f"Deleted access key {args.key}.")
+    return 0
+
+
+def cmd_version(args) -> int:
+    print(__version__)
+    return 0
+
+
+# --- parser ---
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="pio", description="PredictionIO-TPU console"
+    )
+    p.add_argument("--verbose", action="store_true")
+    sub = p.add_subparsers(dest="command", required=True)
+
+    # app
+    app = sub.add_parser("app", help="manage apps")
+    app_sub = app.add_subparsers(dest="app_command", required=True)
+    ap_new = app_sub.add_parser("new")
+    ap_new.add_argument("name")
+    ap_new.add_argument("--id", type=int)
+    ap_new.add_argument("--description")
+    ap_new.add_argument("--access-key")
+    app_sub.add_parser("list")
+    for name in ("show", "delete"):
+        sp = app_sub.add_parser(name)
+        sp.add_argument("name")
+    dd = app_sub.add_parser("data-delete")
+    dd.add_argument("name")
+    dd.add_argument("--channel")
+    dd.add_argument("--all", action="store_true")
+    for name in ("channel-new", "channel-delete"):
+        sp = app_sub.add_parser(name)
+        sp.add_argument("name")
+        sp.add_argument("channel")
+    app.set_defaults(func=cmd_app)
+
+    # accesskey
+    ak = sub.add_parser("accesskey", help="manage access keys")
+    ak_sub = ak.add_subparsers(dest="ak_command", required=True)
+    ak_new = ak_sub.add_parser("new")
+    ak_new.add_argument("app_name")
+    ak_new.add_argument("--key")
+    ak_new.add_argument("--event", action="append")
+    ak_list = ak_sub.add_parser("list")
+    ak_list.add_argument("app_name", nargs="?")
+    ak_del = ak_sub.add_parser("delete")
+    ak_del.add_argument("key")
+    ak.set_defaults(func=cmd_accesskey)
+
+    # build / train / eval / deploy / undeploy
+    build = sub.add_parser("build", help="register the engine manifest")
+    build.add_argument("-v", "--variant", default="engine.json")
+    build.set_defaults(func=cmd_build)
+
+    train = sub.add_parser("train", help="run the training workflow")
+    train.add_argument("-v", "--variant", default="engine.json")
+    train.add_argument("-b", "--batch", default="")
+    train.add_argument("--skip-sanity-check", action="store_true")
+    train.add_argument("--stop-after-read", action="store_true")
+    train.add_argument("--stop-after-prepare", action="store_true")
+    train.set_defaults(func=cmd_train)
+
+    ev = sub.add_parser("eval", help="run an evaluation")
+    ev.add_argument("evaluation_class")
+    ev.add_argument("engine_params_generator_class", nargs="?")
+    ev.set_defaults(func=cmd_eval)
+
+    deploy = sub.add_parser("deploy", help="start the engine query server")
+    deploy.add_argument("-v", "--variant", default="engine.json")
+    deploy.add_argument("--ip", default="localhost")
+    deploy.add_argument("--port", type=int, default=8000)
+    deploy.add_argument("--engine-instance-id")
+    deploy.add_argument("--feedback", action="store_true")
+    deploy.add_argument("--event-server-ip", default="localhost")
+    deploy.add_argument("--event-server-port", type=int, default=7070)
+    deploy.add_argument("--accesskey")
+    deploy.set_defaults(func=cmd_deploy)
+
+    undeploy = sub.add_parser("undeploy", help="stop a deployed server")
+    undeploy.add_argument("--ip", default="localhost")
+    undeploy.add_argument("--port", type=int, default=8000)
+    undeploy.set_defaults(func=cmd_undeploy)
+
+    # servers
+    es = sub.add_parser("eventserver", help="start the Event Server")
+    es.add_argument("--ip", default="localhost")
+    es.add_argument("--port", type=int, default=7070)
+    es.add_argument("--stats", action="store_true")
+    es.set_defaults(func=cmd_eventserver)
+
+    admin = sub.add_parser("adminserver", help="start the admin server")
+    admin.add_argument("--ip", default="localhost")
+    admin.add_argument("--port", type=int, default=7071)
+    admin.set_defaults(func=cmd_adminserver)
+
+    dash = sub.add_parser("dashboard", help="start the evaluation dashboard")
+    dash.add_argument("--ip", default="localhost")
+    dash.add_argument("--port", type=int, default=9000)
+    dash.set_defaults(func=cmd_dashboard)
+
+    # export / import / status / version
+    exp = sub.add_parser("export", help="export events to a JSON-lines file")
+    exp.add_argument("--app-name", required=True)
+    exp.add_argument("--output", required=True)
+    exp.add_argument("--channel")
+    exp.set_defaults(func=cmd_export)
+
+    imp = sub.add_parser("import", help="import events from a JSON-lines file")
+    imp.add_argument("--app-name", required=True)
+    imp.add_argument("--input", required=True)
+    imp.add_argument("--channel")
+    imp.set_defaults(func=cmd_import)
+
+    sub.add_parser("status", help="check storage config").set_defaults(
+        func=cmd_status
+    )
+    sub.add_parser("version").set_defaults(func=cmd_version)
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    logging.basicConfig(
+        level=logging.INFO,
+        format="[%(levelname)s] [%(name)s] %(message)s",
+    )
+    args = build_parser().parse_args(argv)
+    try:
+        return args.func(args)
+    except CommandError as e:
+        print(str(e), file=sys.stderr)
+        return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,223 @@
+"""App/AccessKey/Channel admin commands.
+
+Capability parity with the reference console handlers
+(tools/src/main/scala/io/prediction/tools/console/App.scala:31-478,
+AccessKey.scala:26-83) and the admin CommandClient
+(tools/.../admin/CommandClient.scala:46-160). These are the shared core
+used by both the CLI and the admin REST server.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Dict, List, Optional
+
+from predictionio_tpu.data.storage import Storage, get_storage
+from predictionio_tpu.data.storage.base import AccessKey, App, Channel
+
+logger = logging.getLogger(__name__)
+
+
+class CommandError(Exception):
+    """A command failed in an expected way (bad input, conflict)."""
+
+
+@dataclasses.dataclass
+class AppDescription:
+    app: App
+    access_keys: List[AccessKey]
+    channels: List[Channel]
+
+
+class CommandClient:
+    def __init__(self, storage: Optional[Storage] = None):
+        self.storage = storage or get_storage()
+
+    # --- apps (reference App.scala:31-92 create w/ rollback) ---
+
+    def app_new(
+        self,
+        name: str,
+        app_id: int = 0,
+        description: Optional[str] = None,
+        access_key: str = "",
+    ) -> AppDescription:
+        apps = self.storage.get_meta_data_apps()
+        if apps.get_by_name(name) is not None:
+            raise CommandError(f"App {name} already exists. Aborting.")
+        if app_id:
+            if apps.get(app_id) is not None:
+                raise CommandError(f"App ID {app_id} already exists. Aborting.")
+        new_id = apps.insert(App(id=app_id, name=name, description=description))
+        if new_id is None:
+            raise CommandError("Unable to create new app.")
+        try:
+            events = self.storage.get_l_events()
+            if not events.init(new_id):
+                raise CommandError(
+                    f"Unable to initialize Event Store for app {name}."
+                )
+            key = self.storage.get_meta_data_access_keys().insert(
+                AccessKey(key=access_key, appid=new_id, events=())
+            )
+            if key is None:
+                raise CommandError("Unable to create new access key.")
+        except Exception:
+            # rollback the app row (reference App.scala:70-84)
+            apps.delete(new_id)
+            raise
+        app = apps.get(new_id)
+        logger.info("created app %s (id %d)", name, new_id)
+        return AppDescription(
+            app=app,
+            access_keys=self.storage.get_meta_data_access_keys().get_by_app_id(
+                new_id
+            ),
+            channels=[],
+        )
+
+    def app_list(self) -> List[AppDescription]:
+        apps = self.storage.get_meta_data_apps().get_all()
+        keys = self.storage.get_meta_data_access_keys()
+        channels = self.storage.get_meta_data_channels()
+        return [
+            AppDescription(
+                app=a,
+                access_keys=keys.get_by_app_id(a.id),
+                channels=channels.get_by_app_id(a.id),
+            )
+            for a in sorted(apps, key=lambda a: a.name)
+        ]
+
+    def app_show(self, name: str) -> AppDescription:
+        app = self._app(name)
+        return AppDescription(
+            app=app,
+            access_keys=self.storage.get_meta_data_access_keys().get_by_app_id(
+                app.id
+            ),
+            channels=self.storage.get_meta_data_channels().get_by_app_id(
+                app.id
+            ),
+        )
+
+    def app_delete(self, name: str) -> None:
+        """Delete an app, its channels, event data, and access keys
+        (reference App.scala delete + CommandClient.futureAppDelete)."""
+        app = self._app(name)
+        events = self.storage.get_l_events()
+        channels = self.storage.get_meta_data_channels()
+        for ch in channels.get_by_app_id(app.id):
+            if not events.remove(app.id, ch.id):
+                raise CommandError(
+                    f"Error removing event data of channel {ch.name}."
+                )
+            channels.delete(ch.id)
+        if not events.remove(app.id):
+            raise CommandError(f"Error removing event data of app {name}.")
+        keys = self.storage.get_meta_data_access_keys()
+        for k in keys.get_by_app_id(app.id):
+            keys.delete(k.key)
+        if not self.storage.get_meta_data_apps().delete(app.id):
+            raise CommandError(f"Error deleting app {name}.")
+        logger.info("deleted app %s", name)
+
+    def app_data_delete(
+        self, name: str, channel: Optional[str] = None, all_channels: bool = False
+    ) -> None:
+        """Wipe (and re-init) event data (reference App.scala dataDelete)."""
+        app = self._app(name)
+        events = self.storage.get_l_events()
+        if channel is not None:
+            ch = self._channel(app, channel)
+            if not (events.remove(app.id, ch.id) and events.init(app.id, ch.id)):
+                raise CommandError(
+                    f"Error removing event data of channel {channel}."
+                )
+            return
+        if all_channels:
+            for ch in self.storage.get_meta_data_channels().get_by_app_id(app.id):
+                if not (events.remove(app.id, ch.id) and events.init(app.id, ch.id)):
+                    raise CommandError(
+                        f"Error removing event data of channel {ch.name}."
+                    )
+        if not (events.remove(app.id) and events.init(app.id)):
+            raise CommandError(f"Error removing event data of app {name}.")
+        logger.info("deleted data of app %s", name)
+
+    # --- channels (reference App.scala:416-478) ---
+
+    def channel_new(self, app_name: str, channel_name: str) -> Channel:
+        app = self._app(app_name)
+        if not Channel.is_valid_name(channel_name):
+            raise CommandError(
+                f"Unable to create new channel. Invalid channel name "
+                f"{channel_name!r} (allowed: [a-zA-Z0-9-], max 16 chars)."
+            )
+        channels = self.storage.get_meta_data_channels()
+        if any(
+            c.name == channel_name for c in channels.get_by_app_id(app.id)
+        ):
+            raise CommandError(
+                f"Channel {channel_name} already exists. Aborting."
+            )
+        channel_id = channels.insert(
+            Channel(id=0, name=channel_name, appid=app.id)
+        )
+        if channel_id is None:
+            raise CommandError("Unable to create new channel.")
+        if not self.storage.get_l_events().init(app.id, channel_id):
+            channels.delete(channel_id)  # rollback
+            raise CommandError(
+                f"Unable to initialize Event Store for channel {channel_name}."
+            )
+        return channels.get(channel_id)
+
+    def channel_delete(self, app_name: str, channel_name: str) -> None:
+        app = self._app(app_name)
+        ch = self._channel(app, channel_name)
+        if not self.storage.get_l_events().remove(app.id, ch.id):
+            raise CommandError(
+                f"Error removing event data of channel {channel_name}."
+            )
+        if not self.storage.get_meta_data_channels().delete(ch.id):
+            raise CommandError(f"Unable to delete channel {channel_name}.")
+
+    # --- access keys (reference AccessKey.scala:26-83) ---
+
+    def access_key_new(
+        self, app_name: str, key: str = "", events: tuple = ()
+    ) -> AccessKey:
+        app = self._app(app_name)
+        keys = self.storage.get_meta_data_access_keys()
+        created = keys.insert(AccessKey(key=key, appid=app.id, events=events))
+        if created is None:
+            raise CommandError("Unable to create new access key.")
+        return keys.get(created)
+
+    def access_key_list(self, app_name: Optional[str] = None) -> List[AccessKey]:
+        keys = self.storage.get_meta_data_access_keys()
+        if app_name is None:
+            return sorted(keys.get_all(), key=lambda k: k.appid)
+        return keys.get_by_app_id(self._app(app_name).id)
+
+    def access_key_delete(self, key: str) -> None:
+        if not self.storage.get_meta_data_access_keys().delete(key):
+            raise CommandError(f"Error deleting access key {key}.")
+
+    # --- helpers ---
+
+    def _app(self, name: str) -> App:
+        app = self.storage.get_meta_data_apps().get_by_name(name)
+        if app is None:
+            raise CommandError(f"App {name} does not exist. Aborting.")
+        return app
+
+    def _channel(self, app: App, channel_name: str) -> Channel:
+        for c in self.storage.get_meta_data_channels().get_by_app_id(app.id):
+            if c.name == channel_name:
+                return c
+        raise CommandError(
+            f"Unable to delete channel. Channel {channel_name} doesn't exist."
+        )

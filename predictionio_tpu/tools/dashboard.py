@@ -1,0 +1,108 @@
+"""Evaluation dashboard on :9000.
+
+Capability parity with the reference Dashboard
+(tools/src/main/scala/io/prediction/tools/dashboard/Dashboard.scala:70-141):
+
+  GET /                                      -> HTML index of completed
+                                                evaluation instances
+  GET /engine_instances/<id>/evaluator_results.txt
+  GET /engine_instances/<id>/evaluator_results.html
+  GET /engine_instances/<id>/evaluator_results.json
+  GET /engine_instances/<id>/local_evaluator_results.json  (CORS variant)
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import html as _html
+import logging
+import os
+from typing import Optional, Tuple
+
+from predictionio_tpu.api.http import JsonHTTPServer
+from predictionio_tpu.data.storage import Storage, get_storage
+
+logger = logging.getLogger(__name__)
+
+
+class DashboardAPI:
+    def __init__(self, storage: Optional[Storage] = None):
+        self.storage = storage or get_storage()
+        self.server_start_time = _dt.datetime.now(_dt.timezone.utc)
+
+    def handle(self, method, path, query=None, body=None, form=None) -> Tuple:
+        if method != "GET":
+            return 405, {"message": "Method not allowed."}
+        parts = [p for p in path.strip("/").split("/") if p]
+        if not parts:
+            return 200, self._index(), "text/html"
+        if parts[0] == "engine_instances" and len(parts) == 3:
+            instance_id, resource = parts[1], parts[2]
+            instance = (
+                self.storage.get_meta_data_evaluation_instances().get(
+                    instance_id
+                )
+            )
+            if instance is None:
+                return 404, {"message": "Not Found"}
+            if resource == "evaluator_results.txt":
+                return 200, instance.evaluator_results, "text/plain"
+            if resource == "evaluator_results.html":
+                return 200, instance.evaluator_results_html, "text/html"
+            if resource in (
+                "evaluator_results.json",
+                "local_evaluator_results.json",
+            ):
+                # stored pre-rendered; str payloads pass through verbatim
+                return 200, instance.evaluator_results_json, "application/json"
+        return 404, {"message": "Not Found"}
+
+    def _index(self) -> str:
+        instances = (
+            self.storage.get_meta_data_evaluation_instances().get_completed()
+        )
+        rows = "".join(
+            "<tr>"
+            f"<td>{_html.escape(i.id)}</td>"
+            f"<td>{_html.escape(i.evaluation_class)}</td>"
+            f"<td>{_html.escape(i.start_time.isoformat())}</td>"
+            f"<td>{_html.escape(i.evaluator_results)}</td>"
+            f"<td><a href='/engine_instances/{i.id}/evaluator_results.html'>HTML</a> "
+            f"<a href='/engine_instances/{i.id}/evaluator_results.json'>JSON</a> "
+            f"<a href='/engine_instances/{i.id}/evaluator_results.txt'>TXT</a></td>"
+            "</tr>"
+            for i in instances
+        )
+        env_rows = "".join(
+            f"<tr><td>{_html.escape(k)}</td><td>{_html.escape(v)}</td></tr>"
+            for k, v in sorted(os.environ.items())
+            if k.startswith("PIO_")
+        )
+        return (
+            "<!DOCTYPE html><html><head><title>PredictionIO-TPU Dashboard"
+            "</title></head><body><h1>Evaluation Dashboard</h1>"
+            f"<p>Server started {self.server_start_time.isoformat()}</p>"
+            "<table border='1'><tr><th>ID</th><th>Evaluation</th>"
+            "<th>Started</th><th>Result</th><th>Links</th></tr>"
+            f"{rows}</table>"
+            f"<h2>Environment</h2><table>{env_rows}</table>"
+            "</body></html>"
+        )
+
+
+class Dashboard(JsonHTTPServer):
+    def __init__(
+        self,
+        ip: str = "localhost",
+        port: int = 9000,
+        storage: Optional[Storage] = None,
+    ):
+        self.api = DashboardAPI(storage)
+        super().__init__(self.api.handle, ip, port, "Dashboard")
+
+
+def create_dashboard(
+    ip: str = "localhost", port: int = 9000, storage: Optional[Storage] = None
+) -> Dashboard:
+    """Reference Dashboard.createDashboard (Dashboard.scala:37-68)."""
+    return Dashboard(ip=ip, port=port, storage=storage)

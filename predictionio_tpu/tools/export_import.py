@@ -1,0 +1,68 @@
+"""Event export/import as JSON lines.
+
+Capability parity with the reference export/import jobs
+(tools/src/main/scala/io/prediction/tools/export/EventsToFile.scala:39-104
+— PEvents.find -> json4s strings -> text file; imprt/FileToEvents.scala:
+84-95 — textFile -> read[Event] -> PEvents.write). One event per line in
+the API JSON format, so exports round-trip through import and are
+compatible with event-server payload shapes.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from typing import Optional
+
+from predictionio_tpu.data.event import Event
+from predictionio_tpu.data.storage import Storage, get_storage
+from predictionio_tpu.data.store import app_name_to_id
+
+logger = logging.getLogger(__name__)
+
+
+def events_to_file(
+    app_name: str,
+    path: str,
+    channel_name: Optional[str] = None,
+    storage: Optional[Storage] = None,
+) -> int:
+    """Export all events of an app (channel) to a JSON-lines file.
+    Returns the number of events written."""
+    storage = storage or get_storage()
+    app_id, channel_id = app_name_to_id(app_name, channel_name, storage)
+    n = 0
+    with open(path, "w") as f:
+        for event in storage.get_p_events().find(
+            app_id=app_id, channel_id=channel_id
+        ):
+            f.write(json.dumps(event.to_json()) + "\n")
+            n += 1
+    logger.info("exported %d events of app %s to %s", n, app_name, path)
+    return n
+
+
+def file_to_events(
+    app_name: str,
+    path: str,
+    channel_name: Optional[str] = None,
+    storage: Optional[Storage] = None,
+) -> int:
+    """Import events from a JSON-lines file. Returns the number inserted."""
+    storage = storage or get_storage()
+    app_id, channel_id = app_name_to_id(app_name, channel_name, storage)
+    events = []
+    with open(path) as f:
+        for line_no, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                events.append(Event.from_json(json.loads(line)))
+            except Exception as e:
+                raise ValueError(
+                    f"{path}:{line_no}: invalid event: {e}"
+                ) from e
+    storage.get_p_events().write(events, app_id, channel_id)
+    logger.info("imported %d events into app %s", len(events), app_name)
+    return len(events)

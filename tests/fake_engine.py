@@ -157,3 +157,27 @@ class QxMetric(AverageMetric):
 
     def calculate_point(self, q: Query, p: Prediction, a: Actual) -> float:
         return 1.0 if p.qx == q.qx == a.qx else 0.0
+
+
+class TypedDataSource(DataSource0):
+    """DataSource0 with declared params_class for JSON-driven flows."""
+
+    params_class = DSParams
+
+
+class TypedPreparator(Preparator0):
+    params_class = PrepParams
+
+
+class FakeEngineFactory:
+    """EngineFactory for CLI/deploy tests (reflected from engine.json)."""
+
+    def apply(self):
+        from predictionio_tpu.controller.engine import Engine
+
+        return Engine(
+            data_source_classes=TypedDataSource,
+            preparator_classes=TypedPreparator,
+            algorithm_classes={"a0": Algo0},
+            serving_classes=Serving0,
+        )

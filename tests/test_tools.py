@@ -1,0 +1,249 @@
+"""Tools layer tests: CommandClient, pio CLI, export/import, admin
+server, dashboard — the analog of the reference's tools specs
+(AdminAPISpec.scala, console behavior)."""
+
+import datetime as dt
+import json
+
+import pytest
+
+from predictionio_tpu.data.event import DataMap, Event
+from predictionio_tpu.data.storage.base import EvaluationInstance
+from predictionio_tpu.tools.admin_server import AdminAPI
+from predictionio_tpu.tools.cli import main as cli_main
+from predictionio_tpu.tools.commands import CommandClient, CommandError
+from predictionio_tpu.tools.dashboard import DashboardAPI
+from predictionio_tpu.tools.export_import import events_to_file, file_to_events
+
+
+class TestCommandClient:
+    def test_app_new_creates_app_key_and_store(self, mem_storage):
+        client = CommandClient(mem_storage)
+        d = client.app_new("myapp", description="desc")
+        assert d.app.name == "myapp"
+        assert len(d.access_keys) == 1
+        assert len(d.access_keys[0].key) == 64
+        # event store is initialized: insert works
+        e = Event(event="x", entity_type="u", entity_id="1")
+        assert mem_storage.get_l_events().insert(e, d.app.id)
+
+    def test_duplicate_app_fails(self, mem_storage):
+        client = CommandClient(mem_storage)
+        client.app_new("myapp")
+        with pytest.raises(CommandError, match="already exists"):
+            client.app_new("myapp")
+
+    def test_app_delete_removes_everything(self, mem_storage):
+        client = CommandClient(mem_storage)
+        d = client.app_new("myapp")
+        client.channel_new("myapp", "ch1")
+        client.app_delete("myapp")
+        assert mem_storage.get_meta_data_apps().get_by_name("myapp") is None
+        assert (
+            mem_storage.get_meta_data_access_keys().get_by_app_id(d.app.id)
+            == []
+        )
+
+    def test_data_delete_reinitializes(self, mem_storage):
+        client = CommandClient(mem_storage)
+        d = client.app_new("myapp")
+        events = mem_storage.get_l_events()
+        events.insert(Event(event="x", entity_type="u", entity_id="1"), d.app.id)
+        client.app_data_delete("myapp")
+        assert list(events.find(app_id=d.app.id)) == []
+        # still initialized
+        events.insert(Event(event="y", entity_type="u", entity_id="2"), d.app.id)
+
+    def test_channel_validation(self, mem_storage):
+        client = CommandClient(mem_storage)
+        client.app_new("myapp")
+        with pytest.raises(CommandError, match="Invalid channel name"):
+            client.channel_new("myapp", "bad name!")
+        ch = client.channel_new("myapp", "good-1")
+        assert ch.name == "good-1"
+        with pytest.raises(CommandError, match="already exists"):
+            client.channel_new("myapp", "good-1")
+        client.channel_delete("myapp", "good-1")
+        assert client.app_show("myapp").channels == []
+
+    def test_access_keys(self, mem_storage):
+        client = CommandClient(mem_storage)
+        client.app_new("myapp")
+        k = client.access_key_new("myapp", events=("rate",))
+        assert k.events == ("rate",)
+        assert len(client.access_key_list("myapp")) == 2  # default + new
+        client.access_key_delete(k.key)
+        assert len(client.access_key_list("myapp")) == 1
+
+
+class TestCLI:
+    def test_app_lifecycle(self, mem_storage, capsys):
+        assert cli_main(["app", "new", "cliapp"]) == 0
+        assert "cliapp" in capsys.readouterr().out
+        assert cli_main(["app", "list"]) == 0
+        assert "cliapp" in capsys.readouterr().out
+        assert cli_main(["app", "channel-new", "cliapp", "mobile"]) == 0
+        capsys.readouterr()
+        assert cli_main(["app", "delete", "cliapp"]) == 0
+
+    def test_app_new_duplicate_exits_nonzero(self, mem_storage, capsys):
+        cli_main(["app", "new", "cliapp"])
+        assert cli_main(["app", "new", "cliapp"]) == 1
+        assert "already exists" in capsys.readouterr().err
+
+    def test_version(self, mem_storage, capsys):
+        assert cli_main(["version"]) == 0
+        assert capsys.readouterr().out.strip()
+
+    def test_status(self, mem_storage, capsys):
+        assert cli_main(["status"]) == 0
+        assert "ready to go" in capsys.readouterr().out
+
+    def test_build_train_and_eval_flow(self, mem_storage, tmp_path, capsys):
+        import tests.fake_engine as fe
+
+        fe.reset_counters()
+        variant = {
+            "engineFactory": "tests.fake_engine.FakeEngineFactory",
+            "id": "fakeengine",
+            "version": "1.0",
+            "datasource": {"params": {"id": 3}},
+            "algorithms": [{"name": "a0", "params": {"id": 7}}],
+        }
+        vpath = tmp_path / "engine.json"
+        vpath.write_text(json.dumps(variant))
+
+        assert cli_main(["build", "-v", str(vpath)]) == 0
+        assert "Registered engine fakeengine" in capsys.readouterr().out
+        manifests = mem_storage.get_meta_data_engine_manifests()
+        assert manifests.get("fakeengine", "1.0") is not None
+
+        assert cli_main(["train", "-v", str(vpath)]) == 0
+        out = capsys.readouterr().out
+        assert "Training completed" in out
+        instances = mem_storage.get_meta_data_engine_instances().get_all()
+        assert len(instances) == 1
+        assert instances[0].status == "COMPLETED"
+        assert instances[0].engine_id == "fakeengine"
+
+    def test_train_stop_after_read(self, mem_storage, tmp_path, capsys):
+        import tests.fake_engine as fe
+
+        fe.reset_counters()
+        variant = {
+            "engineFactory": "tests.fake_engine.FakeEngineFactory",
+            "algorithms": [{"name": "a0", "params": {"id": 7}}],
+        }
+        vpath = tmp_path / "engine.json"
+        vpath.write_text(json.dumps(variant))
+        assert cli_main(["train", "-v", str(vpath), "--stop-after-read"]) == 0
+        assert "interrupted" in capsys.readouterr().out
+        assert mem_storage.get_meta_data_engine_instances().get_all() == []
+
+
+class TestExportImport:
+    def test_round_trip(self, mem_storage, tmp_path):
+        client = CommandClient(mem_storage)
+        d = client.app_new("expapp")
+        events = mem_storage.get_l_events()
+        t = dt.datetime(2026, 7, 1, 12, 0, tzinfo=dt.timezone.utc)
+        for k in range(5):
+            events.insert(
+                Event(
+                    event="rate",
+                    entity_type="user",
+                    entity_id=f"u{k}",
+                    target_entity_type="item",
+                    target_entity_id=f"i{k}",
+                    properties=DataMap({"rating": k}),
+                    event_time=t,
+                ),
+                d.app.id,
+            )
+        path = tmp_path / "events.jsonl"
+        assert events_to_file("expapp", str(path), storage=mem_storage) == 5
+
+        client.app_new("impapp")
+        assert file_to_events("impapp", str(path), storage=mem_storage) == 5
+        imported = sorted(
+            mem_storage.get_l_events().find(
+                app_id=mem_storage.get_meta_data_apps()
+                .get_by_name("impapp")
+                .id
+            ),
+            key=lambda e: e.entity_id,
+        )
+        assert [e.entity_id for e in imported] == [f"u{k}" for k in range(5)]
+        assert imported[3].properties["rating"] == 3
+        assert imported[0].event_time == t
+
+    def test_import_invalid_line_raises(self, mem_storage, tmp_path):
+        CommandClient(mem_storage).app_new("impapp")
+        path = tmp_path / "bad.jsonl"
+        path.write_text('{"event": "x"}\n')  # missing entity fields
+        with pytest.raises(ValueError, match="invalid event"):
+            file_to_events("impapp", str(path), storage=mem_storage)
+
+
+class TestAdminAPI:
+    def test_alive(self, mem_storage):
+        api = AdminAPI(mem_storage)
+        assert api.handle("GET", "/") == (200, {"status": "alive"})
+
+    def test_app_crud(self, mem_storage):
+        api = AdminAPI(mem_storage)
+        status, body = api.handle(
+            "POST", "/cmd/app", body=json.dumps({"name": "adminapp"}).encode()
+        )
+        assert status == 200 and body["name"] == "adminapp"
+        assert len(body["accessKeys"]) == 1
+
+        status, body = api.handle("GET", "/cmd/app")
+        assert [a["name"] for a in body["apps"]] == ["adminapp"]
+
+        status, body = api.handle("DELETE", "/cmd/app/adminapp/data")
+        assert status == 200
+
+        status, body = api.handle("DELETE", "/cmd/app/adminapp")
+        assert status == 200
+        assert api.handle("GET", "/cmd/app")[1]["apps"] == []
+
+    def test_errors(self, mem_storage):
+        api = AdminAPI(mem_storage)
+        assert api.handle("DELETE", "/cmd/app/ghost")[0] == 400
+        assert api.handle("POST", "/cmd/app", body=b"{}")[0] == 400
+        assert api.handle("GET", "/nope")[0] == 404
+
+
+class TestDashboard:
+    def test_index_and_results(self, mem_storage):
+        now = dt.datetime.now(dt.timezone.utc)
+        instances = mem_storage.get_meta_data_evaluation_instances()
+        iid = instances.insert(
+            EvaluationInstance(
+                id="",
+                status="COMPLETED",
+                start_time=now,
+                end_time=now,
+                evaluation_class="MyEval",
+                evaluator_results="[metric] 0.9",
+                evaluator_results_html="<html><b>0.9</b></html>",
+                evaluator_results_json='{"score": 0.9}',
+            )
+        )
+        api = DashboardAPI(mem_storage)
+        status, page, ctype = api.handle("GET", "/")
+        assert status == 200 and "MyEval" in page and ctype == "text/html"
+
+        status, txt, _ = api.handle(
+            "GET", f"/engine_instances/{iid}/evaluator_results.txt"
+        )
+        assert (status, txt) == (200, "[metric] 0.9")
+        status, payload, ctype = api.handle(
+            "GET", f"/engine_instances/{iid}/evaluator_results.json"
+        )
+        assert json.loads(payload) == {"score": 0.9}
+        status, _ = api.handle(
+            "GET", "/engine_instances/ghost/evaluator_results.txt"
+        )[:2]
+        assert status == 404
