@@ -40,6 +40,14 @@ from predictionio_tpu.controller.params import (
 logger = logging.getLogger(__name__)
 
 
+import contextlib
+
+
+@contextlib.contextmanager
+def _null_phase(name):
+    yield
+
+
 class StopAfterReadInterruption(Exception):
     """--stop-after-read debug stop (reference WorkflowUtils.scala:410)."""
 
@@ -191,17 +199,22 @@ class Engine(BaseEngine):
     def _train_pipeline(
         self, ctx, data_source, preparator, algorithms, workflow_params
     ) -> List[Any]:
-        td = data_source.read_training(ctx)
+        timer = getattr(ctx, "timer", None)
+        phase = timer.phase if timer is not None else _null_phase
+        with phase("read"):
+            td = data_source.read_training(ctx)
         self._sanity(td, "TrainingData", workflow_params)
         if getattr(workflow_params, "stop_after_read", False):
             raise StopAfterReadInterruption()
-        pd = preparator.prepare(ctx, td)
+        with phase("prepare"):
+            pd = preparator.prepare(ctx, td)
         self._sanity(pd, "PreparedData", workflow_params)
         if getattr(workflow_params, "stop_after_prepare", False):
             raise StopAfterPrepareInterruption()
         models = []
         for i, algo in enumerate(algorithms):
-            model = algo.train(ctx, pd)
+            with phase(f"train[{i}]:{type(algo).__name__}"):
+                model = algo.train(ctx, pd)
             self._sanity(model, f"Model of algorithm[{i}]", workflow_params)
             models.append(model)
         return models

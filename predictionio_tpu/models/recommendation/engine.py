@@ -210,6 +210,9 @@ class ALSAlgorithmParams(Params):
     alpha: float = 1.0
     implicit_prefs: bool = False
     seed: Optional[int] = 3
+    # mid-training checkpoint/resume (absent in the reference, SURVEY §5)
+    checkpoint_dir: Optional[str] = None
+    checkpoint_every: int = 5
 
 
 @dataclasses.dataclass
@@ -307,6 +310,8 @@ class ALSAlgorithm(BaseAlgorithm):
             n_items=len(td.item_index),
             config=config,
             mesh=mesh,
+            checkpoint_dir=p.checkpoint_dir,
+            checkpoint_every=p.checkpoint_every,
         )
         return ALSModel(
             arrays=arrays, user_index=td.user_index, item_index=td.item_index

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import json
 import logging
+import urllib.parse
 from typing import Optional, Tuple
 
 from predictionio_tpu.api.http import JsonHTTPServer
@@ -76,15 +77,19 @@ class AdminAPI:
                     return 400, {"status": 1, "message": str(e)}
                 if "name" not in payload:
                     return 400, {"status": 1, "message": "name is required"}
+                try:
+                    app_id = int(payload.get("id") or 0)
+                except (TypeError, ValueError):
+                    return 400, {"status": 1, "message": "id must be an integer"}
                 d = self.client.app_new(
                     payload["name"],
-                    app_id=int(payload.get("id") or 0),
+                    app_id=app_id,
                     description=payload.get("description"),
                 )
                 return 200, {"status": 0, **_describe(d)}
             return 405, {"message": "Method not allowed."}
 
-        app_name = parts[2]
+        app_name = urllib.parse.unquote(parts[2])
         if len(parts) == 3 and method == "DELETE":
             self.client.app_delete(app_name)
             return 200, {"status": 0, "message": f"App {app_name} deleted."}

@@ -135,6 +135,7 @@ def cmd_train(args) -> int:
         skip_sanity_check=args.skip_sanity_check,
         stop_after_read=args.stop_after_read,
         stop_after_prepare=args.stop_after_prepare,
+        profile_dir=args.profile_dir,
     )
     instance_id = CoreWorkflow.run_train(
         engine, engine_params, instance, workflow_params=workflow_params
@@ -403,6 +404,9 @@ def build_parser() -> argparse.ArgumentParser:
     train.add_argument("--skip-sanity-check", action="store_true")
     train.add_argument("--stop-after-read", action="store_true")
     train.add_argument("--stop-after-prepare", action="store_true")
+    train.add_argument(
+        "--profile-dir", help="write a jax.profiler trace to this directory"
+    )
     train.set_defaults(func=cmd_train)
 
     ev = sub.add_parser("eval", help="run an evaluation")

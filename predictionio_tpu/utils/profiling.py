@@ -1,0 +1,76 @@
+"""Per-phase timers + jax.profiler integration.
+
+The reference's only observability is coarse serving-time bookkeeping
+(CreateServer.scala:399-404) plus the Spark web UI (SURVEY.md §5 —
+"plan for jax.profiler traces + per-phase timers as first-class"). This
+module provides both:
+
+- ``PhaseTimer``: named wall-clock phases with nesting, collected per
+  workflow run and queryable/printable for run summaries;
+- ``trace(dir)``: context manager around ``jax.profiler.trace`` emitting
+  a TensorBoard-loadable device trace when a profile dir is set.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import logging
+import time
+from typing import Dict, Iterator, List, Optional
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class PhaseRecord:
+    name: str
+    seconds: float
+    depth: int
+
+
+class PhaseTimer:
+    """Collects named wall-clock phases (nested phases indent)."""
+
+    def __init__(self):
+        self.records: List[PhaseRecord] = []
+        self._depth = 0
+
+    @contextlib.contextmanager
+    def phase(self, name: str) -> Iterator[None]:
+        start = time.perf_counter()
+        self._depth += 1
+        try:
+            yield
+        finally:
+            self._depth -= 1
+            elapsed = time.perf_counter() - start
+            self.records.append(PhaseRecord(name, elapsed, self._depth))
+            logger.info("phase %s: %.3fs", name, elapsed)
+
+    def totals(self) -> Dict[str, float]:
+        out: Dict[str, float] = {}
+        for r in self.records:
+            out[r.name] = out.get(r.name, 0.0) + r.seconds
+        return out
+
+    def summary(self) -> str:
+        lines = [
+            f"{'  ' * r.depth}{r.name}: {r.seconds:.3f}s"
+            for r in reversed(self.records)
+        ]
+        return "\n".join(lines)
+
+
+@contextlib.contextmanager
+def trace(profile_dir: Optional[str]) -> Iterator[None]:
+    """jax.profiler.trace around a block when profile_dir is set; no-op
+    otherwise. View with TensorBoard's profile plugin or Perfetto."""
+    if not profile_dir:
+        yield
+        return
+    import jax
+
+    logger.info("writing jax profiler trace to %s", profile_dir)
+    with jax.profiler.trace(profile_dir):
+        yield

@@ -1,0 +1,85 @@
+"""Step-level training checkpoints (orbax-backed).
+
+The reference has NO mid-training checkpoint/resume — models persist only
+after a full train (CoreWorkflow.scala:71-76), and distributed (P-style)
+models are re-trained from scratch at deploy (SURVEY.md §5 flags this as
+the gap to fill). This module adds orbax step checkpoints: a training
+kernel saves its state pytree every N steps and resumes from the latest
+step after interruption, with retention bounded by ``max_to_keep``.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Optional
+
+logger = logging.getLogger(__name__)
+
+
+class StepCheckpointer:
+    """Thin wrapper over orbax CheckpointManager for step/pytree saves.
+
+    Usage in a training loop::
+
+        ckpt = StepCheckpointer(dir, every=5)
+        start = 0
+        if (state := ckpt.restore_latest()) is not None:
+            start, arrays = state["step"], state["arrays"]
+        for step in range(start, n_steps):
+            ...
+            ckpt.maybe_save(step + 1, {"step": step + 1, "arrays": arrays})
+        ckpt.close()
+    """
+
+    def __init__(
+        self,
+        directory: Optional[str],
+        every: int = 1,
+        max_to_keep: int = 2,
+    ):
+        self.directory = directory
+        self.every = max(1, every)
+        self._mgr = None
+        if directory is not None:
+            import orbax.checkpoint as ocp
+            import os
+
+            self._ocp = ocp
+            self._mgr = ocp.CheckpointManager(
+                os.path.abspath(directory),
+                options=ocp.CheckpointManagerOptions(max_to_keep=max_to_keep),
+            )
+
+    @property
+    def enabled(self) -> bool:
+        return self._mgr is not None
+
+    def latest_step(self) -> Optional[int]:
+        if self._mgr is None:
+            return None
+        return self._mgr.latest_step()
+
+    def restore_latest(self) -> Optional[Any]:
+        """The latest saved pytree, or None when disabled/empty."""
+        step = self.latest_step()
+        if step is None:
+            return None
+        logger.info(
+            "restoring checkpoint step %d from %s", step, self.directory
+        )
+        return self._mgr.restore(step)
+
+    def maybe_save(self, step: int, pytree: Any, force: bool = False) -> bool:
+        """Save when the step hits the cadence (or force=True)."""
+        if self._mgr is None:
+            return False
+        if not force and step % self.every != 0:
+            return False
+        self._mgr.save(step, args=self._ocp.args.StandardSave(pytree))
+        return True
+
+    def close(self) -> None:
+        if self._mgr is not None:
+            self._mgr.wait_until_finished()
+            self._mgr.close()
+            self._mgr = None

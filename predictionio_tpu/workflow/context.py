@@ -29,11 +29,15 @@ class WorkflowContext:
         mesh=None,
         env: Optional[Dict[str, str]] = None,
     ):
+        from predictionio_tpu.utils.profiling import PhaseTimer
+
         self.mode = mode
         self.batch = batch
         self.env = dict(env or {})
         self._storage = storage
         self._mesh = mesh
+        # per-run phase timers (SURVEY.md §5: first-class observability)
+        self.timer = PhaseTimer()
 
     @property
     def app_name(self) -> str:

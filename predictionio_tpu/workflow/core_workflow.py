@@ -36,6 +36,7 @@ from predictionio_tpu.data.storage.base import (
     EvaluationInstance,
     Model,
 )
+from predictionio_tpu.utils import profiling
 from predictionio_tpu.utils.serialize import dumps_model
 from predictionio_tpu.workflow.context import WorkflowContext, workflow_context
 from predictionio_tpu.workflow.workflow_params import WorkflowParams
@@ -84,7 +85,10 @@ class CoreWorkflow:
                     instances.get(instance_id), status=STATUS_TRAINING
                 )
             )
-            models = engine.train(ctx, engine_params, workflow_params)
+            with profiling.trace(workflow_params.profile_dir):
+                models = engine.train(ctx, engine_params, workflow_params)
+            if ctx.timer.records:
+                logger.info("training phases:\n%s", ctx.timer.summary())
             if workflow_params.save_model:
                 serializable = (
                     engine.make_serializable_models(

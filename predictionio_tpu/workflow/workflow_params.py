@@ -5,6 +5,9 @@ from __future__ import annotations
 import dataclasses
 
 
+from typing import Optional
+
+
 @dataclasses.dataclass
 class WorkflowParams:
     batch: str = ""
@@ -13,3 +16,5 @@ class WorkflowParams:
     skip_sanity_check: bool = False
     stop_after_read: bool = False
     stop_after_prepare: bool = False
+    # TPU additions: jax.profiler trace output dir (None disables)
+    profile_dir: Optional[str] = None

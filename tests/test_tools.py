@@ -213,6 +213,18 @@ class TestAdminAPI:
         assert api.handle("DELETE", "/cmd/app/ghost")[0] == 400
         assert api.handle("POST", "/cmd/app", body=b"{}")[0] == 400
         assert api.handle("GET", "/nope")[0] == 404
+        status, body = api.handle(
+            "POST", "/cmd/app",
+            body=json.dumps({"name": "x", "id": "abc"}).encode(),
+        )
+        assert status == 400 and "integer" in body["message"]
+
+    def test_url_encoded_app_name(self, mem_storage):
+        api = AdminAPI(mem_storage)
+        api.handle(
+            "POST", "/cmd/app", body=json.dumps({"name": "my app"}).encode()
+        )
+        assert api.handle("DELETE", "/cmd/app/my%20app")[0] == 200
 
 
 class TestDashboard:
