@@ -94,6 +94,14 @@ class BiMap(Generic[K, V]):
                 out[k] = len(out)
         return BiMap(out)
 
+    def take(self, n: int) -> "BiMap[K, V]":
+        out = {}
+        for i, (k, v) in enumerate(self._forward.items()):
+            if i >= n:
+                break
+            out[k] = v
+        return BiMap(out)
+
     def map_values_to_list(self, keys: Iterable[K]) -> List[V]:
         fw = self._forward
         return [fw[k] for k in keys]

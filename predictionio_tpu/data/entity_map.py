@@ -1,0 +1,93 @@
+"""EntityMap: dense-indexed entity data.
+
+Capability parity with the reference EntityIdIxMap/EntityMap
+(data/src/main/scala/io/prediction/data/storage/EntityMap.scala:23-98):
+a BiMap of entity id -> dense index, optionally carrying per-entity data.
+The dense index is what device kernels consume (rows of a factor or
+feature matrix); the map translates between the string-id world of the
+event store and array coordinates.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Generic, Iterable, Mapping, Optional, TypeVar
+
+from predictionio_tpu.data.bimap import BiMap
+
+A = TypeVar("A")
+
+
+class EntityIdIxMap:
+    """String id <-> dense index (reference EntityIdIxMap :23-52)."""
+
+    def __init__(self, id_to_ix: BiMap):
+        self.id_to_ix = id_to_ix
+        self.ix_to_id = id_to_ix.inverse()
+
+    @classmethod
+    def from_keys(cls, keys: Iterable[str]) -> "EntityIdIxMap":
+        return cls(BiMap.string_long(keys))
+
+    def __getitem__(self, id_or_ix):
+        if isinstance(id_or_ix, str):
+            return self.id_to_ix[id_or_ix]
+        return self.ix_to_id[id_or_ix]
+
+    def __contains__(self, id_or_ix) -> bool:
+        if isinstance(id_or_ix, str):
+            return id_or_ix in self.id_to_ix
+        return id_or_ix in self.ix_to_id
+
+    def get(self, id_or_ix, default=None):
+        if isinstance(id_or_ix, str):
+            return self.id_to_ix.get(id_or_ix, default)
+        return self.ix_to_id.get(id_or_ix, default)
+
+    def to_map(self) -> Dict[str, int]:
+        return self.id_to_ix.to_dict()
+
+    def __len__(self) -> int:
+        return len(self.id_to_ix)
+
+    def take(self, n: int) -> "EntityIdIxMap":
+        return EntityIdIxMap(self.id_to_ix.take(n))
+
+    def __repr__(self) -> str:
+        return f"EntityIdIxMap({self.id_to_ix!r})"
+
+
+class EntityMap(EntityIdIxMap, Generic[A]):
+    """EntityIdIxMap + per-entity payload (reference EntityMap :60-98)."""
+
+    def __init__(
+        self,
+        id_to_data: Mapping[str, A],
+        id_to_ix: Optional[BiMap] = None,
+    ):
+        super().__init__(
+            id_to_ix
+            if id_to_ix is not None
+            else BiMap.string_long(id_to_data.keys())
+        )
+        self.id_to_data: Dict[str, A] = dict(id_to_data)
+
+    def data(self, id_or_ix) -> A:
+        if isinstance(id_or_ix, str):
+            return self.id_to_data[id_or_ix]
+        return self.id_to_data[self.ix_to_id[id_or_ix]]
+
+    def get_data(self, id_or_ix, default: Any = None):
+        try:
+            return self.data(id_or_ix)
+        except KeyError:
+            return default
+
+    def take(self, n: int) -> "EntityMap[A]":
+        new_ix = self.id_to_ix.take(n)
+        return EntityMap(
+            {k: v for k, v in self.id_to_data.items() if k in new_ix},
+            new_ix,
+        )
+
+    def __repr__(self) -> str:
+        return f"EntityMap({len(self)} entities)"

@@ -1,0 +1,149 @@
+"""Legacy batch views (deprecated in the reference at 0.9.2, kept for
+capability parity).
+
+Reference mapping (data/src/main/scala/io/prediction/data/view/
+LBatchView.scala:99-200): ``EventSeq`` — a filterable in-memory event
+list with ordered per-entity folds — and ``LBatchView`` — all events of
+an app in a time range, with $set/$unset/$delete property aggregation.
+New code should use LEventStore / PEventStore (store.py) instead.
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import warnings
+from typing import Callable, Dict, List, Optional, Sequence, TypeVar
+
+from predictionio_tpu.data.aggregator import aggregate_properties
+from predictionio_tpu.data.event import DataMap, Event, is_special_event
+from predictionio_tpu.data.storage import Storage, get_storage
+
+T = TypeVar("T")
+
+
+class EventSeq:
+    """Filterable event list with ordered per-entity folds
+    (reference EventSeq :99-130)."""
+
+    def __init__(self, events: Sequence[Event]):
+        self.events: List[Event] = list(events)
+
+    def filter(
+        self,
+        predicate: Optional[Callable[[Event], bool]] = None,
+        entity_type: Optional[str] = None,
+        event: Optional[str] = None,
+        start_time: Optional[_dt.datetime] = None,
+        until_time: Optional[_dt.datetime] = None,
+    ) -> "EventSeq":
+        def keep(e: Event) -> bool:
+            if predicate is not None and not predicate(e):
+                return False
+            if entity_type is not None and e.entity_type != entity_type:
+                return False
+            if event is not None and e.event != event:
+                return False
+            if start_time is not None and e.event_time < start_time:
+                return False
+            if until_time is not None and e.event_time >= until_time:
+                return False
+            return True
+
+        return EventSeq([e for e in self.events if keep(e)])
+
+    def aggregate_by_entity_ordered(
+        self, init: T, op: Callable[[T, Event], T]
+    ) -> Dict[str, T]:
+        """Fold each entity's events in event-time order
+        (reference :121-127)."""
+        by_entity: Dict[str, List[Event]] = {}
+        for e in self.events:
+            by_entity.setdefault(e.entity_id, []).append(e)
+        return {
+            entity_id: _fold(sorted(es, key=lambda e: e.event_time), init, op)
+            for entity_id, es in by_entity.items()
+        }
+
+    def group_by_entity_ordered(
+        self, map_fn: Callable[[Event], T]
+    ) -> Dict[str, List[T]]:
+        by_entity: Dict[str, List[Event]] = {}
+        for e in self.events:
+            by_entity.setdefault(e.entity_id, []).append(e)
+        return {
+            entity_id: [
+                map_fn(e) for e in sorted(es, key=lambda e: e.event_time)
+            ]
+            for entity_id, es in by_entity.items()
+        }
+
+    def __len__(self) -> int:
+        return len(self.events)
+
+    def __iter__(self):
+        return iter(self.events)
+
+
+def _fold(events: List[Event], init: T, op: Callable[[T, Event], T]) -> T:
+    acc = init
+    for e in events:
+        acc = op(acc, e)
+    return acc
+
+
+class LBatchView:
+    """All events of an app in a time range (reference LBatchView
+    :134-171). Deprecated: use LEventStore/PEventStore."""
+
+    def __init__(
+        self,
+        app_id: int,
+        start_time: Optional[_dt.datetime] = None,
+        until_time: Optional[_dt.datetime] = None,
+        storage: Optional[Storage] = None,
+    ):
+        warnings.warn(
+            "LBatchView is deprecated; use LEventStore instead",
+            DeprecationWarning,
+            stacklevel=2,
+        )
+        self.app_id = app_id
+        self.start_time = start_time
+        self.until_time = until_time
+        self._storage = storage or get_storage()
+        self._events: Optional[EventSeq] = None
+
+    @property
+    def events(self) -> EventSeq:
+        if self._events is None:
+            self._events = EventSeq(
+                list(
+                    self._storage.get_l_events().find(
+                        app_id=self.app_id,
+                        start_time=self.start_time,
+                        until_time=self.until_time,
+                    )
+                )
+            )
+        return self._events
+
+    def aggregate_properties(
+        self,
+        entity_type: str,
+        start_time: Optional[_dt.datetime] = None,
+        until_time: Optional[_dt.datetime] = None,
+    ) -> Dict[str, DataMap]:
+        """$set/$unset/$delete fold per entity (reference :156-171)."""
+        filtered = self.events.filter(
+            entity_type=entity_type,
+            predicate=lambda e: is_special_event(e.event),
+            start_time=start_time,
+            until_time=until_time,
+        )
+        return dict(aggregate_properties(filtered))
+
+
+# PBatchView (the RDD variant, PBatchView.scala:168) collapses into
+# LBatchView in the single-controller runtime: both read from the same
+# DAO and the columnarization lives in store.PEventStore.find_columns.
+PBatchView = LBatchView

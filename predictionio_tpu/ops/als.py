@@ -320,37 +320,47 @@ def train_als(
 
     from predictionio_tpu.workflow.checkpoint import StepCheckpointer
 
-    # run identity: same data + same config (iteration count aside) may
-    # resume; anything else starts fresh. Guards against silently reusing
-    # a finished run's factors after new events arrive, and against shape
-    # mismatches from changed user/item counts.
-    fingerprint = np.frombuffer(
-        hashlib.sha256(
-            user_idx.tobytes()
-            + item_idx.tobytes()
-            + np.asarray(ratings, np.float32).tobytes()
-            + repr(dataclasses.replace(config, iterations=0)).encode()
-            + f"{n_users},{n_items},{n_shards}".encode()
-        ).digest(),
-        dtype=np.uint8,
-    )
     ckpt = StepCheckpointer(checkpoint_dir, every=checkpoint_every)
     start_it = 0
+    fingerprint = None
     if ckpt.enabled:
+        # run identity: same data + same config (iteration count aside) may
+        # resume; anything else starts fresh. Guards against silently
+        # reusing a finished run's factors after new events arrive, and
+        # against shape mismatches from changed user/item counts.
+        fingerprint = np.frombuffer(
+            hashlib.sha256(
+                user_idx.tobytes()
+                + item_idx.tobytes()
+                + np.asarray(ratings, np.float32).tobytes()
+                + repr(dataclasses.replace(config, iterations=0)).encode()
+                + f"{n_users},{n_items},{n_shards}".encode()
+            ).digest(),
+            dtype=np.uint8,
+        )
         state = ckpt.restore_latest()
         if state is not None:
-            if np.array_equal(
+            saved_it = int(state["iteration"])
+            if not np.array_equal(
                 np.asarray(state.get("fingerprint")), fingerprint
             ):
-                start_it = min(int(state["iteration"]), config.iterations)
-                X = _place(mesh, np.asarray(state["X"], np.float32), row_sharded)
-                Y = _place(mesh, np.asarray(state["Y"], np.float32), row_sharded)
-                logger.info("resuming ALS from iteration %d", start_it)
-            else:
                 logger.info(
                     "checkpoint in %s is from a different run (data/config "
                     "changed); training from scratch", checkpoint_dir,
                 )
+            elif saved_it > config.iterations:
+                # can't "untrain": a checkpoint past the requested
+                # iteration count would silently return an over-trained
+                # model, so start fresh
+                logger.info(
+                    "checkpoint at iteration %d exceeds requested %d; "
+                    "training from scratch", saved_it, config.iterations,
+                )
+            else:
+                start_it = saved_it
+                X = _place(mesh, np.asarray(state["X"], np.float32), row_sharded)
+                Y = _place(mesh, np.asarray(state["Y"], np.float32), row_sharded)
+                logger.info("resuming ALS from iteration %d", start_it)
 
     try:
         for it in range(start_it, config.iterations):

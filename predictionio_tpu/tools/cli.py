@@ -114,6 +114,16 @@ def cmd_train(args) -> int:
     from predictionio_tpu.workflow.core_workflow import CoreWorkflow
     from predictionio_tpu.workflow.workflow_params import WorkflowParams
 
+    from predictionio_tpu.tools.template import verify_template_min_version
+    import os
+
+    if not verify_template_min_version(
+        os.path.dirname(os.path.abspath(args.variant))
+    ):
+        raise CommandError(
+            "this engine template requires a newer predictionio_tpu "
+            "(template.json pio.version.min)"
+        )
     variant = load_variant(args.variant)
     engine, factory_path = engine_from_variant(variant)
     engine_params = engine.jvalue_to_engine_params(variant)
@@ -160,7 +170,13 @@ def cmd_eval(args) -> int:
         epg = epg_cls() if isinstance(epg_cls, type) else epg_cls
         params_list = list(epg.engine_params_list)
     else:
-        params_list = list(evaluation.engine_params_list)
+        params_list = getattr(evaluation, "engine_params_list", None)
+        if params_list is None:
+            raise CommandError(
+                f"{args.evaluation_class} defines no engine_params_list; "
+                "pass an EngineParamsGenerator class as the second argument"
+            )
+        params_list = list(params_list)
     result = CoreWorkflow.run_evaluation(evaluation, params_list)
     print(result.to_one_liner())
     return 0
@@ -228,6 +244,35 @@ def cmd_dashboard(args) -> int:
     server = create_dashboard(ip=args.ip, port=args.port)
     print(f"Dashboard serving on {args.ip}:{server.port}")
     server.serve_forever()
+    return 0
+
+
+def cmd_template(args) -> int:
+    """Reference Console template get|list (Template.scala:226-415);
+    the gallery is the set of packaged engine templates."""
+    from predictionio_tpu.tools.template import template_get, template_list
+
+    if args.template_command == "list":
+        for t in template_list():
+            print(f"{t.name}: {t.description}")
+        return 0
+    directory = args.directory or args.name
+    try:
+        template_get(args.name, directory, app_name=args.app_name)
+    except (KeyError, FileExistsError) as e:
+        raise CommandError(str(e)) from e
+    print(f"Engine template {args.name} created at {directory}/")
+    return 0
+
+
+def cmd_run(args) -> int:
+    """Run an arbitrary ``fn(ctx)`` under the workflow env (reference
+    Console.run:1033 + FakeWorkflow)."""
+    from predictionio_tpu.workflow.fake_workflow import run_fake
+
+    func = resolve_attr(args.main)
+    result = run_fake(func)
+    print(result.to_one_liner())
     return 0
 
 
@@ -446,6 +491,22 @@ def build_parser() -> argparse.ArgumentParser:
     dash.add_argument("--ip", default="localhost")
     dash.add_argument("--port", type=int, default=9000)
     dash.set_defaults(func=cmd_dashboard)
+
+    # template / run
+    tpl = sub.add_parser("template", help="engine template gallery")
+    tpl_sub = tpl.add_subparsers(dest="template_command", required=True)
+    tpl_sub.add_parser("list")
+    tpl_get = tpl_sub.add_parser("get")
+    tpl_get.add_argument("name")
+    tpl_get.add_argument("directory", nargs="?")
+    tpl_get.add_argument("--app-name", default="MyApp")
+    tpl.set_defaults(func=cmd_template)
+
+    run = sub.add_parser(
+        "run", help="run an arbitrary fn(ctx) under the workflow env"
+    )
+    run.add_argument("main", help="module path of a fn(ctx) callable")
+    run.set_defaults(func=cmd_run)
 
     # export / import / status / version
     exp = sub.add_parser("export", help="export events to a JSON-lines file")

@@ -1,0 +1,173 @@
+"""Engine template gallery.
+
+Capability parity with the reference template commands
+(tools/src/main/scala/io/prediction/tools/console/Template.scala:226-429
+— ``pio template list|get`` fetching from a GitHub gallery, unzipping and
+personalizing). This runtime ships its model families in-package, so the
+gallery is local: ``list`` enumerates the built-in engine templates and
+``get`` scaffolds a ready-to-run engine project directory (engine.json
+wired to the packaged EngineFactory, plus a README with the train/deploy
+commands). A ``template.json`` with ``pio.version.min`` is emitted and
+checked like the reference's verifyTemplateMinVersion (:417-429).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Dict, List
+
+from predictionio_tpu import __version__
+
+
+@dataclasses.dataclass(frozen=True)
+class TemplateMetaData:
+    """Reference TemplateMetaData (Template.scala:66)."""
+
+    name: str
+    repo: str  # packaged module path (the local "repo")
+    description: str
+    engine_factory: str
+    variant: Dict
+
+
+TEMPLATES: List[TemplateMetaData] = [
+    TemplateMetaData(
+        name="recommendation",
+        repo="predictionio_tpu.models.recommendation",
+        description="ALS collaborative filtering over rate/buy events "
+        "(reference scala-parallel-recommendation)",
+        engine_factory="predictionio_tpu.models.recommendation.RecommendationEngineFactory",
+        variant={
+            "datasource": {"params": {"app_name": "MyApp"}},
+            "algorithms": [
+                {
+                    "name": "als",
+                    "params": {
+                        "rank": 10,
+                        "num_iterations": 20,
+                        "lambda_": 0.01,
+                        "seed": 3,
+                    },
+                }
+            ],
+        },
+    ),
+    TemplateMetaData(
+        name="similarproduct",
+        repo="predictionio_tpu.models.similarproduct",
+        description="similar items by cosine over implicit-ALS item factors "
+        "(reference scala-parallel-similarproduct)",
+        engine_factory="predictionio_tpu.models.similarproduct.SimilarProductEngineFactory",
+        variant={
+            "datasource": {"params": {"app_name": "MyApp"}},
+            "algorithms": [
+                {
+                    "name": "als",
+                    "params": {"rank": 10, "num_iterations": 20, "lambda_": 0.01},
+                }
+            ],
+        },
+    ),
+    TemplateMetaData(
+        name="classification",
+        repo="predictionio_tpu.models.classification",
+        description="NaiveBayes classification over $set user properties "
+        "(reference scala-parallel-classification)",
+        engine_factory="predictionio_tpu.models.classification.ClassificationEngineFactory",
+        variant={
+            "datasource": {"params": {"app_name": "MyApp"}},
+            "algorithms": [{"name": "naive", "params": {"lambda_": 1.0}}],
+        },
+    ),
+    TemplateMetaData(
+        name="ecommercerecommendation",
+        repo="predictionio_tpu.models.ecommerce",
+        description="ALS + live business rules (seen/unavailable items) "
+        "(reference scala-parallel-ecommercerecommendation)",
+        engine_factory="predictionio_tpu.models.ecommerce.ECommerceEngineFactory",
+        variant={
+            "datasource": {"params": {"app_name": "MyApp"}},
+            "algorithms": [
+                {
+                    "name": "ecomm",
+                    "params": {
+                        "app_name": "MyApp",
+                        "unseen_only": True,
+                        "seen_events": ["buy", "view"],
+                        "rank": 10,
+                        "num_iterations": 20,
+                    },
+                }
+            ],
+        },
+    ),
+]
+
+
+def template_list() -> List[TemplateMetaData]:
+    return list(TEMPLATES)
+
+
+def template_get(name: str, directory: str, app_name: str = "MyApp") -> str:
+    """Scaffold an engine project directory; returns the directory."""
+    matches = [t for t in TEMPLATES if t.name == name]
+    if not matches:
+        raise KeyError(
+            f"template {name!r} not found; available: "
+            f"{[t.name for t in TEMPLATES]}"
+        )
+    t = matches[0]
+    os.makedirs(directory, exist_ok=False)
+
+    def personalize(v):
+        if isinstance(v, dict):
+            return {k: personalize(x) for k, x in v.items()}
+        if isinstance(v, list):
+            return [personalize(x) for x in v]
+        return app_name if v == "MyApp" else v
+
+    variant = personalize(t.variant)
+    engine_json = {
+        "id": name,
+        "version": "0.1.0",
+        "description": t.description,
+        "engineFactory": t.engine_factory,
+        **variant,
+    }
+    with open(os.path.join(directory, "engine.json"), "w") as f:
+        json.dump(engine_json, f, indent=2)
+        f.write("\n")
+    with open(os.path.join(directory, "template.json"), "w") as f:
+        json.dump({"pio": {"version": {"min": __version__}}}, f)
+        f.write("\n")
+    with open(os.path.join(directory, "README.md"), "w") as f:
+        f.write(
+            f"# {name} engine\n\n{t.description}\n\n"
+            "```sh\n"
+            f"pio app new {app_name}\n"
+            "pio build\npio train\npio deploy\n"
+            "```\n\n"
+            f"Engine components: `{t.repo}.engine`. Customize by\n"
+            "subclassing its DataSource/Preparator/Algorithm/Serving and\n"
+            "pointing `engineFactory` at your own EngineFactory.\n"
+        )
+    return directory
+
+
+def verify_template_min_version(directory: str) -> bool:
+    """Reference verifyTemplateMinVersion (Template.scala:417-429)."""
+    path = os.path.join(directory, "template.json")
+    if not os.path.exists(path):
+        return True
+    with open(path) as f:
+        meta = json.load(f)
+    min_version = (
+        meta.get("pio", {}).get("version", {}).get("min", "0")
+    )
+
+    def parse(v: str):
+        return tuple(int(x) for x in v.split(".") if x.isdigit())
+
+    return parse(__version__) >= parse(min_version)

@@ -27,6 +27,7 @@ class PhaseRecord:
     name: str
     seconds: float
     depth: int
+    start: float  # perf_counter at phase entry — orders the summary
 
 
 class PhaseTimer:
@@ -45,7 +46,9 @@ class PhaseTimer:
         finally:
             self._depth -= 1
             elapsed = time.perf_counter() - start
-            self.records.append(PhaseRecord(name, elapsed, self._depth))
+            self.records.append(
+                PhaseRecord(name, elapsed, self._depth, start)
+            )
             logger.info("phase %s: %.3fs", name, elapsed)
 
     def totals(self) -> Dict[str, float]:
@@ -55,11 +58,12 @@ class PhaseTimer:
         return out
 
     def summary(self) -> str:
-        lines = [
-            f"{'  ' * r.depth}{r.name}: {r.seconds:.3f}s"
-            for r in reversed(self.records)
-        ]
-        return "\n".join(lines)
+        # chronological, parents before their children (same start order,
+        # shallower first)
+        ordered = sorted(self.records, key=lambda r: (r.start, r.depth))
+        return "\n".join(
+            f"{'  ' * r.depth}{r.name}: {r.seconds:.3f}s" for r in ordered
+        )
 
 
 @contextlib.contextmanager

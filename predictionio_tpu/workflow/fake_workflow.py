@@ -1,0 +1,69 @@
+"""FakeWorkflow: run an arbitrary function under the full workflow env.
+
+Capability parity with the reference FakeWorkflow/FakeRun
+(core/src/main/scala/io/prediction/workflow/FakeWorkflow.scala:31-106):
+wrap a ``WorkflowContext -> None`` function as an Evaluation so it runs
+through the normal evaluation lifecycle (``pio run`` /
+``CoreWorkflow.run_evaluation``) with storage and the device mesh
+configured — the dev harness for ad-hoc scripts.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+from predictionio_tpu.controller.engine import BaseEngine, EngineParams
+from predictionio_tpu.controller.evaluation import (
+    BaseEvaluator,
+    BaseEvaluatorResult,
+    Evaluation,
+)
+
+
+class FakeEvalResult(BaseEvaluatorResult):
+    """Reference FakeEvalResult (FakeWorkflow.scala:41-48): never saved."""
+
+    no_save = True
+
+    def to_one_liner(self) -> str:
+        return "Done running FakeWorkflow"
+
+
+class _FakeEngine(BaseEngine):
+    def train(self, ctx, engine_params, workflow_params):
+        return []
+
+    def batch_eval(self, ctx, engine_params_list, workflow_params):
+        # one empty eval set per params so the evaluator runs once
+        return [(p, []) for p in engine_params_list]
+
+    def jvalue_to_engine_params(self, json_obj):
+        return EngineParams()
+
+
+class _FakeEvaluator(BaseEvaluator):
+    def __init__(self, func: Callable):
+        self.func = func
+
+    def evaluate_base(self, ctx, evaluation, engine_eval_data_set, workflow_params):
+        self.func(ctx)
+        return FakeEvalResult()
+
+
+class FakeEvaluation(Evaluation):
+    """Reference FakeRun (FakeWorkflow.scala:96-106)."""
+
+    def __init__(self, func: Callable):
+        super().__init__()
+        self.set_engine_evaluator(_FakeEngine(), _FakeEvaluator(func))
+        self.engine_params_list = [EngineParams()]
+
+
+def run_fake(func: Callable, ctx=None):
+    """Run ``func(ctx)`` under the evaluation lifecycle; returns the
+    FakeEvalResult."""
+    from predictionio_tpu.workflow.core_workflow import CoreWorkflow
+
+    return CoreWorkflow.run_evaluation(
+        FakeEvaluation(func), [EngineParams()], ctx=ctx
+    )

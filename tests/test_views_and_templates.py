@@ -1,0 +1,154 @@
+"""Tests for EntityMap, legacy batch views, the template gallery,
+FakeWorkflow, and pio run."""
+
+import datetime as dt
+import json
+
+import pytest
+
+from predictionio_tpu.data.bimap import BiMap
+from predictionio_tpu.data.entity_map import EntityIdIxMap, EntityMap
+from predictionio_tpu.data.event import DataMap, Event
+from predictionio_tpu.data.storage.base import App
+from predictionio_tpu.data.view import EventSeq, LBatchView
+from predictionio_tpu.tools.cli import main as cli_main
+from predictionio_tpu.tools.template import (
+    template_get,
+    template_list,
+    verify_template_min_version,
+)
+from predictionio_tpu.workflow.fake_workflow import run_fake
+
+
+class TestEntityMap:
+    def test_id_ix_round_trip(self):
+        m = EntityIdIxMap.from_keys(["a", "b", "c"])
+        assert len(m) == 3
+        assert m[m["b"]] == "b"
+        assert "a" in m and m["a"] in m
+        assert m.get("zzz") is None
+        assert set(m.to_map()) == {"a", "b", "c"}
+
+    def test_entity_map_data(self):
+        m = EntityMap({"u1": {"age": 3}, "u2": {"age": 5}})
+        assert m.data("u1") == {"age": 3}
+        assert m.data(m["u2"]) == {"age": 5}
+        assert m.get_data("nope", default="d") == "d"
+
+    def test_take(self):
+        m = EntityMap({f"u{i}": i for i in range(5)})
+        t = m.take(2)
+        assert len(t) == 2
+        for key in t.to_map():
+            assert t.data(key) == int(key[1:])
+
+
+def _ev(entity, event="$set", props=None, minute=0):
+    return Event(
+        event=event,
+        entity_type="user",
+        entity_id=entity,
+        properties=DataMap(props or {}),
+        event_time=dt.datetime(2026, 7, 1, 12, minute, tzinfo=dt.timezone.utc),
+    )
+
+
+class TestEventSeq:
+    def test_filter_and_ordered_fold(self):
+        events = [
+            _ev("u1", props={"a": 1}, minute=0),
+            _ev("u1", props={"a": 2}, minute=5),
+            _ev("u2", props={"a": 9}, minute=1),
+            _ev("u1", event="view", minute=2),
+        ]
+        seq = EventSeq(events)
+        sets = seq.filter(event="$set")
+        assert len(sets) == 3
+        # ordered fold: later $set wins
+        folded = sets.aggregate_by_entity_ordered(
+            None, lambda acc, e: e.properties["a"]
+        )
+        assert folded == {"u1": 2, "u2": 9}
+
+    def test_group_by_entity_ordered(self):
+        events = [_ev("u1", minute=5), _ev("u1", minute=1)]
+        groups = EventSeq(events).group_by_entity_ordered(
+            lambda e: e.event_time.minute
+        )
+        assert groups == {"u1": [1, 5]}
+
+
+class TestLBatchView:
+    def test_aggregate_properties(self, mem_storage):
+        app_id = mem_storage.get_meta_data_apps().insert(App(id=0, name="v"))
+        events = mem_storage.get_l_events()
+        events.init(app_id)
+        events.insert(_ev("u1", props={"a": 1, "b": 1}, minute=0), app_id)
+        events.insert(_ev("u1", event="$unset", props={"b": 1}, minute=1), app_id)
+        events.insert(_ev("u2", props={"a": 5}, minute=2), app_id)
+        with pytest.warns(DeprecationWarning):
+            view = LBatchView(app_id, storage=mem_storage)
+        agg = view.aggregate_properties("user")
+        assert dict(agg["u1"]) == {"a": 1}
+        assert dict(agg["u2"]) == {"a": 5}
+
+
+class TestTemplateGallery:
+    def test_list_has_all_families(self):
+        names = {t.name for t in template_list()}
+        assert names == {
+            "recommendation",
+            "similarproduct",
+            "classification",
+            "ecommercerecommendation",
+        }
+
+    def test_get_scaffolds_runnable_variant(self, tmp_path):
+        d = str(tmp_path / "myrec")
+        template_get("recommendation", d, app_name="shop")
+        variant = json.loads((tmp_path / "myrec" / "engine.json").read_text())
+        assert variant["datasource"]["params"]["app_name"] == "shop"
+        # the scaffolded variant resolves to a working engine
+        from predictionio_tpu.tools.cli import engine_from_variant
+
+        engine, factory = engine_from_variant(variant)
+        params = engine.jvalue_to_engine_params(variant)
+        assert params.algorithm_params_list[0][0] == "als"
+        assert verify_template_min_version(d)
+
+    def test_get_unknown_raises(self, tmp_path):
+        with pytest.raises(KeyError):
+            template_get("nope", str(tmp_path / "x"))
+
+    def test_cli_template_commands(self, mem_storage, tmp_path, capsys):
+        assert cli_main(["template", "list"]) == 0
+        assert "recommendation" in capsys.readouterr().out
+        d = str(tmp_path / "scaffold")
+        assert cli_main(["template", "get", "classification", d]) == 0
+        assert (tmp_path / "scaffold" / "engine.json").exists()
+
+
+_ran = {}
+
+
+def fake_main(ctx):
+    _ran["ctx"] = ctx
+
+
+class TestFakeWorkflow:
+    def test_run_fake_executes_function(self, mem_storage):
+        _ran.clear()
+        result = run_fake(fake_main)
+        assert "ctx" in _ran
+        assert _ran["ctx"].storage is mem_storage
+        assert result.no_save
+        # no_save results leave no evaluation instance behind
+        assert (
+            mem_storage.get_meta_data_evaluation_instances().get_all() == []
+        )
+
+    def test_cli_run(self, mem_storage, capsys):
+        _ran.clear()
+        assert cli_main(["run", f"{__name__}.fake_main"]) == 0
+        assert "ctx" in _ran
+        assert "FakeWorkflow" in capsys.readouterr().out
