@@ -66,9 +66,10 @@ def main():
     u, i, r = synth_ml100k()
     config = ALSConfig(rank=RANK, iterations=ITERS, reg=0.05)
 
-    # warm-up: compile all bucket kernels with a 1-iteration run
-    warm = ALSConfig(rank=RANK, iterations=1, reg=0.05)
-    train_als(u, i, r, N_USERS, N_ITEMS, warm)
+    # warm-up with the identical config: the whole training loop is ONE
+    # jitted program (ops/als.py _run_iterations), so this compiles it and
+    # the timed run below measures pure execution
+    train_als(u, i, r, N_USERS, N_ITEMS, config)
 
     t0 = time.perf_counter()
     model = train_als(u, i, r, N_USERS, N_ITEMS, config)

@@ -144,11 +144,6 @@ def bucketize(
 # --- device kernels ---
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=("implicit", "weighted_reg", "compute_dtype"),
-    donate_argnames=("X",),
-)
 def _solve_bucket(
     X: jax.Array,  # [n_rows+1, k] factor matrix being solved (row-sharded)
     Y: jax.Array,  # [n_cols(+1), k] counter-side factors (replicated)
@@ -217,6 +212,67 @@ def _gramian(Y: jax.Array) -> jax.Array:
         "nk,nj->kj", Yf, Yf,
         preferred_element_type=jnp.float32, precision="highest",
     )
+
+
+def _constrain(a: jax.Array, sharding) -> jax.Array:
+    return (
+        jax.lax.with_sharding_constraint(a, sharding)
+        if sharding is not None
+        else a
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "implicit", "weighted_reg", "compute_dtype",
+        "rep_sharding", "row_sharding",
+    ),
+    donate_argnums=(0, 1),
+)
+def _run_iterations(
+    X: jax.Array,
+    Y: jax.Array,
+    user_buckets,  # tuple of (rows, cols, vals, mask) tuples
+    item_buckets,
+    reg: float,
+    alpha: float,
+    n_iters: jax.Array,  # dynamic: one compile serves every chunk size
+    *,
+    implicit: bool,
+    weighted_reg: bool,
+    compute_dtype: str,
+    rep_sharding,  # NamedSharding(P()) or None — replicate for gathers
+    row_sharding,  # NamedSharding(P(axis)) or None
+) -> Tuple[jax.Array, jax.Array]:
+    """The whole training loop as ONE XLA program: lax.fori_loop over
+    iterations with the (static) bucket structure unrolled inside the
+    body. One dispatch covers all iterations — no host round trip per
+    half-step, factors never leave HBM, and the replicate/shard handoffs
+    become compiled all-gathers instead of per-step device_puts. The trip
+    count is a runtime value so warm-up, checkpoint chunks, and resumes
+    all reuse the same executable."""
+    k = X.shape[-1]
+    zeros_g = jnp.zeros((k, k), jnp.float32)
+
+    def half(X, Y, buckets):
+        G = _gramian(Y) if implicit else zeros_g
+        Y_rep = _constrain(Y, rep_sharding)
+        for rows, cols, vals, mask in buckets:
+            X = _solve_bucket(
+                X, Y_rep, G, rows, cols, vals, mask, reg, alpha,
+                implicit=implicit, weighted_reg=weighted_reg,
+                compute_dtype=compute_dtype,
+            )
+        return _constrain(X, row_sharding)
+
+    def body(_, carry):
+        X, Y = carry
+        X = half(X, Y, user_buckets)
+        Y = half(Y, X, item_buckets)
+        return (X, Y)
+
+    return jax.lax.fori_loop(0, n_iters, body, (X, Y))
 
 
 def _place(mesh: Optional[Mesh], arr, spec):
@@ -300,26 +356,25 @@ def train_als(
             )
         return out
 
-    user_buckets = put_side(user_side)
-    item_buckets = put_side(item_side)
-    zeros_g = jnp.zeros((k, k), jnp.float32)
+    user_buckets = tuple(put_side(user_side))
+    item_buckets = tuple(put_side(item_side))
+    rep_sharding = NamedSharding(mesh, rep) if mesh is not None else None
+    row_sharding = NamedSharding(mesh, row_sharded) if mesh is not None else None
 
-    def half_step(X, Y, buckets):
-        G = _gramian(Y) if config.implicit_prefs else zeros_g
-        # replicate counter-side factors for local gathers (all-gather on ICI)
-        Y_rep = jax.device_put(Y, NamedSharding(mesh, rep)) if mesh is not None else Y
-        for rows, cols, vals, mask in buckets:
-            X = _solve_bucket(
-                X, Y_rep, G, rows, cols, vals, mask,
-                config.reg, config.alpha,
-                implicit=config.implicit_prefs,
-                weighted_reg=(config.reg_mode == "weighted"),
-                compute_dtype=config.compute_dtype,
-            )
-        return X
+    def run_iters(X, Y, n_iters: int):
+        return _run_iterations(
+            X, Y, user_buckets, item_buckets, config.reg, config.alpha,
+            jnp.int32(n_iters),
+            implicit=config.implicit_prefs,
+            weighted_reg=(config.reg_mode == "weighted"),
+            compute_dtype=config.compute_dtype,
+            rep_sharding=rep_sharding,
+            row_sharding=row_sharding,
+        )
 
     from predictionio_tpu.workflow.checkpoint import StepCheckpointer
 
+    checkpoint_every = max(1, checkpoint_every)
     ckpt = StepCheckpointer(checkpoint_dir, every=checkpoint_every)
     start_it = 0
     fingerprint = None
@@ -363,20 +418,29 @@ def train_als(
                 logger.info("resuming ALS from iteration %d", start_it)
 
     try:
-        for it in range(start_it, config.iterations):
-            X = half_step(X, Y, user_buckets)
-            Y = half_step(Y, X, item_buckets)
-            logger.debug("ALS iteration %d/%d done", it + 1, config.iterations)
-            if ckpt.enabled:
+        if not ckpt.enabled:
+            # the entire loop is one device program
+            if config.iterations > start_it:
+                X, Y = run_iters(X, Y, config.iterations - start_it)
+        else:
+            # chunk the fused loop at the checkpoint cadence
+            it = start_it
+            while it < config.iterations:
+                chunk = min(checkpoint_every, config.iterations - it)
+                X, Y = run_iters(X, Y, chunk)
+                it += chunk
+                logger.debug(
+                    "ALS iteration %d/%d done", it, config.iterations
+                )
                 ckpt.maybe_save(
-                    it + 1,
+                    it,
                     {
-                        "iteration": it + 1,
+                        "iteration": it,
                         "X": np.asarray(X),
                         "Y": np.asarray(Y),
                         "fingerprint": fingerprint,
                     },
-                    force=(it + 1 == config.iterations),
+                    force=True,  # chunk boundaries ARE the cadence
                 )
     finally:
         ckpt.close()

@@ -168,6 +168,14 @@ def verify_template_min_version(directory: str) -> bool:
     )
 
     def parse(v: str):
-        return tuple(int(x) for x in v.split(".") if x.isdigit())
+        out = []
+        for part in v.split("."):
+            digits = "".join(c for c in part if c.isdigit())
+            out.append(int(digits) if digits else 0)
+        return out
 
-    return parse(__version__) >= parse(min_version)
+    have, need = parse(__version__), parse(min_version)
+    width = max(len(have), len(need))
+    have += [0] * (width - len(have))
+    need += [0] * (width - len(need))
+    return have >= need
