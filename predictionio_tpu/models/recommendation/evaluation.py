@@ -1,0 +1,93 @@
+"""Evaluation for the recommendation engine: Precision@K over a rank/reg
+grid.
+
+Reference mapping: the recommendation template's evaluation module
+(the official template evaluation pattern the reference documents —
+PrecisionAtK as an OptionAverageMetric over held-out positives, an
+Evaluation binding engine + metric, and an EngineParamsGenerator holding
+the tuning grid; see also the MovieLens evaluation example,
+examples/experimental/scala-local-movielens-evaluation). Run with::
+
+    pio eval predictionio_tpu.models.recommendation.evaluation.RecommendationEvaluation \\
+             predictionio_tpu.models.recommendation.evaluation.ParamsGrid
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from predictionio_tpu.controller import OptionAverageMetric
+from predictionio_tpu.controller.engine import EngineParams
+from predictionio_tpu.controller.evaluation import (
+    EngineParamsGenerator,
+    Evaluation,
+)
+from predictionio_tpu.models.recommendation.engine import (
+    ActualResult,
+    ALSAlgorithmParams,
+    DataSourceParams,
+    PredictedResult,
+    Query,
+    recommendation_engine,
+)
+
+
+class PrecisionAtK(OptionAverageMetric):
+    """|top-K ∩ relevant| / min(K, |relevant|); None when a query has no
+    held-out positives (excluded from the average)."""
+
+    def __init__(self, k: int = 10):
+        if k < 1:
+            raise ValueError("k must be >= 1")
+        self.k = k
+
+    @property
+    def header(self) -> str:
+        return f"Precision@{self.k}"
+
+    def calculate_point(
+        self, q: Query, p: PredictedResult, a: ActualResult
+    ) -> Optional[float]:
+        positives = set(a.items)
+        if not positives:
+            return None
+        predicted = [s.item for s in p.item_scores[: self.k]]
+        tp = sum(1 for item in predicted if item in positives)
+        return tp / min(self.k, len(positives))
+
+
+def _engine_params(
+    rank: int, reg: float, app_name: str = "default", eval_k: int = 3
+) -> EngineParams:
+    return EngineParams(
+        data_source_params=(
+            "",
+            DataSourceParams(app_name=app_name, eval_k=eval_k),
+        ),
+        algorithm_params_list=(
+            ("als", ALSAlgorithmParams(rank=rank, lambda_=reg)),
+        ),
+    )
+
+
+class RecommendationEvaluation(Evaluation):
+    """Engine + Precision@10 (the template's Evaluation object). The app
+    under evaluation comes from the DataSourceParams in each EngineParams
+    of the grid (ParamsGrid(app_name=...))."""
+
+    def __init__(self, k: int = 10):
+        super().__init__()
+        self.set_engine_metric(recommendation_engine(), PrecisionAtK(k=k))
+
+
+class ParamsGrid(EngineParamsGenerator):
+    """rank x reg tuning grid (the template's EngineParamsGenerator)."""
+
+    def __init__(self, app_name: str = "default"):
+        super().__init__(
+            [
+                _engine_params(rank, reg, app_name)
+                for rank in (8, 16)
+                for reg in (0.01, 0.1)
+            ]
+        )

@@ -6,6 +6,10 @@ ICI/DCN. This package centralizes mesh construction and sharding helpers so
 algorithms declare *what* is sharded and XLA decides the collectives.
 """
 
+from predictionio_tpu.parallel.distributed import (
+    initialize_distributed,
+    is_multi_host,
+)
 from predictionio_tpu.parallel.mesh import (
     default_mesh,
     device_count,
@@ -13,4 +17,11 @@ from predictionio_tpu.parallel.mesh import (
     shard_batch,
 )
 
-__all__ = ["default_mesh", "device_count", "make_mesh", "shard_batch"]
+__all__ = [
+    "default_mesh",
+    "device_count",
+    "initialize_distributed",
+    "is_multi_host",
+    "make_mesh",
+    "shard_batch",
+]

@@ -1,0 +1,80 @@
+"""Multi-host (multi-slice) initialization.
+
+The reference's "distributed backend" is the Spark cluster runtime —
+driver↔executor control plus shuffle-based data exchange (SURVEY.md §2.11).
+The TPU-native equivalent has two layers:
+
+- **within a slice**: XLA collectives over ICI, produced by the sharding
+  annotations in `ops/` and `parallel/mesh.py` — nothing to initialize;
+- **across hosts/slices**: JAX's single-controller-per-host model wired by
+  ``jax.distributed.initialize`` over DCN. Every host runs the same
+  program; ``jax.devices()`` then spans all hosts and meshes built from it
+  shard globally, with XLA routing inter-slice collective traffic over DCN.
+
+This image exposes one TPU chip, so multi-host paths here are exercised in
+process-count=1 form plus the virtual-device CPU mesh tests; the entry
+point is the standard one and takes the standard environment
+(coordinator_address, num_processes, process_id) or auto-detects on
+managed TPU pods.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+logger = logging.getLogger(__name__)
+
+_initialized = False
+
+
+def initialize_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Wire this host into the multi-host JAX runtime (idempotent).
+
+    With no arguments, relies on the TPU pod metadata autodetection. Call
+    before any other JAX API on every host of the pod/slice set.
+    """
+    global _initialized
+    if _initialized:
+        logger.info("jax.distributed already initialized; skipping")
+        return
+    import jax
+
+    kwargs = {}
+    if coordinator_address is not None:
+        kwargs["coordinator_address"] = coordinator_address
+    if num_processes is not None:
+        kwargs["num_processes"] = num_processes
+    if process_id is not None:
+        kwargs["process_id"] = process_id
+    try:
+        jax.distributed.initialize(**kwargs)
+    except RuntimeError as e:
+        # either another component initialized the distributed runtime
+        # first, or the JAX backend was already touched single-process —
+        # surface loudly but don't crash a running job
+        logger.error(
+            "jax.distributed.initialize failed (%s); continuing with the "
+            "current runtime (%d process(es)). Call initialize_distributed "
+            "before any other JAX usage on every host.",
+            e,
+            jax.process_count(),
+        )
+    _initialized = True
+    logger.info(
+        "distributed runtime up: process %d/%d, %d local / %d global devices",
+        jax.process_index(),
+        jax.process_count(),
+        jax.local_device_count(),
+        jax.device_count(),
+    )
+
+
+def is_multi_host() -> bool:
+    import jax
+
+    return jax.process_count() > 1

@@ -276,6 +276,33 @@ def cmd_run(args) -> int:
     return 0
 
 
+def cmd_shell(args) -> int:
+    """Interactive Python with the pio environment loaded (reference
+    bin/pio-shell — a Spark shell with the pio classpath)."""
+    import code
+
+    from predictionio_tpu.data.storage import get_storage
+    from predictionio_tpu.data.store import LEventStore, PEventStore
+    from predictionio_tpu.workflow.context import WorkflowContext
+
+    storage = get_storage()
+    ctx = WorkflowContext(mode="shell", storage=storage)
+    banner = (
+        f"predictionio_tpu {__version__} shell\n"
+        "bindings: storage, ctx, PEventStore, LEventStore"
+    )
+    code.interact(
+        banner=banner,
+        local={
+            "storage": storage,
+            "ctx": ctx,
+            "PEventStore": PEventStore,
+            "LEventStore": LEventStore,
+        },
+    )
+    return 0
+
+
 def cmd_export(args) -> int:
     from predictionio_tpu.tools.export_import import events_to_file
 
@@ -524,6 +551,9 @@ def build_parser() -> argparse.ArgumentParser:
     sub.add_parser("status", help="check storage config").set_defaults(
         func=cmd_status
     )
+    sub.add_parser(
+        "shell", help="interactive Python with the pio env loaded"
+    ).set_defaults(func=cmd_shell)
     sub.add_parser("version").set_defaults(func=cmd_version)
     return p
 

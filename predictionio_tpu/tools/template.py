@@ -16,6 +16,7 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
+import re
 from typing import Dict, List
 
 from predictionio_tpu import __version__
@@ -170,8 +171,8 @@ def verify_template_min_version(directory: str) -> bool:
     def parse(v: str):
         out = []
         for part in v.split("."):
-            digits = "".join(c for c in part if c.isdigit())
-            out.append(int(digits) if digits else 0)
+            m = re.match(r"\d+", part)  # leading digits only: "0rc1" -> 0
+            out.append(int(m.group()) if m else 0)
         return out
 
     have, need = parse(__version__), parse(min_version)
