@@ -14,6 +14,7 @@ from __future__ import annotations
 import json
 import logging
 import threading
+import time
 import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Optional, Tuple
@@ -73,13 +74,34 @@ class _Handler(BaseHTTPRequestHandler):
 
 
 class JsonHTTPServer:
-    """Threaded HTTP server around a request-core callable."""
+    """Threaded HTTP server around a request-core callable.
+
+    Binding retries (reference CreateServer.scala:347-357 retries the
+    spray bind 3x, 1s apart — covers the undeploy-then-redeploy race
+    where the old server's port lingers in TIME_WAIT).
+    """
+
+    BIND_RETRIES = 3
+    BIND_RETRY_DELAY_S = 1.0
 
     def __init__(self, handle_fn: HandleFn, ip: str, port: int, name: str):
         self.name = name
         self.ip = ip
         handler = type("BoundHandler", (_Handler,), {"handle_fn": staticmethod(handle_fn)})
-        self.httpd = ThreadingHTTPServer((ip, port), handler)
+        last_error: Optional[OSError] = None
+        for attempt in range(self.BIND_RETRIES):
+            try:
+                self.httpd = ThreadingHTTPServer((ip, port), handler)
+                break
+            except OSError as e:
+                last_error = e
+                logger.warning(
+                    "%s bind to %s:%d failed (%s); retry %d/%d",
+                    name, ip, port, e, attempt + 1, self.BIND_RETRIES,
+                )
+                time.sleep(self.BIND_RETRY_DELAY_S)
+        else:
+            raise last_error
         self._thread: Optional[threading.Thread] = None
 
     @property

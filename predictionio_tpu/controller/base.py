@@ -50,6 +50,10 @@ def doer(cls, params: Optional[Params] = None):
     (reference Doer.apply, core/AbstractDoer.scala:33-66). The instance's
     params are always available as ``self.params``."""
     params = params if params is not None else EmptyParams()
+    # an EmptyParams slot (EngineParams default) upgrades to the class's
+    # declared params defaults, mirroring Controller.__init__
+    if isinstance(params, EmptyParams) and getattr(cls, "params_class", None):
+        params = cls.params_class()
     try:
         sig = inspect.signature(cls.__init__)
         takes_params = any(n != "self" for n in sig.parameters)
@@ -68,10 +72,19 @@ def doer(cls, params: Optional[Params] = None):
 
 class Controller:
     """Common base: every DASE component may take a Params in its
-    constructor; ``self.params`` is always set (by the ctor or by doer)."""
+    constructor; ``self.params`` is always set (by the ctor or by doer).
+    A declared ``params_class`` supplies the default (all-defaults)
+    instance when none is given."""
+
+    params_class: Optional[type] = None
 
     def __init__(self, params: Optional[Params] = None):
-        self.params = params if params is not None else EmptyParams()
+        if params is not None:
+            self.params = params
+        elif type(self).params_class is not None:
+            self.params = type(self).params_class()
+        else:
+            self.params = EmptyParams()
 
 
 class BaseDataSource(Controller, Generic[TD, EI, Q, A]):

@@ -1,0 +1,1 @@
+"""Experimental engine examples (the reference's examples/experimental)."""

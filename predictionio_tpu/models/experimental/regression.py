@@ -1,0 +1,174 @@
+"""Local linear-regression engine example.
+
+Reference mapping (examples/experimental/scala-local-regression/Run.scala):
+- DataSource reads "y x1 x2 ..." lines from a file (filepath param), and
+  hands out k-fold eval sets
+- Preparator drops every n-th point (the reference's (n, k) holdout)
+- Algorithm: OLS (breeze LinearRegression there; batched
+  ``jnp.linalg.lstsq`` here)
+- Serving: first prediction
+- Metric: mean squared error
+
+This mirrors the reference's "local" engine style: the dataset is small
+and host-resident; the solve still runs on device.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from predictionio_tpu.controller import (
+    AverageMetric,
+    BaseAlgorithm,
+    BaseDataSource,
+    BasePreparator,
+    EngineFactory,
+    FirstServing,
+    Params,
+)
+from predictionio_tpu.controller.engine import Engine
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass(frozen=True)
+class Query:
+    features: Tuple[float, ...]
+
+    def __post_init__(self):
+        object.__setattr__(
+            self, "features", tuple(float(f) for f in self.features)
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class PredictedResult:
+    prediction: float
+
+
+@dataclasses.dataclass
+class TrainingData:
+    x: np.ndarray  # [n, F]
+    y: np.ndarray  # [n]
+
+
+@dataclasses.dataclass(frozen=True)
+class DataSourceParams(Params):
+    filepath: str = ""
+    eval_k: Optional[int] = None
+    seed: int = 9527
+
+
+class DataSource(BaseDataSource):
+    """Reads "y x1 x2 ..." lines (reference LocalDataSource)."""
+
+    params_class = DataSourceParams
+
+    def _read(self) -> TrainingData:
+        xs: List[List[float]] = []
+        ys: List[float] = []
+        with open(self.params.filepath) as f:
+            for line in f:
+                parts = line.split()
+                if not parts:
+                    continue
+                ys.append(float(parts[0]))
+                xs.append([float(v) for v in parts[1:]])
+        return TrainingData(
+            x=np.asarray(xs, np.float32), y=np.asarray(ys, np.float32)
+        )
+
+    def read_training(self, ctx) -> TrainingData:
+        return self._read()
+
+    def read_eval(self, ctx):
+        if not self.params.eval_k:
+            return []
+        td = self._read()
+        k = self.params.eval_k
+        out = []
+        for fold in range(k):
+            sel = np.arange(len(td.y)) % k == fold
+            out.append(
+                (
+                    TrainingData(x=td.x[~sel], y=td.y[~sel]),
+                    fold,
+                    [
+                        (Query(tuple(x)), float(y))
+                        for x, y in zip(td.x[sel], td.y[sel])
+                    ],
+                )
+            )
+        return out
+
+
+@dataclasses.dataclass(frozen=True)
+class PreparatorParams(Params):
+    n: int = 0  # drop every point with index % n == k (0 disables)
+    k: int = 0
+
+
+class Preparator(BasePreparator):
+    """Reference LocalPreparator: holds out every n-th point."""
+
+    params_class = PreparatorParams
+
+    def prepare(self, ctx, td: TrainingData) -> TrainingData:
+        p = self.params
+        if not p.n:
+            return td
+        keep = np.arange(len(td.y)) % p.n != p.k
+        return TrainingData(x=td.x[keep], y=td.y[keep])
+
+
+class OLSAlgorithm(BaseAlgorithm):
+    """Ordinary least squares via device lstsq (reference LocalAlgorithm's
+    breeze LinearRegression.regress)."""
+
+    query_class = Query
+
+    def train(self, ctx, td: TrainingData) -> np.ndarray:
+        import jax.numpy as jnp
+
+        if len(td.y) == 0:
+            raise ValueError("cannot regress on an empty dataset")
+        coef, *_ = jnp.linalg.lstsq(jnp.asarray(td.x), jnp.asarray(td.y))
+        return np.asarray(coef)
+
+    def predict(self, model: np.ndarray, query: Query) -> PredictedResult:
+        return PredictedResult(
+            prediction=float(np.dot(model, np.asarray(query.features)))
+        )
+
+    def batch_predict(self, model, queries) -> List[Tuple[int, PredictedResult]]:
+        X = np.asarray([q.features for _, q in queries], np.float32)
+        preds = X @ model
+        return [
+            (i, PredictedResult(prediction=float(p)))
+            for (i, _), p in zip(queries, preds)
+        ]
+
+
+class MeanSquareError(AverageMetric):
+    def calculate_point(self, q: Query, p: PredictedResult, a: float) -> float:
+        return (p.prediction - a) ** 2
+
+    is_larger_better = False
+
+
+def regression_engine() -> Engine:
+    return Engine(
+        data_source_classes=DataSource,
+        preparator_classes=Preparator,
+        algorithm_classes={"ols": OLSAlgorithm},
+        serving_classes=FirstServing,
+    )
+
+
+class RegressionEngineFactory(EngineFactory):
+    def apply(self) -> Engine:
+        return regression_engine()

@@ -356,6 +356,129 @@ class LikeAlgorithm(ALSAlgorithm):
         return {k: val for k, (_, val) in latest.items()}
 
 
+@dataclasses.dataclass(frozen=True)
+class DIMSUMAlgorithmParams(Params):
+    threshold: float = 0.0
+
+
+@dataclasses.dataclass
+class DIMSUMModel:
+    """Thresholded item-item cosine similarity matrix + metadata."""
+
+    similarities: np.ndarray  # [n_items, n_items], zeroed under threshold
+    item_index: BiMap
+    items: Dict[int, Item]
+    _inv_index: Optional[BiMap] = dataclasses.field(
+        default=None, repr=False, compare=False
+    )
+
+    def __getstate__(self):
+        state = self.__dict__.copy()
+        state["_inv_index"] = None
+        return state
+
+    @property
+    def inv_index(self) -> BiMap:
+        if self._inv_index is None:
+            self._inv_index = self.item_index.inverse()
+        return self._inv_index
+
+
+class DIMSUMAlgorithm(BaseAlgorithm):
+    """Item-item column similarity of the binary user x item view matrix
+    (reference experimental scala-parallel-similarproduct-dimsum,
+    DIMSUMAlgorithm.scala: RowMatrix.columnSimilarities(threshold)).
+
+    DIMSUM's sampling approximation exists because the exact Gram matrix
+    is shuffle-bound on a Spark cluster; on the MXU the EXACT computation
+    is one [I, U] x [U, I] matmul of the normalized view matrix, so this
+    computes exact cosine similarities and applies the threshold as a
+    filter rather than a sampling parameter."""
+
+    params_class = DIMSUMAlgorithmParams
+    query_class = Query
+
+    def train(self, ctx, pd: PreparedData) -> DIMSUMModel:
+        import jax
+        import jax.numpy as jnp
+
+        from predictionio_tpu.ops.similarity import normalize_rows
+
+        td = pd.td
+        user_index = BiMap.string_int(
+            set(td.users.keys()) | {v.user for v in td.view_events}
+        )
+        item_index = BiMap.string_int(td.items.keys())
+        R = np.zeros((len(user_index), len(item_index)), np.float32)
+        for v in td.view_events:
+            if v.item in item_index:
+                R[user_index[v.user], item_index[v.item]] = 1.0
+        # cosine over columns = normalized-column Gram matrix (one matmul)
+        Rn = normalize_rows(R.T)  # [I, U] rows = items, L2-normalized
+        sims = np.array(  # writable host copy (np.asarray of a jax.Array is read-only)
+            jax.jit(
+                lambda a: jnp.dot(a, a.T, preferred_element_type=jnp.float32)
+            )(jnp.asarray(Rn))
+        )
+        np.fill_diagonal(sims, 0.0)
+        sims[sims < self.params.threshold] = 0.0
+        return DIMSUMModel(
+            similarities=sims,
+            item_index=item_index,
+            items={item_index[i]: item for i, item in td.items.items()},
+        )
+
+    def predict(self, model: DIMSUMModel, query: Query) -> PredictedResult:
+        query_idx = [
+            model.item_index[i] for i in query.items if i in model.item_index
+        ]
+        if not query_idx:
+            return PredictedResult()
+        scores = model.similarities[query_idx].sum(axis=0)
+        mask = scores > 0
+        mask[query_idx] = False
+        if query.white_list is not None:
+            wl = np.zeros_like(mask)
+            wl[[
+                model.item_index[i]
+                for i in query.white_list
+                if i in model.item_index
+            ]] = True
+            mask &= wl
+        if query.black_list is not None:
+            mask[[
+                model.item_index[i]
+                for i in query.black_list
+                if i in model.item_index
+            ]] = False
+        if query.categories is not None:
+            cats = set(query.categories)
+            for idx in np.nonzero(mask)[0]:
+                item = model.items.get(int(idx))
+                if item is None or not cats.intersection(item.categories):
+                    mask[idx] = False
+        scores = np.where(mask, scores, -np.inf)
+        num = min(query.num, int(mask.sum()))
+        if num <= 0:
+            return PredictedResult()
+        top = np.argpartition(-scores, num - 1)[:num]
+        top = top[np.argsort(-scores[top])]
+        return PredictedResult(
+            item_scores=tuple(
+                ItemScore(item=model.inv_index[int(i)], score=float(scores[i]))
+                for i in top
+            )
+        )
+
+    def result_to_json(self, result: PredictedResult):
+        return {
+            "itemScores": [
+                {"item": s.item, "score": s.score}
+                for s in result.item_scores
+            ]
+        }
+
+
 class Serving(BaseServing):
     """Sums scores per item across algorithms (reference multi/Serving.scala
     combines standard + like predictions by summed score)."""
@@ -377,7 +500,11 @@ def similarproduct_engine() -> Engine:
     return Engine(
         data_source_classes=DataSource,
         preparator_classes=Preparator,
-        algorithm_classes={"als": ALSAlgorithm, "likealgo": LikeAlgorithm},
+        algorithm_classes={
+            "als": ALSAlgorithm,
+            "likealgo": LikeAlgorithm,
+            "dimsum": DIMSUMAlgorithm,
+        },
         serving_classes=Serving,
     )
 

@@ -1,0 +1,172 @@
+"""Tests for the DIMSUM similarproduct algorithm and the experimental
+regression engine."""
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.workflow.context import WorkflowContext
+
+
+class TestDIMSUM:
+    @pytest.fixture()
+    def model_and_algo(self, similarproduct_setup_data):
+        from predictionio_tpu.models.similarproduct.engine import (
+            DataSource,
+            DataSourceParams,
+            DIMSUMAlgorithm,
+            DIMSUMAlgorithmParams,
+            Preparator,
+        )
+
+        storage = similarproduct_setup_data
+        ctx = WorkflowContext(mode="training", storage=storage)
+        td = DataSource(DataSourceParams(app_name="spapp")).read_training(ctx)
+        pd = Preparator().prepare(ctx, td)
+        algo = DIMSUMAlgorithm(DIMSUMAlgorithmParams(threshold=0.0))
+        return algo, algo.train(ctx, pd)
+
+    def test_similarities_are_cosine(self, model_and_algo):
+        algo, model = model_and_algo
+        sims = model.similarities
+        n = sims.shape[0]
+        assert sims.shape == (n, n)
+        assert np.allclose(np.diag(sims), 0.0)  # self-sim removed
+        assert np.allclose(sims, sims.T, atol=1e-5)
+        assert (sims >= 0).all() and (sims <= 1.0 + 1e-5).all()
+
+    def test_cluster_structure_recovered(self, model_and_algo):
+        from predictionio_tpu.models.similarproduct.engine import Query
+
+        algo, model = model_and_algo
+        result = algo.predict(model, Query(items=("i0",), num=3))
+        got = {s.item for s in result.item_scores}
+        assert "i0" not in got
+        # co-viewed items (cluster 0: i0-i3) dominate
+        assert len(got & {"i1", "i2", "i3"}) >= 2
+
+    def test_threshold_filters(self, similarproduct_setup_data):
+        from predictionio_tpu.models.similarproduct.engine import (
+            DataSource,
+            DataSourceParams,
+            DIMSUMAlgorithm,
+            DIMSUMAlgorithmParams,
+            Preparator,
+        )
+
+        ctx = WorkflowContext(
+            mode="training", storage=similarproduct_setup_data
+        )
+        td = DataSource(DataSourceParams(app_name="spapp")).read_training(ctx)
+        pd = Preparator().prepare(ctx, td)
+        model = DIMSUMAlgorithm(
+            DIMSUMAlgorithmParams(threshold=0.99)
+        ).train(ctx, pd)
+        assert (model.similarities[model.similarities > 0] >= 0.99).all()
+
+
+@pytest.fixture()
+def similarproduct_setup_data(mem_storage):
+    # same clustered fixture shape as test_templates.similarproduct_setup
+    import datetime as dt
+
+    from predictionio_tpu.data.event import DataMap, Event
+    from predictionio_tpu.data.storage.base import App
+
+    app_id = mem_storage.get_meta_data_apps().insert(App(id=0, name="spapp"))
+    mem_storage.get_l_events().init(app_id)
+    rng = np.random.default_rng(2)
+    for i in range(8):
+        mem_storage.get_l_events().insert(
+            Event(
+                event="$set", entity_type="item", entity_id=f"i{i}",
+                properties=DataMap({"categories": ["c"]}),
+            ),
+            app_id,
+        )
+    for uid in range(30):
+        mem_storage.get_l_events().insert(
+            Event(event="$set", entity_type="user", entity_id=f"u{uid}"),
+            app_id,
+        )
+        base = 0 if uid % 2 == 0 else 4
+        for _ in range(6):
+            item = base + int(rng.integers(0, 4))
+            mem_storage.get_l_events().insert(
+                Event(
+                    event="view", entity_type="user", entity_id=f"u{uid}",
+                    target_entity_type="item", target_entity_id=f"i{item}",
+                ),
+                app_id,
+            )
+    return mem_storage
+
+
+class TestRegressionEngine:
+    @pytest.fixture()
+    def data_file(self, tmp_path):
+        rng = np.random.default_rng(7)
+        w = np.array([2.0, -1.0, 0.5])
+        X = rng.standard_normal((100, 3))
+        y = X @ w + 0.01 * rng.standard_normal(100)
+        path = tmp_path / "reg.txt"
+        with open(path, "w") as f:
+            for xi, yi in zip(X, y):
+                f.write(f"{yi} {' '.join(str(v) for v in xi)}\n")
+        return str(path)
+
+    def test_ols_recovers_weights(self, data_file):
+        from predictionio_tpu.models.experimental.regression import (
+            DataSource,
+            DataSourceParams,
+            OLSAlgorithm,
+            Preparator,
+            Query,
+        )
+
+        ctx = WorkflowContext(mode="training")
+        td = DataSource(DataSourceParams(filepath=data_file)).read_training(ctx)
+        td = Preparator().prepare(ctx, td)
+        algo = OLSAlgorithm()
+        model = algo.train(ctx, td)
+        np.testing.assert_allclose(model, [2.0, -1.0, 0.5], atol=0.02)
+        pred = algo.predict(model, Query(features=(1.0, 1.0, 1.0)))
+        assert pred.prediction == pytest.approx(1.5, abs=0.05)
+
+    def test_preparator_holdout(self, data_file):
+        from predictionio_tpu.models.experimental.regression import (
+            DataSource,
+            DataSourceParams,
+            Preparator,
+            PreparatorParams,
+        )
+
+        ctx = WorkflowContext(mode="training")
+        td = DataSource(DataSourceParams(filepath=data_file)).read_training(ctx)
+        out = Preparator(PreparatorParams(n=4, k=0)).prepare(ctx, td)
+        assert len(out.y) == 75
+
+    def test_eval_with_mse(self, data_file, mem_storage):
+        from predictionio_tpu.controller.engine import EngineParams
+        from predictionio_tpu.controller.evaluation import Evaluation
+        from predictionio_tpu.models.experimental.regression import (
+            DataSourceParams,
+            MeanSquareError,
+            regression_engine,
+        )
+        from predictionio_tpu.workflow.core_workflow import CoreWorkflow
+
+        evaluation = Evaluation().set_engine_metric(
+            regression_engine(), MeanSquareError()
+        )
+        from predictionio_tpu.controller import EmptyParams
+
+        params = EngineParams(
+            data_source_params=(
+                "",
+                DataSourceParams(filepath=data_file, eval_k=3),
+            ),
+            algorithm_params_list=(("ols", EmptyParams()),),
+        )
+        ctx = WorkflowContext(mode="evaluation", storage=mem_storage)
+        result = CoreWorkflow.run_evaluation(evaluation, [params], ctx=ctx)
+        assert result.best_score.score < 0.01  # near-noiseless linear fit
