@@ -93,9 +93,17 @@ class CategoricalNaiveBayesModel:
         (reference logScore :96-115)."""
         if point.label not in self.label_index:
             return None
+        self._check_feature_count(point.features)
         return self._log_score_internal(
             point.label, point.features, default_likelihood
         )
+
+    def _check_feature_count(self, features: Sequence[str]) -> None:
+        if len(features) != self.feature_count:
+            raise ValueError(
+                f"query has {len(features)} feature(s); model was trained "
+                f"with {self.feature_count}"
+            )
 
     def _log_score_internal(
         self, label: str, features: Sequence[str], default_likelihood
@@ -121,6 +129,8 @@ class CategoricalNaiveBayesModel:
         """Vectorized prediction: one gather+sum device program for the
         whole batch (the TPU hot path; no reference analog)."""
         n, S = len(features_batch), self.feature_count
+        for features in features_batch:
+            self._check_feature_count(features)
         enc = np.zeros((n, S), np.int32)
         known = np.zeros((n, S), bool)
         for i, features in enumerate(features_batch):

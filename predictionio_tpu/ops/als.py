@@ -167,16 +167,21 @@ def _solve_bucket(
     Yg = Y[cols].astype(cdt)  # [N, L, k] gather from HBM
     n_obs = mask.sum(-1)  # [N]
     if implicit:
-        # A = G + Σ alpha·r·y yᵀ ; b = Σ (1 + alpha·r)·y  (preference 1)
-        w = (alpha * vals * mask).astype(cdt)
+        # MLlib trainImplicit semantics (Hu-Koren-Volinsky): confidence
+        # c = alpha·|r| (non-negative — keeps A positive-definite even for
+        # dislike ratings r<0, e.g. similarproduct LikeAlgorithm's -1);
+        # preference p = 1(r>0). A = G + Σ c·y yᵀ ; b = Σ p·(1+c)·y, so a
+        # dislike contributes confidence to A but nothing to b.
+        c = (alpha * jnp.abs(vals) * mask).astype(cdt)
         A = G + jnp.einsum(
-            "nlk,nl,nlj->nkj", Yg, w, Yg,
+            "nlk,nl,nlj->nkj", Yg, c, Yg,
             preferred_element_type=jnp.float32, precision=prec,
         )
+        pref = (vals > 0).astype(jnp.float32) * mask
         b = jnp.einsum(
             "nlk,nl->nk",
             Yg,
-            (mask + w.astype(jnp.float32)).astype(cdt),
+            (pref * (1.0 + alpha * jnp.abs(vals))).astype(cdt),
             preferred_element_type=jnp.float32, precision=prec,
         )
     else:
@@ -432,12 +437,16 @@ def train_als(
                 logger.debug(
                     "ALS iteration %d/%d done", it, config.iterations
                 )
+                # hand the (possibly mesh-sharded) factor arrays to orbax
+                # as-is: StandardSave handles sharded jax.Arrays natively,
+                # and np.asarray would both crash on non-fully-addressable
+                # multi-host arrays and force a device->host copy per chunk
                 ckpt.maybe_save(
                     it,
                     {
                         "iteration": it,
-                        "X": np.asarray(X),
-                        "Y": np.asarray(Y),
+                        "X": X,
+                        "Y": Y,
                         "fingerprint": fingerprint,
                     },
                     force=True,  # chunk boundaries ARE the cadence

@@ -1,0 +1,129 @@
+"""Numpy reference of MLlib 1.3 ALS semantics — the parity oracle.
+
+An independent, deliberately-slow implementation of the algorithm the
+reference's recommendation templates call
+(examples/scala-parallel-recommendation/custom-query/src/main/scala/
+ALSAlgorithm.scala:66-73 -> org.apache.spark.mllib.recommendation.ALS):
+
+* **Explicit** (``ALS.train``): alternating ridge solves where MLlib<=1.3
+  scales the regularizer by the per-row observation count (the ALS-WR
+  "weighted-lambda" scheme): ``A = Ys^T Ys + lambda * n_i * I``.
+* **Implicit** (``ALS.trainImplicit``): Hu-Koren-Volinsky — confidence
+  ``c = alpha * |r|`` (non-negative), preference ``p = 1(r > 0)``,
+  ``A = Y^T Y + Ys^T diag(c) Ys + lambda_row * I``,
+  ``b = Ys^T (p * (1 + c))``.
+* **Init / update order**: item factors drawn as |N(0,1)|/sqrt(k)
+  (MLlib's nonnegative-gaussian init), user phase solved first each
+  iteration — matching ops/als.py so factor-level comparison is possible
+  when both start from identical init.
+
+This module exists so tests/test_mllib_parity.py and bench.py can assert
+RMSE parity of the fused TPU kernel (ops/als.py) against the reference
+semantics without Spark. Pure numpy; no jax imports — an oracle must not
+share code with the thing it checks.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Tuple
+
+import numpy as np
+
+
+def init_item_factors(n_items: int, rank: int, seed: int) -> np.ndarray:
+    """MLlib-style nonnegative scaled-gaussian init (matches ops/als.py)."""
+    rng = np.random.default_rng(seed)
+    return (
+        np.abs(rng.standard_normal((n_items, rank))) / math.sqrt(rank)
+    ).astype(np.float64)
+
+
+def _solve_side(
+    rows: np.ndarray,
+    cols: np.ndarray,
+    vals: np.ndarray,
+    prev: np.ndarray,
+    Y: np.ndarray,
+    reg: float,
+    alpha: float,
+    implicit: bool,
+    weighted_reg: bool,
+) -> np.ndarray:
+    k = Y.shape[1]
+    # rows with no observations keep their previous value — matching both
+    # MLlib and the TPU kernel, which only scatter solved rows (an unrated
+    # item stays at its random init; zeroing it would also corrupt the
+    # shared Gramian in implicit mode)
+    X = np.array(prev, np.float64)
+    G = Y.T @ Y if implicit else None
+    order = np.argsort(rows, kind="stable")
+    rows_s, cols_s, vals_s = rows[order], cols[order], vals[order]
+    boundaries = np.flatnonzero(np.diff(rows_s)) + 1
+    for grp_cols, grp_vals, rid in zip(
+        np.split(cols_s, boundaries),
+        np.split(vals_s, boundaries),
+        rows_s[np.concatenate([[0], boundaries])] if len(rows_s) else [],
+    ):
+        Ys = Y[grp_cols]
+        n_obs = len(grp_vals)
+        lam = reg * n_obs if weighted_reg else reg
+        if implicit:
+            c = alpha * np.abs(grp_vals)
+            A = G + (Ys * c[:, None]).T @ Ys + lam * np.eye(k)
+            b = Ys.T @ ((grp_vals > 0) * (1.0 + c))
+        else:
+            A = Ys.T @ Ys + lam * np.eye(k)
+            b = Ys.T @ grp_vals
+        X[rid] = np.linalg.solve(A, b)
+    return X
+
+
+def train_als_reference(
+    user_idx: np.ndarray,
+    item_idx: np.ndarray,
+    ratings: np.ndarray,
+    n_users: int,
+    n_items: int,
+    rank: int = 10,
+    iterations: int = 10,
+    reg: float = 0.01,
+    alpha: float = 1.0,
+    implicit_prefs: bool = False,
+    reg_mode: str = "weighted",
+    seed: int = 0,
+    item_init: Optional[np.ndarray] = None,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Run the MLlib-semantics alternating solves; returns (X, Y) float64.
+
+    ``reg_mode="weighted"`` scales lambda by the per-row observation count
+    (MLlib<=1.3's ALS-WR scheme); ``"plain"`` uses unscaled lambda —
+    mirroring ALSConfig.reg_mode so the oracle and the TPU kernel can be
+    run under identical semantics.
+    """
+    u = np.asarray(user_idx, np.int64)
+    i = np.asarray(item_idx, np.int64)
+    r = np.asarray(ratings, np.float64)
+    Y = (
+        np.array(item_init, np.float64)
+        if item_init is not None
+        else init_item_factors(n_items, rank, seed)
+    )
+    X = np.zeros((n_users, rank), np.float64)
+    weighted = reg_mode == "weighted"
+    for _ in range(iterations):
+        X = _solve_side(
+            u, i, r, X, Y, reg, alpha, implicit_prefs, weighted
+        )
+        Y = _solve_side(
+            i, u, r, Y, X, reg, alpha, implicit_prefs, weighted
+        )
+    return X, Y
+
+
+def rmse_reference(
+    X: np.ndarray, Y: np.ndarray, u: np.ndarray, i: np.ndarray, r: np.ndarray
+) -> float:
+    pred = np.sum(X[np.asarray(u, np.int64)] * Y[np.asarray(i, np.int64)], -1)
+    err = pred - np.asarray(r, np.float64)
+    return float(np.sqrt(np.mean(err * err)))

@@ -167,10 +167,42 @@ class TestImplicitALS:
             if not sel.any():
                 continue
             Xs = X[u[sel]]
-            w = 1.5 * r[sel]
-            A = G + (Xs * w[:, None]).T @ Xs + 0.1 * np.eye(k)
-            b = (Xs * (1 + w)[:, None]).sum(0)
+            c = 1.5 * np.abs(r[sel])
+            A = G + (Xs * c[:, None]).T @ Xs + 0.1 * np.eye(k)
+            b = (Xs * ((r[sel] > 0) * (1 + c))[:, None]).sum(0)
             np.testing.assert_allclose(Y[it], np.linalg.solve(A, b), rtol=2e-3, atol=2e-4)
+
+    def test_implicit_dislikes_hukoren_semantics(self):
+        """Dislike ratings (r<0, the similarproduct LikeAlgorithm encoding)
+        must contribute confidence alpha*|r| to A (PSD-safe) and nothing to
+        b — MLlib trainImplicit semantics. With the pre-fix signed-weight
+        math, alpha=3 here drives A indefinite and the solve to NaN."""
+        rng = np.random.default_rng(7)
+        n_users, n_items, k = 30, 20, 4
+        u = np.repeat(np.arange(n_users, dtype=np.int32), 6)
+        i = rng.integers(0, n_items, len(u)).astype(np.int32)
+        r = rng.choice([-1.0, 1.0], size=len(u), p=[0.4, 0.6]).astype(np.float32)
+        cfg = ALSConfig(
+            rank=k, iterations=3, reg=0.1, alpha=3.0, implicit_prefs=True,
+            reg_mode="plain",
+        )
+        model = train_als(u, i, r, n_users, n_items, cfg)
+        X, Y = model.user_factors, model.item_factors
+        assert np.isfinite(X).all() and np.isfinite(Y).all()
+        # the item phase runs last, so final Y must satisfy the Hu-Koren
+        # normal equations against final X
+        G = X.T @ X
+        for it in range(n_items):
+            sel = i == it
+            if not sel.any():
+                continue
+            Xs = X[u[sel]]
+            c = 3.0 * np.abs(r[sel])
+            A = G + (Xs * c[:, None]).T @ Xs + 0.1 * np.eye(k)
+            b = (Xs * ((r[sel] > 0) * (1 + c))[:, None]).sum(0)
+            np.testing.assert_allclose(
+                Y[it], np.linalg.solve(A, b), rtol=2e-3, atol=2e-4
+            )
 
 
 class TestMeshALS:
