@@ -109,6 +109,10 @@ class DeployedEngine:
             raise ValueError(
                 f"{len(self.models)} models for {len(self.algorithms)} algorithms"
             )
+        # compile serving executables before taking traffic (cold compiles
+        # cost seconds and would land on the first unlucky requests)
+        for algo, model in zip(self.algorithms, self.models):
+            algo.warm(model)
 
     @classmethod
     def from_storage(
@@ -239,23 +243,27 @@ class _BatchingExecutor:
             for item in batch:
                 groups.setdefault(id(item[0]), []).append(item)
             for items in groups.values():
-                dep = items[0][0]
-                try:
-                    results = dep.serve_batch([q for _, q, _ in items])
-                    for (_, _, s), r in zip(items, results):
-                        s["result"] = r
-                        s["done"].set()
-                except Exception:
-                    # isolate the failure: retry each query alone so one
-                    # bad query can't 500 its batchmates (the reference
-                    # serves per-request and has this isolation for free)
-                    for _, q, s in items:
-                        try:
-                            [r] = dep.serve_batch([q])
-                            s["result"] = r
-                        except Exception as e:
-                            s["error"] = e
-                        s["done"].set()
+                self._serve_isolating(items[0][0], items)
+
+    def _serve_isolating(self, dep: DeployedEngine, items) -> None:
+        """Serve a batch; on failure bisect it so the poison query is
+        located in O(log n) batched calls and its batchmates still get
+        batched service (a serial per-query retry would multiply every
+        innocent's latency by the batch size)."""
+        try:
+            results = dep.serve_batch([q for _, q, _ in items])
+            for (_, _, s), r in zip(items, results):
+                s["result"] = r
+                s["done"].set()
+        except Exception as e:
+            if len(items) == 1:
+                _, _, s = items[0]
+                s["error"] = e
+                s["done"].set()
+                return
+            mid = len(items) // 2
+            self._serve_isolating(dep, items[:mid])
+            self._serve_isolating(dep, items[mid:])
 
 
 class QueryAPI:

@@ -25,16 +25,38 @@ logger = logging.getLogger(__name__)
 HandleFn = Callable[..., Tuple]
 
 
+class _Server(ThreadingHTTPServer):
+    # the stdlib default backlog (5) drops connections under concurrent
+    # load — a burst of clients gets RSTs before threads even spawn
+    request_queue_size = 128
+
+
 class _Handler(BaseHTTPRequestHandler):
     handle_fn: HandleFn  # bound by JsonHTTPServer
+
+    # HTTP/1.1 keep-alive: every response carries Content-Length, so
+    # persistent connections are safe and spare concurrent clients a
+    # TCP handshake per request
+    protocol_version = "HTTP/1.1"
+    # small request/response pairs on persistent connections stall for
+    # tens of ms under Nagle + delayed ACK; serving latency is the product
+    disable_nagle_algorithm = True
 
     def _dispatch(self, method: str) -> None:
         parsed = urllib.parse.urlsplit(self.path)
         query = dict(urllib.parse.parse_qsl(parsed.query))
+        # under keep-alive, any request body we fail to consume would be
+        # parsed as the NEXT request on the connection — refuse framings
+        # we can't read and drop the connection when length is unknowable
+        if "chunked" in (self.headers.get("Transfer-Encoding") or "").lower():
+            self.close_connection = True
+            self.send_error(501, "chunked transfer encoding not supported")
+            return
         try:
             length = int(self.headers.get("Content-Length") or 0)
         except ValueError:
             length = 0
+            self.close_connection = True
         body = self.rfile.read(length) if length > 0 else b""
         # form-encoded bodies are parsed as a convenience, but the raw body
         # is kept too: clients (curl -d) often post JSON without setting
@@ -91,7 +113,7 @@ class JsonHTTPServer:
         last_error: Optional[OSError] = None
         for attempt in range(self.BIND_RETRIES):
             try:
-                self.httpd = ThreadingHTTPServer((ip, port), handler)
+                self.httpd = _Server((ip, port), handler)
                 break
             except OSError as e:
                 last_error = e

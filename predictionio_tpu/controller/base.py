@@ -139,6 +139,13 @@ class BaseAlgorithm(Controller, Generic[PD, M, Q, P]):
         with a vectorized device predict for the TPU fast path."""
         return [(i, self.predict(model, q)) for i, q in queries]
 
+    def warm(self, model: M) -> None:
+        """Deploy-time warm-up hook (no reference analog — JIT frameworks
+        need it): compile the serving executables NOW so the first real
+        queries don't pay multi-second cold-compile tail latency. Called
+        once per algorithm when a DeployedEngine is constructed. Default:
+        nothing."""
+
     # --- query class resolution (reference queryClass via TypeResolver) ---
 
     def query_from_json(self, json_obj: Any) -> Q:

@@ -213,6 +213,12 @@ class ALSAlgorithmParams(Params):
     # mid-training checkpoint/resume (absent in the reference, SURVEY §5)
     checkpoint_dir: Optional[str] = None
     checkpoint_every: int = 5
+    # deploy-time warm-up coverage: the largest query `num` and serving
+    # batch size to pre-compile for (queries beyond these still work but
+    # pay a one-time cold compile on live traffic; match warm_max_batch
+    # to ServerConfig.max_batch if you raise that)
+    warm_num: int = 16
+    warm_max_batch: int = 128
 
 
 @dataclasses.dataclass
@@ -265,7 +271,11 @@ class ALSModel:
         if not known:
             return unknown
         max_num = max(n for _, _, n in known)
-        max_num = min(max_num, len(self.item_index))
+        # pad the top-k width to a power of two (min 16) so varying query
+        # `num`s share O(log) compiled executables instead of one each
+        max_num = min(
+            max(16, 1 << (max_num - 1).bit_length()), len(self.item_index)
+        )
         scores, idx = self.serving.topn_by_user(
             [u for _, u, _ in known], max_num
         )
@@ -322,6 +332,19 @@ class ALSAlgorithm(BaseAlgorithm):
 
     def batch_predict(self, model: ALSModel, queries) -> List[Tuple[int, PredictedResult]]:
         return model.recommend_many(queries)
+
+    def warm(self, model: ALSModel) -> None:
+        """Compile the padded serving executables at deploy (tail-latency
+        control; no reference analog — Spark has no JIT cold start).
+        Covers every top-k tier up to warm_num and every padded batch
+        size up to warm_max_batch."""
+        p: ALSAlgorithmParams = self.params
+        n = 16
+        while True:
+            model.serving.warm(n=n, max_batch=p.warm_max_batch)
+            if n >= min(p.warm_num, len(model.item_index)):
+                break
+            n *= 2
 
     def result_to_json(self, result: PredictedResult):
         # reference wire format (Engine.scala PredictedResult(itemScores))

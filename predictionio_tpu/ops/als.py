@@ -487,8 +487,7 @@ def rmse(model: ALSModelArrays, user_idx, item_idx, ratings) -> float:
     return float(np.sqrt(np.mean(err * err)))
 
 
-@functools.partial(jax.jit, static_argnames=("n",))
-def _topn_packed(factors_q, Y, n):
+def _topn_packed_impl(factors_q, Y, n):
     scores = jnp.dot(factors_q, Y.T, preferred_element_type=jnp.float32)
     s, i = jax.lax.top_k(scores, n)  # [B, n] each — one MXU matmul + top_k
     # pack scores+indices into ONE buffer: device->host fetches cost a
@@ -497,6 +496,25 @@ def _topn_packed(factors_q, Y, n):
     # corrupt ids >= 2^24 (float32 mantissa) on large catalogs.
     i_bits = jax.lax.bitcast_convert_type(i, jnp.float32)
     return jnp.concatenate([s, i_bits], axis=1)
+
+
+_topn_packed = jax.jit(_topn_packed_impl, static_argnames=("n",))
+
+
+@functools.partial(jax.jit, static_argnames=("n",))
+def _topn_packed_chain(factors_q, Y, n, n_iters):
+    """n_iters chained top-N passes in ONE dispatch — a measurement tool:
+    per-pass device time = (t(K) - t(1)) / (K - 1) cancels the host<->device
+    round trip (which on relayed rigs costs ~100 ms and would otherwise
+    swamp the ~0.1 ms compute). The query is perturbed per iteration so
+    XLA cannot hoist the matmul out of the loop."""
+    init = jnp.zeros((factors_q.shape[0], 2 * n), jnp.float32)
+
+    def body(i, _):
+        qq = factors_q + i.astype(jnp.float32) * 1e-7
+        return _topn_packed_impl(qq, Y, n)
+
+    return jax.lax.fori_loop(0, n_iters, body, init)
 
 
 class ServingFactors:
@@ -514,9 +532,70 @@ class ServingFactors:
 
     def topn_by_rows(self, user_rows: np.ndarray, n: int):
         """Top-N for explicit query factor rows [B, k]."""
-        q = jax.device_put(np.asarray(user_rows, np.float32))
-        packed = np.asarray(_topn_packed(q, self._if_dev, n))
+        b = len(user_rows)
+        packed = np.asarray(self.topn_packed_device(user_rows, n))[:b]
         return packed[:, :n], _unpack_indices(packed, n)
+
+    def topn_packed_device(self, user_rows: np.ndarray, n: int) -> jax.Array:
+        """Device-resident top-N: upload query rows, run the matmul+top_k,
+        return the packed result buffer WITHOUT fetching it to host. Lets
+        latency instrumentation separate compute from the device->host hop
+        (which costs a full relay round trip on tunneled rigs).
+
+        The row dimension is padded to the next power of two (min 8) so a
+        serving workload with varying batch sizes compiles O(log max_batch)
+        executables instead of one per distinct size — a cold compile costs
+        seconds, which under concurrent load turns the micro-batching
+        executor into a compile queue. Callers slice the padding off.
+        """
+        rows = np.asarray(user_rows, np.float32)
+        b = rows.shape[0]
+        b_pad = max(8, 1 << (b - 1).bit_length())
+        if b_pad != b:
+            rows = np.concatenate(
+                [rows, np.zeros((b_pad - b, rows.shape[1]), np.float32)]
+            )
+        q = jax.device_put(rows)
+        return _topn_packed(q, self._if_dev, n)
+
+    def warm(self, n: int = 16, max_batch: int = 128) -> None:
+        """Compile every padded-batch-size executable the serving path can
+        hit (deploy-time warm-up; see BaseAlgorithm.warm). With row
+        padding to powers of two this is O(log max_batch) compiles."""
+        k = self._uf_dev.shape[1]
+        n = min(n, self.n_items)
+        b = 8
+        while True:
+            self.topn_by_rows(np.zeros((b, k), np.float32), n)
+            if b >= max_batch:
+                break
+            b *= 2
+
+    def measure_compute_ms(
+        self, user_rows: np.ndarray, n: int, iters: int = 256, reps: int = 5
+    ) -> float:
+        """Amortized per-call device compute time of the top-N op: a
+        chained on-device loop of `iters` passes in one dispatch, so the
+        host/relay round trip contributes once and cancels in
+        (t(iters) - t(1)) / (iters - 1)."""
+        import time as _time
+
+        q = jax.device_put(np.asarray(user_rows, np.float32))
+
+        def chain(k):
+            return _topn_packed_chain(q, self._if_dev, n, jnp.int32(k))
+
+        chain(1).block_until_ready()  # compile (trip count is dynamic)
+        samples = []
+        for _ in range(reps):
+            t0 = _time.perf_counter()
+            chain(1).block_until_ready()
+            t1 = _time.perf_counter() - t0
+            t0 = _time.perf_counter()
+            chain(iters).block_until_ready()
+            tk = _time.perf_counter() - t0
+            samples.append((tk - t1) / (iters - 1) * 1000.0)
+        return float(np.median(samples))
 
     def topn_by_user(self, user_ids: Sequence[int], n: int):
         """Top-N for known user indices (gathers rows host-side; the row
