@@ -1,0 +1,177 @@
+"""Storage gateway — the server half of the client-server storage backend.
+
+The reference's production storage is client-server: HBase regionservers
+for events (hbase/StorageClient.scala:40), PostgreSQL/MySQL over JDBC
+(jdbc/StorageClient.scala), Elasticsearch over its transport protocol
+(elasticsearch/StorageClient.scala:31-45). This gateway plays that role
+for the TPU framework: one process owns the physical store (any embedded
+backend — sqlite for durability, memory for tests) and exposes every DAO
+trait over HTTP, so event servers, trainers, engine servers, and CLIs on
+other hosts share a single storage service through the ``http`` client
+backend (data/storage/http.py).
+
+Protocol: POST /rpc with ``{"dao": <repo>, "method": <name>,
+"args": {...}}`` -> ``{"result": ...}`` or ``{"error", "type"}``.
+DAO methods, argument names, and record layouts mirror
+data/storage/base.py one-to-one (the wire format lives in
+data/storage/wire.py). An optional shared secret
+(``--secret`` / PIO_STORAGE_SOURCES_<NAME>_SECRET on clients) gates every
+request, playing the access-key role the event server has
+(EventServer.scala:81-107).
+
+Run via ``pio storagegateway [--port 7077]`` or programmatically with
+``StorageGatewayServer(storage).start()``.
+"""
+
+from __future__ import annotations
+
+import hmac
+import logging
+from typing import Any, Dict, Optional
+
+from predictionio_tpu.api.http import JsonHTTPServer
+from predictionio_tpu.data.storage import Storage, get_storage
+from predictionio_tpu.data.storage.base import StorageError
+from predictionio_tpu.data.storage import wire
+
+logger = logging.getLogger(__name__)
+
+DEFAULT_PORT = 7077  # beside the reference's 7070/7071 tools ports
+
+# dao name on the wire -> (Storage accessor, record kind for rows)
+_DAOS = {
+    "levents": ("get_l_events", None),
+    "apps": ("get_meta_data_apps", "app"),
+    "access_keys": ("get_meta_data_access_keys", "access_key"),
+    "channels": ("get_meta_data_channels", "channel"),
+    "engine_manifests": ("get_meta_data_engine_manifests", "engine_manifest"),
+    "engine_instances": ("get_meta_data_engine_instances", "engine_instance"),
+    "evaluation_instances": (
+        "get_meta_data_evaluation_instances",
+        "evaluation_instance",
+    ),
+    "models": ("get_model_data_models", "model"),
+}
+
+class StorageGatewayCore:
+    """Transport-independent RPC core (same pattern as QueryAPI)."""
+
+    def __init__(self, storage: Optional[Storage] = None, secret: str = ""):
+        self.storage = storage or get_storage()
+        self.secret = secret
+
+    # --- request entry ---
+
+    def handle(self, method, path, query, body, form):
+        import json
+
+        if path == "/status" and method == "GET":
+            return 200, {"status": "alive", "daos": sorted(_DAOS)}
+        if path != "/rpc" or method != "POST":
+            return 404, {"error": f"unknown route {method} {path}"}
+        try:
+            payload = json.loads(body.decode("utf-8"))
+        except (ValueError, UnicodeDecodeError) as e:
+            return 400, {"error": f"invalid JSON body: {e}"}
+        if not isinstance(payload, dict):
+            return 400, {"error": "body must be a JSON object"}
+        if self.secret:
+            # in-body secret (request lines get logged; bodies don't),
+            # constant-time comparison
+            given = payload.get("secret") or ""
+            if not hmac.compare_digest(str(given), self.secret):
+                return 401, {"error": "invalid or missing secret"}
+        try:
+            result = self.call(
+                payload.get("dao", ""),
+                payload.get("method", ""),
+                payload.get("args") or {},
+            )
+            return 200, {"result": result}
+        except StorageError as e:
+            return 400, {"error": str(e), "type": "StorageError"}
+        except (KeyError, TypeError, ValueError) as e:
+            return 400, {"error": str(e), "type": type(e).__name__}
+        except Exception as e:  # backend bug — surface, don't hide
+            logger.exception("gateway RPC failed")
+            return 500, {"error": str(e), "type": type(e).__name__}
+
+    # --- dispatch ---
+
+    def call(self, dao: str, method: str, args: Dict[str, Any]) -> Any:
+        if dao not in _DAOS:
+            raise KeyError(f"unknown dao {dao!r}")
+        accessor, kind = _DAOS[dao]
+        target = getattr(self.storage, accessor)()
+        if dao == "levents":
+            return self._call_levents(target, method, args)
+        return self._call_metadata(target, kind, method, args)
+
+    def _call_levents(self, le, method: str, args: Dict[str, Any]) -> Any:
+        a = dict(args)
+        if method in ("init", "remove"):
+            return getattr(le, method)(a["app_id"], a.get("channel_id"))
+        if method == "insert":
+            ev = wire.event_from_wire(a["event"])
+            return le.insert(ev, a["app_id"], a.get("channel_id"))
+        if method == "write":
+            evs = [wire.event_from_wire(e) for e in a["events"]]
+            return le.write(evs, a["app_id"], a.get("channel_id"))
+        if method == "get":
+            ev = le.get(a["event_id"], a["app_id"], a.get("channel_id"))
+            return None if ev is None else wire.event_to_wire(ev)
+        if method == "delete":
+            return le.delete(a["event_id"], a["app_id"], a.get("channel_id"))
+        if method == "find":
+            from predictionio_tpu.data.storage.base import UNSET
+
+            kwargs: Dict[str, Any] = {
+                "app_id": a["app_id"],
+                "channel_id": a.get("channel_id"),
+                "start_time": wire.opt_dt_from_wire(a.get("start_time")),
+                "until_time": wire.opt_dt_from_wire(a.get("until_time")),
+                "entity_type": a.get("entity_type"),
+                "entity_id": a.get("entity_id"),
+                "event_names": a.get("event_names"),
+                "limit": a.get("limit"),
+                "reversed": a.get("reversed", False),
+            }
+            for f in ("target_entity_type", "target_entity_id"):
+                v = a.get(f, wire.UNSET_WIRE)
+                kwargs[f] = UNSET if v == wire.UNSET_WIRE else v
+            return [wire.event_to_wire(e) for e in le.find(**kwargs)]
+        raise KeyError(f"unknown levents method {method!r}")
+
+    def _call_metadata(self, dao, kind: str, method: str, args: Dict[str, Any]) -> Any:
+        a = dict(args)
+        if "record" in a:
+            a["record"] = wire.record_from_wire(kind, a["record"])
+        record = a.pop("record", None)
+        fn = getattr(dao, method, None)
+        if fn is None or method.startswith("_"):
+            raise KeyError(f"unknown {kind} method {method!r}")
+        out = fn(record, **a) if record is not None else fn(**a)
+        # serialize records/record lists; scalars pass through
+        if isinstance(out, list):
+            return [
+                wire.record_to_wire(x) if _is_record(x) else x for x in out
+            ]
+        return wire.record_to_wire(out) if _is_record(out) else out
+
+
+def _is_record(x: Any) -> bool:
+    import dataclasses
+
+    return dataclasses.is_dataclass(x) and not isinstance(x, type)
+
+
+class StorageGatewayServer(JsonHTTPServer):
+    def __init__(
+        self,
+        storage: Optional[Storage] = None,
+        ip: str = "0.0.0.0",
+        port: int = DEFAULT_PORT,
+        secret: str = "",
+    ):
+        self.core = StorageGatewayCore(storage, secret=secret)
+        super().__init__(self.core.handle, ip, port, "StorageGateway")

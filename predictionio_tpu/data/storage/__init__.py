@@ -49,6 +49,9 @@ BUILTIN_BACKENDS: Dict[str, tuple] = {
     "memory": ("predictionio_tpu.data.storage.memory", "Mem"),
     "sqlite": ("predictionio_tpu.data.storage.sqlite", "SQLite"),
     "localfs": ("predictionio_tpu.data.storage.localfs", "LocalFS"),
+    # client-server backend: DAOs proxied to a storage gateway service
+    # (api/storage_gateway.py) — the HBase/JDBC/Elasticsearch role
+    "http": ("predictionio_tpu.data.storage.http", "HTTP"),
 }
 
 REPOSITORIES = ("METADATA", "EVENTDATA", "MODELDATA")

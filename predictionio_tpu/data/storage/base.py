@@ -21,7 +21,28 @@ import dataclasses
 import datetime as _dt
 import re
 import secrets
+import threading
 from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Union
+
+
+class DAOCacheMixin:
+    """Per-(DAO class, namespace) instance cache for backend StorageClients
+    (the reference caches clients per source, Storage.scala:202-208). Call
+    ``_init_dao_cache`` in __init__; pass a lock to share one with other
+    client state (e.g. sqlite's connection lock)."""
+
+    def _init_dao_cache(self, lock: Optional[threading.Lock] = None) -> None:
+        self._daos: Dict[str, object] = {}
+        self._dao_lock = lock if lock is not None else threading.Lock()
+
+    def dao(self, cls, namespace: str):
+        key = f"{cls.__name__}:{namespace}"
+        with self._dao_lock:
+            if key not in self._daos:
+                self._daos[key] = cls(
+                    client=self, config=self.config, namespace=namespace
+                )
+            return self._daos[key]
 
 
 class _Unset:

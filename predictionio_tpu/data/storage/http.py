@@ -1,0 +1,437 @@
+"""``http`` storage backend — client half of the client-server storage.
+
+Implements every DAO trait in base.py against a storage gateway service
+(api/storage_gateway.py) over HTTP, the role the reference's HBase/JDBC/
+Elasticsearch clients play (Storage.getDataObject resolves
+``io.prediction.data.storage.<type>.<prefix><Trait>`` exactly as the env
+registry resolves ``HTTP<Trait>`` here, Storage.scala:263-312).
+
+Configuration (env registry, data/storage/__init__.py):
+
+    PIO_STORAGE_SOURCES_GATEWAY_TYPE=http
+    PIO_STORAGE_SOURCES_GATEWAY_URL=http://storage-host:7077
+    PIO_STORAGE_SOURCES_GATEWAY_SECRET=...            # optional
+    PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE=GATEWAY  # etc.
+
+Connections are pooled per thread (HTTP/1.1 keep-alive); operations
+retry once on a dropped connection (gateway restart) before failing with
+StorageError, mirroring the reference clients' single-reconnect behavior.
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import http.client
+import json
+import threading
+import urllib.parse
+from typing import Any, Dict, Iterator, List, Optional, Sequence
+
+from predictionio_tpu.data.event import Event
+from predictionio_tpu.data.storage import base, wire
+from predictionio_tpu.data.storage.base import (
+    UNSET,
+    AccessKey,
+    App,
+    Channel,
+    EngineInstance,
+    EngineManifest,
+    EvaluationInstance,
+    Model,
+    OptFilter,
+    StorageError,
+)
+
+PREFIX = "HTTP"
+
+# reads may retry on any transport failure; everything else only when the
+# request provably never reached the gateway (see StorageClient.call)
+_IDEMPOTENT_METHODS = frozenset(
+    {
+        "get",
+        "get_all",
+        "get_by_name",
+        "get_by_app_id",
+        "get_latest_completed",
+        "get_completed",
+        "find",
+    }
+)
+
+
+class StorageClient(base.DAOCacheMixin):
+    """Connection pool + RPC transport for one gateway URL."""
+
+    def __init__(self, config=None):
+        self.config = config
+        props = getattr(config, "properties", None) or {}
+        url = props.get("URL") or props.get("HOSTS") or "http://localhost:7077"
+        if "://" not in url:
+            url = f"http://{url}"
+        parsed = urllib.parse.urlsplit(url)
+        self.host = parsed.hostname or "localhost"
+        self.port = parsed.port or 7077
+        self.secret = props.get("SECRET", "")
+        timeout = float(props.get("TIMEOUT_S", "60"))  # LEvents.scala:39
+        self._timeout = timeout
+        self._local = threading.local()
+        self._init_dao_cache()
+
+    # --- transport ---
+
+    def _conn(self) -> "tuple[http.client.HTTPConnection, bool]":
+        """Returns (connection, is_reused_keepalive)."""
+        conn = getattr(self._local, "conn", None)
+        if conn is None:
+            conn = http.client.HTTPConnection(
+                self.host, self.port, timeout=self._timeout
+            )
+            self._local.conn = conn
+            return conn, False
+        return conn, True
+
+    def _drop_conn(self) -> None:
+        conn = getattr(self._local, "conn", None)
+        if conn is not None:
+            try:
+                conn.close()
+            finally:
+                self._local.conn = None
+
+    def call(self, dao: str, method: str, args: Dict[str, Any]) -> Any:
+        # the secret travels in the body, not the URL — request lines land
+        # in access logs and proxies, bodies don't
+        payload: Dict[str, Any] = {"dao": dao, "method": method, "args": args}
+        if self.secret:
+            payload["secret"] = self.secret
+        body = json.dumps(payload)
+        idempotent = method in _IDEMPOTENT_METHODS
+        last: Optional[Exception] = None
+        for attempt in (0, 1):  # at most one reconnect
+            conn, reused = self._conn()
+            sent = False
+            try:
+                conn.request(
+                    "POST", "/rpc", body, {"Content-Type": "application/json"}
+                )
+                sent = True
+                resp = conn.getresponse()
+                data = resp.read()
+            except (OSError, http.client.HTTPException) as e:
+                self._drop_conn()
+                last = e
+                # Retry rules: a send failure on a reused keep-alive means
+                # the gateway closed the idle connection and never saw the
+                # request — always safe. A failure after the request went
+                # out may have committed server-side, so only idempotent
+                # reads retry (re-sending an insert could duplicate it).
+                if attempt == 0 and ((not sent and reused) or idempotent):
+                    continue
+                break
+            try:
+                out = json.loads(data.decode("utf-8"))
+            except ValueError as e:
+                raise StorageError(
+                    f"gateway returned non-JSON ({resp.status}): {data[:200]!r}"
+                ) from e
+            if resp.status == 200:
+                return out.get("result")
+            raise StorageError(
+                f"gateway {dao}.{method} failed ({resp.status}): "
+                f"{out.get('error')}"
+            )
+        raise StorageError(
+            f"storage gateway at {self.host}:{self.port} unreachable: {last}"
+        ) from last
+
+    def close(self) -> None:
+        self._drop_conn()
+
+
+class _RemoteDAO:
+    DAO = ""
+
+    def __init__(self, client: StorageClient, config=None, namespace: str = ""):
+        self._client = client
+        self.namespace = namespace
+
+    def _call(self, method: str, **args) -> Any:
+        return self._client.call(self.DAO, method, args)
+
+
+class HTTPLEvents(_RemoteDAO, base.LEvents):
+    DAO = "levents"
+
+    def init(self, app_id: int, channel_id: Optional[int] = None) -> bool:
+        return self._call("init", app_id=app_id, channel_id=channel_id)
+
+    def remove(self, app_id: int, channel_id: Optional[int] = None) -> bool:
+        return self._call("remove", app_id=app_id, channel_id=channel_id)
+
+    def close(self) -> None:
+        self._client.close()
+
+    def insert(self, event: Event, app_id: int, channel_id: Optional[int] = None) -> str:
+        return self._call(
+            "insert",
+            event=wire.event_to_wire(event),
+            app_id=app_id,
+            channel_id=channel_id,
+        )
+
+    def write(self, events, app_id: int, channel_id: Optional[int] = None) -> List[str]:
+        # one round trip for the whole batch (import path), not one per event
+        return self._call(
+            "write",
+            events=[wire.event_to_wire(e) for e in events],
+            app_id=app_id,
+            channel_id=channel_id,
+        )
+
+    def get(
+        self, event_id: str, app_id: int, channel_id: Optional[int] = None
+    ) -> Optional[Event]:
+        out = self._call(
+            "get", event_id=event_id, app_id=app_id, channel_id=channel_id
+        )
+        return None if out is None else wire.event_from_wire(out)
+
+    def delete(
+        self, event_id: str, app_id: int, channel_id: Optional[int] = None
+    ) -> bool:
+        return self._call(
+            "delete", event_id=event_id, app_id=app_id, channel_id=channel_id
+        )
+
+    def find(
+        self,
+        app_id: int,
+        channel_id: Optional[int] = None,
+        start_time: Optional[_dt.datetime] = None,
+        until_time: Optional[_dt.datetime] = None,
+        entity_type: Optional[str] = None,
+        entity_id: Optional[str] = None,
+        event_names: Optional[Sequence[str]] = None,
+        target_entity_type: OptFilter = UNSET,
+        target_entity_id: OptFilter = UNSET,
+        limit: Optional[int] = None,
+        reversed: bool = False,
+    ) -> Iterator[Event]:
+        # all 9 filter dimensions are pushed down to the gateway, which
+        # runs them inside the owning backend (the reference pushes scan
+        # filters into HBase the same way, HBEventsUtil.createScan)
+        out = self._call(
+            "find",
+            app_id=app_id,
+            channel_id=channel_id,
+            start_time=wire.opt_dt_to_wire(start_time),
+            until_time=wire.opt_dt_to_wire(until_time),
+            entity_type=entity_type,
+            entity_id=entity_id,
+            event_names=list(event_names) if event_names is not None else None,
+            target_entity_type=(
+                wire.UNSET_WIRE if target_entity_type is UNSET else target_entity_type
+            ),
+            target_entity_id=(
+                wire.UNSET_WIRE if target_entity_id is UNSET else target_entity_id
+            ),
+            limit=limit,
+            reversed=reversed,
+        )
+        return iter([wire.event_from_wire(e) for e in out])
+
+
+class HTTPApps(_RemoteDAO, base.Apps):
+    DAO = "apps"
+
+    def insert(self, app: App) -> Optional[int]:
+        return self._call("insert", record=wire.record_to_wire(app))
+
+    def get(self, app_id: int) -> Optional[App]:
+        return wire.record_from_wire("app", self._call("get", app_id=app_id))
+
+    def get_by_name(self, name: str) -> Optional[App]:
+        return wire.record_from_wire(
+            "app", self._call("get_by_name", name=name)
+        )
+
+    def get_all(self) -> List[App]:
+        return [
+            wire.record_from_wire("app", x) for x in self._call("get_all")
+        ]
+
+    def update(self, app: App) -> bool:
+        return self._call("update", record=wire.record_to_wire(app))
+
+    def delete(self, app_id: int) -> bool:
+        return self._call("delete", app_id=app_id)
+
+
+class HTTPAccessKeys(_RemoteDAO, base.AccessKeys):
+    DAO = "access_keys"
+
+    def insert(self, access_key: AccessKey) -> Optional[str]:
+        return self._call("insert", record=wire.record_to_wire(access_key))
+
+    def get(self, key: str) -> Optional[AccessKey]:
+        return wire.record_from_wire(
+            "access_key", self._call("get", key=key)
+        )
+
+    def get_all(self) -> List[AccessKey]:
+        return [
+            wire.record_from_wire("access_key", x)
+            for x in self._call("get_all")
+        ]
+
+    def get_by_app_id(self, app_id: int) -> List[AccessKey]:
+        return [
+            wire.record_from_wire("access_key", x)
+            for x in self._call("get_by_app_id", app_id=app_id)
+        ]
+
+    def update(self, access_key: AccessKey) -> bool:
+        return self._call("update", record=wire.record_to_wire(access_key))
+
+    def delete(self, key: str) -> bool:
+        return self._call("delete", key=key)
+
+
+class HTTPChannels(_RemoteDAO, base.Channels):
+    DAO = "channels"
+
+    def insert(self, channel: Channel) -> Optional[int]:
+        return self._call("insert", record=wire.record_to_wire(channel))
+
+    def get(self, channel_id: int) -> Optional[Channel]:
+        return wire.record_from_wire(
+            "channel", self._call("get", channel_id=channel_id)
+        )
+
+    def get_by_app_id(self, app_id: int) -> List[Channel]:
+        return [
+            wire.record_from_wire("channel", x)
+            for x in self._call("get_by_app_id", app_id=app_id)
+        ]
+
+    def delete(self, channel_id: int) -> bool:
+        return self._call("delete", channel_id=channel_id)
+
+
+class HTTPEngineManifests(_RemoteDAO, base.EngineManifests):
+    DAO = "engine_manifests"
+
+    def insert(self, manifest: EngineManifest) -> None:
+        return self._call("insert", record=wire.record_to_wire(manifest))
+
+    def get(self, id: str, version: str) -> Optional[EngineManifest]:
+        return wire.record_from_wire(
+            "engine_manifest", self._call("get", id=id, version=version)
+        )
+
+    def get_all(self) -> List[EngineManifest]:
+        return [
+            wire.record_from_wire("engine_manifest", x)
+            for x in self._call("get_all")
+        ]
+
+    def update(self, manifest: EngineManifest, upsert: bool = False) -> None:
+        return self._call(
+            "update", record=wire.record_to_wire(manifest), upsert=upsert
+        )
+
+    def delete(self, id: str, version: str) -> None:
+        return self._call("delete", id=id, version=version)
+
+
+class HTTPEngineInstances(_RemoteDAO, base.EngineInstances):
+    DAO = "engine_instances"
+
+    def insert(self, instance: EngineInstance) -> str:
+        return self._call("insert", record=wire.record_to_wire(instance))
+
+    def get(self, id: str) -> Optional[EngineInstance]:
+        return wire.record_from_wire(
+            "engine_instance", self._call("get", id=id)
+        )
+
+    def get_all(self) -> List[EngineInstance]:
+        return [
+            wire.record_from_wire("engine_instance", x)
+            for x in self._call("get_all")
+        ]
+
+    def get_latest_completed(
+        self, engine_id: str, engine_version: str, engine_variant: str
+    ) -> Optional[EngineInstance]:
+        return wire.record_from_wire(
+            "engine_instance",
+            self._call(
+                "get_latest_completed",
+                engine_id=engine_id,
+                engine_version=engine_version,
+                engine_variant=engine_variant,
+            ),
+        )
+
+    def get_completed(
+        self, engine_id: str, engine_version: str, engine_variant: str
+    ) -> List[EngineInstance]:
+        return [
+            wire.record_from_wire("engine_instance", x)
+            for x in self._call(
+                "get_completed",
+                engine_id=engine_id,
+                engine_version=engine_version,
+                engine_variant=engine_variant,
+            )
+        ]
+
+    def update(self, instance: EngineInstance) -> None:
+        return self._call("update", record=wire.record_to_wire(instance))
+
+    def delete(self, id: str) -> None:
+        return self._call("delete", id=id)
+
+
+class HTTPEvaluationInstances(_RemoteDAO, base.EvaluationInstances):
+    DAO = "evaluation_instances"
+
+    def insert(self, instance: EvaluationInstance) -> str:
+        return self._call("insert", record=wire.record_to_wire(instance))
+
+    def get(self, id: str) -> Optional[EvaluationInstance]:
+        return wire.record_from_wire(
+            "evaluation_instance", self._call("get", id=id)
+        )
+
+    def get_all(self) -> List[EvaluationInstance]:
+        return [
+            wire.record_from_wire("evaluation_instance", x)
+            for x in self._call("get_all")
+        ]
+
+    def get_completed(self) -> List[EvaluationInstance]:
+        return [
+            wire.record_from_wire("evaluation_instance", x)
+            for x in self._call("get_completed")
+        ]
+
+    def update(self, instance: EvaluationInstance) -> None:
+        return self._call("update", record=wire.record_to_wire(instance))
+
+    def delete(self, id: str) -> None:
+        return self._call("delete", id=id)
+
+
+class HTTPModels(_RemoteDAO, base.Models):
+    DAO = "models"
+
+    def insert(self, model: Model) -> None:
+        return self._call("insert", record=wire.record_to_wire(model))
+
+    def get(self, id: str) -> Optional[Model]:
+        return wire.record_from_wire("model", self._call("get", id=id))
+
+    def delete(self, id: str) -> None:
+        return self._call("delete", id=id)

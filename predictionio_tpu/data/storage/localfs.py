@@ -14,7 +14,7 @@ from predictionio_tpu.data.storage import base
 from predictionio_tpu.data.storage.base import Model
 
 
-class StorageClient:
+class StorageClient(base.DAOCacheMixin):
     def __init__(self, config=None):
         self.config = config
         props = getattr(config, "properties", {}) or {}
@@ -23,15 +23,7 @@ class StorageClient:
             "models",
         )
         os.makedirs(self.path, exist_ok=True)
-        self._daos: Dict[str, object] = {}
-        self._lock = threading.Lock()
-
-    def dao(self, cls, namespace: str):
-        key = f"{cls.__name__}:{namespace}"
-        with self._lock:
-            if key not in self._daos:
-                self._daos[key] = cls(client=self, config=self.config, namespace=namespace)
-            return self._daos[key]
+        self._init_dao_cache()
 
 
 class LocalFSModels(base.Models):

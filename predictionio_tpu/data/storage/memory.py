@@ -379,19 +379,10 @@ class MemModels(base.Models):
             self._models.pop(id, None)
 
 
-class StorageClient:
+class StorageClient(base.DAOCacheMixin):
     """Client object for the memory backend. Holds shared DAO instances so
-    that every lookup of the same source returns the same data (the
-    reference caches clients per source, Storage.scala:202-208)."""
+    that every lookup of the same source returns the same data."""
 
     def __init__(self, config=None):
         self.config = config
-        self._daos: Dict[str, object] = {}
-        self._lock = threading.Lock()
-
-    def dao(self, cls, namespace: str):
-        key = f"{cls.__name__}:{namespace}"
-        with self._lock:
-            if key not in self._daos:
-                self._daos[key] = cls(client=self, config=self.config, namespace=namespace)
-            return self._daos[key]
+        self._init_dao_cache()

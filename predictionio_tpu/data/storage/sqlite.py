@@ -76,7 +76,7 @@ class _LockedCursor:
         return self._rows
 
 
-class StorageClient:
+class StorageClient(base.DAOCacheMixin):
     """Shared sqlite connection per source (reference caches clients per
     source name, Storage.scala:202-208). ``check_same_thread=False`` plus a
     lock serializes access from REST worker threads."""
@@ -94,7 +94,7 @@ class StorageClient:
         self.conn = sqlite3.connect(path, check_same_thread=False)
         self.conn.execute("PRAGMA journal_mode=WAL")
         self.lock = threading.RLock()
-        self._daos: Dict[str, object] = {}
+        self._init_dao_cache(self.lock)
 
     def execute(self, sql: str, params=()) -> _LockedCursor:
         return _LockedCursor(self, sql, params)
@@ -102,14 +102,6 @@ class StorageClient:
     def commit(self) -> None:
         with self.lock:
             self.conn.commit()
-
-    def dao(self, cls, namespace: str):
-        key = f"{cls.__name__}:{namespace}"
-        with self.lock:
-            if key not in self._daos:
-                self._daos[key] = cls(client=self, config=self.config, namespace=namespace)
-            return self._daos[key]
-
 
 def _table_name(namespace: str, suffix: str) -> str:
     ns = "".join(c if c.isalnum() else "_" for c in (namespace or "pio"))

@@ -1,0 +1,119 @@
+"""JSON wire format for storage records — shared by the storage gateway
+server (api/storage_gateway.py) and the ``http`` client backend
+(data/storage/http.py).
+
+The reference's client-server backends serialize DAO records onto the wire
+too (HBase cell layout hbase/HBEventsUtil.scala:145-207, Elasticsearch
+document JSON); here the wire is explicit JSON so any HTTP client can speak
+it. Events reuse the API JSON format (event.py to_json/from_json) with
+creationTime preserved verbatim; metadata dataclasses serialize field-wise
+with ISO8601 datetimes; model blobs travel base64.
+"""
+
+from __future__ import annotations
+
+import base64
+import dataclasses
+import datetime as _dt
+from typing import Any, Dict, Optional
+
+from predictionio_tpu.data.event import Event, parse_iso8601
+from predictionio_tpu.data.storage.base import (
+    AccessKey,
+    App,
+    Channel,
+    EngineInstance,
+    EngineManifest,
+    EvaluationInstance,
+    Model,
+)
+
+
+# find()'s UNSET sentinel on the wire (absence-of-filter vs filter-for-None)
+UNSET_WIRE = "\x00unset"
+
+
+def event_to_wire(e: Event) -> Dict[str, Any]:
+    out = e.to_json()
+    # the API JSON format truncates times to milliseconds; the wire must
+    # round-trip exactly or find()'s time-range semantics diverge from the
+    # embedded backends at sub-ms boundaries
+    out["eventTime"] = _dt_to_wire(e.event_time)
+    out["creationTime"] = _dt_to_wire(e.creation_time)
+    return out
+
+
+def event_from_wire(obj: Dict[str, Any]) -> Event:
+    # stored events were validated on ingestion; re-validating here would
+    # reject reserved/builtin events ($set on pio_pr etc.) on read-back
+    e = Event.from_json(obj, validate=False)
+    raw_created = obj.get("creationTime")
+    if raw_created:
+        e = dataclasses.replace(e, creation_time=parse_iso8601(raw_created))
+    return e
+
+
+def _dt_to_wire(d: _dt.datetime) -> str:
+    # full microsecond precision (datetime.isoformat), NOT the API format's
+    # millisecond rendering — storage round-trips must be lossless
+    if d.tzinfo is None:
+        d = d.replace(tzinfo=_dt.timezone.utc)
+    return d.isoformat()
+
+
+def _dt_from_wire(s: str) -> _dt.datetime:
+    return parse_iso8601(s)
+
+
+_DATACLASS_TYPES = {
+    "app": App,
+    "access_key": AccessKey,
+    "channel": Channel,
+    "engine_manifest": EngineManifest,
+    "engine_instance": EngineInstance,
+    "evaluation_instance": EvaluationInstance,
+}
+
+
+def record_to_wire(rec: Any) -> Dict[str, Any]:
+    """Serialize a metadata dataclass field-wise (datetimes -> ISO8601)."""
+    if isinstance(rec, Model):
+        return {
+            "id": rec.id,
+            "models": base64.b64encode(rec.models).decode("ascii"),
+        }
+    out = {}
+    for f in dataclasses.fields(rec):
+        v = getattr(rec, f.name)
+        if isinstance(v, _dt.datetime):
+            v = _dt_to_wire(v)
+        elif isinstance(v, tuple):
+            v = list(v)
+        out[f.name] = v
+    return out
+
+
+def record_from_wire(kind: str, obj: Optional[Dict[str, Any]]) -> Any:
+    if obj is None:
+        return None
+    if kind == "model":
+        return Model(id=obj["id"], models=base64.b64decode(obj["models"]))
+    cls = _DATACLASS_TYPES[kind]
+    kwargs = {}
+    for f in dataclasses.fields(cls):
+        if f.name not in obj:
+            continue
+        v = obj[f.name]
+        # the only datetime fields across the metadata records
+        if f.name in ("start_time", "end_time") and isinstance(v, str):
+            v = _dt_from_wire(v)
+        kwargs[f.name] = v
+    return cls(**kwargs)
+
+
+def opt_dt_to_wire(d: Optional[_dt.datetime]) -> Optional[str]:
+    return None if d is None else _dt_to_wire(d)
+
+
+def opt_dt_from_wire(s: Optional[str]) -> Optional[_dt.datetime]:
+    return None if s is None else _dt_from_wire(s)

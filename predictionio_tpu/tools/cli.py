@@ -229,6 +229,22 @@ def cmd_eventserver(args) -> int:
     return 0
 
 
+def cmd_storagegateway(args) -> int:
+    from predictionio_tpu.api.storage_gateway import StorageGatewayServer
+
+    if not args.secret and args.ip not in ("localhost", "127.0.0.1", "::1"):
+        print(
+            "WARNING: binding a non-loopback interface without --secret "
+            "exposes unauthenticated read/write access to ALL storage"
+        )
+    server = StorageGatewayServer(
+        ip=args.ip, port=args.port, secret=args.secret
+    )
+    print(f"Storage gateway serving on {args.ip}:{server.port}")
+    server.serve_forever()
+    return 0
+
+
 def cmd_adminserver(args) -> int:
     from predictionio_tpu.tools.admin_server import create_admin_server
 
@@ -508,6 +524,15 @@ def build_parser() -> argparse.ArgumentParser:
     es.add_argument("--port", type=int, default=7070)
     es.add_argument("--stats", action="store_true")
     es.set_defaults(func=cmd_eventserver)
+
+    gw = sub.add_parser(
+        "storagegateway",
+        help="serve this host's storage to remote processes (http backend)",
+    )
+    gw.add_argument("--ip", default="localhost")
+    gw.add_argument("--port", type=int, default=7077)
+    gw.add_argument("--secret", default="")
+    gw.set_defaults(func=cmd_storagegateway)
 
     admin = sub.add_parser("adminserver", help="start the admin server")
     admin.add_argument("--ip", default="localhost")

@@ -42,10 +42,37 @@ def sqlite_storage(tmp_path):
     )
 
 
-@pytest.fixture(params=["memory", "sqlite"])
+def gateway_storage(request):
+    """A Storage whose every DAO is proxied over live HTTP to an in-process
+    storage gateway backed by a fresh memory universe — the client-server
+    tier of the reference's LEventsSpec matrix (HBase/JDBC backends,
+    LEventsSpec.scala:20-45)."""
+    from predictionio_tpu.api.storage_gateway import StorageGatewayServer
+
+    server = StorageGatewayServer(
+        memory_storage(), ip="127.0.0.1", port=0
+    ).start()
+    request.addfinalizer(server.shutdown)
+    return Storage(
+        {
+            "PIO_STORAGE_SOURCES_GW_TYPE": "http",
+            "PIO_STORAGE_SOURCES_GW_URL": f"http://127.0.0.1:{server.port}",
+            "PIO_STORAGE_REPOSITORIES_METADATA_NAME": "meta",
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "GW",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_NAME": "event",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "GW",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_NAME": "model",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "GW",
+        }
+    )
+
+
+@pytest.fixture(params=["memory", "sqlite", "gateway"])
 def storage(request, tmp_path):
     if request.param == "memory":
         return memory_storage()
+    if request.param == "gateway":
+        return gateway_storage(request)
     return sqlite_storage(tmp_path)
 
 

@@ -1,0 +1,215 @@
+"""Client-server storage tests beyond the shared trait matrix
+(tests/test_storage.py runs the full DAO matrix over the gateway):
+auth, reconnection, error mapping, and a complete train->deploy->query
+workflow whose every storage touch crosses the wire.
+"""
+
+import datetime as dt
+
+import pytest
+
+from predictionio_tpu.api.storage_gateway import StorageGatewayServer
+from predictionio_tpu.data.event import DataMap, Event
+from predictionio_tpu.data.storage import Storage, memory_storage
+from predictionio_tpu.data.storage.base import App, StorageError
+
+
+def gw_config(port, name="GW", secret=None):
+    cfg = {
+        f"PIO_STORAGE_SOURCES_{name}_TYPE": "http",
+        f"PIO_STORAGE_SOURCES_{name}_URL": f"http://127.0.0.1:{port}",
+        "PIO_STORAGE_REPOSITORIES_METADATA_NAME": "meta",
+        f"PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": name,
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_NAME": "event",
+        f"PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": name,
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_NAME": "model",
+        f"PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": name,
+    }
+    if secret is not None:
+        cfg[f"PIO_STORAGE_SOURCES_{name}_SECRET"] = secret
+    return cfg
+
+
+@pytest.fixture()
+def gateway():
+    server = StorageGatewayServer(memory_storage(), ip="127.0.0.1", port=0)
+    server.start()
+    yield server
+    server.shutdown()
+
+
+class TestTransport:
+    def test_secret_required_when_configured(self):
+        server = StorageGatewayServer(
+            memory_storage(), ip="127.0.0.1", port=0, secret="s3cret"
+        ).start()
+        try:
+            wrong = Storage(gw_config(server.port, secret="nope"))
+            with pytest.raises(StorageError, match="401|secret"):
+                wrong.get_meta_data_apps().get_all()
+            right = Storage(gw_config(server.port, secret="s3cret"))
+            assert right.get_meta_data_apps().get_all() == []
+        finally:
+            server.shutdown()
+
+    def test_unreachable_gateway_raises_storage_error(self):
+        s = Storage(gw_config(1))  # nothing listens on port 1
+        with pytest.raises(StorageError, match="unreachable"):
+            s.get_meta_data_apps().get_all()
+
+    def test_reconnects_after_gateway_restart(self, gateway):
+        s = Storage(gw_config(gateway.port))
+        apps = s.get_meta_data_apps()
+        apps.insert(App(id=0, name="a1"))
+        assert len(apps.get_all()) == 1
+        port = gateway.port
+        backing = gateway.core.storage
+        gateway.shutdown()
+        # new gateway process on the same port, same backing store
+        revived = StorageGatewayServer(backing, ip="127.0.0.1", port=port)
+        revived.start()
+        try:
+            # the pooled keep-alive connection died with the old server;
+            # the client must drop it and retry once
+            assert [a.name for a in apps.get_all()] == ["a1"]
+        finally:
+            revived.shutdown()
+
+    def test_storage_error_crosses_the_wire(self, gateway):
+        s = Storage(gw_config(gateway.port))
+        le = s.get_l_events()
+        with pytest.raises(StorageError, match="not\\s+initialized"):
+            le.insert(
+                Event(event="x", entity_type="user", entity_id="u"), 42
+            )
+
+    def test_bulk_write_is_one_round_trip(self, gateway):
+        s = Storage(gw_config(gateway.port))
+        le = s.get_l_events()
+        le.init(1)
+        events = [
+            Event(
+                event="rate", entity_type="user", entity_id=f"u{j}",
+                target_entity_type="item", target_entity_id=f"i{j}",
+                properties=DataMap({"rating": float(j % 5 + 1)}),
+                event_time=dt.datetime(2026, 7, 29, tzinfo=dt.timezone.utc),
+            )
+            for j in range(50)
+        ]
+        ids = le.write(events, 1)
+        assert len(ids) == len(set(ids)) == 50
+        assert len(list(le.find(1))) == 50
+
+    def test_sub_millisecond_times_round_trip(self, gateway):
+        """The wire must carry full microsecond precision — the API JSON
+        format's ms truncation would silently shift find() boundaries."""
+        s = Storage(gw_config(gateway.port))
+        le = s.get_l_events()
+        le.init(1)
+        t0 = dt.datetime(2026, 7, 29, 12, 0, 0, 123456, tzinfo=dt.timezone.utc)
+        eid = le.insert(
+            Event(event="x", entity_type="user", entity_id="u", event_time=t0),
+            1,
+        )
+        assert le.get(eid, 1).event_time == t0
+        # exclusive until_time just above the stored microsecond
+        just_above = t0 + dt.timedelta(microseconds=1)
+        assert len(list(le.find(1, until_time=just_above))) == 1
+        assert len(list(le.find(1, until_time=t0))) == 0
+
+    def test_mutations_do_not_retry_after_send(self, gateway, monkeypatch):
+        """A transport failure AFTER an insert went out must not re-send it
+        (the gateway may have committed); reads may retry freely."""
+        import http.client as hc
+
+        s = Storage(gw_config(gateway.port))
+        le = s.get_l_events()
+        le.init(1)
+
+        real_getresponse = hc.HTTPConnection.getresponse
+        state = {"fail_next": False, "calls": 0}
+
+        def flaky_getresponse(conn):
+            if state["fail_next"]:
+                state["fail_next"] = False
+                state["calls"] += 1
+                raise ConnectionResetError("mid-response drop")
+            return real_getresponse(conn)
+
+        monkeypatch.setattr(hc.HTTPConnection, "getresponse", flaky_getresponse)
+        state["fail_next"] = True
+        with pytest.raises(StorageError, match="unreachable"):
+            le.insert(Event(event="x", entity_type="user", entity_id="u"), 1)
+        # the failed insert was sent once and not replayed
+        assert state["calls"] == 1
+        # a read after the same failure mode retries and succeeds
+        state["fail_next"] = True
+        assert isinstance(list(le.find(1)), list)
+
+    def test_status_route(self, gateway):
+        import json
+        import urllib.request
+
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{gateway.port}/status"
+        ) as resp:
+            payload = json.loads(resp.read())
+        assert payload["status"] == "alive"
+        assert "levents" in payload["daos"]
+
+
+class TestWorkflowOverGateway:
+    def test_train_deploy_query(self, gateway):
+        """The multi-process story: trainer and engine server both talk to
+        the storage service over HTTP only (reference: trainer writes
+        models to HBase/ES, CreateServer reads them back)."""
+        import numpy as np
+
+        from predictionio_tpu.api.engine_server import DeployedEngine
+        from predictionio_tpu.data.storage.base import EngineInstance
+        from predictionio_tpu.models.recommendation.engine import (
+            Query,
+            recommendation_engine,
+        )
+        from predictionio_tpu.models.recommendation.evaluation import (
+            _engine_params,
+        )
+        from predictionio_tpu.workflow.context import WorkflowContext
+        from predictionio_tpu.workflow.core_workflow import CoreWorkflow
+
+        s = Storage(gw_config(gateway.port))
+        app_id = s.get_meta_data_apps().insert(App(id=0, name="default"))
+        le = s.get_l_events()
+        le.init(app_id)
+        rng = np.random.default_rng(3)
+        le.write(
+            [
+                Event(
+                    event="rate", entity_type="user", entity_id=f"u{uu}",
+                    target_entity_type="item",
+                    target_entity_id=f"i{(uu % 2) * 10 + j}",
+                    properties=DataMap({"rating": 5.0}),
+                )
+                for uu in range(16)
+                for j in rng.permutation(10)[:6].tolist()
+            ],
+            app_id,
+        )
+        now = dt.datetime.now(dt.timezone.utc)
+        iid = CoreWorkflow.run_train(
+            recommendation_engine(),
+            _engine_params(rank=4, reg=0.05, eval_k=0),
+            EngineInstance(
+                id="", status="", start_time=now, end_time=now,
+                engine_id="gw", engine_version="1",
+                engine_variant="engine.json",
+                engine_factory="predictionio_tpu.models.recommendation",
+            ),
+            ctx=WorkflowContext(mode="training", storage=s),
+        )
+        assert iid
+        # a "different process": a fresh Storage client over the same wire
+        s2 = Storage(gw_config(gateway.port))
+        dep = DeployedEngine.from_storage(recommendation_engine(), s2)
+        [result] = dep.serve_batch([Query(user="u0", num=3)])
+        assert len(result.item_scores) == 3
