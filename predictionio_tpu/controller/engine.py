@@ -92,6 +92,20 @@ class EngineParams:
         }
 
 
+def _run_grid(items: Sequence[Any], fn, workflow_params) -> List[Any]:
+    """Map fn over grid items, in order, with a thread pool when
+    workflow_params.eval_parallelism > 1."""
+    items = list(items)
+    workers = getattr(workflow_params, "eval_parallelism", 1) or 1
+    workers = min(int(workers), len(items))
+    if workers <= 1 or len(items) <= 1:
+        return [fn(x) for x in items]
+    import concurrent.futures
+
+    with concurrent.futures.ThreadPoolExecutor(max_workers=workers) as pool:
+        return list(pool.map(fn, items))
+
+
 def _as_class_map(classes) -> Dict[str, type]:
     """A single class becomes the default-name map (reference's implicit
     ``Map("" -> cls)`` helpers, Engine.scala:512-575)."""
@@ -116,11 +130,17 @@ class BaseEngine:
     def batch_eval(
         self, ctx, engine_params_list: Sequence[EngineParams], workflow_params
     ) -> List[Tuple[EngineParams, List[Tuple[Any, List[Tuple[Any, Any, Any]]]]]]:
-        """Default: loop eval over the params grid
-        (reference BaseEngine.batchEval:79-90)."""
-        return [
-            (ep, self.eval(ctx, ep, workflow_params)) for ep in engine_params_list
-        ]
+        """Eval over the params grid, concurrently when
+        workflow_params.eval_parallelism > 1 (the reference's `.par` over
+        param sets, MetricEvaluator.scala:221-230; here a thread pool —
+        device programs serialize on the chip but each variant's host
+        stages overlap the others' device time). Results keep grid order.
+        """
+        return _run_grid(
+            engine_params_list,
+            lambda ep: (ep, self.eval(ctx, ep, workflow_params)),
+            workflow_params,
+        )
 
     def jvalue_to_engine_params(self, json_obj: Mapping[str, Any]) -> EngineParams:
         raise NotImplementedError

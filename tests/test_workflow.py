@@ -179,6 +179,46 @@ class TestFastEvalEngine:
         # models and the serving results are shared
         assert out[0][1] == out[2][1]
 
+    def test_parallel_grid_wall_clock(self, mem_storage):
+        """VERDICT acceptance: a grid of 8 variants through the FastEval
+        path must cost <= 2x a single variant's wall-clock (the reference
+        runs the grid with `.par`, MetricEvaluator.scala:221-230)."""
+        import time
+
+        from tests.fake_engine import Algo0, Model0
+
+        class SlowAlgo(Algo0):
+            DELAY_S = 0.15
+
+            def train(self, ctx, pd):
+                time.sleep(self.DELAY_S)  # a host-bound stage (releases GIL)
+                return Model0(self.params.id, pd.id)
+
+        ctx = WorkflowContext(storage=mem_storage)
+        engine = make_engine(FastEvalEngine)
+        engine.algorithm_class_map["slow"] = SlowAlgo
+        base = make_params(n_eval_sets=2)
+
+        def variant(i):
+            return dataclasses.replace(
+                base, algorithm_params_list=(("slow", AlgoParams(id=i)),)
+            )
+
+        wp = WorkflowParams(eval_parallelism=8)
+        t0 = time.perf_counter()
+        engine.batch_eval(ctx, [variant(0)], wp)
+        single_s = time.perf_counter() - t0
+
+        engine2 = make_engine(FastEvalEngine)
+        engine2.algorithm_class_map["slow"] = SlowAlgo
+        t0 = time.perf_counter()
+        out = engine2.batch_eval(ctx, [variant(i) for i in range(8)], wp)
+        grid_s = time.perf_counter() - t0
+        assert len(out) == 8
+        # order preserved despite concurrency
+        assert [ep.algorithm_params_list[0][1].id for ep, _ in out] == list(range(8))
+        assert grid_s <= 2 * single_s + 0.25, (grid_s, single_s)
+
     def test_results_match_plain_engine(self, mem_storage):
         ctx = WorkflowContext(storage=mem_storage)
         plain = make_engine(Engine)
