@@ -51,6 +51,23 @@ def initialize_distributed(
         return
     import jax
 
+    if num_processes is not None and num_processes > 1:
+        # Multi-process on the CPU backend (CI rigs, local rehearsal of a
+        # pod launch) needs an explicit cross-process collectives transport:
+        # on jax 0.9.0 the coordination handshake succeeds without one, but
+        # the global device view never aggregates past the local device and
+        # collectives hang/fail. Gloo is the bundled implementation. The
+        # flag is consulted only by the CPU backend, so set it whenever CPU
+        # is a candidate platform (explicitly listed, or unset = autoselect,
+        # which falls back to CPU) — on TPU the ICI/DCN transport is native
+        # and the flag is inert.
+        platforms = [
+            p.strip().lower()
+            for p in (jax.config.jax_platforms or "").split(",")
+        ]
+        if "cpu" in platforms or platforms == [""]:
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+
     kwargs = {}
     if coordinator_address is not None:
         kwargs["coordinator_address"] = coordinator_address

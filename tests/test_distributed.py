@@ -14,6 +14,26 @@ import textwrap
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+def worker_env() -> dict:
+    """Env for worker subprocesses: conftest.py injects
+    ``--xla_force_host_platform_device_count=8`` into this process's
+    XLA_FLAGS for the virtual-mesh tests, and the workers would inherit it
+    and see 8 local devices each. Here each worker models one single-chip
+    host, so drop that flag (and only that flag — ambient XLA flags the
+    environment set deliberately still apply)."""
+    env = {**os.environ, "PYTHONPATH": _REPO}
+    kept = [
+        f
+        for f in env.get("XLA_FLAGS", "").split()
+        if "xla_force_host_platform_device_count" not in f
+    ]
+    if kept:
+        env["XLA_FLAGS"] = " ".join(kept)
+    else:
+        env.pop("XLA_FLAGS", None)
+    return env
+
+
 def free_port() -> int:
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
@@ -63,7 +83,7 @@ class TestTwoProcessRuntime:
                 stdout=subprocess.PIPE,
                 stderr=subprocess.STDOUT,
                 text=True,
-                env={**os.environ, "PYTHONPATH": _REPO},
+                env=worker_env(),
             )
             for rank in (0, 1)
         ]
@@ -121,7 +141,7 @@ class TestStrictInit:
             capture_output=True,
             text=True,
             timeout=120,
-            env={**os.environ, "PYTHONPATH": _REPO},
+            env=worker_env(),
         )
         assert out.returncode == 0, out.stderr
         assert "STRICT RAISED" in out.stdout
