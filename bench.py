@@ -34,6 +34,7 @@ BASELINE.md; estimates are labeled in each section).
 
 import concurrent.futures
 import json
+import os
 import time
 
 import numpy as np
@@ -49,6 +50,19 @@ SPARK_LOCAL_NB_S = 8.0  # MLlib NaiveBayes, ~50k points
 SPARK_LOCAL_SIMILAR_S = 30.0  # trainImplicit + item-factor cosine
 SPARK_LOCAL_ECOMM_S = 30.0  # ALS.train + LEventStore rule reads
 SPARK_LOCAL_CV_S = 240.0  # 4 variants x 3 folds, each an ALS train+eval
+SPARK_LOCAL_ALS_ML20M_S = 900.0  # MLlib ALS ML-20M rank=32 iters=10 local[*]
+
+# Published per-chip peak dense-matmul rates (bf16), for the MFU field of
+# the ML-20M bench. Keyed by jax device_kind; unknown kinds report mfu=None
+# rather than a number derived from a guessed peak.
+PEAK_BF16_FLOPS = {
+    "TPU v5 lite": 197e12,  # v5e
+    "TPU v5e": 197e12,
+    "TPU v4": 275e12,
+    "TPU v5p": 459e12,
+    "TPU v6 lite": 918e12,  # v6e / Trillium
+    "TPU v6e": 918e12,
+}
 
 
 def synth_ml100k(seed=7):
@@ -244,6 +258,146 @@ def bench_rest_serving(u, i, r):
         }
     finally:
         server.shutdown()
+
+
+# --- config 6: north-star scale — ML-20M-shaped ALS with MFU ---
+
+
+def synth_ml20m(n_users, n_items, n_ratings, seed=41):
+    """MovieLens-20M-shaped synthetic ratings (the real dataset is not
+    redistributable in this image): low-rank-plus-noise scores on a
+    lognormal-activity x zipf-popularity long tail, snapped to ML-20M's
+    0.5-step 0.5..5.0 rating scale."""
+    rng = np.random.default_rng(seed)
+    k0 = 12
+    U = (rng.standard_normal((n_users, k0)) / np.sqrt(k0)).astype(np.float32)
+    V = (rng.standard_normal((n_items, k0)) / np.sqrt(k0)).astype(np.float32)
+    u_p = rng.lognormal(0, 1.1, n_users)
+    u_p /= u_p.sum()
+    i_p = 1.0 / np.arange(1, n_items + 1) ** 0.9
+    i_p /= i_p.sum()
+    u = rng.choice(n_users, size=n_ratings, p=u_p).astype(np.int32)
+    i = rng.choice(n_items, size=n_ratings, p=i_p).astype(np.int32)
+    raw = np.empty(n_ratings, np.float32)
+    for s in range(0, n_ratings, 4_000_000):  # chunk the 20M-row gather
+        e = min(s + 4_000_000, n_ratings)
+        raw[s:e] = np.einsum("nk,nk->n", U[u[s:e]], V[i[s:e]])
+    scores = 3.0 + 1.3 * raw + 0.5 * rng.standard_normal(n_ratings)
+    r = np.clip(np.round(scores * 2.0) / 2.0, 0.5, 5.0).astype(np.float32)
+    return u, i, r
+
+
+def bench_ml20m(device_name):
+    """The north-star config at its stated scale: 138k x 27k x 20M ALS,
+    rank 32, 10 iterations, single chip. Reports the phase-split wall
+    clock, peak HBM, achieved FLOP/s and MFU (vs the published bf16 peak
+    of the chip), plus RMSE parity vs the float64 MLlib oracle on a
+    subsampled slice (the oracle is O(minutes) at full scale)."""
+    from predictionio_tpu.ops.als import (
+        ALSConfig,
+        predict_ratings,
+        train_als,
+    )
+    from predictionio_tpu.ops.als_reference import (
+        rmse_reference,
+        train_als_reference,
+    )
+    import jax
+
+    n_users, n_items = 138_493, 26_744
+    n_ratings = int(os.environ.get("BENCH_ML20M_RATINGS", 20_000_000))
+    rank, iters, reg = 32, 10, 0.05
+
+    u, i, r = synth_ml20m(n_users, n_items, n_ratings)
+
+    config = ALSConfig(
+        rank=rank, iterations=iters, reg=reg,
+        compute_dtype="bfloat16",  # MXU-rate einsums, f32 accumulation
+    )
+
+    # one call does everything: train_als compiles via a zero-iteration
+    # run before its timed loop (timings["compile_s"]), so no separate
+    # warm-up pass re-packs and re-transfers the ~1 GB of segment data
+    timings = {}
+    t0 = time.perf_counter()
+    model = train_als(u, i, r, n_users, n_items, config, timings=timings)
+    total_s = time.perf_counter() - t0
+    loop_s = timings.get("device_loop_s", total_s)
+    # grid slots both sides, incl. chunk-grid padding segments — the true
+    # denominator for hardware busyness
+    slots = timings.get("padded_slots", 0)
+
+    # model FLOPs (real observations only — padding work is excluded, so
+    # this is true MFU, not hardware busyness): per observation per side,
+    # the Gramian-correction einsum is k^2 MACs and the rhs k MACs
+    flops_per_slot = 2 * rank * rank + 2 * rank
+    model_flops = 2 * n_ratings * flops_per_slot * iters
+    padded_flops = slots * flops_per_slot * iters
+    achieved = model_flops / loop_s
+    peak = PEAK_BF16_FLOPS.get(jax.devices()[0].device_kind)
+
+    try:
+        stats = jax.local_devices()[0].memory_stats() or {}
+        peak_hbm_gb = round(stats.get("peak_bytes_in_use", 0) / 2**30, 3)
+        peak_hbm_gb = peak_hbm_gb or None  # relayed devices report 0
+    except Exception:
+        peak_hbm_gb = None
+
+    # train-RMSE on a 2M-pair sample (full 20M predict is 20 relay trips)
+    rng = np.random.default_rng(43)
+    idx = rng.choice(n_ratings, size=min(2_000_000, n_ratings), replace=False)
+    err = predict_ratings(model, u[idx], i[idx]) - r[idx]
+    rmse_train = float(np.sqrt(np.mean(err * err)))
+
+    # MLlib-semantics parity on a subsampled slice: the head of both long
+    # tails (ids are popularity-ordered in the generator), full float64
+    # oracle vs the TPU kernel in float32 on identical data
+    sub = (u < 3000) & (i < 2000)
+    su, si, sr = u[sub], i[sub], r[sub]
+    if len(su) > 150_000:
+        keep = rng.choice(len(su), size=150_000, replace=False)
+        su, si, sr = su[keep], si[keep], sr[keep]
+    sub_cfg = ALSConfig(rank=rank, iterations=iters, reg=reg)
+    sub_model = train_als(su, si, sr, 3000, 2000, sub_cfg)
+    sub_rmse = float(
+        np.sqrt(np.mean((predict_ratings(sub_model, su, si) - sr) ** 2))
+    )
+    X_ref, Y_ref = train_als_reference(
+        su, si, sr, 3000, 2000, rank=rank, iterations=iters, reg=reg,
+        reg_mode="weighted", seed=0,
+    )
+    rmse_ref = rmse_reference(X_ref, Y_ref, su, si, sr)
+
+    emit(
+        {
+            "metric": "als_ml20m_train_wall_clock",
+            "value": round(total_s, 3),
+            "unit": "s",
+            "vs_baseline": round(SPARK_LOCAL_ALS_ML20M_S / total_s, 2),
+            "n_users": n_users,
+            "n_items": n_items,
+            "n_ratings": n_ratings,
+            "rank": rank,
+            "iterations": iters,
+            "pack_s": round(timings.get("pack_s", 0.0), 3),
+            "compile_s": round(timings.get("compile_s", 0.0), 3),
+            "device_put_s": round(timings.get("device_put_s", 0.0), 3),
+            "device_loop_s": round(loop_s, 3),
+            "model_tflops": round(model_flops / 1e12, 2),
+            "achieved_tflops_per_s": round(achieved / 1e12, 2),
+            "mfu": round(achieved / peak, 4) if peak else None,
+            "hw_util_incl_padding": (
+                round(padded_flops / loop_s / peak, 4) if peak else None
+            ),
+            "peak_flops_assumed_tflops": round(peak / 1e12) if peak else None,
+            "peak_hbm_gb": peak_hbm_gb,
+            "rmse_train_2m_sample": round(rmse_train, 4),
+            "rmse_subsample": round(sub_rmse, 4),
+            "rmse_mllib_oracle_subsample": round(rmse_ref, 4),
+            "rmse_vs_mllib_subsample": round(abs(sub_rmse - rmse_ref), 4),
+            "device": device_name,
+        }
+    )
 
 
 # --- config 2: classification NaiveBayes ---
@@ -488,15 +642,33 @@ def bench_kfold_cv(device_name):
     )
 
 
-def main():
+BENCHES = {
+    "recommendation": bench_recommendation,
+    "classification": bench_classification,
+    "similarproduct": bench_similarproduct,
+    "ecommerce": bench_ecommerce,
+    "kfold_cv": bench_kfold_cv,
+    "ml20m": bench_ml20m,
+}
+
+
+def main(argv=None):
+    import argparse
+
     import jax
 
+    ap = argparse.ArgumentParser()
+    ap.add_argument(
+        "--only",
+        choices=sorted(BENCHES),
+        action="append",
+        help="run only the named config(s); default runs all, headline first",
+    )
+    args = ap.parse_args(argv)
     device_name = str(jax.devices()[0])
-    bench_recommendation(device_name)
-    bench_classification(device_name)
-    bench_similarproduct(device_name)
-    bench_ecommerce(device_name)
-    bench_kfold_cv(device_name)
+    names = args.only or list(BENCHES)
+    for name in names:
+        BENCHES[name](device_name)
 
 
 if __name__ == "__main__":

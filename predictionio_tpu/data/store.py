@@ -126,6 +126,35 @@ class PEventStore:
             required=required,
         )
 
+    def extract_entity_map(
+        self,
+        app_name: str,
+        entity_type: str,
+        mapper,
+        channel_name: Optional[str] = None,
+        start_time: Optional[_dt.datetime] = None,
+        until_time: Optional[_dt.datetime] = None,
+        required: Optional[Sequence[str]] = None,
+    ):
+        """Fold an entity type's property history into a typed
+        :class:`~predictionio_tpu.data.entity_map.EntityMap` (reference
+        PEvents.extractEntityMap, data/storage/PEvents.scala:73-102):
+        aggregate ``$set/$unset/$delete``, drop entities missing a
+        ``required`` property, and apply ``mapper(PropertyMap) -> A``.
+        The resulting dense indices are what device kernels consume as
+        factor/feature matrix rows."""
+        from predictionio_tpu.data.entity_map import EntityMap
+
+        props = self.aggregate_properties(
+            app_name,
+            entity_type=entity_type,
+            channel_name=channel_name,
+            start_time=start_time,
+            until_time=until_time,
+            required=required,
+        )
+        return EntityMap({eid: mapper(pm) for eid, pm in props.items()})
+
     # --- columnar view: events -> device-ready arrays ---
 
     def find_columns(

@@ -1,0 +1,287 @@
+"""Recommendation-with-EntityMap example engine.
+
+Reference mapping (examples/experimental/scala-parallel-recommendation-entitymap/):
+- DataSource extracts TYPED user/item entities through
+  ``PEventStore.extract_entity_map`` (reference
+  DataSource.scala:27-52 -> eventsDb.extractEntityMap[User]/[Item] with
+  required attributes), plus rate/buy events (buy -> rating 4.0,
+  DataSource.scala:54-79)
+- The EntityMap's dense index IS the factor-matrix row id, and the same
+  map translates recommendations back to external string ids
+  (ALSAlgorithm.scala:26-55) — the example exists to demonstrate exactly
+  this id-discipline
+- ALS itself runs on the TPU mesh kernel (ops/als.py), replacing
+  ``org.apache.spark.mllib.recommendation.ALS.train``
+- Query(user, num) / PredictedResult(itemScores)   <- Engine.scala:6-19
+
+Typed payloads: User(attr0: float, attr1: int, attr2: int),
+Item(attr_a: str, attr_b: int, attr_c: bool)       <- DataSource.scala:85-96.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from predictionio_tpu.controller import (
+    BaseAlgorithm,
+    BaseDataSource,
+    BasePreparator,
+    EngineFactory,
+    FirstServing,
+    Params,
+    SanityCheck,
+)
+from predictionio_tpu.controller.engine import Engine
+from predictionio_tpu.data.entity_map import EntityMap
+from predictionio_tpu.data.store import PEventStore
+from predictionio_tpu.ops.als import ALSConfig, ALSModelArrays, ServingFactors, train_als
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass(frozen=True)
+class Query:
+    user: str
+    num: int = 10
+
+
+@dataclasses.dataclass(frozen=True)
+class ItemScore:
+    item: str
+    score: float
+
+
+@dataclasses.dataclass(frozen=True)
+class PredictedResult:
+    item_scores: Tuple[ItemScore, ...] = ()
+
+    def __post_init__(self):
+        object.__setattr__(
+            self,
+            "item_scores",
+            tuple(
+                s if isinstance(s, ItemScore) else ItemScore(**s)
+                for s in self.item_scores
+            ),
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class User:
+    attr0: float
+    attr1: int
+    attr2: int
+
+
+@dataclasses.dataclass(frozen=True)
+class Item:
+    attr_a: str
+    attr_b: int
+    attr_c: bool
+
+
+@dataclasses.dataclass
+class Rating:
+    user: str
+    item: str
+    rating: float
+
+
+@dataclasses.dataclass
+class TrainingData(SanityCheck):
+    users: EntityMap
+    items: EntityMap
+    ratings: List[Rating]
+
+    def sanity_check(self) -> None:
+        if not self.ratings:
+            raise ValueError("ratings is empty — are rate/buy events present?")
+        if not len(self.users) or not len(self.items):
+            raise ValueError(
+                "users/items EntityMap is empty — are $set events with the "
+                "required attributes present?"
+            )
+
+
+@dataclasses.dataclass
+class PreparedData:
+    td: TrainingData
+
+
+@dataclasses.dataclass(frozen=True)
+class DataSourceParams(Params):
+    app_name: str = "default"
+    channel_name: Optional[str] = None
+
+
+class DataSource(BaseDataSource):
+    """Typed EntityMap extraction + rating events (DataSource.scala:25-80)."""
+
+    params_class = DataSourceParams
+
+    def read_training(self, ctx) -> TrainingData:
+        p = self.params
+        store = PEventStore(ctx.storage)
+        users = store.extract_entity_map(
+            p.app_name,
+            entity_type="user",
+            channel_name=p.channel_name,
+            required=["attr0", "attr1", "attr2"],
+            mapper=lambda dm: User(
+                attr0=float(dm.get("attr0")),
+                attr1=int(dm.get("attr1")),
+                attr2=int(dm.get("attr2")),
+            ),
+        )
+        items = store.extract_entity_map(
+            p.app_name,
+            entity_type="item",
+            channel_name=p.channel_name,
+            required=["attrA", "attrB", "attrC"],
+            mapper=lambda dm: Item(
+                attr_a=str(dm.get("attrA")),
+                attr_b=int(dm.get("attrB")),
+                attr_c=bool(dm.get("attrC")),
+            ),
+        )
+        ratings = []
+        for e in store.find(
+            p.app_name,
+            channel_name=p.channel_name,
+            entity_type="user",
+            event_names=["rate", "buy"],
+            target_entity_type="item",
+        ):
+            if e.event == "rate":
+                value = float(e.properties.get("rating"))
+            else:  # buy maps to a strong implicit signal
+                value = 4.0
+            ratings.append(
+                Rating(user=e.entity_id, item=e.target_entity_id, rating=value)
+            )
+        logger.info(
+            "DataSource: %d users, %d items, %d ratings",
+            len(users), len(items), len(ratings),
+        )
+        return TrainingData(users=users, items=items, ratings=ratings)
+
+
+class Preparator(BasePreparator):
+    def prepare(self, ctx, td: TrainingData) -> PreparedData:
+        return PreparedData(td=td)
+
+
+@dataclasses.dataclass(frozen=True)
+class ALSAlgorithmParams(Params):
+    rank: int = 10
+    num_iterations: int = 10
+    lambda_: float = 0.01
+    seed: Optional[int] = 3
+
+
+@dataclasses.dataclass
+class EntityMapALSModel:
+    """Factors indexed BY the EntityMaps (ALSModel.scala:20-26): dense
+    row = EntityMap index, translation back to string ids goes through
+    the same maps that produced the training matrix."""
+
+    arrays: ALSModelArrays
+    users: EntityMap
+    items: EntityMap
+    _serving: Optional[ServingFactors] = dataclasses.field(
+        default=None, repr=False, compare=False
+    )
+
+    def __getstate__(self):
+        state = self.__dict__.copy()
+        state["_serving"] = None
+        return state
+
+    @property
+    def serving(self) -> ServingFactors:
+        if self._serving is None:
+            self._serving = ServingFactors(
+                self.arrays.user_factors, self.arrays.item_factors
+            )
+        return self._serving
+
+
+class ALSAlgorithm(BaseAlgorithm):
+    """TPU-mesh ALS over EntityMap-indexed ratings (ALSAlgorithm.scala:
+    25-40; MLlib ALS.train replaced by ops/als.py)."""
+
+    params_class = ALSAlgorithmParams
+    query_class = Query
+
+    def train(self, ctx, pd: PreparedData) -> EntityMapALSModel:
+        td = pd.td
+        p: ALSAlgorithmParams = self.params
+        kept = [
+            r for r in td.ratings if r.user in td.users and r.item in td.items
+        ]
+        dropped = len(td.ratings) - len(kept)
+        if dropped:
+            logger.info(
+                "dropping %d ratings for entities without required "
+                "attributes", dropped,
+            )
+        u = np.fromiter(
+            (td.users[r.user] for r in kept), np.int32, count=len(kept)
+        )
+        i = np.fromiter(
+            (td.items[r.item] for r in kept), np.int32, count=len(kept)
+        )
+        v = np.fromiter((r.rating for r in kept), np.float32, count=len(kept))
+        arrays = train_als(
+            u, i, v,
+            n_users=len(td.users),
+            n_items=len(td.items),
+            config=ALSConfig(
+                rank=p.rank,
+                iterations=p.num_iterations,
+                reg=p.lambda_,
+                seed=p.seed if p.seed is not None else 0,
+            ),
+            mesh=ctx.mesh if ctx is not None else None,
+        )
+        return EntityMapALSModel(arrays=arrays, users=td.users, items=td.items)
+
+    def predict(self, model: EntityMapALSModel, query: Query) -> PredictedResult:
+        uix = model.users.get(query.user)
+        if uix is None:
+            logger.info("No prediction for unknown user %s.", query.user)
+            return PredictedResult()
+        num = min(query.num, len(model.items))
+        scores, idx = model.serving.topn_by_user([uix], num)
+        return PredictedResult(
+            item_scores=tuple(
+                ItemScore(item=model.items[int(j)], score=float(s))
+                for j, s in zip(idx[0, :num], scores[0, :num])
+            )
+        )
+
+    def result_to_json(self, result: PredictedResult):
+        return {
+            "itemScores": [
+                {"item": s.item, "score": s.score}
+                for s in result.item_scores
+            ]
+        }
+
+
+def entitymap_recommendation_engine() -> Engine:
+    return Engine(
+        data_source_classes=DataSource,
+        preparator_classes=Preparator,
+        algorithm_classes={"als": ALSAlgorithm},
+        serving_classes=FirstServing,
+    )
+
+
+class EntityMapRecommendationEngineFactory(EngineFactory):
+    def apply(self) -> Engine:
+        return entitymap_recommendation_engine()

@@ -1,0 +1,47 @@
+"""Standalone DIMSUM similar-product example engine.
+
+Reference mapping (examples/experimental/scala-parallel-similarproduct-dimsum/):
+the project is the similarproduct template with its ALS algorithm swapped
+for MLlib's DIMSUM column-similarity (DIMSUMAlgorithm.scala:
+RowMatrix.columnSimilarities(threshold)). This framework implements that
+algorithm inside the similarproduct family
+(models/similarproduct/engine.py DIMSUMAlgorithm — exact cosine via one
+MXU Gram matmul; DIMSUM's sampling approximation exists only because the
+exact Gram matrix is shuffle-bound on a Spark cluster). This module
+assembles it as the standalone engine the reference project ships:
+DataSource/Preparator from the template (DataSource.scala, the dimsum
+project's copies are identical), DIMSUM as the only algorithm
+(Engine.scala: Map("dimsum" -> classOf[DIMSUMAlgorithm])), first-serving
+(Serving.scala).
+"""
+
+from __future__ import annotations
+
+from predictionio_tpu.controller import EngineFactory, FirstServing
+from predictionio_tpu.controller.engine import Engine
+from predictionio_tpu.models.similarproduct.engine import (  # noqa: F401
+    DataSource,
+    DataSourceParams,
+    DIMSUMAlgorithm,
+    DIMSUMAlgorithmParams,
+    Item,
+    ItemScore,
+    PredictedResult,
+    Preparator,
+    Query,
+    TrainingData,
+)
+
+
+def dimsum_engine() -> Engine:
+    return Engine(
+        data_source_classes=DataSource,
+        preparator_classes=Preparator,
+        algorithm_classes={"dimsum": DIMSUMAlgorithm},
+        serving_classes=FirstServing,
+    )
+
+
+class DIMSUMEngineFactory(EngineFactory):
+    def apply(self) -> Engine:
+        return dimsum_engine()

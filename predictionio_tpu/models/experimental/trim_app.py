@@ -1,0 +1,121 @@
+"""Trim-app maintenance "engine": copy a time window of one app's events
+into an empty destination app.
+
+Reference mapping (examples/experimental/scala-parallel-trim-app/):
+- DataSourceParams(srcAppId, dstAppId, startTime, untilTime)
+  <- DataSource.scala:17-22 (app names here — the idiomatic handle in
+  this stack; `app_name_to_id` resolves them like the reference's
+  `--access-key` path resolves ids)
+- readTraining: read src events in [startTime, untilTime), refuse a
+  non-empty destination, write the window to the destination
+  <- DataSource.scala:31-56
+- Algorithm/Model/Serving are deliberate no-ops — the side effect IS the
+  product (Algorithm.scala:14-28); `pio train` is the run button.
+
+The copy streams through the host event store; there is no device work to
+map to the TPU (this example is storage maintenance, not compute).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import datetime as dt
+import logging
+from typing import Optional
+
+from predictionio_tpu.controller import (
+    BaseAlgorithm,
+    BaseDataSource,
+    EngineFactory,
+    FirstServing,
+    Params,
+)
+from predictionio_tpu.controller.engine import Engine
+from predictionio_tpu.data.store import app_name_to_id
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass(frozen=True)
+class Query:
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class PredictedResult:
+    p: str = ""
+
+
+@dataclasses.dataclass
+class TrainingData:
+    copied: int = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class DataSourceParams(Params):
+    src_app_name: str = ""
+    dst_app_name: str = ""
+    start_time: Optional[dt.datetime] = None
+    until_time: Optional[dt.datetime] = None
+
+
+class DataSource(BaseDataSource):
+    """The copy job (reference DataSource.scala:31-56): read the source
+    window, require an empty destination, write."""
+
+    params_class = DataSourceParams
+
+    def read_training(self, ctx) -> TrainingData:
+        p = self.params
+        storage = ctx.storage
+        src_id, _ = app_name_to_id(p.src_app_name, None, storage)
+        dst_id, _ = app_name_to_id(p.dst_app_name, None, storage)
+        events = storage.get_l_events()
+        events.init(dst_id)
+        if next(iter(events.find(app_id=dst_id, limit=1)), None) is not None:
+            # reference DataSource.scala:45-47 — a non-empty destination
+            # aborts rather than mixing two apps' histories
+            raise RuntimeError(
+                f"DstApp {p.dst_app_name!r} is not empty. Quitting."
+            )
+        logger.info("TrimApp: reading events from app %r", p.src_app_name)
+        n = 0
+        for e in events.find(
+            app_id=src_id, start_time=p.start_time, until_time=p.until_time
+        ):
+            events.insert(e, dst_id)
+            n += 1
+        logger.info(
+            "TrimApp: wrote %d events to app %r", n, p.dst_app_name
+        )
+        return TrainingData(copied=n)
+
+
+@dataclasses.dataclass
+class Model:
+    copied: int = 0
+
+
+class Algorithm(BaseAlgorithm):
+    """No-op (reference Algorithm.scala:14-28)."""
+
+    query_class = Query
+
+    def train(self, ctx, td: TrainingData) -> Model:
+        return Model(copied=td.copied)
+
+    def predict(self, model: Model, query: Query) -> PredictedResult:
+        return PredictedResult(p="")
+
+
+def trim_app_engine() -> Engine:
+    return Engine(
+        data_source_classes=DataSource,
+        algorithm_classes={"algo": Algorithm},
+        serving_classes=FirstServing,
+    )
+
+
+class TrimAppEngineFactory(EngineFactory):
+    def apply(self) -> Engine:
+        return trim_app_engine()

@@ -3,6 +3,7 @@
 This package is the in-tree replacement for Spark MLlib's role in the
 reference (SURVEY.md §0): the numerical algorithms engine templates call.
 Everything here is jit/shard_map-compatible JAX with static shapes —
-host-side preprocessing produces padded, bucketed arrays; device code is
+host-side preprocessing produces padded, fixed-width segment arrays;
+device code is
 pure functional XLA programs over a `jax.sharding.Mesh`.
 """

@@ -4,23 +4,32 @@ This is the TPU-native replacement for MLlib ALS
 (`ALS.train` / `ALS.trainImplicit`), which the reference's recommendation
 templates delegate to (examples/scala-parallel-recommendation/custom-query/
 src/main/scala/ALSAlgorithm.scala:66-73). MLlib's implementation exchanges
-rating blocks over Spark shuffles each half-iteration; here the design
-follows the ALX paper's TPU recipe (PAPERS.md — arXiv:2112.02194):
+rating blocks over Spark shuffles each half-iteration; here the ragged
+rating matrix is repacked host-side into a **fixed-width segment layout**
+(ELL-style, in the spirit of the ALX paper's static-shape recipe,
+PAPERS.md — arXiv:2112.02194), chosen over per-density bucketing after
+profiling: a bucket ladder turns each half-iteration into ~40 small
+sequential device ops, each at ~1% utilization, while one packed layout
+runs the whole side as a handful of large ops.
 
-- **Density bucketing (host):** rows (users, then items) are grouped into
-  buckets by observation count; each bucket pads its rows' observation
-  lists to a fixed length. All device shapes are static; the ragged CSR
-  never reaches the accelerator.
-- **Gather + einsum normal equations (device):** for each bucket, gather
-  the counter-side factors ``Yg = Y[cols]`` ([N, L, k]), form per-row
-  Gramian corrections with one einsum ([N, k, k] — MXU work), add the
-  shared Gramian (implicit mode) and regularization, and solve the batched
-  k×k systems with Cholesky.
-- **Sharding:** bucket rows are sharded over the mesh's ``data`` axis;
-  counter-side factors are replicated. The shared Gramian ``YᵀY`` of a
-  row-sharded factor matrix is a sharded matmul whose partial products XLA
-  all-reduces over ICI — the explicit Gramian all-reduce of the ALX/MLlib
-  designs falls out of the sharding annotations.
+- **Segment packing (host, vectorized):** each row's observation list is
+  split into segments of exactly ``L`` slots (short rows pad their single
+  segment; long rows span several segments). All device shapes are
+  static; the ragged CSR never reaches the accelerator, and padding waste
+  is bounded by L per nonempty row.
+- **Gather + einsum normal equations (device):** gather the counter-side
+  factors ``Yg = Y[cols]`` ([S, L, k]) chunk-by-chunk, form per-segment
+  Gramian corrections with one einsum ([S, k, k] — MXU work), and
+  scatter-add segments into per-row systems ``A`` [R, k, k], ``b`` [R, k]
+  (most rows are a single segment). Add the shared Gramian (implicit
+  mode) and regularization, then solve ALL rows with one batched
+  Cholesky. Rows with no observations keep their previous factors.
+- **Sharding:** segments are sharded over the mesh's ``data`` axis;
+  factor/system rows are row-sharded and the counter-side factors
+  replicated for the gather. The shared Gramian ``YᵀY`` of a row-sharded
+  factor matrix is a sharded matmul whose partial products XLA
+  all-reduces over ICI — the explicit Gramian all-reduce of the
+  ALX/MLlib designs falls out of the sharding annotations.
 
 Solves run in float32 (k×k, numerically delicate); gathers/einsums can run
 in bfloat16 with float32 accumulation via ``compute_dtype``.
@@ -33,7 +42,7 @@ import functools
 import hashlib
 import logging
 import math
-from typing import List, Optional, Sequence, Tuple
+from typing import Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -57,7 +66,15 @@ class ALSConfig:
     reg_mode: str = "weighted"
     seed: int = 0
     compute_dtype: str = "float32"  # or "bfloat16" for MXU-rate einsums
-    bucket_sizes: Sequence[int] = (16, 64, 256, 1024, 4096)
+    # MAX slot width of the packed segment layout. Each solve side uses
+    # the smallest power of two >= its mean observation count (min 8,
+    # capped here): sparse sides would otherwise pad every row out to the
+    # full width (e.g. 3 obs/user -> 40x waste at width 128), while dense
+    # sides want wide segments for big einsum chunks.
+    segment_length: int = 128
+    # max gathered slots per device chunk (bounds the [chunk, L, k]
+    # gather buffer; ~4M slots * rank 32 * bf16 = 256 MB)
+    chunk_slots: int = 4_194_304
 
     def __post_init__(self):
         if self.reg_mode not in ("weighted", "plain"):
@@ -65,40 +82,44 @@ class ALSConfig:
 
 
 @dataclasses.dataclass
-class _Bucket:
-    """One padded bucket: rows with ≤ L observations each."""
+class PackedSide:
+    """Host-side fixed-width segment view of one solve side, pre-shaped
+    for the chunked device loop: segment arrays are [C, Sc, L] where
+    C·Sc ≥ #segments and Sc·L ≤ chunk_slots."""
 
-    rows: np.ndarray  # [N] row ids (padding rows = n_rows sentinel)
-    cols: np.ndarray  # [N, L] column ids (padding = 0, masked)
-    vals: np.ndarray  # [N, L] ratings
-    mask: np.ndarray  # [N, L] 1.0 where real
-
-
-@dataclasses.dataclass
-class BucketedSide:
-    """Host-side bucketed view of the rating matrix for one solve side."""
-
-    n_rows: int
-    buckets: List[_Bucket]
+    n_rows: int  # real (unpadded) row count
+    seg_rows: np.ndarray  # [C, Sc] row id of each segment (padding -> n_rows)
+    cols: np.ndarray  # [C, Sc, L] column ids (padding = 0, masked)
+    vals: np.ndarray  # [C, Sc, L] ratings
+    mask: np.ndarray  # [C, Sc, L] uint8, 1 where real (cast on device;
+    # uint8 cuts the host->HBM transfer, which is minutes at 20M scale
+    # through a relayed link)
     counts: np.ndarray  # [n_rows] observation counts
 
+    @property
+    def n_segments(self) -> int:
+        return self.seg_rows.shape[0] * self.seg_rows.shape[1]
 
-def bucketize(
+
+def pack_segments(
     rows: np.ndarray,
     cols: np.ndarray,
     vals: np.ndarray,
     n_rows: int,
-    bucket_sizes: Sequence[int] = (16, 64, 256, 1024, 4096),
-    pad_rows_to: int = 1,
-) -> BucketedSide:
-    """Group rows by observation count into fixed-width padded buckets.
+    segment_length: int = 128,
+    pad_segments_to: int = 1,
+    chunk_slots: int = 4_194_304,
+) -> PackedSide:
+    """Pack COO observations into fixed-width row segments (vectorized).
 
-    Rows with more observations than the largest bucket size get a final
-    bucket sized to the next power of two ≥ the max count. Each bucket's
-    row count is padded to a multiple of ``pad_rows_to`` (the mesh axis
-    size) with sentinel rows (id == n_rows) so the row dimension shards
-    evenly.
+    Each nonempty row occupies ``ceil(count / L)`` consecutive segments of
+    exactly ``L`` slots; the last segment of a row is zero-padded and
+    masked. Padding segments (to fill the [C, Sc] grid and make the
+    segment dim divide ``pad_segments_to``, the mesh axis size) carry the
+    sentinel row id ``n_rows`` so their scatter-add lands in a discarded
+    system row.
     """
+    L = int(segment_length)
     rows = np.asarray(rows, dtype=np.int32)
     cols = np.asarray(cols, dtype=np.int32)
     vals = np.asarray(vals, dtype=np.float32)
@@ -108,104 +129,135 @@ def bucketize(
     starts = np.zeros(n_rows + 1, dtype=np.int64)
     np.cumsum(counts, out=starts[1:])
 
-    sizes = sorted(set(int(s) for s in bucket_sizes))
-    max_count = int(counts.max()) if n_rows else 0
-    if max_count > sizes[-1]:
-        sizes.append(1 << int(math.ceil(math.log2(max(max_count, 2)))))
+    segs_per_row = -(-counts // L)  # ceil; 0 for empty rows
+    seg_base = np.zeros(n_rows + 1, dtype=np.int64)
+    np.cumsum(segs_per_row, out=seg_base[1:])
+    n_segs = int(seg_base[-1])
 
-    # assign each (nonempty) row to the smallest sufficient bucket
-    row_ids_by_bucket: List[List[int]] = [[] for _ in sizes]
-    nonempty = np.nonzero(counts)[0]
-    bucket_of = np.searchsorted(np.asarray(sizes), counts[nonempty])
-    for rid, b in zip(nonempty.tolist(), bucket_of.tolist()):
-        row_ids_by_bucket[b].append(rid)
+    # chunk grid: Sc segments per chunk, Sc*L <= chunk_slots, Sc a
+    # multiple of the shard count so each chunk's segment dim shards
+    # evenly — and no larger than the data needs, so small inputs don't
+    # pad out to the full chunk budget
+    sc = max(1, int(chunk_slots) // L)
+    sc = max(pad_segments_to, sc - sc % pad_segments_to)
+    sc_needed = -(-max(n_segs, 1) // pad_segments_to) * pad_segments_to
+    sc = min(sc, sc_needed)
+    n_chunks = max(1, -(-max(n_segs, 1) // sc))
+    total = n_chunks * sc
 
-    buckets: List[_Bucket] = []
-    for L, rids in zip(sizes, row_ids_by_bucket):
-        if not rids:
-            continue
-        n = len(rids)
-        n_pad = pad_to_multiple(n, pad_rows_to)
-        b_rows = np.full(n_pad, n_rows, dtype=np.int32)
-        b_cols = np.zeros((n_pad, L), dtype=np.int32)
-        b_vals = np.zeros((n_pad, L), dtype=np.float32)
-        b_mask = np.zeros((n_pad, L), dtype=np.float32)
-        for i, rid in enumerate(rids):
-            s, e = starts[rid], starts[rid + 1]
-            c = e - s
-            b_rows[i] = rid
-            b_cols[i, :c] = cols_s[s:e]
-            b_vals[i, :c] = vals_s[s:e]
-            b_mask[i, :c] = 1.0
-        buckets.append(_Bucket(b_rows, b_cols, b_vals, b_mask))
-    return BucketedSide(n_rows=n_rows, buckets=buckets, counts=counts)
+    seg_rows = np.full(total, n_rows, dtype=np.int32)
+    p_cols = np.zeros((total, L), dtype=np.int32)
+    p_vals = np.zeros((total, L), dtype=np.float32)
+    p_mask = np.zeros((total, L), dtype=np.uint8)
+    if len(rows_s):
+        offset = np.arange(len(rows_s), dtype=np.int64) - starts[rows_s]
+        seg_of = seg_base[rows_s] + offset // L
+        slot_of = offset % L
+        flat = seg_of * L + slot_of
+        p_cols.reshape(-1)[flat] = cols_s
+        p_vals.reshape(-1)[flat] = vals_s
+        p_mask.reshape(-1)[flat] = 1
+        seg_rows[:n_segs] = np.repeat(
+            np.arange(n_rows, dtype=np.int32), segs_per_row
+        )
+    return PackedSide(
+        n_rows=n_rows,
+        seg_rows=seg_rows.reshape(n_chunks, sc),
+        cols=p_cols.reshape(n_chunks, sc, L),
+        vals=p_vals.reshape(n_chunks, sc, L),
+        mask=p_mask.reshape(n_chunks, sc, L),
+        counts=counts,
+    )
 
 
 # --- device kernels ---
 
 
-def _solve_bucket(
-    X: jax.Array,  # [n_rows+1, k] factor matrix being solved (row-sharded)
-    Y: jax.Array,  # [n_cols(+1), k] counter-side factors (replicated)
-    G: jax.Array,  # [k, k] shared Gramian YᵀY (implicit) or zeros
-    rows: jax.Array,  # [N]
-    cols: jax.Array,  # [N, L]
-    vals: jax.Array,  # [N, L]
-    mask: jax.Array,  # [N, L]
-    reg: float,
-    alpha: float,
+def _accumulate_systems(
+    Y: jax.Array,  # [n_cols(+pad), k] counter-side factors (replicated)
+    seg_rows: jax.Array,  # [C, Sc]
+    cols: jax.Array,  # [C, Sc, L]
+    vals: jax.Array,  # [C, Sc, L]
+    mask: jax.Array,  # [C, Sc, L]
+    alpha,
+    n_sys_rows: int,
     *,
     implicit: bool,
-    weighted_reg: bool,
     compute_dtype: str,
-) -> jax.Array:
+) -> Tuple[jax.Array, jax.Array]:
+    """Per-row normal-equation systems A [R, k, k], b [R, k] from the
+    packed segments: a fori_loop over chunks, each chunk ONE gather + two
+    einsums + a scatter-add. The chunk loop bounds the [Sc, L, k] gather
+    buffer; the einsums are the MXU work."""
     k = Y.shape[-1]
     cdt = jnp.dtype(compute_dtype)
     # float32 inputs ask for full-precision MXU passes; bfloat16 trades
     # precision for MXU rate explicitly via compute_dtype
     prec = "highest" if cdt == jnp.float32 else "default"
-    Yg = Y[cols].astype(cdt)  # [N, L, k] gather from HBM
-    n_obs = mask.sum(-1)  # [N]
+    A0 = jnp.zeros((n_sys_rows, k, k), jnp.float32)
+    b0 = jnp.zeros((n_sys_rows, k), jnp.float32)
+
+    def body(c, carry):
+        A, b = carry
+        rows_c = jax.lax.dynamic_index_in_dim(seg_rows, c, keepdims=False)
+        cols_c = jax.lax.dynamic_index_in_dim(cols, c, keepdims=False)
+        vals_c = jax.lax.dynamic_index_in_dim(vals, c, keepdims=False)
+        mask_c = jax.lax.dynamic_index_in_dim(mask, c, keepdims=False)
+        Yg = Y[cols_c].astype(cdt)  # [Sc, L, k] gather from HBM
+        if implicit:
+            # MLlib trainImplicit semantics (Hu-Koren-Volinsky):
+            # confidence c = alpha·|r| (non-negative — keeps A
+            # positive-definite even for dislike ratings r<0, e.g.
+            # similarproduct LikeAlgorithm's -1); preference p = 1(r>0).
+            # A = G + Σ c·y yᵀ ; b = Σ p·(1+c)·y, so a dislike contributes
+            # confidence to A but nothing to b.
+            aw = (alpha * jnp.abs(vals_c) * mask_c).astype(cdt)
+            pref = (vals_c > 0).astype(jnp.float32) * mask_c
+            bw = (pref * (1.0 + alpha * jnp.abs(vals_c))).astype(cdt)
+        else:
+            # A = Σ y yᵀ over observed ; b = Σ r·y
+            aw = mask_c.astype(cdt)
+            bw = (vals_c * mask_c).astype(cdt)
+        A_seg = jnp.einsum(
+            "slk,sl,slj->skj", Yg, aw, Yg,
+            preferred_element_type=jnp.float32, precision=prec,
+        )
+        b_seg = jnp.einsum(
+            "slk,sl->sk", Yg, bw,
+            preferred_element_type=jnp.float32, precision=prec,
+        )
+        # most rows are one segment; multi-segment rows combine here
+        return A.at[rows_c].add(A_seg), b.at[rows_c].add(b_seg)
+
+    return jax.lax.fori_loop(0, seg_rows.shape[0], body, (A0, b0))
+
+
+def _solve_side(
+    X_prev: jax.Array,  # [R, k] previous factors (kept for zero-obs rows)
+    Y: jax.Array,  # [n_cols(+pad), k] counter-side factors
+    G: jax.Array,  # [k, k] shared Gramian YᵀY (implicit) or zeros
+    pack,  # (seg_rows, cols, vals, mask) pre-shaped [C, Sc(, L)]
+    lam: jax.Array,  # [R] per-row regularizer (precomputed, guarded > 0)
+    has_obs: jax.Array,  # [R] bool — rows with at least one observation
+    alpha,
+    *,
+    implicit: bool,
+    compute_dtype: str,
+) -> jax.Array:
+    k = Y.shape[-1]
+    seg_rows, cols, vals, mask = pack
+    A, b = _accumulate_systems(
+        Y, seg_rows, cols, vals, mask, alpha, X_prev.shape[0],
+        implicit=implicit, compute_dtype=compute_dtype,
+    )
     if implicit:
-        # MLlib trainImplicit semantics (Hu-Koren-Volinsky): confidence
-        # c = alpha·|r| (non-negative — keeps A positive-definite even for
-        # dislike ratings r<0, e.g. similarproduct LikeAlgorithm's -1);
-        # preference p = 1(r>0). A = G + Σ c·y yᵀ ; b = Σ p·(1+c)·y, so a
-        # dislike contributes confidence to A but nothing to b.
-        c = (alpha * jnp.abs(vals) * mask).astype(cdt)
-        A = G + jnp.einsum(
-            "nlk,nl,nlj->nkj", Yg, c, Yg,
-            preferred_element_type=jnp.float32, precision=prec,
-        )
-        pref = (vals > 0).astype(jnp.float32) * mask
-        b = jnp.einsum(
-            "nlk,nl->nk",
-            Yg,
-            (pref * (1.0 + alpha * jnp.abs(vals))).astype(cdt),
-            preferred_element_type=jnp.float32, precision=prec,
-        )
-    else:
-        # A = Σ y yᵀ over observed ; b = Σ r·y
-        A = jnp.einsum(
-            "nlk,nl,nlj->nkj",
-            Yg,
-            mask.astype(cdt),
-            Yg,
-            preferred_element_type=jnp.float32, precision=prec,
-        )
-        b = jnp.einsum(
-            "nlk,nl->nk",
-            Yg,
-            (vals * mask).astype(cdt),
-            preferred_element_type=jnp.float32, precision=prec,
-        )
-    lam = reg * n_obs if weighted_reg else jnp.full_like(n_obs, reg)
-    # guard all-padding rows against singular systems
-    lam = jnp.maximum(lam, 1e-8)
+        A = A + G[None]
     A = A + lam[:, None, None] * jnp.eye(k, dtype=jnp.float32)
+    # ONE batched Cholesky over every row's k x k system
     x = jax.scipy.linalg.cho_solve(jax.scipy.linalg.cho_factor(A), b)
-    # scatter solved rows into X; sentinel rows land in the padding row
-    return X.at[rows].set(x.astype(X.dtype))
+    # rows with no observations keep their previous factors (MLlib only
+    # materializes factors for observed ids; init survives here)
+    return jnp.where(has_obs[:, None], x.astype(X_prev.dtype), X_prev)
 
 
 @jax.jit
@@ -230,51 +282,52 @@ def _constrain(a: jax.Array, sharding) -> jax.Array:
 @functools.partial(
     jax.jit,
     static_argnames=(
-        "implicit", "weighted_reg", "compute_dtype",
-        "rep_sharding", "row_sharding",
+        "implicit", "compute_dtype", "rep_sharding", "row_sharding",
     ),
     donate_argnums=(0, 1),
 )
 def _run_iterations(
     X: jax.Array,
     Y: jax.Array,
-    user_buckets,  # tuple of (rows, cols, vals, mask) tuples
-    item_buckets,
-    reg: float,
-    alpha: float,
+    user_pack,  # (seg_rows, cols, vals, mask) each [C, Sc(, L)]
+    item_pack,
+    user_lam: jax.Array,  # [R_u] per-row regularizer
+    item_lam: jax.Array,  # [R_i]
+    user_has_obs: jax.Array,  # [R_u] bool
+    item_has_obs: jax.Array,  # [R_i]
+    alpha,
     n_iters: jax.Array,  # dynamic: one compile serves every chunk size
     *,
     implicit: bool,
-    weighted_reg: bool,
     compute_dtype: str,
     rep_sharding,  # NamedSharding(P()) or None — replicate for gathers
     row_sharding,  # NamedSharding(P(axis)) or None
 ) -> Tuple[jax.Array, jax.Array]:
     """The whole training loop as ONE XLA program: lax.fori_loop over
-    iterations with the (static) bucket structure unrolled inside the
-    body. One dispatch covers all iterations — no host round trip per
-    half-step, factors never leave HBM, and the replicate/shard handoffs
-    become compiled all-gathers instead of per-step device_puts. The trip
-    count is a runtime value so warm-up, checkpoint chunks, and resumes
-    all reuse the same executable."""
+    iterations, each half-iteration a chunked gather/einsum accumulation
+    plus one batched solve. One dispatch covers all iterations — no host
+    round trip per half-step, factors never leave HBM, and the
+    replicate/shard handoffs become compiled all-gathers instead of
+    per-step device_puts. The trip count is a runtime value so warm-up,
+    checkpoint chunks, and resumes all reuse the same executable. The
+    regularizer (with reg and, in weighted mode, per-row counts baked in)
+    arrives as data, so sweeping reg reuses the executable too."""
     k = X.shape[-1]
     zeros_g = jnp.zeros((k, k), jnp.float32)
 
-    def half(X, Y, buckets):
+    def half(X, Y, pack, lam, has_obs):
         G = _gramian(Y) if implicit else zeros_g
         Y_rep = _constrain(Y, rep_sharding)
-        for rows, cols, vals, mask in buckets:
-            X = _solve_bucket(
-                X, Y_rep, G, rows, cols, vals, mask, reg, alpha,
-                implicit=implicit, weighted_reg=weighted_reg,
-                compute_dtype=compute_dtype,
-            )
+        X = _solve_side(
+            X, Y_rep, G, pack, lam, has_obs, alpha,
+            implicit=implicit, compute_dtype=compute_dtype,
+        )
         return _constrain(X, row_sharding)
 
     def body(_, carry):
         X, Y = carry
-        X = half(X, Y, user_buckets)
-        Y = half(Y, X, item_buckets)
+        X = half(X, Y, user_pack, user_lam, user_has_obs)
+        Y = half(Y, X, item_pack, item_lam, item_has_obs)
         return (X, Y)
 
     return jax.lax.fori_loop(0, n_iters, body, (X, Y))
@@ -284,6 +337,16 @@ def _place(mesh: Optional[Mesh], arr, spec):
     if mesh is None:
         return jnp.asarray(arr)
     return jax.device_put(arr, NamedSharding(mesh, spec))
+
+
+def _sync_fetch(tree) -> None:
+    """Force device work to completion for phase timing: on relayed
+    backends ``block_until_ready`` can return before execution finishes,
+    so fetch results through the real transfer path. Callers pass SMALL
+    arrays only — a scalar-index fence would jit a fresh tiny executable
+    per shape, which costs seconds through a relayed backend."""
+    for a in jax.tree_util.tree_leaves(tree):
+        jax.device_get(a)
 
 
 @dataclasses.dataclass
@@ -306,36 +369,69 @@ def train_als(
     axis: str = "data",
     checkpoint_dir: Optional[str] = None,
     checkpoint_every: int = 5,
+    timings: Optional[dict] = None,
 ) -> ALSModelArrays:
     """Train ALS factors from COO ratings.
 
-    With a mesh, bucket rows are sharded over ``axis`` and counter-side
-    factors replicated; each half-iteration's Gramian + factor handoff
-    generates the all-reduce/all-gather pattern over ICI.
+    With a mesh, packed segments and factor rows are sharded over
+    ``axis`` and counter-side factors replicated; each half-iteration's
+    Gramian + factor handoff generates the all-reduce/all-gather pattern
+    over ICI.
 
     With ``checkpoint_dir``, factor state saves every ``checkpoint_every``
     iterations and training resumes from the latest step after an
     interruption (mid-training checkpoint/resume — absent in the
     reference, SURVEY.md §5).
+
+    ``timings``, if given, receives a phase breakdown: ``pack_s``,
+    ``device_put_s``, ``compile_s`` (a zero-iteration run that builds the
+    executable before the timed loop — the trip count is dynamic, so the
+    real run reuses it), ``device_loop_s`` (accumulated across checkpoint
+    chunks when checkpointing), and ``padded_slots`` (total segment-grid
+    slots both sides, the denominator for hardware-busyness numbers). At
+    ML-20M scale host prep and the ~1 GB HBM transfer are distinct from
+    the on-device solve loop, and MFU must be computed against the latter.
     """
+    import time as _time
+
     k = config.rank
     n_shards = mesh.shape[axis] if mesh is not None else 1
-    user_side = bucketize(
-        user_idx, item_idx, ratings, n_users, config.bucket_sizes, n_shards
+
+    def auto_segment_length(idx, n_rows: int) -> int:
+        # smallest power of two >= the side's mean observation count,
+        # within [8, config.segment_length] — see ALSConfig.segment_length
+        nonempty = int((np.bincount(idx, minlength=n_rows) > 0).sum())
+        if nonempty == 0:
+            return 8
+        mean = len(idx) / nonempty
+        L = 8
+        while L < config.segment_length and L < mean:
+            L *= 2
+        return L
+
+    t_phase = _time.perf_counter()
+    user_side = pack_segments(
+        user_idx, item_idx, ratings, n_users,
+        auto_segment_length(user_idx, n_users), n_shards, config.chunk_slots,
     )
-    item_side = bucketize(
-        item_idx, user_idx, ratings, n_items, config.bucket_sizes, n_shards
+    item_side = pack_segments(
+        item_idx, user_idx, ratings, n_items,
+        auto_segment_length(item_idx, n_items), n_shards, config.chunk_slots,
     )
+    if timings is not None:
+        timings["pack_s"] = _time.perf_counter() - t_phase
     logger.info(
-        "ALS: %d users (%d buckets), %d items (%d buckets), %d ratings, rank %d",
-        n_users, len(user_side.buckets), n_items, len(item_side.buckets),
+        "ALS: %d users (%d segments of %d), %d items (%d segments of %d), "
+        "%d ratings, rank %d",
+        n_users, user_side.n_segments, user_side.cols.shape[2],
+        n_items, item_side.n_segments, item_side.cols.shape[2],
         len(ratings), k,
     )
 
     rng = np.random.default_rng(config.seed)
 
     def padded_rows(n: int) -> int:
-        # +1 sentinel row for bucket padding, rounded up so the row dim
+        # +1 sentinel row for segment padding, rounded up so the row dim
         # shards evenly over the mesh
         return pad_to_multiple(n + 1, n_shards)
 
@@ -345,37 +441,72 @@ def train_als(
     Y0[:n_items] = np.abs(rng.standard_normal((n_items, k))) / math.sqrt(k)
     rep = P()
     row_sharded = P(axis) if mesh is not None else P()
+    # segment arrays are [C, Sc(, L)]; the segment dim (Sc, a multiple of
+    # the shard count) shards over the mesh axis, the chunk dim C is the
+    # device-loop trip dim and stays unsharded
+    seg_sharded2 = P(None, axis) if mesh is not None else P()
+    seg_sharded3 = P(None, axis, None) if mesh is not None else P()
     X = _place(mesh, np.zeros((padded_rows(n_users), k), np.float32), row_sharded)
     Y = _place(mesh, Y0, row_sharded)
 
-    def put_side(side: BucketedSide):
-        out = []
-        for b in side.buckets:
-            out.append(
-                (
-                    _place(mesh, b.rows, row_sharded),
-                    _place(mesh, b.cols, row_sharded),
-                    _place(mesh, b.vals, row_sharded),
-                    _place(mesh, b.mask, row_sharded),
-                )
-            )
-        return out
+    weighted = config.reg_mode == "weighted"
 
-    user_buckets = tuple(put_side(user_side))
-    item_buckets = tuple(put_side(item_side))
+    def lam_and_obs(side: PackedSide, n_sys_rows: int):
+        counts = np.zeros(n_sys_rows, np.float32)
+        counts[: side.n_rows] = side.counts
+        lam = config.reg * counts if weighted else np.full_like(counts, config.reg)
+        # guard zero-count/padding rows against singular systems (their
+        # solutions are discarded by the has_obs select anyway)
+        lam = np.maximum(lam, 1e-8).astype(np.float32)
+        return (
+            _place(mesh, lam, row_sharded),
+            _place(mesh, counts > 0, row_sharded),
+        )
+
+    def put_pack(side: PackedSide):
+        return (
+            _place(mesh, side.seg_rows, seg_sharded2),
+            _place(mesh, side.cols, seg_sharded3),
+            _place(mesh, side.vals, seg_sharded3),
+            _place(mesh, side.mask, seg_sharded3),
+        )
+
+    t_phase = _time.perf_counter()
+    user_pack = put_pack(user_side)
+    item_pack = put_pack(item_side)
+    user_lam, user_has_obs = lam_and_obs(user_side, X.shape[0])
+    item_lam, item_has_obs = lam_and_obs(item_side, Y.shape[0])
+    if timings is not None:
+        # the has_obs arrays were enqueued last; fetching them (small)
+        # fences the serialized transfer queue behind the ~GB pack arrays
+        _sync_fetch((user_has_obs, item_has_obs))
+        timings["device_put_s"] = _time.perf_counter() - t_phase
+        timings["padded_slots"] = (
+            user_side.n_segments * user_side.cols.shape[2]
+            + item_side.n_segments * item_side.cols.shape[2]
+        )
     rep_sharding = NamedSharding(mesh, rep) if mesh is not None else None
     row_sharding = NamedSharding(mesh, row_sharded) if mesh is not None else None
 
     def run_iters(X, Y, n_iters: int):
         return _run_iterations(
-            X, Y, user_buckets, item_buckets, config.reg, config.alpha,
-            jnp.int32(n_iters),
+            X, Y, user_pack, item_pack,
+            user_lam, item_lam, user_has_obs, item_has_obs,
+            config.alpha, jnp.int32(n_iters),
             implicit=config.implicit_prefs,
-            weighted_reg=(config.reg_mode == "weighted"),
             compute_dtype=config.compute_dtype,
             rep_sharding=rep_sharding,
             row_sharding=row_sharding,
         )
+
+    if timings is not None:
+        # compile outside the timed loop: a ZERO-iteration run builds the
+        # same executable the real run reuses (dynamic trip count).
+        # Donation consumes its inputs, so feed it copies of the factor
+        # arrays (cheap HBM-side copies).
+        t_phase = _time.perf_counter()
+        _sync_fetch(run_iters(X + 0, Y + 0, 0))
+        timings["compile_s"] = _time.perf_counter() - t_phase
 
     from predictionio_tpu.workflow.checkpoint import StepCheckpointer
 
@@ -426,13 +557,23 @@ def train_als(
         if not ckpt.enabled:
             # the entire loop is one device program
             if config.iterations > start_it:
+                t_phase = _time.perf_counter()
                 X, Y = run_iters(X, Y, config.iterations - start_it)
+                if timings is not None:
+                    _sync_fetch((X, Y))
+                    timings["device_loop_s"] = _time.perf_counter() - t_phase
         else:
             # chunk the fused loop at the checkpoint cadence
             it = start_it
             while it < config.iterations:
                 chunk = min(checkpoint_every, config.iterations - it)
+                t_phase = _time.perf_counter()
                 X, Y = run_iters(X, Y, chunk)
+                if timings is not None:
+                    _sync_fetch((X, Y))
+                    timings["device_loop_s"] = timings.get(
+                        "device_loop_s", 0.0
+                    ) + (_time.perf_counter() - t_phase)
                 it += chunk
                 logger.debug(
                     "ALS iteration %d/%d done", it, config.iterations

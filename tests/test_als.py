@@ -1,5 +1,5 @@
 """ALS kernel tests: exact normal-equation parity vs a numpy reference,
-convergence on a synthetic low-rank matrix, implicit mode, bucketing
+convergence on a synthetic low-rank matrix, implicit mode, segment-packing
 edge cases, and mesh-sharded execution on the virtual 8-device CPU mesh.
 """
 
@@ -8,7 +8,7 @@ import pytest
 
 from predictionio_tpu.ops.als import (
     ALSConfig,
-    bucketize,
+    pack_segments,
     predict_ratings,
     recommend_batch,
     rmse,
@@ -28,38 +28,52 @@ def synthetic(n_users=60, n_items=40, k=4, density=0.4, seed=1, noise=0.0):
     return u.astype(np.int32), i.astype(np.int32), r.astype(np.float32)
 
 
-class TestBucketize:
-    def test_buckets_cover_all_ratings(self):
+class TestPackSegments:
+    def test_segments_cover_all_ratings(self):
         u, i, r = synthetic()
-        side = bucketize(u, i, r, 60, bucket_sizes=(4, 16, 64), pad_rows_to=8)
-        total = sum(int(b.mask.sum()) for b in side.buckets)
-        assert total == len(u)
-        for b in side.buckets:
-            assert b.rows.shape[0] % 8 == 0
-            # all real rows' data reconstructs the original per-row sets
-            for j, rid in enumerate(b.rows):
-                if rid == 60:
-                    assert b.mask[j].sum() == 0
-                    continue
-                n = int(b.mask[j].sum())
-                expect = set(i[u == rid].tolist())
-                assert set(b.cols[j, :n].tolist()) == expect
+        L = 8
+        side = pack_segments(u, i, r, 60, segment_length=L, pad_segments_to=8)
+        assert int(side.mask.sum()) == len(u)
+        assert side.seg_rows.shape[1] % 8 == 0  # shards evenly
+        seg_rows = side.seg_rows.reshape(-1)
+        cols = side.cols.reshape(-1, L)
+        vals = side.vals.reshape(-1, L)
+        mask = side.mask.reshape(-1, L)
+        for rid in range(60):
+            sel = seg_rows == rid
+            got_cols = cols[sel][mask[sel] > 0]
+            expect = i[u == rid]
+            assert sorted(got_cols.tolist()) == sorted(expect.tolist())
+            # values travel with their columns
+            got = dict(zip(got_cols.tolist(), vals[sel][mask[sel] > 0].tolist()))
+            for cc, vv in zip(expect.tolist(), r[u == rid].tolist()):
+                assert got[cc] == pytest.approx(vv)
 
-    def test_huge_row_gets_oversize_bucket(self):
+    def test_long_row_spans_consecutive_segments(self):
         u = np.zeros(100, np.int32)
         i = np.arange(100, dtype=np.int32)
         r = np.ones(100, np.float32)
-        side = bucketize(u, i, r, 1, bucket_sizes=(4, 16))
-        assert len(side.buckets) == 1
-        assert side.buckets[0].cols.shape[1] >= 100
+        side = pack_segments(u, i, r, 1, segment_length=16)
+        seg_rows = side.seg_rows.reshape(-1)
+        assert int((seg_rows == 0).sum()) == 7  # 6 full + 1 partial
+        assert int(side.mask.sum()) == 100
 
-    def test_empty_rows_skipped(self):
+    def test_empty_rows_get_no_segments(self):
         u = np.array([5], np.int32)
         i = np.array([0], np.int32)
         r = np.array([1.0], np.float32)
-        side = bucketize(u, i, r, 10, bucket_sizes=(4,))
-        assert sum(b.rows.shape[0] for b in side.buckets) >= 1
+        side = pack_segments(u, i, r, 10, segment_length=4)
+        seg_rows = side.seg_rows.reshape(-1)
+        assert int((seg_rows == 5).sum()) == 1
         assert side.counts[5] == 1 and side.counts.sum() == 1
+        # every other segment is padding, pointing at the sentinel row
+        assert (seg_rows[seg_rows != 5] == 10).all()
+
+    def test_chunk_grid_bounds_slots(self):
+        u, i, r = synthetic()
+        side = pack_segments(u, i, r, 60, segment_length=8, chunk_slots=64)
+        assert side.cols.shape[1] * side.cols.shape[2] <= 64
+        assert int(side.mask.sum()) == len(u)
 
 
 def numpy_als_half_step(Y, u, i, r, n_users, reg, weighted):
@@ -82,7 +96,7 @@ def numpy_als_half_step(Y, u, i, r, n_users, reg, weighted):
 class TestExplicitALS:
     def test_single_half_step_matches_numpy(self):
         u, i, r = synthetic(n_users=30, n_items=20, seed=2)
-        cfg = ALSConfig(rank=4, iterations=1, reg=0.1, bucket_sizes=(4, 16, 64))
+        cfg = ALSConfig(rank=4, iterations=1, reg=0.1, segment_length=8)
         model = train_als(u, i, r, 30, 20, cfg)
         # after iter 1: X solved against Y0; recompute X from returned Y? No —
         # instead verify the fixpoint property on a fresh solve: the returned

@@ -1,0 +1,475 @@
+"""Tests for the round-3 experimental example engines: trim-app,
+recommendation-entitymap, friend recommendation (keyword sim + random +
+SimRank), sliding-window MovieLens evaluation, and the standalone DIMSUM
+engine assembly."""
+
+import datetime as dt
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.data.event import DataMap, Event
+from predictionio_tpu.data.storage.base import App
+from predictionio_tpu.workflow.context import WorkflowContext
+
+UTC = dt.timezone.utc
+
+
+def make_app(storage, name):
+    aid = storage.get_meta_data_apps().insert(App(id=0, name=name))
+    storage.get_l_events().init(aid)
+    return aid
+
+
+class TestTrimApp:
+    def test_copies_window_into_empty_dst(self, mem_storage):
+        from predictionio_tpu.models.experimental.trim_app import (
+            DataSource,
+            DataSourceParams,
+        )
+
+        src = make_app(mem_storage, "src")
+        make_app(mem_storage, "dst")
+        events = mem_storage.get_l_events()
+        for day in range(10):
+            events.insert(
+                Event(
+                    event="rate", entity_type="user", entity_id=f"u{day}",
+                    target_entity_type="item", target_entity_id="i0",
+                    properties=DataMap({"rating": 3.0}),
+                    event_time=dt.datetime(2014, 1, 1 + day, tzinfo=UTC),
+                ),
+                src,
+            )
+        ctx = WorkflowContext(mode="training", storage=mem_storage)
+        td = DataSource(
+            DataSourceParams(
+                src_app_name="src",
+                dst_app_name="dst",
+                start_time=dt.datetime(2014, 1, 3, tzinfo=UTC),
+                until_time=dt.datetime(2014, 1, 7, tzinfo=UTC),
+            )
+        ).read_training(ctx)
+        assert td.copied == 4  # days 3,4,5,6
+        from predictionio_tpu.data.store import app_name_to_id
+
+        dst_id, _ = app_name_to_id("dst", None, mem_storage)
+        copied = list(events.find(app_id=dst_id))
+        assert len(copied) == 4
+        assert {e.entity_id for e in copied} == {"u2", "u3", "u4", "u5"}
+
+    def test_nonempty_dst_aborts(self, mem_storage):
+        from predictionio_tpu.models.experimental.trim_app import (
+            DataSource,
+            DataSourceParams,
+        )
+
+        src = make_app(mem_storage, "src")
+        dst = make_app(mem_storage, "dst")
+        events = mem_storage.get_l_events()
+        for app_id in (src, dst):
+            events.insert(
+                Event(event="$set", entity_type="user", entity_id="u0"),
+                app_id,
+            )
+        ctx = WorkflowContext(mode="training", storage=mem_storage)
+        with pytest.raises(RuntimeError, match="not empty"):
+            DataSource(
+                DataSourceParams(src_app_name="src", dst_app_name="dst")
+            ).read_training(ctx)
+
+
+class TestEntityMapRecommendation:
+    @pytest.fixture()
+    def setup(self, mem_storage):
+        app_id = make_app(mem_storage, "default")
+        events = mem_storage.get_l_events()
+        for u in range(12):
+            events.insert(
+                Event(
+                    event="$set", entity_type="user", entity_id=f"u{u}",
+                    properties=DataMap(
+                        {"attr0": 1.5, "attr1": u, "attr2": 2 * u}
+                    ),
+                ),
+                app_id,
+            )
+        # one user missing required attributes -> excluded from the map
+        events.insert(
+            Event(
+                event="$set", entity_type="user", entity_id="incomplete",
+                properties=DataMap({"attr0": 0.0}),
+            ),
+            app_id,
+        )
+        for i in range(8):
+            events.insert(
+                Event(
+                    event="$set", entity_type="item", entity_id=f"i{i}",
+                    properties=DataMap(
+                        {"attrA": f"a{i}", "attrB": i, "attrC": i % 2 == 0}
+                    ),
+                ),
+                app_id,
+            )
+        # sharp two-block structure: love the own group, hate a slice of
+        # the other, so in-group recommendations clearly dominate
+        for u in range(12):
+            own = 0 if u % 2 == 0 else 4
+            other = 4 - own
+            ratings = [(own + i, 5.0) for i in range(4)] + [
+                (other, 1.0), (other + 1, 1.0)
+            ]
+            for item, value in ratings:
+                events.insert(
+                    Event(
+                        event="rate", entity_type="user", entity_id=f"u{u}",
+                        target_entity_type="item",
+                        target_entity_id=f"i{item}",
+                        properties=DataMap({"rating": value}),
+                    ),
+                    app_id,
+                )
+        # a buy event maps to rating 4.0 (from a user without $set
+        # attributes: it must surface in TrainingData.ratings but be
+        # dropped at train time for lack of an EntityMap row)
+        events.insert(
+            Event(
+                event="buy", entity_type="user", entity_id="buyer",
+                target_entity_type="item", target_entity_id="i0",
+            ),
+            app_id,
+        )
+        return mem_storage
+
+    def test_train_and_predict_through_entity_maps(self, setup):
+        from predictionio_tpu.models.experimental.recommendation_entitymap import (
+            ALSAlgorithm,
+            ALSAlgorithmParams,
+            DataSource,
+            DataSourceParams,
+            Preparator,
+            Query,
+            User,
+        )
+
+        ctx = WorkflowContext(mode="training", storage=setup)
+        td = DataSource(DataSourceParams(app_name="default")).read_training(ctx)
+        assert len(td.users) == 12  # "incomplete" dropped by required=
+        assert len(td.items) == 8
+        assert td.users.data("u3") == User(attr0=1.5, attr1=3, attr2=6)
+        buys = [r for r in td.ratings if r.user == "buyer"]
+        assert buys and buys[0].rating == 4.0 and buys[0].item == "i0"
+
+        pd = Preparator().prepare(ctx, td)
+        algo = ALSAlgorithm(
+            ALSAlgorithmParams(rank=4, num_iterations=8, lambda_=0.05)
+        )
+        model = algo.train(ctx, pd)
+        res = algo.predict(model, Query(user="u2", num=3))
+        assert len(res.item_scores) == 3
+        # even-group users rate i0..i3; recommendations stay in-group
+        assert all(
+            int(s.item[1:]) < 4 for s in res.item_scores
+        ), res.item_scores
+
+        assert algo.predict(model, Query(user="ghost")).item_scores == ()
+
+
+class TestKeywordSimilarity:
+    @pytest.fixture()
+    def files(self, tmp_path):
+        # reference file formats (FriendRecommendationDataSource.scala)
+        (tmp_path / "items.txt").write_text(
+            "101 1 7;8;9\n102 1 8\n"
+        )
+        (tmp_path / "users.txt").write_text(
+            "11 7:0.5;8:1.0\n12 3:2.0\n"
+        )
+        (tmp_path / "actions.txt").write_text(
+            "11 12 1 0 1\n11 99 1 1 1\n"
+        )
+        return tmp_path
+
+    def test_reads_and_scores(self, files):
+        from predictionio_tpu.models.experimental.friend_recommendation import (
+            DataSourceParams,
+            FriendRecommendationDataSource,
+            KeywordSimilarityAlgorithm,
+            Prediction,
+            Query,
+        )
+
+        ds = FriendRecommendationDataSource(
+            DataSourceParams(
+                item_file_path=str(files / "items.txt"),
+                user_keyword_file_path=str(files / "users.txt"),
+                user_action_file_path=str(files / "actions.txt"),
+            )
+        )
+        td = ds.read_training(None)
+        assert td.user_id_map == {11: 0, 12: 1}
+        assert td.item_keyword[0] == {7: 1.0, 8: 1.0, 9: 1.0}
+        # action row with unknown user 99 is dropped; weight = 1+0+1
+        assert td.social_action[0] == [(1, 2)]
+
+        algo = KeywordSimilarityAlgorithm()
+        model = algo.train(None, td)
+        # user 11 x item 101: 0.5*1.0 + 1.0*1.0 = 1.5 >= threshold 1.0
+        p = algo.predict(model, Query(user=11, item=101))
+        assert p == Prediction(confidence=1.5, acceptance=True)
+        # user 12 shares no keywords with item 102
+        p = algo.predict(model, Query(user=12, item=102))
+        assert p.confidence == 0.0 and not p.acceptance
+        # unknown ids -> 0 confidence
+        assert algo.predict(model, Query(user=99, item=101)).confidence == 0.0
+
+    def test_random_baseline_seeded(self, files):
+        from predictionio_tpu.models.experimental.friend_recommendation import (
+            DataSourceParams,
+            FriendRecommendationDataSource,
+            Query,
+            RandomAlgoParams,
+            RandomAlgorithm,
+        )
+
+        ds = FriendRecommendationDataSource(
+            DataSourceParams(
+                item_file_path=str(files / "items.txt"),
+                user_keyword_file_path=str(files / "users.txt"),
+                user_action_file_path=str(files / "actions.txt"),
+            )
+        )
+        td = ds.read_training(None)
+        algo = RandomAlgorithm(RandomAlgoParams(seed=7))
+        model = algo.train(None, td)
+        q = Query(user=11, item=101)
+        p1, p2 = algo.predict(model, q), algo.predict(model, q)
+        assert p1 == p2  # seeded -> reproducible
+        assert 0.0 <= p1.confidence <= 1.0
+        assert p1.acceptance == (p1.confidence >= 0.5)
+
+
+def numpy_simrank(out_adj, n, iters, decay):
+    """Independent pair-based SimRank with the reference's out-neighbor
+    semantics (DeltaSimRankRDD.calculateNthIter propagates pair deltas to
+    out-neighbor pairs / outdegree products)."""
+    S = np.eye(n)
+    for _ in range(iters):
+        S2 = np.eye(n)
+        for x in range(n):
+            for y in range(n):
+                if x == y:
+                    continue
+                ox, oy = out_adj[x], out_adj[y]
+                if ox and oy:
+                    s = sum(S[a, b] for a in ox for b in oy)
+                    S2[x, y] = decay * s / (len(ox) * len(oy))
+        S = S2
+    return S
+
+
+class TestSimRank:
+    def test_matches_pairwise_reference(self, tmp_path):
+        from predictionio_tpu.models.experimental.friend_recommendation import (
+            SimRankAlgorithm,
+            SimRankDataSource,
+            SimRankDataSourceParams,
+            SimRankQuery,
+        )
+
+        # 0 and 1 both point at {2, 3}; 4 points at 3 only
+        edges = [(0, 2), (0, 3), (1, 2), (1, 3), (4, 3), (2, 4), (3, 4)]
+        path = tmp_path / "graph.txt"
+        path.write_text("".join(f"{s} {d}\n" for s, d in edges))
+        td = SimRankDataSource(
+            SimRankDataSourceParams(graph_edgelist_path=str(path))
+        ).read_training(None)
+        assert td.n_vertices == 5
+
+        algo = SimRankAlgorithm()
+        model = algo.train(None, td)
+
+        out_adj = [[] for _ in range(5)]
+        for s, d in td.edges:
+            out_adj[s].append(int(d))
+        expect = numpy_simrank(out_adj, 5, algo.params.num_iterations, 0.8)
+        np.testing.assert_allclose(model.scores, expect, rtol=1e-5, atol=1e-6)
+        # hand-derived fixpoint: O(2)=O(3)={4} -> s(2,3)=decay=0.8, and
+        # s(0,1)=0.8*(s22+s23+s32+s33)/4 = 0.8*3.6/4 = 0.72
+        s23 = algo.predict(model, SimRankQuery(item1=2, item2=3))
+        s01 = algo.predict(model, SimRankQuery(item1=0, item2=1))
+        assert s23 == pytest.approx(0.8, abs=1e-5)
+        assert s01 == pytest.approx(0.72, abs=1e-5)
+
+    def test_sampling_datasources_shrink_edges(self, tmp_path):
+        from predictionio_tpu.models.experimental.friend_recommendation import (
+            ForestFireDSParams,
+            ForestFireSamplingDataSource,
+            NodeSamplingDataSource,
+            NodeSamplingDSParams,
+        )
+
+        rng = np.random.default_rng(0)
+        lines = {
+            (int(a), int(b))
+            for a, b in rng.integers(0, 30, (200, 2))
+            if a != b
+        }
+        path = tmp_path / "graph.txt"
+        path.write_text("".join(f"{s} {d}\n" for s, d in lines))
+
+        full = len(lines)
+        node_td = NodeSamplingDataSource(
+            NodeSamplingDSParams(
+                graph_edgelist_path=str(path), sample_fraction=0.5
+            )
+        ).read_training(None)
+        assert 0 < len(node_td.edges) < full
+
+        ff_td = ForestFireSamplingDataSource(
+            ForestFireDSParams(
+                graph_edgelist_path=str(path), sample_fraction=0.5
+            )
+        ).read_training(None)
+        assert 0 < len(ff_td.edges) < full
+
+
+class TestMovieLensSlidingEvaluation:
+    @pytest.fixture()
+    def setup(self, mem_storage):
+        app_id = make_app(mem_storage, "default")
+        events = mem_storage.get_l_events()
+        rng = np.random.default_rng(31)
+        t0 = dt.datetime(2014, 1, 1, tzinfo=UTC)
+        # 40 users x 30 items, clustered tastes, events spread over 6 weeks
+        for u in range(40):
+            base = 0 if u % 2 == 0 else 15
+            for _ in range(20):
+                item = base + int(rng.integers(0, 15))
+                events.insert(
+                    Event(
+                        event="rate", entity_type="user", entity_id=f"u{u}",
+                        target_entity_type="item",
+                        target_entity_id=f"i{item}",
+                        properties=DataMap(
+                            {"rating": float(rng.integers(3, 6))}
+                        ),
+                        event_time=t0
+                        + dt.timedelta(
+                            seconds=float(rng.uniform(0, 42 * 86400))
+                        ),
+                    ),
+                    app_id,
+                )
+        return mem_storage, t0
+
+    def test_windows_never_leak_future_events(self, setup):
+        from predictionio_tpu.models.experimental.movielens_evaluation import (
+            SlidingEvalDataSource,
+            SlidingEvalParams,
+        )
+
+        storage, t0 = setup
+        ctx = WorkflowContext(mode="evaluation", storage=storage)
+        cut0 = t0 + dt.timedelta(days=21)
+        splits = SlidingEvalDataSource(
+            SlidingEvalParams(
+                app_name="default",
+                first_training_until=cut0,
+                eval_duration_seconds=7 * 86400.0,
+                eval_count=3,
+            )
+        ).read_eval(ctx)
+        assert len(splits) == 3
+        sizes = []
+        for w, (td, info, qa) in enumerate(splits):
+            assert info["window"] == w
+            assert len(qa) > 0
+            sizes.append(len(td.ratings))
+        # each successive window trains on strictly more history
+        assert sizes == sorted(sizes) and sizes[0] < sizes[-1]
+
+    def test_end_to_end_evaluation_beats_nothing(self, setup):
+        from predictionio_tpu.controller.engine import EngineParams
+        from predictionio_tpu.models.experimental.movielens_evaluation import (
+            MovieLensEvaluation,
+            SlidingParamsGrid,
+        )
+        from predictionio_tpu.workflow.core_workflow import CoreWorkflow
+
+        storage, t0 = setup
+        grid = SlidingParamsGrid(
+            app_name="default",
+            first_training_until=t0 + dt.timedelta(days=21),
+            eval_count=2,
+            grid=((4, 0.05),),
+        )
+        ctx = WorkflowContext(mode="evaluation", storage=storage)
+        result = CoreWorkflow.run_evaluation(
+            MovieLensEvaluation(k=5), grid.engine_params_list, ctx=ctx
+        )
+        assert len(result.engine_params_scores) == 1
+        assert result.best_score.score > 0.1  # clustered tastes are learnable
+
+
+class TestDIMSUMStandaloneEngine:
+    @pytest.fixture()
+    def spapp(self, mem_storage):
+        app_id = make_app(mem_storage, "spapp")
+        events = mem_storage.get_l_events()
+        rng = np.random.default_rng(2)
+        for i in range(8):
+            events.insert(
+                Event(
+                    event="$set", entity_type="item", entity_id=f"i{i}",
+                    properties=DataMap({"categories": ["c"]}),
+                ),
+                app_id,
+            )
+        for uid in range(30):
+            events.insert(
+                Event(event="$set", entity_type="user", entity_id=f"u{uid}"),
+                app_id,
+            )
+            base = 0 if uid % 2 == 0 else 4
+            for _ in range(6):
+                item = base + int(rng.integers(0, 4))
+                events.insert(
+                    Event(
+                        event="view", entity_type="user",
+                        entity_id=f"u{uid}",
+                        target_entity_type="item",
+                        target_entity_id=f"i{item}",
+                    ),
+                    app_id,
+                )
+        return mem_storage
+
+    def test_engine_assembles_and_trains(self, spapp):
+        from predictionio_tpu.controller.engine import EngineParams
+        from predictionio_tpu.models.experimental.similarproduct_dimsum import (
+            DataSourceParams,
+            DIMSUMAlgorithm,
+            DIMSUMAlgorithmParams,
+            Query,
+            dimsum_engine,
+        )
+        from predictionio_tpu.workflow.workflow_params import WorkflowParams
+
+        engine = dimsum_engine()
+        assert engine.algorithm_class_map == {"dimsum": DIMSUMAlgorithm}
+        params = EngineParams(
+            data_source_params=("", DataSourceParams(app_name="spapp")),
+            algorithm_params_list=(
+                ("dimsum", DIMSUMAlgorithmParams(threshold=0.0)),
+            ),
+        )
+        ctx = WorkflowContext(mode="training", storage=spapp)
+        [model] = engine.train(ctx, params, WorkflowParams())
+        _, _, [algo], _ = engine.make_components(params)
+        result = algo.predict(model, Query(items=("i0",), num=3))
+        got = {s.item for s in result.item_scores}
+        assert got and "i0" not in got
+        # co-viewed cluster dominates
+        assert got <= {"i1", "i2", "i3"}
