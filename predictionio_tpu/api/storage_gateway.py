@@ -38,20 +38,46 @@ logger = logging.getLogger(__name__)
 
 DEFAULT_PORT = 7077  # beside the reference's 7070/7071 tools ports
 
-# dao name on the wire -> (Storage accessor, record kind for rows)
+# dao name on the wire -> (Storage accessor, record kind for rows,
+# base.py trait whose public methods define the RPC surface)
 _DAOS = {
-    "levents": ("get_l_events", None),
-    "apps": ("get_meta_data_apps", "app"),
-    "access_keys": ("get_meta_data_access_keys", "access_key"),
-    "channels": ("get_meta_data_channels", "channel"),
-    "engine_manifests": ("get_meta_data_engine_manifests", "engine_manifest"),
-    "engine_instances": ("get_meta_data_engine_instances", "engine_instance"),
+    "levents": ("get_l_events", None, None),
+    "apps": ("get_meta_data_apps", "app", "Apps"),
+    "access_keys": ("get_meta_data_access_keys", "access_key", "AccessKeys"),
+    "channels": ("get_meta_data_channels", "channel", "Channels"),
+    "engine_manifests": (
+        "get_meta_data_engine_manifests", "engine_manifest", "EngineManifests",
+    ),
+    "engine_instances": (
+        "get_meta_data_engine_instances", "engine_instance", "EngineInstances",
+    ),
     "evaluation_instances": (
         "get_meta_data_evaluation_instances",
         "evaluation_instance",
+        "EvaluationInstances",
     ),
-    "models": ("get_model_data_models", "model"),
+    "models": ("get_model_data_models", "model", "Models"),
 }
+
+
+def _trait_methods(trait_name: str) -> frozenset:
+    """Public methods declared on the base.py trait — the RPC surface.
+
+    Dispatching against the trait (not the backend instance) keeps the
+    wire protocol pinned to data/storage/base.py: extra public helpers a
+    concrete DAO happens to grow are NOT remotely callable.
+    """
+    from predictionio_tpu.data.storage import base as _base
+
+    trait = getattr(_base, trait_name)
+    return frozenset(
+        m
+        for m in vars(trait)
+        if not m.startswith("_") and callable(getattr(trait, m, None))
+    )
+
+
+_TRAIT_ALLOWLIST: Dict[str, frozenset] = {}
 
 class StorageGatewayCore:
     """Transport-independent RPC core (same pattern as QueryAPI)."""
@@ -101,10 +127,14 @@ class StorageGatewayCore:
     def call(self, dao: str, method: str, args: Dict[str, Any]) -> Any:
         if dao not in _DAOS:
             raise KeyError(f"unknown dao {dao!r}")
-        accessor, kind = _DAOS[dao]
+        accessor, kind, trait = _DAOS[dao]
         target = getattr(self.storage, accessor)()
         if dao == "levents":
             return self._call_levents(target, method, args)
+        if trait not in _TRAIT_ALLOWLIST:
+            _TRAIT_ALLOWLIST[trait] = _trait_methods(trait)
+        if method not in _TRAIT_ALLOWLIST[trait]:
+            raise KeyError(f"unknown {kind} method {method!r}")
         return self._call_metadata(target, kind, method, args)
 
     def _call_levents(self, le, method: str, args: Dict[str, Any]) -> Any:
@@ -147,8 +177,8 @@ class StorageGatewayCore:
         if "record" in a:
             a["record"] = wire.record_from_wire(kind, a["record"])
         record = a.pop("record", None)
-        fn = getattr(dao, method, None)
-        if fn is None or method.startswith("_"):
+        fn = getattr(dao, method, None)  # allowlisted against the trait in call()
+        if fn is None:
             raise KeyError(f"unknown {kind} method {method!r}")
         out = fn(record, **a) if record is not None else fn(**a)
         # serialize records/record lists; scalars pass through
@@ -165,13 +195,29 @@ def _is_record(x: Any) -> bool:
     return dataclasses.is_dataclass(x) and not isinstance(x, type)
 
 
+_LOOPBACK_IPS = ("localhost", "127.0.0.1", "::1")
+
+
 class StorageGatewayServer(JsonHTTPServer):
+    """Defaults to loopback: the gateway exposes read/write access to ALL
+    storage, so a non-loopback bind without a shared secret must be an
+    explicit opt-in (``allow_insecure=True``), not a constructor default.
+    The CLI path (`pio storagegateway`) opts in after printing a warning.
+    """
+
     def __init__(
         self,
         storage: Optional[Storage] = None,
-        ip: str = "0.0.0.0",
+        ip: str = "localhost",
         port: int = DEFAULT_PORT,
         secret: str = "",
+        allow_insecure: bool = False,
     ):
+        if not secret and not allow_insecure and ip not in _LOOPBACK_IPS:
+            raise ValueError(
+                f"refusing to bind {ip!r} without a secret: pass secret=... "
+                "or allow_insecure=True to expose unauthenticated storage "
+                "on a non-loopback interface"
+            )
         self.core = StorageGatewayCore(storage, secret=secret)
         super().__init__(self.core.handle, ip, port, "StorageGateway")

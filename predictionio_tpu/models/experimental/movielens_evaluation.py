@@ -89,10 +89,9 @@ class SlidingEvalDataSource(RecommendationDataSource):
         user_index = BiMap.string_int(e.entity_id for e in events)
         item_index = BiMap.string_int(e.target_entity_id for e in events)
 
-        def value_of(e):
-            if e.event == "buy":
-                return 4.0
-            return float(e.properties.get_or_else("rating", 1.0))
+        from predictionio_tpu.models.recommendation.engine import (
+            rating_of_event as value_of,
+        )
 
         duration = dt.timedelta(seconds=p.eval_duration_seconds)
         out = []

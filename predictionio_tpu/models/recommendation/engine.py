@@ -116,6 +116,17 @@ class DataSourceParams(Params):
     seed: int = 3
 
 
+def rating_of_event(e) -> float:
+    """The template's event->rating mapping: explicit 'rate' events carry a
+    rating property; 'buy' events become rating 4.0 (reference
+    DataSource.scala implicit mapping). Shared with the sliding-window
+    evaluator (models/experimental/movielens_evaluation.py) so both always
+    score the same rating scheme."""
+    if e.event == "buy":
+        return 4.0
+    return float(e.properties.get_or_else("rating", 1.0))
+
+
 class DataSource(BaseDataSource):
     """Reads rate/buy events into dense-indexed rating columns
     (reference DataSource.scala — PEventStore.find + Rating mapping;
@@ -125,15 +136,9 @@ class DataSource(BaseDataSource):
 
     def _read_columns(self, ctx):
         store = PEventStore(ctx.storage)
-
-        def value_of(e):
-            if e.event == "buy":
-                return 4.0
-            return float(e.properties.get_or_else("rating", 1.0))
-
         return store.find_columns(
             self.params.app_name,
-            value_of=value_of,
+            value_of=rating_of_event,
             channel_name=self.params.channel_name,
             entity_type="user",
             target_entity_type="item",

@@ -400,11 +400,12 @@ def train_als(
     def auto_segment_length(idx, n_rows: int) -> int:
         # smallest power of two >= the side's mean observation count,
         # within [8, config.segment_length] — see ALSConfig.segment_length
+        floor = min(8, config.segment_length)  # honor caps below 8
         nonempty = int((np.bincount(idx, minlength=n_rows) > 0).sum())
         if nonempty == 0:
-            return 8
+            return floor
         mean = len(idx) / nonempty
-        L = 8
+        L = floor
         while L < config.segment_length and L < mean:
             L *= 2
         return L
@@ -592,6 +593,11 @@ def train_als(
                     },
                     force=True,  # chunk boundaries ARE the cadence
                 )
+                # The next run_iters call DONATES X/Y (donate_argnums),
+                # overwriting these buffers in place; orbax's save may
+                # still be copying them device->host. Block until the
+                # save has committed before handing the buffers back.
+                ckpt.wait_until_finished()
     finally:
         ckpt.close()
 

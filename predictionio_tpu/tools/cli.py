@@ -241,15 +241,19 @@ def cmd_eventserver(args) -> int:
 
 
 def cmd_storagegateway(args) -> int:
-    from predictionio_tpu.api.storage_gateway import StorageGatewayServer
+    from predictionio_tpu.api.storage_gateway import (
+        _LOOPBACK_IPS,
+        StorageGatewayServer,
+    )
 
-    if not args.secret and args.ip not in ("localhost", "127.0.0.1", "::1"):
+    if not args.secret and args.ip not in _LOOPBACK_IPS:
         print(
             "WARNING: binding a non-loopback interface without --secret "
             "exposes unauthenticated read/write access to ALL storage"
         )
     server = StorageGatewayServer(
-        ip=args.ip, port=args.port, secret=args.secret
+        ip=args.ip, port=args.port, secret=args.secret,
+        allow_insecure=True,  # the explicit --ip flag + warning above
     )
     print(f"Storage gateway serving on {args.ip}:{server.port}")
     server.serve_forever()

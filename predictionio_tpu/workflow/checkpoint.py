@@ -78,6 +78,18 @@ class StepCheckpointer:
         self._mgr.save(step, args=self._ocp.args.StandardSave(pytree))
         return True
 
+    def wait_until_finished(self) -> None:
+        """Block until any in-flight async save has fully committed.
+
+        Callers whose training step DONATES its input buffers (e.g. the
+        fused ALS loop, ops/als.py donate_argnums) must call this between
+        ``maybe_save(..., state)`` and the next step: orbax may copy
+        device arrays to host asynchronously, and a donated buffer that
+        gets overwritten mid-copy would silently corrupt the checkpoint.
+        """
+        if self._mgr is not None:
+            self._mgr.wait_until_finished()
+
     def close(self) -> None:
         if self._mgr is not None:
             self._mgr.wait_until_finished()

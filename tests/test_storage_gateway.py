@@ -52,6 +52,61 @@ class TestTransport:
         finally:
             server.shutdown()
 
+    def test_non_loopback_bind_requires_secret_or_opt_in(self):
+        """The gateway exposes read/write of ALL storage: binding beyond
+        loopback without a secret must be an explicit opt-in."""
+        with pytest.raises(ValueError, match="allow_insecure"):
+            StorageGatewayServer(memory_storage(), ip="0.0.0.0", port=0)
+        # each escape hatch works: a secret, or the explicit opt-in
+        StorageGatewayServer(
+            memory_storage(), ip="0.0.0.0", port=0, secret="s"
+        )
+        StorageGatewayServer(
+            memory_storage(), ip="0.0.0.0", port=0, allow_insecure=True
+        )
+
+    def test_rpc_surface_is_trait_allowlisted(self, gateway):
+        """Only data/storage/base.py trait methods are remotely callable —
+        public helpers a backend DAO happens to expose are NOT."""
+        import json
+        import urllib.request
+
+        def rpc(dao, method):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{gateway.port}/rpc",
+                data=json.dumps(
+                    {"dao": dao, "method": method, "args": {}}
+                ).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            try:
+                with urllib.request.urlopen(req) as resp:
+                    return resp.status, json.loads(resp.read())
+            except urllib.error.HTTPError as e:
+                return e.code, json.loads(e.read())
+
+        # a genuine trait method passes dispatch
+        status, payload = rpc("apps", "get_all")
+        assert status == 200 and payload["result"] == []
+        # a real public attribute of the concrete backend that is NOT on
+        # the Apps trait is rejected, not dispatched via getattr
+        backend = gateway.core.storage.get_meta_data_apps()
+        non_trait = [
+            m
+            for m in dir(backend)
+            if not m.startswith("_")
+            and callable(getattr(backend, m))
+            and m not in dir(type(backend).__mro__[-2])
+        ]
+        from predictionio_tpu.data.storage import base as storage_base
+
+        trait_methods = set(vars(storage_base.Apps))
+        extras = [m for m in non_trait if m not in trait_methods]
+        for m in extras[:3]:
+            status, payload = rpc("apps", m)
+            assert status == 400, (m, payload)
+            assert "unknown" in payload["error"]
+
     def test_unreachable_gateway_raises_storage_error(self):
         s = Storage(gw_config(1))  # nothing listens on port 1
         with pytest.raises(StorageError, match="unreachable"):
