@@ -30,6 +30,7 @@ so throughput scales with batch size instead of request count.
 
 from __future__ import annotations
 
+import concurrent.futures
 import dataclasses
 import datetime as _dt
 import html
@@ -80,6 +81,16 @@ class ServerConfig:
     # micro-batching knobs (TPU addition)
     batch_window_ms: float = 2.0
     max_batch: int = 128
+    # Batches allowed in flight at once: 2 = double-buffering, so batch
+    # k+1's device dispatch overlaps batch k's result fetch. CONTRACT:
+    # depth > 1 means serve_batch (supplement -> batch_predict -> serve)
+    # runs CONCURRENTLY on the deployed engine, so controller code must
+    # not mutate shared state without locking. The packaged templates are
+    # pure; engines that keep mutable predict-time state (a cache dict, a
+    # lazily-built index) must set pipeline_depth=1 to restore the
+    # strictly-serial behavior (which is still ahead of the reference's
+    # serial per-query loop, CreateServer.scala:497-500).
+    pipeline_depth: int = 2
 
     def __post_init__(self):
         if self.feedback and not self.access_key:
@@ -180,7 +191,11 @@ class DeployedEngine:
     def serve_batch(self, queries: Sequence[Any]) -> List[Any]:
         """supplement each -> ONE batch_predict per algorithm -> serve each
         with its original query (reference Engine.scala:769-810 eval path
-        applies the same supplement/batch/serve order)."""
+        applies the same supplement/batch/serve order).
+
+        May be called concurrently (up to ServerConfig.pipeline_depth
+        batches in flight): algorithms/serving with mutable predict-time
+        state must lock it or deploy with pipeline_depth=1."""
         supplemented = [self.serving.supplement(q) for q in queries]
         indexed = list(enumerate(supplemented))
         per_algo: List[Dict[int, Any]] = [
@@ -198,17 +213,27 @@ class _BatchingExecutor:
 
     Request threads enqueue (query, slot) and block; one collector thread
     drains the queue — waiting up to window_ms after the first arrival —
-    and runs the whole batch through DeployedEngine.serve_batch. One
-    in-flight batch at a time keeps the device queue shallow (latency)
-    while the next batch accumulates behind it (throughput).
+    and hands each batch to a serve pool holding up to ``pipeline_depth``
+    batches in flight (default 2: double-buffering). While batch k's
+    result fetch is crossing host<->device (or, on a relay rig, the
+    network), batch k+1 already dispatched and batch k+2 accumulates
+    behind the semaphore — the device never idles waiting on a fetch.
+    The reference serves strictly serially (CreateServer.scala:473-624);
+    one-in-flight was this executor's round-2 shape and capped REST qps
+    at the relay round-trip rate.
     """
 
-    def __init__(self, window_ms: float, max_batch: int):
+    def __init__(self, window_ms: float, max_batch: int, pipeline_depth: int = 2):
         self.window_ms = window_ms
         self.max_batch = max_batch
+        self.pipeline_depth = max(1, pipeline_depth)
         self._queue: "queue.Queue" = queue.Queue()
         self._worker: Optional[threading.Thread] = None
         self._lock = threading.Lock()
+        self._inflight = threading.Semaphore(self.pipeline_depth)
+        self._serve_pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=self.pipeline_depth, thread_name_prefix="serve"
+        )
 
     def submit(self, deployed: DeployedEngine, query: Any) -> Any:
         slot: Dict[str, Any] = {"done": threading.Event()}
@@ -243,7 +268,16 @@ class _BatchingExecutor:
             for item in batch:
                 groups.setdefault(id(item[0]), []).append(item)
             for items in groups.values():
-                self._serve_isolating(items[0][0], items)
+                # blocks while pipeline_depth batches are in flight — the
+                # next batch keeps accumulating in self._queue meanwhile
+                self._inflight.acquire()
+                self._serve_pool.submit(self._serve_and_release, items[0][0], items)
+
+    def _serve_and_release(self, dep: DeployedEngine, items) -> None:
+        try:
+            self._serve_isolating(dep, items)
+        finally:
+            self._inflight.release()
 
     def _serve_isolating(self, dep: DeployedEngine, items) -> None:
         """Serve a batch; on failure bisect it so the poison query is
@@ -283,7 +317,9 @@ class QueryAPI:
         self._reload_fn = reload_fn
         self._stop_fn = stop_fn
         self._executor = _BatchingExecutor(
-            self.config.batch_window_ms, self.config.max_batch
+            self.config.batch_window_ms,
+            self.config.max_batch,
+            self.config.pipeline_depth,
         )
         self.server_start_time = _dt.datetime.now(_dt.timezone.utc)
         self.request_count = 0

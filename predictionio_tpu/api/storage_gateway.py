@@ -170,6 +170,31 @@ class StorageGatewayCore:
                 v = a.get(f, wire.UNSET_WIRE)
                 kwargs[f] = UNSET if v == wire.UNSET_WIRE else v
             return [wire.event_to_wire(e) for e in le.find(**kwargs)]
+        if method == "aggregate_properties":
+            out = le.aggregate_properties(
+                app_id=a["app_id"],
+                entity_type=a["entity_type"],
+                channel_id=a.get("channel_id"),
+                start_time=wire.opt_dt_from_wire(a.get("start_time")),
+                until_time=wire.opt_dt_from_wire(a.get("until_time")),
+                required=a.get("required"),
+            )
+            # the fold happens HERE, next to the store: the wire carries
+            # one PropertyMap per entity, not the full event history
+            # (reference LEventAggregator.scala:39-136 semantics)
+            return {
+                k: wire.property_map_to_wire(v) for k, v in out.items()
+            }
+        if method == "aggregate_properties_of_entity":
+            pm = le.aggregate_properties_of_entity(
+                app_id=a["app_id"],
+                entity_type=a["entity_type"],
+                entity_id=a["entity_id"],
+                channel_id=a.get("channel_id"),
+                start_time=wire.opt_dt_from_wire(a.get("start_time")),
+                until_time=wire.opt_dt_from_wire(a.get("until_time")),
+            )
+            return None if pm is None else wire.property_map_to_wire(pm)
         raise KeyError(f"unknown levents method {method!r}")
 
     def _call_metadata(self, dao, kind: str, method: str, args: Dict[str, Any]) -> Any:

@@ -55,6 +55,8 @@ _IDEMPOTENT_METHODS = frozenset(
         "get_latest_completed",
         "get_completed",
         "find",
+        "aggregate_properties",
+        "aggregate_properties_of_entity",
     }
 )
 
@@ -239,6 +241,70 @@ class HTTPLEvents(_RemoteDAO, base.LEvents):
             reversed=reversed,
         )
         return iter([wire.event_from_wire(e) for e in out])
+
+    def aggregate_properties(
+        self,
+        app_id: int,
+        entity_type: str,
+        channel_id: Optional[int] = None,
+        start_time: Optional[_dt.datetime] = None,
+        until_time: Optional[_dt.datetime] = None,
+        required: Optional[Sequence[str]] = None,
+    ) -> Dict[str, "PropertyMap"]:
+        # pushed down: the gateway folds $set/$unset/$delete next to the
+        # store and ships one PropertyMap per entity — one round trip,
+        # bytes proportional to entities, not history length (reference
+        # folds at the store too, LEventAggregator.scala:39). Falls back
+        # to the trait's find()+fold against gateways predating the RPC.
+        try:
+            out = self._call(
+                "aggregate_properties",
+                app_id=app_id,
+                entity_type=entity_type,
+                channel_id=channel_id,
+                start_time=wire.opt_dt_to_wire(start_time),
+                until_time=wire.opt_dt_to_wire(until_time),
+                required=list(required) if required is not None else None,
+            )
+        except StorageError as e:
+            if "unknown levents method" not in str(e):
+                raise
+            return super().aggregate_properties(
+                app_id, entity_type, channel_id=channel_id,
+                start_time=start_time, until_time=until_time,
+                required=required,
+            )
+        return {
+            k: wire.property_map_from_wire(v) for k, v in out.items()
+        }
+
+    def aggregate_properties_of_entity(
+        self,
+        app_id: int,
+        entity_type: str,
+        entity_id: str,
+        channel_id: Optional[int] = None,
+        start_time: Optional[_dt.datetime] = None,
+        until_time: Optional[_dt.datetime] = None,
+    ) -> Optional["PropertyMap"]:
+        try:
+            out = self._call(
+                "aggregate_properties_of_entity",
+                app_id=app_id,
+                entity_type=entity_type,
+                entity_id=entity_id,
+                channel_id=channel_id,
+                start_time=wire.opt_dt_to_wire(start_time),
+                until_time=wire.opt_dt_to_wire(until_time),
+            )
+        except StorageError as e:
+            if "unknown levents method" not in str(e):
+                raise
+            return super().aggregate_properties_of_entity(
+                app_id, entity_type, entity_id, channel_id=channel_id,
+                start_time=start_time, until_time=until_time,
+            )
+        return wire.property_map_from_wire(out)
 
 
 class HTTPApps(_RemoteDAO, base.Apps):

@@ -111,6 +111,29 @@ def record_from_wire(kind: str, obj: Optional[Dict[str, Any]]) -> Any:
     return cls(**kwargs)
 
 
+def property_map_to_wire(pm) -> Dict[str, Any]:
+    """Folded PropertyMap for the gateway's aggregate pushdown — the wire
+    carries the already-aggregated result, not the raw $set/$unset/$delete
+    history (reference folds at the store, LEventAggregator.scala:39)."""
+    return {
+        "fields": dict(pm.fields),
+        "firstUpdated": _dt_to_wire(pm.first_updated),
+        "lastUpdated": _dt_to_wire(pm.last_updated),
+    }
+
+
+def property_map_from_wire(obj: Optional[Dict[str, Any]]):
+    from predictionio_tpu.data.event import PropertyMap
+
+    if obj is None:
+        return None
+    return PropertyMap(
+        obj["fields"],
+        first_updated=_dt_from_wire(obj["firstUpdated"]),
+        last_updated=_dt_from_wire(obj["lastUpdated"]),
+    )
+
+
 def opt_dt_to_wire(d: Optional[_dt.datetime]) -> Optional[str]:
     return None if d is None else _dt_to_wire(d)
 

@@ -201,6 +201,102 @@ class TestTransport:
         state["fail_next"] = True
         assert isinstance(list(le.find(1)), list)
 
+    def test_aggregate_pushdown_one_round_trip(self, gateway, monkeypatch):
+        """VERDICT acceptance: a trainer's property read folds the
+        $set/$unset/$delete history AT the gateway — one round trip, and
+        the wire carries fewer bytes than the raw event history would
+        (reference folds at the store, LEventAggregator.scala:39-136)."""
+        import json
+
+        s = Storage(gw_config(gateway.port))
+        le = s.get_l_events()
+        le.init(1)
+        # a 40-update $set history on one entity with bulky properties
+        base_t = dt.datetime(2026, 7, 1, tzinfo=dt.timezone.utc)
+        for j in range(40):
+            le.insert(
+                Event(
+                    event="$set", entity_type="user", entity_id="u1",
+                    properties=DataMap({"bio": "x" * 200, "step": j}),
+                    event_time=base_t + dt.timedelta(minutes=j),
+                ),
+                1,
+            )
+        le.insert(
+            Event(
+                event="$set", entity_type="user", entity_id="u2",
+                properties=DataMap({"bio": "y" * 200, "step": -1}),
+                event_time=base_t,
+            ),
+            1,
+        )
+
+        calls = []
+        real_call = gateway.core.call
+
+        def spy(dao, method, args):
+            out = real_call(dao, method, args)
+            calls.append((method, len(json.dumps(out, default=str))))
+            return out
+
+        monkeypatch.setattr(gateway.core, "call", spy)
+        props = le.aggregate_properties(1, "user")
+        # correctness: latest fold per entity, both entities present
+        assert props["u1"]["step"] == 39
+        assert props["u1"].first_updated == base_t
+        assert props["u1"].last_updated == base_t + dt.timedelta(minutes=39)
+        assert props["u2"]["step"] == -1
+        # structure: exactly ONE round trip, method was the pushdown RPC
+        assert [m for m, _ in calls] == ["aggregate_properties"]
+        # bytes: folded payload < the raw 41-event history it replaces
+        raw_events = real_call(
+            "levents",
+            "find",
+            {
+                "app_id": 1,
+                "entity_type": "user",
+                "event_names": ["$set", "$unset", "$delete"],
+            },
+        )
+        assert calls[0][1] < len(json.dumps(raw_events, default=str)) / 10
+
+        # single-entity variant also folds server-side in one trip
+        calls.clear()
+        pm = le.aggregate_properties_of_entity(1, "user", "u1")
+        assert pm["step"] == 39
+        assert [m for m, _ in calls] == ["aggregate_properties_of_entity"]
+
+        # `required` filter applies server-side
+        calls.clear()
+        assert le.aggregate_properties(1, "user", required=["missing"]) == {}
+        assert [m for m, _ in calls] == ["aggregate_properties"]
+
+    def test_aggregate_falls_back_against_old_gateway(self, gateway, monkeypatch):
+        """A gateway predating the aggregate RPC rejects the method; the
+        client must fall back to find()+client-side fold transparently."""
+        s = Storage(gw_config(gateway.port))
+        le = s.get_l_events()
+        le.init(1)
+        le.insert(
+            Event(
+                event="$set", entity_type="user", entity_id="u1",
+                properties=DataMap({"a": 1}),
+            ),
+            1,
+        )
+
+        real_call = gateway.core.call
+
+        def old_gateway(dao, method, args):
+            if method.startswith("aggregate"):
+                raise KeyError(f"unknown levents method {method!r}")
+            return real_call(dao, method, args)
+
+        monkeypatch.setattr(gateway.core, "call", old_gateway)
+        props = le.aggregate_properties(1, "user")
+        assert props["u1"]["a"] == 1
+        assert le.aggregate_properties_of_entity(1, "user", "u1")["a"] == 1
+
     def test_status_route(self, gateway):
         import json
         import urllib.request
