@@ -280,17 +280,33 @@ def cmd_dashboard(args) -> int:
 
 def cmd_template(args) -> int:
     """Reference Console template get|list (Template.scala:226-415);
-    the gallery is the set of packaged engine templates."""
-    from predictionio_tpu.tools.template import template_get, template_list
+    packaged engine templates by name, or ``user/repo`` fetched from
+    the GitHub gallery."""
+    from predictionio_tpu.tools.template import (
+        template_get,
+        template_get_remote,
+        template_list,
+    )
 
     if args.template_command == "list":
         for t in template_list():
             print(f"{t.name}: {t.description}")
         return 0
-    directory = args.directory or args.name
+    import tarfile
+
+    directory = args.directory or args.name.rsplit("/", 1)[-1]
     try:
-        template_get(args.name, directory, app_name=args.app_name)
-    except (KeyError, FileExistsError) as e:
+        if "/" in args.name:
+            template_get_remote(
+                args.name, directory, app_name=args.app_name,
+                ref=args.ref, sha256=args.sha256,
+            )
+        else:
+            template_get(args.name, directory, app_name=args.app_name)
+    except (
+        KeyError, FileExistsError, ValueError, OSError,
+        tarfile.TarError,  # corrupt/non-tar archive from the gallery
+    ) as e:
         raise CommandError(str(e)) from e
     print(f"Engine template {args.name} created at {directory}/")
     return 0
@@ -337,8 +353,10 @@ def cmd_shell(args) -> int:
 def cmd_export(args) -> int:
     from predictionio_tpu.tools.export_import import events_to_file
 
-    n = events_to_file(args.app_name, args.output, args.channel)
-    print(f"Exported {n} events to {args.output}")
+    n = events_to_file(
+        args.app_name, args.output, args.channel, format=args.format
+    )
+    print(f"Exported {n} events to {args.output} ({args.format})")
     return 0
 
 
@@ -448,6 +466,15 @@ def cmd_accesskey(args) -> int:
 
 def cmd_version(args) -> int:
     print(__version__)
+    return 0
+
+
+def cmd_upgrade(args) -> int:
+    """Reference Console.upgrade (Console.scala:1130) — best-effort
+    newer-release check; never fails the CLI when offline."""
+    from predictionio_tpu.tools.upgrade import check_for_upgrade
+
+    print(check_for_upgrade(url=args.url))
     return 0
 
 
@@ -570,10 +597,20 @@ def build_parser() -> argparse.ArgumentParser:
     tpl = sub.add_parser("template", help="engine template gallery")
     tpl_sub = tpl.add_subparsers(dest="template_command", required=True)
     tpl_sub.add_parser("list")
-    tpl_get = tpl_sub.add_parser("get")
+    tpl_get = tpl_sub.add_parser(
+        "get",
+        help="packaged template by name, or user/repo from GitHub",
+    )
     tpl_get.add_argument("name")
     tpl_get.add_argument("directory", nargs="?")
     tpl_get.add_argument("--app-name", default="MyApp")
+    tpl_get.add_argument(
+        "--ref", default="", help="git tag to fetch (default: latest)"
+    )
+    tpl_get.add_argument(
+        "--sha256", default="",
+        help="pin the downloaded archive to this checksum",
+    )
     tpl.set_defaults(func=cmd_template)
 
     run = sub.add_parser(
@@ -583,13 +620,22 @@ def build_parser() -> argparse.ArgumentParser:
     run.set_defaults(func=cmd_run)
 
     # export / import / status / version
-    exp = sub.add_parser("export", help="export events to a JSON-lines file")
+    exp = sub.add_parser(
+        "export", help="export events to a JSON-lines or Parquet file"
+    )
     exp.add_argument("--app-name", required=True)
     exp.add_argument("--output", required=True)
     exp.add_argument("--channel")
+    exp.add_argument(
+        "--format", choices=("json", "parquet"), default="json",
+        help="output format (reference EventsToFile.scala:85-100)",
+    )
     exp.set_defaults(func=cmd_export)
 
-    imp = sub.add_parser("import", help="import events from a JSON-lines file")
+    imp = sub.add_parser(
+        "import",
+        help="import events from a JSON-lines or Parquet file (auto-detected)",
+    )
     imp.add_argument("--app-name", required=True)
     imp.add_argument("--input", required=True)
     imp.add_argument("--channel")
@@ -602,6 +648,11 @@ def build_parser() -> argparse.ArgumentParser:
         "shell", help="interactive Python with the pio env loaded"
     ).set_defaults(func=cmd_shell)
     sub.add_parser("version").set_defaults(func=cmd_version)
+    upg = sub.add_parser(
+        "upgrade", help="check whether a newer release is available"
+    )
+    upg.add_argument("--url", default="", help="override the release index")
+    upg.set_defaults(func=cmd_upgrade)
     return p
 
 

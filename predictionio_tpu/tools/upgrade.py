@@ -1,0 +1,62 @@
+"""Upgrade check — `pio upgrade` / the deploy-time daily check
+(reference console/Console.scala:1130 `upgrade` command and
+WorkflowUtils.scala:386-406 UpgradeCheckRunner, which phones home to
+check for a newer release; CreateServer.scala:253-260 runs it daily).
+
+The check is best-effort and never blocks work: any network failure —
+including the fully-offline case — reports "could not check" and
+returns None. The endpoint is injectable for tests and air-gapped
+mirrors (PIO_UPGRADE_URL)."""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+from typing import Optional
+
+from predictionio_tpu import __version__
+from predictionio_tpu.utils.version import version_lt
+
+logger = logging.getLogger(__name__)
+
+DEFAULT_URL = "https://pypi.org/pypi/predictionio-tpu/json"
+
+
+def latest_version(url: str = "", timeout: float = 5.0) -> Optional[str]:
+    """The newest released version, or None when unreachable/unparsable."""
+    import urllib.request
+
+    url = url or os.environ.get("PIO_UPGRADE_URL") or DEFAULT_URL
+    try:
+        req = urllib.request.Request(
+            url, headers={"User-Agent": f"predictionio_tpu/{__version__}"}
+        )
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            payload = json.loads(resp.read().decode("utf-8"))
+        # accept both the PyPI JSON shape and a bare {"version": "..."}
+        # mirror; anything else (list, string, non-dict info) is
+        # "unparsable", not an exception — the check must never fail
+        info = payload.get("info") if isinstance(payload, dict) else None
+        source = info if isinstance(info, dict) else payload
+        version = source.get("version") if isinstance(source, dict) else None
+    except Exception as e:  # offline, DNS, TLS, bad JSON — all non-fatal
+        logger.debug("upgrade check unreachable: %s", e)
+        return None
+    return version if isinstance(version, str) else None
+
+
+def check_for_upgrade(url: str = "", timeout: float = 5.0) -> str:
+    """One-line, human-readable upgrade status."""
+    latest = latest_version(url, timeout)
+    if latest is None:
+        return (
+            f"predictionio_tpu {__version__} — could not check for "
+            "upgrades (offline?)"
+        )
+    if version_lt(__version__, latest):
+        return (
+            f"predictionio_tpu {__version__} — a newer version {latest} "
+            "is available"
+        )
+    return f"predictionio_tpu {__version__} is up to date"

@@ -1,0 +1,39 @@
+"""Version parsing/comparison shared by the template min-version gate
+(tools/template.py, reference Template.scala:417-429) and the upgrade
+check (tools/upgrade.py, reference WorkflowUtils.scala:386-406)."""
+
+from __future__ import annotations
+
+import re
+from typing import Tuple
+
+
+def parse_version(v: str) -> Tuple[int, ...]:
+    """Leading-digit numeric components: '0.9.3-SNAPSHOT' -> (0, 9, 3),
+    '0rc1' components parse as their leading digits."""
+    out = []
+    for part in v.split("."):
+        m = re.match(r"\d+", part)
+        out.append(int(m.group()) if m else 0)
+    return tuple(out)
+
+
+def _padded(a: str, b: str) -> Tuple[Tuple[int, ...], Tuple[int, ...]]:
+    ta, tb = parse_version(a), parse_version(b)
+    width = max(len(ta), len(tb))
+    return (
+        ta + (0,) * (width - len(ta)),
+        tb + (0,) * (width - len(tb)),
+    )
+
+
+def version_lt(a: str, b: str) -> bool:
+    """True when a < b, comparing width-normalized numeric components so
+    '1.0' == '1.0.0' (not less-than)."""
+    ta, tb = _padded(a, b)
+    return ta < tb
+
+
+def version_gte(a: str, b: str) -> bool:
+    ta, tb = _padded(a, b)
+    return ta >= tb

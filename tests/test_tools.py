@@ -177,6 +177,69 @@ class TestExportImport:
         assert imported[3].properties["rating"] == 3
         assert imported[0].event_time == t
 
+    def test_parquet_round_trip(self, mem_storage, tmp_path):
+        """pio export --format parquet writes a columnar file; import
+        auto-detects it and round-trips every field — including
+        sub-millisecond event times the JSON format truncates (reference
+        EventsToFile.scala:85-100 offers text or Parquet the same way)."""
+        pytest.importorskip("pyarrow")
+        client = CommandClient(mem_storage)
+        d = client.app_new("pqapp")
+        events = mem_storage.get_l_events()
+        t = dt.datetime(2026, 7, 1, 12, 0, 0, 123456, tzinfo=dt.timezone.utc)
+        originals = [
+            Event(
+                event="rate",
+                entity_type="user",
+                entity_id=f"u{k}",
+                target_entity_type="item",
+                target_entity_id=f"i{k}",
+                properties=DataMap({"rating": k, "tags_obj": {"a": [1, 2]}}),
+                event_time=t + dt.timedelta(microseconds=k),
+                tags=("t1", "t2") if k % 2 else (),
+                pr_id="p" * 64 if k == 0 else None,
+            )
+            for k in range(5)
+        ] + [
+            # no-target, empty-properties event exercises the nullable cols
+            Event(event="$set", entity_type="user", entity_id="u9",
+                  properties=DataMap({"x": 1}), event_time=t)
+        ]
+        for e in originals:
+            events.insert(e, d.app.id)
+        path = tmp_path / "events.parquet"
+        n = events_to_file(
+            "pqapp", str(path), storage=mem_storage, format="parquet"
+        )
+        assert n == 6
+        assert path.read_bytes()[:4] == b"PAR1"
+
+        client.app_new("pqimp")
+        assert file_to_events("pqimp", str(path), storage=mem_storage) == 6
+        imported = sorted(
+            mem_storage.get_l_events().find(
+                app_id=mem_storage.get_meta_data_apps().get_by_name("pqimp").id
+            ),
+            key=lambda e: e.entity_id,
+        )
+        by_id = {e.entity_id: e for e in imported}
+        for orig in originals:
+            got = by_id[orig.entity_id]
+            assert got.event == orig.event
+            assert got.target_entity_id == orig.target_entity_id
+            assert dict(got.properties) == dict(orig.properties)
+            assert got.event_time == orig.event_time  # full microseconds
+            assert got.tags == orig.tags
+            assert got.pr_id == orig.pr_id
+
+    def test_export_unknown_format_raises(self, mem_storage, tmp_path):
+        CommandClient(mem_storage).app_new("fmtapp")
+        with pytest.raises(ValueError, match="unknown export format"):
+            events_to_file(
+                "fmtapp", str(tmp_path / "x"), storage=mem_storage,
+                format="csv",
+            )
+
     def test_import_invalid_line_raises(self, mem_storage, tmp_path):
         CommandClient(mem_storage).app_new("impapp")
         path = tmp_path / "bad.jsonl"
@@ -259,3 +322,88 @@ class TestDashboard:
             "GET", "/engine_instances/ghost/evaluator_results.txt"
         )[:2]
         assert status == 404
+
+
+class TestUpgradeCheck:
+    """Reference Console.upgrade (Console.scala:1130) + UpgradeCheckRunner
+    (WorkflowUtils.scala:386-406): best-effort, never blocks when offline."""
+
+    @pytest.fixture()
+    def release_index(self):
+        import http.server
+        import threading
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            latest = "99.0.0"
+
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                body = json.dumps({"info": {"version": Handler.latest}}).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        server = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        yield Handler, f"http://127.0.0.1:{server.server_address[1]}/json"
+        server.shutdown()
+
+    def test_newer_version_reported(self, release_index):
+        from predictionio_tpu.tools.upgrade import check_for_upgrade
+
+        _, url = release_index
+        assert "newer version 99.0.0" in check_for_upgrade(url=url)
+
+    def test_up_to_date(self, release_index):
+        from predictionio_tpu import __version__
+        from predictionio_tpu.tools.upgrade import check_for_upgrade
+
+        handler, url = release_index
+        handler.latest = __version__
+        assert "up to date" in check_for_upgrade(url=url)
+
+    def test_offline_never_raises(self):
+        from predictionio_tpu.tools.upgrade import check_for_upgrade
+
+        out = check_for_upgrade(url="http://127.0.0.1:1/nope", timeout=0.2)
+        assert "could not check" in out
+
+    def test_cli_command(self, release_index, capsys):
+        _, url = release_index
+        assert cli_main(["upgrade", "--url", url]) == 0
+        assert "newer version" in capsys.readouterr().out
+
+    def test_garbage_payload_never_raises(self):
+        """A mirror returning valid-but-wrong JSON (a list, a string info)
+        must still report 'could not check', not crash."""
+        import http.server
+        import threading
+
+        from predictionio_tpu.tools.upgrade import check_for_upgrade
+
+        payloads = [b'["1.0"]', b'{"info": "maintenance"}', b'"x"']
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                body = payloads[int(self.path.rstrip("/")[-1])]
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        server = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        try:
+            port = server.server_address[1]
+            for i in range(len(payloads)):
+                out = check_for_upgrade(url=f"http://127.0.0.1:{port}/{i}")
+                assert "could not check" in out, (i, out)
+        finally:
+            server.shutdown()

@@ -128,6 +128,226 @@ class TestTemplateGallery:
         assert (tmp_path / "scaffold" / "engine.json").exists()
 
 
+class _FakeGallery:
+    """A local HTTP stand-in for the GitHub tags + tarball API
+    (reference console/Template.scala:226-415)."""
+
+    def __init__(
+        self,
+        repo="acme/pio-template-rec",
+        tags=("v2.0", "v1.0"),
+        min_version="0.1",
+    ):
+        import hashlib
+        import http.server
+        import io
+        import tarfile
+        import threading
+
+        self.repo = repo
+        archives = {}
+        for tag in tags:
+            buf = io.BytesIO()
+            top = f"{repo.replace('/', '-')}-{tag}-abc123"
+            with tarfile.open(fileobj=buf, mode="w:gz") as tf:
+
+                def add(name, text):
+                    data = text.encode()
+                    info = tarfile.TarInfo(f"{top}/{name}")
+                    info.size = len(data)
+                    tf.addfile(info, io.BytesIO(data))
+
+                add(
+                    "engine.json",
+                    json.dumps(
+                        {
+                            "engineFactory": "my.Engine",
+                            "datasource": {"params": {"app_name": "MyApp"}},
+                            "tag": tag,
+                        }
+                    ),
+                )
+                add(
+                    "template.json",
+                    json.dumps({"pio": {"version": {"min": min_version}}}),
+                )
+                add("README.md", f"# template {tag}\n")
+                # a traversal attempt the extractor must reject silently
+                evil = tarfile.TarInfo(f"{top}/../../evil.txt")
+                evil.size = 4
+                tf.addfile(evil, io.BytesIO(b"pwnd"))
+            archives[tag] = buf.getvalue()
+        self.archives = archives
+        self.sha256 = {
+            t: hashlib.sha256(b).hexdigest() for t, b in archives.items()
+        }
+        gallery = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                if self.path == f"/repos/{gallery.repo}/tags":
+                    body = json.dumps(
+                        [
+                            {
+                                "name": t,
+                                "tarball_url": (
+                                    f"http://127.0.0.1:{gallery.port}"
+                                    f"/repos/{gallery.repo}/tarball/{t}"
+                                ),
+                            }
+                            for t in tags  # newest first, like GitHub
+                        ]
+                    ).encode()
+                    ctype = "application/json"
+                elif self.path.startswith(f"/repos/{gallery.repo}/tarball/"):
+                    tag = self.path.rsplit("/", 1)[-1]
+                    body = gallery.archives[tag]
+                    ctype = "application/gzip"
+                else:
+                    self.send_error(404)
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self.server = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.port = self.server.server_address[1]
+        self.base_url = f"http://127.0.0.1:{self.port}"
+        threading.Thread(
+            target=self.server.serve_forever, daemon=True
+        ).start()
+
+    def close(self):
+        self.server.shutdown()
+
+
+class TestRemoteTemplateGallery:
+    @pytest.fixture()
+    def gallery(self):
+        g = _FakeGallery()
+        yield g
+        g.close()
+
+    def test_fetches_latest_tag_and_personalizes(self, gallery, tmp_path):
+        from predictionio_tpu.tools.template import template_get_remote
+
+        d = str(tmp_path / "fetched")
+        template_get_remote(
+            gallery.repo, d, app_name="shop", base_url=gallery.base_url
+        )
+        variant = json.loads((tmp_path / "fetched" / "engine.json").read_text())
+        assert variant["tag"] == "v2.0"  # latest tag wins by default
+        assert variant["datasource"]["params"]["app_name"] == "shop"
+        assert (tmp_path / "fetched" / "README.md").exists()
+        # the traversal member did NOT escape the target directory
+        assert not (tmp_path / "evil.txt").exists()
+        assert not (tmp_path.parent / "evil.txt").exists()
+
+    def test_ref_and_checksum_pinning(self, gallery, tmp_path):
+        from predictionio_tpu.tools.template import template_get_remote
+
+        d = str(tmp_path / "pinned")
+        template_get_remote(
+            gallery.repo, d, ref="v1.0",
+            sha256=gallery.sha256["v1.0"], base_url=gallery.base_url,
+        )
+        variant = json.loads((tmp_path / "pinned" / "engine.json").read_text())
+        assert variant["tag"] == "v1.0"
+        # wrong checksum refuses the archive and leaves nothing behind
+        with pytest.raises(ValueError, match="checksum mismatch"):
+            template_get_remote(
+                gallery.repo, str(tmp_path / "bad"), ref="v1.0",
+                sha256="0" * 64, base_url=gallery.base_url,
+            )
+        assert not (tmp_path / "bad").exists()
+
+    def test_unknown_ref_lists_available(self, gallery, tmp_path):
+        from predictionio_tpu.tools.template import template_get_remote
+
+        with pytest.raises(ValueError, match="v2.0"):
+            template_get_remote(
+                gallery.repo, str(tmp_path / "x"), ref="v9.9",
+                base_url=gallery.base_url,
+            )
+
+    def test_min_version_gate_cleans_up_for_retry(self, tmp_path):
+        """A failed install (min-version too new) must not leave a
+        half-populated directory that breaks every retry with
+        FileExistsError."""
+        from predictionio_tpu.tools.template import template_get_remote
+
+        g = _FakeGallery(min_version="99.0")
+        try:
+            d = str(tmp_path / "gated")
+            with pytest.raises(ValueError, match="newer predictionio_tpu"):
+                template_get_remote(g.repo, d, base_url=g.base_url)
+            assert not (tmp_path / "gated").exists()
+        finally:
+            g.close()
+        # retry into the same directory now succeeds with a good template
+        g2 = _FakeGallery()
+        try:
+            template_get_remote(g2.repo, d, base_url=g2.base_url)
+            assert (tmp_path / "gated" / "engine.json").exists()
+        finally:
+            g2.close()
+
+    def test_corrupt_archive_is_a_command_error(self, tmp_path, monkeypatch, capsys):
+        """An HTML error page served as the tarball must surface as a CLI
+        error message, not a raw traceback."""
+        import http.server
+        import threading
+
+        class BadHandler(http.server.BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                if self.path.endswith("/tags"):
+                    body = json.dumps(
+                        [{"name": "v1", "tarball_url":
+                          f"http://127.0.0.1:{srv.server_address[1]}/tar"}]
+                    ).encode()
+                else:
+                    body = b"<html>rate limited</html>"
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), BadHandler)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        try:
+            import predictionio_tpu.tools.template as template_mod
+
+            monkeypatch.setattr(
+                template_mod, "GITHUB_API",
+                f"http://127.0.0.1:{srv.server_address[1]}",
+            )
+            monkeypatch.chdir(tmp_path)
+            assert cli_main(["template", "get", "acme/broken"]) == 1
+            assert "file could not be opened" in capsys.readouterr().err
+            assert not (tmp_path / "broken").exists()
+        finally:
+            srv.shutdown()
+
+    def test_cli_routes_slash_names_to_remote(self, gallery, tmp_path, monkeypatch):
+        import predictionio_tpu.tools.template as template_mod
+
+        monkeypatch.setattr(template_mod, "GITHUB_API", gallery.base_url)
+        monkeypatch.chdir(tmp_path)
+        assert cli_main(
+            ["template", "get", gallery.repo, "--app-name", "shop"]
+        ) == 0
+        # default directory = repo basename
+        assert (tmp_path / "pio-template-rec" / "engine.json").exists()
+
+
 _ran = {}
 
 
