@@ -1,0 +1,43 @@
+"""API-stability markers (reference common module:
+common/src/main/scala/io/prediction/annotation/DeveloperApi.java and
+Experimental.java — the only contents of the reference's `common` sbt
+module). The Java originals are retention-CLASS annotations surfaced in
+scaladoc; the Python analogs are decorators that tag the object with
+``__pio_api__`` and prepend the stability contract to its docstring, so
+the marker is visible both to tooling (``getattr(obj, "__pio_api__")``)
+and in ``help()``.
+"""
+
+from __future__ import annotations
+
+from typing import TypeVar
+
+T = TypeVar("T")
+
+_DEVELOPER_NOTE = (
+    "A lower-level, developer-facing API. Unlike the user-facing "
+    "controller API, these interfaces may change across minor versions."
+)
+_EXPERIMENTAL_NOTE = (
+    "An experimental API for users who want to try new features; may be "
+    "changed or removed in minor versions without deprecation."
+)
+
+
+def _mark(obj: T, kind: str, note: str) -> T:
+    try:
+        obj.__pio_api__ = kind
+        obj.__doc__ = f"::{kind}:: {note}\n\n{obj.__doc__ or ''}"
+    except (AttributeError, TypeError):  # slotted/builtin objects
+        pass
+    return obj
+
+
+def developer_api(obj: T) -> T:
+    """Marks a developer-facing API (reference @DeveloperApi)."""
+    return _mark(obj, "developer_api", _DEVELOPER_NOTE)
+
+
+def experimental(obj: T) -> T:
+    """Marks an experimental API (reference @Experimental)."""
+    return _mark(obj, "experimental", _EXPERIMENTAL_NOTE)

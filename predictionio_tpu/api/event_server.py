@@ -46,6 +46,10 @@ from predictionio_tpu.data.webhooks import (
     ConnectorException,
     to_event,
 )
+from predictionio_tpu.data.webhooks.example import (
+    ExampleFormConnector,
+    ExampleJsonConnector,
+)
 from predictionio_tpu.data.webhooks.mailchimp import MailChimpConnector
 from predictionio_tpu.data.webhooks.segmentio import SegmentIOConnector
 from predictionio_tpu.api.plugins import EventServerPlugin, EventServerPluginContext
@@ -53,9 +57,16 @@ from predictionio_tpu.api.stats import StatsTracker
 
 logger = logging.getLogger(__name__)
 
-# reference WebhooksConnectors.scala:26-34
-JSON_CONNECTORS = {"segmentio": SegmentIOConnector()}
-FORM_CONNECTORS = {"mailchimp": MailChimpConnector()}
+# reference WebhooksConnectors.scala:26-34 (+ the example connectors the
+# reference ships as copy-me templates, data/webhooks/example{json,form})
+JSON_CONNECTORS = {
+    "segmentio": SegmentIOConnector(),
+    "examplejson": ExampleJsonConnector(),
+}
+FORM_CONNECTORS = {
+    "mailchimp": MailChimpConnector(),
+    "exampleform": ExampleFormConnector(),
+}
 
 DEFAULT_LIMIT = 20  # reference EventServer.scala:307
 

@@ -29,6 +29,7 @@ from predictionio_tpu.controller.base import (
 )
 from predictionio_tpu.controller.engine import (
     BaseEngine,
+    Deployment,
     Engine,
     EngineFactory,
     EngineParams,

@@ -25,6 +25,7 @@ import abc
 import inspect
 from typing import Any, Generic, List, Optional, Sequence, Tuple, TypeVar
 
+from predictionio_tpu.annotation import developer_api
 from predictionio_tpu.controller.params import EmptyParams, Params
 
 TD = TypeVar("TD")  # training data
@@ -45,6 +46,7 @@ class SanityCheck(abc.ABC):
     def sanity_check(self) -> None: ...
 
 
+@developer_api  # reference core/AbstractDoer.scala:25
 def doer(cls, params: Optional[Params] = None):
     """Instantiate a controller class with (params) or zero-arg constructor
     (reference Doer.apply, core/AbstractDoer.scala:33-66). The instance's

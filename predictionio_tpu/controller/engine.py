@@ -459,6 +459,34 @@ class EngineFactory:
         raise KeyError(f"engine params key {key!r} is not defined")
 
 
+class Deployment(EngineFactory):
+    """EngineFactory variant wrapping a set-once engine (reference
+    controller/Deployment.scala:27-56): assign ``deployment.engine = e``
+    once — typically in a module-level object an engine.json points its
+    ``engineFactory`` at — and ``apply()`` serves it. Re-assignment
+    raises, mirroring the reference's assert-guarded setter."""
+
+    def __init__(self, engine: Optional[BaseEngine] = None):
+        self._engine: Optional[BaseEngine] = None
+        if engine is not None:
+            self.engine = engine
+
+    @property
+    def engine(self) -> BaseEngine:
+        if self._engine is None:
+            raise ValueError("Deployment's engine is not set")
+        return self._engine
+
+    @engine.setter
+    def engine(self, value: BaseEngine) -> None:
+        if self._engine is not None:
+            raise ValueError("Deployment's engine can only be set once")
+        self._engine = value
+
+    def apply(self) -> BaseEngine:
+        return self.engine
+
+
 def engine_params_from_file(engine: BaseEngine, path: str) -> EngineParams:
     """Load an engine.json variant file into EngineParams."""
     with open(path) as f:

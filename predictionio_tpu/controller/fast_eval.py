@@ -16,6 +16,7 @@ import json
 import threading
 from typing import Any, Callable, Dict, List, Sequence, Tuple
 
+from predictionio_tpu.annotation import experimental
 from predictionio_tpu.controller.engine import Engine, EngineParams
 from predictionio_tpu.controller.params import Params, params_to_json
 
@@ -26,6 +27,7 @@ def _key_of(pairs: Sequence[Tuple[str, Params]]) -> str:
     )
 
 
+@experimental  # reference FastEvalEngine.scala:282
 class FastEvalEngineWorkflow:
     """Holds the per-stage caches (reference FastEvalEngineWorkflow:295-298)."""
 
@@ -147,6 +149,7 @@ class FastEvalEngineWorkflow:
         )
 
 
+@experimental  # reference FastEvalEngine.scala:309
 class FastEvalEngine(Engine):
     """Engine whose batch_eval memoizes shared params-prefixes
     (reference FastEvalEngine.scala:309-343)."""

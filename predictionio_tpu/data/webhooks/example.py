@@ -1,0 +1,131 @@
+"""Example webhook connectors — the template third parties copy to write
+their own (reference data/webhooks/examplejson/ExampleJsonConnector.scala
+and exampleform/ExampleFormConnector.scala). Both translate two payload
+types, ``userAction`` and ``userActionItem``, into the canonical event
+JSON; the form variant also demonstrates two-level ``context[...]``
+fields and string->number coercion.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Mapping, Optional
+
+from predictionio_tpu.data.webhooks import (
+    ConnectorException,
+    FormConnector,
+    JsonConnector,
+)
+
+
+class ExampleJsonConnector(JsonConnector):
+    """Reference ExampleJsonConnector (examplejson/ExampleJsonConnector.scala:60-126)."""
+
+    def to_event_json(self, data: Mapping[str, Any]) -> Dict[str, Any]:
+        kind = data.get("type")
+        if kind is None:
+            raise ConnectorException(
+                f"Cannot extract Common field from {dict(data)!r}: "
+                "'type' is required."
+            )
+        try:
+            if kind == "userAction":
+                return {
+                    "event": data["event"],
+                    "entityType": "user",
+                    "entityId": data["userId"],
+                    "eventTime": data["timestamp"],
+                    "properties": {
+                        "context": data.get("context"),
+                        "anotherProperty1": data["anotherProperty1"],
+                        "anotherProperty2": data.get("anotherProperty2"),
+                    },
+                }
+            if kind == "userActionItem":
+                return {
+                    "event": data["event"],
+                    "entityType": "user",
+                    "entityId": data["userId"],
+                    "targetEntityType": "item",
+                    "targetEntityId": data["itemId"],
+                    "eventTime": data["timestamp"],
+                    "properties": {
+                        "context": data.get("context"),
+                        "anotherPropertyA": data.get("anotherPropertyA"),
+                        "anotherPropertyB": data.get("anotherPropertyB"),
+                    },
+                }
+        except KeyError as e:
+            raise ConnectorException(
+                f"Cannot convert {dict(data)!r} to event JSON: "
+                f"missing field {e}."
+            ) from e
+        raise ConnectorException(
+            f"Cannot convert unknown type {kind!r} to Event JSON."
+        )
+
+
+class ExampleFormConnector(FormConnector):
+    """Reference ExampleFormConnector (exampleform/ExampleFormConnector.scala:52-130)."""
+
+    def to_event_json(self, data: Mapping[str, str]) -> Dict[str, Any]:
+        kind = data.get("type")
+        if kind is None:
+            raise ConnectorException("The field 'type' is required.")
+        try:
+            if kind == "userAction":
+                props: Dict[str, Any] = {
+                    "anotherProperty1": int(data["anotherProperty1"]),
+                }
+                if "anotherProperty2" in data:
+                    props["anotherProperty2"] = data["anotherProperty2"]
+                context = self._context(data)
+                if context is not None:
+                    props["context"] = context
+                return self._base(data, props)
+            if kind == "userActionItem":
+                props = {}
+                if "anotherPropertyA" in data:
+                    props["anotherPropertyA"] = float(data["anotherPropertyA"])
+                if "anotherPropertyB" in data:
+                    props["anotherPropertyB"] = (
+                        data["anotherPropertyB"].lower() == "true"
+                    )
+                context = self._context(data)
+                if context is not None:
+                    props["context"] = context
+                out = self._base(data, props)
+                out["targetEntityType"] = "item"
+                out["targetEntityId"] = data["itemId"]
+                return out
+        except (KeyError, ValueError) as e:
+            raise ConnectorException(
+                f"Cannot convert {dict(data)!r} to event JSON: {e}."
+            ) from e
+        raise ConnectorException(
+            f"Cannot convert unknown type {kind!r} to event JSON"
+        )
+
+    @staticmethod
+    def _base(data: Mapping[str, str], props: Dict[str, Any]) -> Dict[str, Any]:
+        return {
+            "event": data["event"],
+            "entityType": "user",
+            "entityId": data["userId"],
+            "eventTime": data["timestamp"],
+            "properties": props,
+        }
+
+    @staticmethod
+    def _context(data: Mapping[str, str]) -> Optional[Dict[str, Any]]:
+        """Two-level optional ``context[...]`` form fields
+        (ExampleFormConnector.scala:77-86)."""
+        if not any(k.startswith("context[") for k in data):
+            return None
+        out: Dict[str, Any] = {}
+        if "context[ip]" in data:
+            out["ip"] = data["context[ip]"]
+        if "context[prop1]" in data:
+            out["prop1"] = float(data["context[prop1]"])
+        if "context[prop2]" in data:
+            out["prop2"] = data["context[prop2]"]
+        return out

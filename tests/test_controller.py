@@ -302,3 +302,58 @@ class TestMetrics:
         from predictionio_tpu.controller import ZeroMetric
 
         assert ZeroMetric().calculate(None, self._eval_data(0, 3)) == 0.0
+
+
+class TestDeployment:
+    """Reference controller/Deployment.scala:27-56 — EngineFactory variant
+    wrapping a set-once engine."""
+
+    def test_set_once_and_apply(self):
+        from predictionio_tpu.controller import Deployment
+
+        engine = make_engine()
+        dep = Deployment()
+        dep.engine = engine
+        assert dep.apply() is engine
+        with pytest.raises(ValueError, match="only be set once"):
+            dep.engine = make_engine()
+
+    def test_unset_engine_raises(self):
+        from predictionio_tpu.controller import Deployment
+
+        with pytest.raises(ValueError, match="not set"):
+            Deployment().apply()
+
+    def test_constructor_shortcut(self):
+        from predictionio_tpu.controller import Deployment
+
+        engine = make_engine()
+        assert Deployment(engine).apply() is engine
+
+
+class TestApiAnnotations:
+    """Reference common module @DeveloperApi/@Experimental markers."""
+
+    def test_markers_tag_and_document(self):
+        from predictionio_tpu.annotation import developer_api, experimental
+
+        @experimental
+        class Thing:
+            """Does things."""
+
+        assert Thing.__pio_api__ == "experimental"
+        assert Thing.__doc__.startswith("::experimental::")
+        assert "Does things." in Thing.__doc__
+
+        @developer_api
+        def helper():
+            pass
+
+        assert helper.__pio_api__ == "developer_api"
+
+    def test_shipped_markers(self):
+        from predictionio_tpu.controller import FastEvalEngine
+        from predictionio_tpu.controller.base import doer
+
+        assert FastEvalEngine.__pio_api__ == "experimental"
+        assert doer.__pio_api__ == "developer_api"

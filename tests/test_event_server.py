@@ -429,6 +429,119 @@ class TestMailChimpConnector:
             )
 
 
+EXAMPLE_USER_ACTION = {
+    "type": "userAction",
+    "userId": "as34smg4",
+    "event": "do_something",
+    "context": {"ip": "24.5.68.47", "prop1": 2.345, "prop2": "value1"},
+    "anotherProperty1": 100,
+    "anotherProperty2": "optional1",
+    "timestamp": "2015-01-02T00:30:12.984Z",
+}
+
+EXAMPLE_FORM_ACTION_ITEM = {
+    "type": "userActionItem",
+    "userId": "as34smg4",
+    "event": "do_something_on",
+    "itemId": "kfjd312bc",
+    "context[ip]": "1.23.4.56",
+    "context[prop1]": "2.345",
+    "anotherPropertyA": "4.567",
+    "anotherPropertyB": "false",
+    "timestamp": "2015-01-15T04:20:23.567Z",
+}
+
+
+class TestExampleConnectors:
+    """Reference ExampleJsonConnectorSpec / ExampleFormConnectorSpec —
+    the copy-me templates ship working and registered."""
+
+    def test_json_user_action(self):
+        from predictionio_tpu.data.webhooks.example import ExampleJsonConnector
+
+        event = to_event(ExampleJsonConnector(), EXAMPLE_USER_ACTION)
+        assert event.event == "do_something"
+        assert event.entity_type == "user"
+        assert event.entity_id == "as34smg4"
+        assert event.target_entity_id is None
+        assert event.properties["anotherProperty1"] == 100
+        assert event.properties["context"]["prop1"] == 2.345
+
+    def test_json_user_action_item(self):
+        from predictionio_tpu.data.webhooks.example import ExampleJsonConnector
+
+        event = to_event(
+            ExampleJsonConnector(),
+            {
+                "type": "userActionItem",
+                "userId": "u1",
+                "event": "view",
+                "itemId": "i9",
+                "timestamp": "2015-01-15T04:20:23.567Z",
+                "anotherPropertyA": 4.5,
+            },
+        )
+        assert event.target_entity_type == "item"
+        assert event.target_entity_id == "i9"
+
+    def test_json_unknown_and_missing(self):
+        from predictionio_tpu.data.webhooks.example import ExampleJsonConnector
+
+        with pytest.raises(ConnectorException, match="unknown type"):
+            ExampleJsonConnector().to_event_json({"type": "nope"})
+        with pytest.raises(ConnectorException, match="required"):
+            ExampleJsonConnector().to_event_json({"userId": "u"})
+        with pytest.raises(ConnectorException, match="missing field"):
+            ExampleJsonConnector().to_event_json(
+                {"type": "userAction", "userId": "u"}
+            )
+
+    def test_form_user_action_item_coerces_types(self):
+        from predictionio_tpu.data.webhooks.example import ExampleFormConnector
+
+        event = to_event(ExampleFormConnector(), EXAMPLE_FORM_ACTION_ITEM)
+        assert event.event == "do_something_on"
+        assert event.target_entity_id == "kfjd312bc"
+        # strings became numbers/booleans (ExampleFormConnector.scala)
+        assert event.properties["anotherPropertyA"] == 4.567
+        assert event.properties["anotherPropertyB"] is False
+        assert event.properties["context"] == {
+            "ip": "1.23.4.56", "prop1": 2.345,
+        }
+
+    def test_form_user_action_without_context(self):
+        from predictionio_tpu.data.webhooks.example import ExampleFormConnector
+
+        event = to_event(
+            ExampleFormConnector(),
+            {
+                "type": "userAction",
+                "userId": "u1",
+                "event": "e",
+                "anotherProperty1": "7",
+                "timestamp": "2015-01-02T00:30:12.984Z",
+            },
+        )
+        assert event.properties["anotherProperty1"] == 7
+        assert "context" not in event.properties
+
+    def test_registered_routes(self, api):
+        status, _ = api.handle(
+            "POST",
+            "/webhooks/examplejson.json",
+            {"accessKey": "secret"},
+            json.dumps(EXAMPLE_USER_ACTION).encode(),
+        )
+        assert status == 201
+        status, _ = api.handle(
+            "POST",
+            "/webhooks/exampleform",
+            {"accessKey": "secret"},
+            form=EXAMPLE_FORM_ACTION_ITEM,
+        )
+        assert status == 201
+
+
 class TestWebhookRoutes:
     def test_json_webhook_roundtrip(self, api):
         status, body = api.handle(
