@@ -129,8 +129,30 @@ class BaseAlgorithm(Controller, Generic[PD, M, Q, P]):
 
     sharded_model: bool = False
 
+    # Param field names allowed to differ between variants that train
+    # TOGETHER in one batched device program (see train_grid). Empty =
+    # this algorithm has no device-side grid path; the eval grid falls
+    # back to thread-parallel per-variant training.
+    GRID_AXES: Tuple[str, ...] = ()
+
     def train(self, ctx, prepared_data: PD) -> M:
         raise NotImplementedError
+
+    @classmethod
+    def train_grid(
+        cls, ctx, prepared_data: PD, algos: Sequence["BaseAlgorithm"]
+    ) -> Optional[List[M]]:
+        """Train several param-variants of this algorithm in ONE batched
+        device program, returning one model per entry of ``algos`` (same
+        order), or None when these variants can't be batched (the caller
+        falls back to per-variant ``train``). Called by the FastEval grid
+        with variants whose params differ only in ``GRID_AXES`` fields.
+
+        No reference analog: the reference's grid parallelism is host
+        threads (`.par`, MetricEvaluator.scala:221-230). On TPU, a
+        vmapped train amortizes dispatch and batches the per-variant
+        math onto the MXU — see ops/als.py train_als_grid."""
+        return None
 
     def predict(self, model: M, query: Q) -> P:
         raise NotImplementedError

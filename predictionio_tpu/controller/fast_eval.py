@@ -12,13 +12,17 @@ typically device-resident and stays in HBM across the sweep.
 
 from __future__ import annotations
 
+import dataclasses
 import json
+import logging
 import threading
 from typing import Any, Callable, Dict, List, Sequence, Tuple
 
 from predictionio_tpu.annotation import experimental
 from predictionio_tpu.controller.engine import Engine, EngineParams
 from predictionio_tpu.controller.params import Params, params_to_json
+
+logger = logging.getLogger(__name__)
 
 
 def _key_of(pairs: Sequence[Tuple[str, Params]]) -> str:
@@ -111,6 +115,137 @@ class FastEvalEngineWorkflow:
             build,
         )
 
+    def prefill_grid_models(
+        self, engine_params_list: Sequence[EngineParams]
+    ) -> int:
+        """Device-side grid training: single-algorithm variants whose
+        params differ only in the algorithm's GRID_AXES fields train
+        together in one batched program (BaseAlgorithm.train_grid), and
+        the per-variant models seed algorithms_cache so get_models is a
+        cache hit. Returns the number of variants trained this way.
+
+        Anything that doesn't group (multi-algo engines, differing
+        non-axis params, an algorithm without a grid path) is left for
+        the thread-parallel fallback in batch_eval."""
+        from predictionio_tpu.controller.base import doer
+
+        mode = getattr(self.workflow_params, "grid_train", "auto")
+        if mode not in ("auto", "always", "never"):
+            raise ValueError(
+                f"grid_train must be auto/always/never, got {mode!r}"
+            )
+        if mode == "never":
+            return 0
+        if mode == "auto":
+            import jax
+
+            if jax.default_backend() == "cpu":
+                # CPU dispatch is cheap and the vmapped program serializes
+                # the variants anyway — measured slower than per-variant
+                # trains with shared (bucketed-shape) executables
+                return 0
+
+        # group by (ds, prep, algo name, params-with-axes-normalized)
+        groups: Dict[Tuple, List[EngineParams]] = {}
+        defaults_by_class: Dict[type, Any] = {}
+        for ep in engine_params_list:
+            if len(ep.algorithm_params_list) != 1:
+                continue
+            name, params = ep.algorithm_params_list[0]
+            try:
+                cls = self.engine._lookup(
+                    self.engine.algorithm_class_map, name, "Algorithm"
+                )
+            except (KeyError, ValueError):
+                continue
+            axes = getattr(cls, "GRID_AXES", ())
+            if not axes or not dataclasses.is_dataclass(params):
+                continue
+            fields = {f.name for f in dataclasses.fields(params)}
+            if not all(a in fields for a in axes):
+                continue
+            pcls = type(params)
+            if pcls not in defaults_by_class:
+                try:
+                    defaults_by_class[pcls] = pcls()
+                except TypeError:
+                    # params class with required fields can't provide
+                    # neutral axis values — skip grouping, don't crash
+                    defaults_by_class[pcls] = None
+            default_params = defaults_by_class[pcls]
+            if default_params is None:
+                continue
+            normalized = dataclasses.replace(
+                params, **{a: getattr(default_params, a, None) for a in axes}
+            )
+            key = (
+                _key_of([ep.data_source_params, ep.preparator_params]),
+                name,
+                _key_of([("", normalized)]),
+            )
+            groups.setdefault(key, []).append(ep)
+
+        def grid_one_group(item) -> int:
+            (_, name, _), eps = item
+            # dedup variants whose FULL algo params match (they share a
+            # cache entry anyway)
+            unique: Dict[str, EngineParams] = {}
+            for ep in eps:
+                unique.setdefault(self._models_key(ep), ep)
+            eps = list(unique.values())
+            if len(eps) < 2:
+                return 0
+            cls = self.engine._lookup(
+                self.engine.algorithm_class_map, name, "Algorithm"
+            )
+            algos = [
+                doer(cls, ep.algorithm_params_list[0][1]) for ep in eps
+            ]
+            prepared = self.get_prepared(
+                eps[0].data_source_params, eps[0].preparator_params
+            )
+            fold_models = []  # [fold][variant]
+            for pd, _, _ in prepared:
+                try:
+                    models = cls.train_grid(self.ctx, pd, algos)
+                except Exception:
+                    # a failed batched train (e.g. the vmapped program
+                    # OOMs where serial variants would fit) must fall
+                    # back, not abort the evaluation
+                    logger.warning(
+                        "train_grid failed for %s; falling back to "
+                        "per-variant training", cls.__name__, exc_info=True,
+                    )
+                    return 0
+                if models is None or len(models) != len(algos):
+                    return 0
+                fold_models.append(models)
+            for v, ep in enumerate(eps):
+                self.algorithms_cache[self._models_key(ep)] = [
+                    [models[v]] for models in fold_models
+                ]
+            return len(eps)
+
+        # groups (e.g. the rank-8 and rank-16 halves of a grid) run
+        # concurrently: their XLA compiles release the GIL and overlap
+        from predictionio_tpu.controller.engine import _run_grid
+
+        n_gridded = sum(
+            _run_grid(list(groups.items()), grid_one_group, self.workflow_params)
+        )
+        if n_gridded:
+            logger.info(
+                "FastEval: %d grid variants trained device-side (vmapped)",
+                n_gridded,
+            )
+        return n_gridded
+
+    def _models_key(self, ep: EngineParams) -> str:
+        return _key_of(
+            [ep.data_source_params, ep.preparator_params]
+            + list(ep.algorithm_params_list)
+        )
+
     def get_results(self, engine_params: EngineParams):
         ds_pair = engine_params.data_source_params
         prep_pair = engine_params.preparator_params
@@ -160,6 +295,10 @@ class FastEvalEngine(Engine):
         from predictionio_tpu.controller.engine import _run_grid
 
         workflow = FastEvalEngineWorkflow(self, ctx, workflow_params)
+        # device-side grid pass first: variants differing only in an
+        # algorithm's GRID_AXES train in one vmapped program; whatever
+        # it can't batch runs through the thread-parallel fallback below
+        workflow.prefill_grid_models(engine_params_list)
         return _run_grid(
             engine_params_list,
             lambda ep: (ep, workflow.get_results(ep)),

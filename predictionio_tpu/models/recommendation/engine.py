@@ -304,6 +304,47 @@ class ALSAlgorithm(BaseAlgorithm):
 
     params_class = ALSAlgorithmParams
     query_class = Query
+    # reg variants of one config train together in a single vmapped
+    # program during grid evaluation (ops/als.py train_als_grid)
+    GRID_AXES = ("lambda_",)
+
+    @classmethod
+    def train_grid(cls, ctx, pd: PreparedData, algos):
+        from predictionio_tpu.ops.als import train_als_grid
+
+        base: ALSAlgorithmParams = algos[0].params
+        for a in algos:
+            p: ALSAlgorithmParams = a.params
+            if dataclasses.replace(p, lambda_=0.0) != dataclasses.replace(
+                base, lambda_=0.0
+            ):
+                return None  # differ beyond the reg axis
+            if p.checkpoint_dir is not None:
+                return None  # checkpoint state is per-run, not per-grid
+        td = pd.td
+        config = ALSConfig(
+            rank=base.rank,
+            iterations=base.num_iterations,
+            reg=0.0,  # per-variant regs travel in the grid axis
+            alpha=base.alpha,
+            implicit_prefs=base.implicit_prefs,
+            seed=base.seed if base.seed is not None else 0,
+        )
+        arrays_list = train_als_grid(
+            td.user_idx, td.item_idx, td.ratings,
+            n_users=len(td.user_index), n_items=len(td.item_index),
+            config=config,
+            regs=[a.params.lambda_ for a in algos],
+            mesh=ctx.mesh if ctx is not None else None,
+        )
+        return [
+            ALSModel(
+                arrays=arrays,
+                user_index=td.user_index,
+                item_index=td.item_index,
+            )
+            for arrays in arrays_list
+        ]
 
     def train(self, ctx, pd: PreparedData) -> ALSModel:
         td = pd.td

@@ -42,7 +42,7 @@ import functools
 import hashlib
 import logging
 import math
-from typing import Optional, Sequence, Tuple
+from typing import List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -140,7 +140,19 @@ def pack_segments(
     # pad out to the full chunk budget
     sc = max(1, int(chunk_slots) // L)
     sc = max(pad_segments_to, sc - sc % pad_segments_to)
-    sc_needed = -(-max(n_segs, 1) // pad_segments_to) * pad_segments_to
+    # Bucket the needed segment count to a power-of-two multiple of the
+    # shard pad: the packed arrays' shapes feed straight into jit, and
+    # k-fold/grid evaluation produces near-identical segment counts
+    # (e.g. 402/403/408) that would otherwise each pay a full XLA
+    # compile. Pow2 bucketing collapses them onto one executable; the
+    # extra segments carry the sentinel row id and are masked out.
+    # Waste is bounded: bucketing only changes sc in the single-chunk
+    # regime (sc_needed below the chunk budget, min() below), so the
+    # extra slots never exceed one chunk budget (chunk_slots ≈ 36 MB of
+    # pack arrays at the default); budget-capped large trains (ML-20M)
+    # get the same sc as before and pad at most one trailing chunk.
+    per_pad = -(-max(n_segs, 1) // pad_segments_to)
+    sc_needed = pad_segments_to * (1 << (per_pad - 1).bit_length())
     sc = min(sc, sc_needed)
     n_chunks = max(1, -(-max(n_segs, 1) // sc))
     total = n_chunks * sc
@@ -333,10 +345,174 @@ def _run_iterations(
     return jax.lax.fori_loop(0, n_iters, body, (X, Y))
 
 
+@functools.partial(
+    jax.jit,
+    static_argnames=("implicit", "compute_dtype"),
+    donate_argnums=(0, 1),
+)
+def _run_iterations_grid(
+    X: jax.Array,  # [V, R_u, k] per-variant factors
+    Y: jax.Array,  # [V, R_i, k]
+    user_pack,  # shared across variants — only the regularizer differs
+    item_pack,
+    user_lam: jax.Array,  # [V, R_u]
+    item_lam: jax.Array,  # [V, R_i]
+    user_has_obs: jax.Array,  # [R_u]
+    item_has_obs: jax.Array,  # [R_i]
+    alpha,
+    n_iters: jax.Array,
+    *,
+    implicit: bool,
+    compute_dtype: str,
+) -> Tuple[jax.Array, jax.Array]:
+    """The reg-grid training loop as ONE vmapped XLA program: V variants
+    that share data/rank/iterations and differ only in the regularizer
+    train together, so one dispatch covers the whole grid axis and the
+    per-variant einsums batch onto the MXU instead of running as V
+    serial programs (the reference's grid is host-thread `.par`,
+    MetricEvaluator.scala:221-230 — there is no device-side analog)."""
+
+    def single(X1, Y1, ul, il):
+        k = X1.shape[-1]
+        zeros_g = jnp.zeros((k, k), jnp.float32)
+
+        def half(Xs, Ys, pack, lam, has_obs):
+            G = _gramian(Ys) if implicit else zeros_g
+            return _solve_side(
+                Xs, Ys, G, pack, lam, has_obs, alpha,
+                implicit=implicit, compute_dtype=compute_dtype,
+            )
+
+        def body(_, carry):
+            Xc, Yc = carry
+            Xc = half(Xc, Yc, user_pack, ul, user_has_obs)
+            Yc = half(Yc, Xc, item_pack, il, item_has_obs)
+            return (Xc, Yc)
+
+        return jax.lax.fori_loop(0, n_iters, body, (X1, Y1))
+
+    return jax.vmap(single)(X, Y, user_lam, item_lam)
+
+
+def train_als_grid(
+    user_idx: np.ndarray,
+    item_idx: np.ndarray,
+    ratings: np.ndarray,
+    n_users: int,
+    n_items: int,
+    config: "ALSConfig",
+    regs: Sequence[float],
+    mesh: Optional[Mesh] = None,
+    axis: str = "data",
+) -> List["ALSModelArrays"]:
+    """Train ``len(regs)`` regularizer variants of one ALS configuration
+    in a single batched device program (everything but ``config.reg`` is
+    shared: data is packed once, initial factors are identical, and the
+    iteration loop is vmapped over the reg axis).
+
+    Returns one ALSModelArrays per reg, in order — numerically identical
+    to ``train_als`` with ``config.reg = regs[i]`` run one at a time.
+    With a multi-device mesh the batched axis would need per-variant
+    sharding specs; the grid path is an eval-time optimization for
+    single-chip tuning runs, so it falls back to serial sharded training
+    there. A one-device mesh (the default workflow context) uses the
+    grid path — there is nothing to shard.
+    """
+    if mesh is not None and mesh.size == 1:
+        mesh = None
+    if mesh is not None:
+        return [
+            train_als(
+                user_idx, item_idx, ratings, n_users, n_items,
+                dataclasses.replace(config, reg=float(r)),
+                mesh=mesh, axis=axis,
+            )
+            for r in regs
+        ]
+    k = config.rank
+    n_variants = len(regs)
+    if n_variants == 0:
+        return []
+
+    user_side = pack_segments(
+        user_idx, item_idx, ratings, n_users,
+        auto_segment_length(user_idx, n_users, config.segment_length),
+        1, config.chunk_slots,
+    )
+    item_side = pack_segments(
+        item_idx, user_idx, ratings, n_items,
+        auto_segment_length(item_idx, n_items, config.segment_length),
+        1, config.chunk_slots,
+    )
+    logger.info(
+        "ALS grid: %d reg variants x (%d users, %d items, %d ratings, "
+        "rank %d) in one vmapped program",
+        n_variants, n_users, n_items, len(ratings), k,
+    )
+
+    rng = np.random.default_rng(config.seed)
+    r_u, r_i = n_users + 1, n_items + 1  # +1 sentinel row
+    Y0 = np.zeros((r_i, k), np.float32)
+    Y0[:n_items] = np.abs(rng.standard_normal((n_items, k))) / math.sqrt(k)
+
+    weighted = config.reg_mode == "weighted"
+
+    def lam_grid(side: PackedSide, n_sys_rows: int) -> np.ndarray:
+        counts = np.zeros(n_sys_rows, np.float32)
+        counts[: side.n_rows] = side.counts
+        out = np.empty((n_variants, n_sys_rows), np.float32)
+        for v, reg in enumerate(regs):
+            lam = reg * counts if weighted else np.full_like(counts, reg)
+            out[v] = np.maximum(lam, 1e-8)
+        return out
+
+    def obs(side: PackedSide, n_sys_rows: int) -> np.ndarray:
+        counts = np.zeros(n_sys_rows, np.float32)
+        counts[: side.n_rows] = side.counts
+        return counts > 0
+
+    pack = lambda side: (
+        jnp.asarray(side.seg_rows), jnp.asarray(side.cols),
+        jnp.asarray(side.vals), jnp.asarray(side.mask),
+    )
+    X = jnp.zeros((n_variants, r_u, k), jnp.float32)
+    Y = jnp.broadcast_to(jnp.asarray(Y0), (n_variants, r_i, k)) + 0.0
+    X, Y = _run_iterations_grid(
+        X, Y, pack(user_side), pack(item_side),
+        jnp.asarray(lam_grid(user_side, r_u)),
+        jnp.asarray(lam_grid(item_side, r_i)),
+        jnp.asarray(obs(user_side, r_u)),
+        jnp.asarray(obs(item_side, r_i)),
+        config.alpha, jnp.int32(config.iterations),
+        implicit=config.implicit_prefs,
+        compute_dtype=config.compute_dtype,
+    )
+    X_host, Y_host = np.asarray(X), np.asarray(Y)
+    return [
+        ALSModelArrays(X_host[v, :n_users], Y_host[v, :n_items])
+        for v in range(n_variants)
+    ]
+
+
 def _place(mesh: Optional[Mesh], arr, spec):
     if mesh is None:
         return jnp.asarray(arr)
     return jax.device_put(arr, NamedSharding(mesh, spec))
+
+
+def auto_segment_length(idx: np.ndarray, n_rows: int, cap: int) -> int:
+    """Smallest power of two >= the side's mean observation count, within
+    [min(8, cap), cap] — shared by train_als and train_als_grid so the
+    two paths always pack identically (see ALSConfig.segment_length)."""
+    floor = min(8, cap)  # honor caps below 8
+    nonempty = int((np.bincount(idx, minlength=n_rows) > 0).sum())
+    if nonempty == 0:
+        return floor
+    mean = len(idx) / nonempty
+    L = floor
+    while L < cap and L < mean:
+        L *= 2
+    return L
 
 
 def _sync_fetch(tree) -> None:
@@ -397,27 +573,16 @@ def train_als(
     k = config.rank
     n_shards = mesh.shape[axis] if mesh is not None else 1
 
-    def auto_segment_length(idx, n_rows: int) -> int:
-        # smallest power of two >= the side's mean observation count,
-        # within [8, config.segment_length] — see ALSConfig.segment_length
-        floor = min(8, config.segment_length)  # honor caps below 8
-        nonempty = int((np.bincount(idx, minlength=n_rows) > 0).sum())
-        if nonempty == 0:
-            return floor
-        mean = len(idx) / nonempty
-        L = floor
-        while L < config.segment_length and L < mean:
-            L *= 2
-        return L
-
     t_phase = _time.perf_counter()
     user_side = pack_segments(
         user_idx, item_idx, ratings, n_users,
-        auto_segment_length(user_idx, n_users), n_shards, config.chunk_slots,
+        auto_segment_length(user_idx, n_users, config.segment_length),
+        n_shards, config.chunk_slots,
     )
     item_side = pack_segments(
         item_idx, user_idx, ratings, n_items,
-        auto_segment_length(item_idx, n_items), n_shards, config.chunk_slots,
+        auto_segment_length(item_idx, n_items, config.segment_length),
+        n_shards, config.chunk_slots,
     )
     if timings is not None:
         timings["pack_s"] = _time.perf_counter() - t_phase

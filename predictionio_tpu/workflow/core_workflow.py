@@ -21,6 +21,7 @@ from typing import List, Optional, Sequence
 
 from predictionio_tpu.controller.engine import (
     BaseEngine,
+    Engine,
     EngineParams,
     StopAfterPrepareInterruption,
     StopAfterReadInterruption,
@@ -134,6 +135,7 @@ class CoreWorkflow:
     ):
         """Evaluate a params grid; store + return the evaluator result."""
         workflow_params = workflow_params or WorkflowParams()
+        engine_params_list = list(engine_params_list)  # may be a generator
         ctx = ctx or workflow_context(mode="evaluation", batch=workflow_params.batch)
         storage = ctx.storage
         instances = storage.get_meta_data_evaluation_instances()
@@ -151,9 +153,31 @@ class CoreWorkflow:
         )
         try:
             engine = evaluation.engine
+            if (
+                workflow_params.fast_eval
+                and type(engine) is Engine
+                and len(engine_params_list) > 1
+            ):
+                # Grid evaluation runs through FastEvalEngine: stage
+                # results memoize across shared params-prefixes and
+                # reg-axis variants train in one vmapped device program
+                # (BaseAlgorithm.train_grid). Results are identical to
+                # the plain engine — FastEval is the reference's own
+                # eval-only engine (FastEvalEngine.scala:42-48); it
+                # leaves it opt-in only because its caches cost memory.
+                from predictionio_tpu.controller.fast_eval import (
+                    FastEvalEngine,
+                )
+
+                engine = FastEvalEngine(
+                    engine.data_source_class_map,
+                    engine.preparator_class_map,
+                    engine.algorithm_class_map,
+                    engine.serving_class_map,
+                )
             # EvaluationWorkflow.runEvaluation (reference :31-42)
             engine_eval_data_set = engine.batch_eval(
-                ctx, list(engine_params_list), workflow_params
+                ctx, engine_params_list, workflow_params
             )
             result = evaluation.evaluator.evaluate_base(
                 ctx, evaluation, engine_eval_data_set, workflow_params

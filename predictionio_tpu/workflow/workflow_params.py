@@ -23,3 +23,20 @@ class WorkflowParams:
     # Host stages (reads, bucketization, python glue) overlap while device
     # programs queue; <=1 runs the grid serially.
     eval_parallelism: int = 4
+    # Device-side grid training (BaseAlgorithm.train_grid): variants
+    # differing only in an algorithm's GRID_AXES train in one vmapped
+    # program. "auto" enables it except on the CPU backend, where
+    # dispatch is cheap and the batched program compiles/executes slower
+    # than per-variant trains; "always"/"never" force it either way.
+    grid_train: str = "auto"
+    # Multi-variant evaluation upgrades a plain Engine to FastEvalEngine
+    # (stage memoization + the grid_train path). The caches retain every
+    # variant's models and served results for the sweep's duration —
+    # set False on very large grids where that working set won't fit.
+    fast_eval: bool = True
+
+    def __post_init__(self):
+        if self.grid_train not in ("auto", "always", "never"):
+            raise ValueError(
+                f"grid_train must be auto/always/never, got {self.grid_train!r}"
+            )

@@ -265,3 +265,91 @@ class TestServingOps:
         np.testing.assert_array_equal(
             idx, np.argsort(-full, axis=1, kind="stable")[:, :7]
         )
+
+
+class TestPackShapeBucketing:
+    def test_near_equal_segment_counts_share_shapes(self):
+        """k-fold/grid eval packs near-identical segment counts (402 vs
+        408); bucketed Sc must give them the SAME array shapes so they
+        share one compiled executable instead of one each."""
+        shapes = set()
+        for n in (402, 403, 408):
+            u = np.arange(n, dtype=np.int32) % 450
+            i = np.arange(n, dtype=np.int32) % 30
+            r = np.ones(n, np.float32)
+            side = pack_segments(u, i, r, 450, segment_length=8)
+            shapes.add(side.cols.shape)
+        assert len(shapes) == 1, shapes
+
+    def test_bucketing_keeps_shard_divisibility(self):
+        u = np.arange(100, dtype=np.int32)
+        i = np.zeros(100, np.int32)
+        r = np.ones(100, np.float32)
+        side = pack_segments(u, i, r, 100, segment_length=8, pad_segments_to=8)
+        assert side.seg_rows.shape[1] % 8 == 0
+        assert int(side.mask.sum()) == 100
+
+
+class TestGridALS:
+    def test_grid_matches_serial_per_reg(self):
+        """train_als_grid == train_als per variant, explicit + implicit
+        (the device-side grid path must be a pure speedup, VERDICT r2 #7)."""
+        import dataclasses
+
+        from predictionio_tpu.ops.als import train_als_grid
+
+        u, i, r = synthetic(noise=0.1)
+        regs = [0.01, 0.1, 1.0]
+        for implicit in (False, True):
+            cfg = ALSConfig(rank=4, iterations=4, implicit_prefs=implicit)
+            grid = train_als_grid(u, i, r, 60, 40, cfg, regs)
+            assert len(grid) == 3
+            for v, reg in enumerate(regs):
+                single = train_als(
+                    u, i, r, 60, 40, dataclasses.replace(cfg, reg=reg)
+                )
+                np.testing.assert_allclose(
+                    grid[v].user_factors, single.user_factors,
+                    rtol=2e-4, atol=2e-5,
+                )
+                np.testing.assert_allclose(
+                    grid[v].item_factors, single.item_factors,
+                    rtol=2e-4, atol=2e-5,
+                )
+
+    def test_one_device_mesh_uses_grid_path(self):
+        """The default workflow context carries a 1-device mesh; the grid
+        must still train batched there (nothing to shard)."""
+        from unittest import mock
+
+        from predictionio_tpu.ops.als import _run_iterations_grid, train_als_grid
+        from predictionio_tpu.parallel import make_mesh
+
+        import jax
+
+        mesh = make_mesh({"data": 1}, jax.devices()[:1])
+        u, i, r = synthetic()
+        cfg = ALSConfig(rank=4, iterations=2)
+        with mock.patch(
+            "predictionio_tpu.ops.als._run_iterations_grid",
+            wraps=_run_iterations_grid,
+        ) as spy:
+            out = train_als_grid(u, i, r, 60, 40, cfg, [0.01, 0.1], mesh=mesh)
+        assert len(out) == 2
+        assert spy.call_count == 1  # one batched program, not serial falls
+
+    def test_multi_device_mesh_falls_back_serially(self):
+        from predictionio_tpu.ops.als import train_als_grid
+        from predictionio_tpu.parallel import make_mesh
+
+        import jax
+
+        if len(jax.devices()) < 2:
+            pytest.skip("needs the virtual multi-device CPU platform")
+        mesh = make_mesh({"data": 2}, jax.devices()[:2])
+        u, i, r = synthetic()
+        cfg = ALSConfig(rank=4, iterations=2)
+        out = train_als_grid(u, i, r, 60, 40, cfg, [0.01, 0.1], mesh=mesh)
+        assert len(out) == 2
+        for m in out:
+            assert np.isfinite(m.user_factors).all()

@@ -21,6 +21,7 @@ from predictionio_tpu.models.recommendation.engine import (
 )
 from predictionio_tpu.workflow.context import WorkflowContext
 from predictionio_tpu.workflow.core_workflow import CoreWorkflow
+from predictionio_tpu.workflow.workflow_params import WorkflowParams
 
 
 class TestPrecisionAtK:
@@ -106,3 +107,71 @@ class TestRecommendationEvaluation:
             ctx=ctx,
         )
         assert result.best_score.score > 0.2
+
+
+class TestDeviceSideGrid:
+    def test_prefill_grid_trains_reg_variants_batched(self, seeded):
+        """The rank-8 pair and rank-16 pair of the grid each train in ONE
+        vmapped program (BaseAlgorithm.train_grid via FastEval prefill),
+        and scores are identical to per-variant training."""
+        from unittest import mock
+
+        from predictionio_tpu.controller.fast_eval import (
+            FastEvalEngineWorkflow,
+        )
+        from predictionio_tpu.models.recommendation.engine import ALSAlgorithm
+
+        ctx = WorkflowContext(mode="evaluation", storage=seeded)
+        grid = ParamsGrid()
+
+        with mock.patch.object(
+            ALSAlgorithm, "train_grid", wraps=ALSAlgorithm.train_grid
+        ) as grid_spy, mock.patch.object(
+            ALSAlgorithm, "train", wraps=ALSAlgorithm.train
+        ) as train_spy:
+            result = CoreWorkflow.run_evaluation(
+                RecommendationEvaluation(k=5), grid.engine_params_list, ctx=ctx,
+                workflow_params=WorkflowParams(grid_train="always"),
+            )
+        # 2 rank-groups x 3 eval folds grid-trained; zero per-variant trains
+        assert grid_spy.call_count == 6
+        assert train_spy.call_count == 0
+        assert len(result.engine_params_scores) == 4
+
+        # identical scores vs the thread-pool path with prefill disabled
+        ctx2 = WorkflowContext(mode="evaluation", storage=seeded)
+        with mock.patch.object(
+            FastEvalEngineWorkflow, "prefill_grid_models", return_value=0
+        ):
+            result2 = CoreWorkflow.run_evaluation(
+                RecommendationEvaluation(k=5), grid.engine_params_list,
+                ctx=ctx2,
+            )
+        scores1 = [sc.score for _, sc in result.engine_params_scores]
+        scores2 = [sc.score for _, sc in result2.engine_params_scores]
+        assert scores1 == pytest.approx(scores2, abs=1e-9)
+
+    def test_rank_variants_do_not_cross_batch(self, seeded):
+        """Variants differing beyond the reg axis (different rank) must
+        not share a grid train; they group separately."""
+        from unittest import mock
+
+        from predictionio_tpu.models.recommendation.engine import ALSAlgorithm
+
+        ctx = WorkflowContext(mode="evaluation", storage=seeded)
+        grid = ParamsGrid()
+        seen_groups = []
+        real = ALSAlgorithm.train_grid.__func__
+
+        def spy(cls, c, pd, algos):
+            seen_groups.append(tuple(a.params.rank for a in algos))
+            return real(cls, c, pd, algos)
+
+        with mock.patch.object(ALSAlgorithm, "train_grid", classmethod(spy)):
+            CoreWorkflow.run_evaluation(
+                RecommendationEvaluation(k=5), grid.engine_params_list, ctx=ctx,
+                workflow_params=WorkflowParams(grid_train="always"),
+            )
+        assert seen_groups  # grid engaged
+        for ranks in seen_groups:
+            assert len(set(ranks)) == 1  # never mixes ranks in one batch
