@@ -129,11 +129,8 @@ class FastEvalEngineWorkflow:
         the thread-parallel fallback in batch_eval."""
         from predictionio_tpu.controller.base import doer
 
+        # value validated by WorkflowParams.__post_init__
         mode = getattr(self.workflow_params, "grid_train", "auto")
-        if mode not in ("auto", "always", "never"):
-            raise ValueError(
-                f"grid_train must be auto/always/never, got {mode!r}"
-            )
         if mode == "never":
             return 0
         if mode == "auto":

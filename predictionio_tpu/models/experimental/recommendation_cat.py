@@ -1,0 +1,222 @@
+"""Recommendation-with-categories example engine.
+
+Reference mapping (examples/experimental/scala-parallel-recommendation-cat/):
+implicit-feedback ALS over aggregated VIEW counts — view events of the
+same (user, item) pair sum into one implicit rating
+(ALSAlgorithm.scala:77-100 ``reduceByKey(_ + _)`` then
+``ALS.trainImplicit`` :107-116) — with predict-time candidate filtering
+by item ``categories`` (an optional item property, DataSource.scala:51-52)
+plus query whiteList/blackList (ALSAlgorithm.scala predict :137-186;
+isCandidateItem :200-216). Scores <= 0 are dropped like the reference's
+``.filter(_._2 > 0)``.
+
+This build reuses the e-commerce family's model + candidate-mask
+machinery (models/ecommerce/engine.py — same Query shape and filters)
+and swaps training to the view-count implicit path. Predict uses no
+live event-store reads — the reference example has none.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from predictionio_tpu.controller import EngineFactory, FirstServing, Params
+from predictionio_tpu.controller.base import BaseDataSource, BasePreparator
+from predictionio_tpu.controller.engine import Engine
+from predictionio_tpu.data.bimap import BiMap
+from predictionio_tpu.data.store import PEventStore
+from predictionio_tpu.models.ecommerce.engine import (  # noqa: F401
+    ECommAlgorithm,
+    ECommModel,
+    Item,
+    ItemScore,
+    PredictedResult,
+    Query,
+)
+from predictionio_tpu.ops.als import ALSConfig, train_als
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class ViewEvent:
+    """Reference ViewEvent (DataSource.scala:102)."""
+
+    user: str
+    item: str
+    t: float
+
+
+@dataclasses.dataclass
+class TrainingData:
+    users: Dict[str, dict]
+    items: Dict[str, Item]
+    view_events: List[ViewEvent]
+
+    def sanity_check(self) -> None:
+        if not self.view_events:
+            raise ValueError("viewEvents is empty — are view events present?")
+        if not self.users:
+            raise ValueError("users is empty — are user $set events present?")
+        if not self.items:
+            raise ValueError("items is empty — are item $set events present?")
+
+
+@dataclasses.dataclass
+class PreparedData:
+    td: TrainingData
+
+
+@dataclasses.dataclass(frozen=True)
+class DataSourceParams(Params):
+    app_name: str = "default"
+    channel_name: Optional[str] = None
+
+
+class DataSource(BaseDataSource):
+    """Users + items (with optional categories) + view events
+    (reference DataSource.scala:20-96)."""
+
+    params_class = DataSourceParams
+
+    def read_training(self, ctx) -> TrainingData:
+        store = PEventStore(ctx.storage)
+        p = self.params
+        users = {
+            eid: dict(props)
+            for eid, props in store.aggregate_properties(
+                p.app_name, entity_type="user", channel_name=p.channel_name
+            ).items()
+        }
+        items = {
+            eid: Item(categories=tuple(props.get_or_else("categories", [])))
+            for eid, props in store.aggregate_properties(
+                p.app_name, entity_type="item", channel_name=p.channel_name
+            ).items()
+        }
+        views = [
+            ViewEvent(
+                user=e.entity_id,
+                item=e.target_entity_id,
+                t=e.event_time.timestamp(),
+            )
+            for e in store.find(
+                p.app_name,
+                channel_name=p.channel_name,
+                entity_type="user",
+                event_names=["view"],
+                target_entity_type="item",
+            )
+        ]
+        logger.info(
+            "DataSource: %d users, %d items, %d view events",
+            len(users), len(items), len(views),
+        )
+        return TrainingData(users=users, items=items, view_events=views)
+
+
+class Preparator(BasePreparator):
+    def prepare(self, ctx, td: TrainingData) -> PreparedData:
+        return PreparedData(td=td)
+
+
+@dataclasses.dataclass(frozen=True)
+class CatALSAlgorithmParams(Params):
+    """Reference ALSAlgorithmParams (ALSAlgorithm.scala:20-25)."""
+
+    rank: int = 10
+    num_iterations: int = 20
+    lambda_: float = 0.01
+    alpha: float = 1.0
+    seed: Optional[int] = 3
+
+
+class CatALSAlgorithm(ECommAlgorithm):
+    """Implicit ALS over summed view counts; candidate filtering by
+    categories/whiteList/blackList at predict (no live store reads)."""
+
+    params_class = CatALSAlgorithmParams
+    query_class = Query
+
+    def train(self, ctx, pd: PreparedData) -> ECommModel:
+        td = pd.td
+        p = self.params
+        user_index = BiMap.string_int(
+            set(td.users.keys()) | {v.user for v in td.view_events}
+        )
+        item_index = BiMap.string_int(td.items.keys())
+        # aggregate all view events of the same user-item pair
+        # (reference reduceByKey(_ + _), ALSAlgorithm.scala:96)
+        counts: Dict[Tuple[int, int], float] = {}
+        for v in td.view_events:
+            if v.item not in item_index:
+                logger.info(
+                    "couldn't convert nonexistent item ID %s", v.item
+                )
+                continue
+            key = (user_index[v.user], item_index[v.item])
+            counts[key] = counts.get(key, 0.0) + 1.0
+        if not counts:
+            raise ValueError(
+                "mllibRatings cannot be empty — do events reference "
+                "$set items?"
+            )
+        triples = [(u, i, c) for (u, i), c in counts.items()]
+        u, i, c = (np.asarray(x) for x in zip(*triples))
+        arrays = train_als(
+            u.astype(np.int32),
+            i.astype(np.int32),
+            c.astype(np.float32),
+            n_users=len(user_index),
+            n_items=len(item_index),
+            config=ALSConfig(
+                rank=p.rank,
+                iterations=p.num_iterations,
+                reg=p.lambda_,
+                alpha=p.alpha,
+                implicit_prefs=True,  # ALS.trainImplicit :107
+                seed=p.seed if p.seed is not None else 0,
+            ),
+            mesh=ctx.mesh if ctx is not None else None,
+        )
+        return ECommModel(
+            user_factors=arrays.user_factors,
+            item_factors=arrays.item_factors,
+            user_index=user_index,
+            item_index=item_index,
+            items={item_index[k]: v for k, v in td.items.items()},
+        )
+
+    # The reference example has no live event-store lookups at predict:
+    # no seen-item filtering, no unavailableItems constraint, no
+    # unknown-user similar-items fallback.
+
+    def _seen_items(self, query: Query):
+        return set()
+
+    def _unavailable_items(self):
+        return set()
+
+    def _similar_to_recent(self, model: ECommModel, query: Query):
+        return None
+
+    # "only keep items with score > 0" (ALSAlgorithm.scala:178) is the
+    # inherited _finish's `scores > 0` mask — no override needed.
+
+
+def recommendation_cat_engine() -> Engine:
+    return Engine(
+        data_source_classes=DataSource,
+        preparator_classes=Preparator,
+        algorithm_classes={"als": CatALSAlgorithm},
+        serving_classes=FirstServing,
+    )
+
+
+class RecommendationCatEngineFactory(EngineFactory):
+    def apply(self) -> Engine:
+        return recommendation_cat_engine()

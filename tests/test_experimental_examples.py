@@ -473,3 +473,345 @@ class TestDIMSUMStandaloneEngine:
         assert got and "i0" not in got
         # co-viewed cluster dominates
         assert got <= {"i1", "i2", "i3"}
+
+
+class TestHelloWorld:
+    def test_average_per_day(self, tmp_path):
+        from predictionio_tpu.models.experimental.helloworld import (
+            helloworld_engine,
+        )
+        from predictionio_tpu.controller import EngineParams
+        from predictionio_tpu.controller.engine import SimpleEngineParams
+        from predictionio_tpu.models.experimental.helloworld import (
+            DataSourceParams,
+            Query,
+        )
+        from predictionio_tpu.workflow.workflow_params import WorkflowParams
+
+        csv = tmp_path / "data.csv"
+        csv.write_text("Mon,75.5\nTue,80.1\nMon,76.5\nWed,69.0\n")
+        engine = helloworld_engine()
+        ep = SimpleEngineParams(
+            data_source_params=DataSourceParams(filepath=str(csv)),
+        ).to_engine_params()
+        [model] = engine.train(None, ep, WorkflowParams())
+        assert model.temperatures["Mon"] == pytest.approx(76.0)
+        assert model.temperatures["Wed"] == pytest.approx(69.0)
+        from predictionio_tpu.models.experimental.helloworld import Algorithm
+
+        algo = Algorithm()
+        assert algo.predict(model, Query(day="Tue")).temperature == pytest.approx(80.1)
+
+    def test_factory(self):
+        from predictionio_tpu.models.experimental.helloworld import (
+            HelloWorldEngineFactory,
+        )
+
+        assert HelloWorldEngineFactory().apply() is not None
+
+
+class TestMovieLensFiltering:
+    def test_blacklist_filter_applied_per_query(self, mem_storage, tmp_path):
+        from predictionio_tpu.models.experimental.movielens_filtering import (
+            TempFilter,
+            TempFilterParams,
+        )
+        from predictionio_tpu.models.recommendation.engine import (
+            ItemScore,
+            PredictedResult,
+            Query,
+        )
+
+        blacklist = tmp_path / "blacklisted.txt"
+        blacklist.write_text("i2\ni4\n")
+        serving = TempFilter(TempFilterParams(filepath=str(blacklist)))
+        pred = PredictedResult(
+            item_scores=tuple(
+                ItemScore(item=f"i{j}", score=float(10 - j)) for j in range(5)
+            )
+        )
+        out = serving.serve(Query(user="u", num=5), [pred])
+        assert [s.item for s in out.item_scores] == ["i0", "i1", "i3"]
+        # the file is re-read per query: edits apply without redeploys
+        blacklist.write_text("i0\n")
+        out2 = serving.serve(Query(user="u", num=5), [pred])
+        assert [s.item for s in out2.item_scores] == ["i1", "i2", "i3", "i4"]
+
+    def test_engine_end_to_end(self, mem_storage, tmp_path):
+        from predictionio_tpu.controller import EngineParams
+        from predictionio_tpu.models.experimental.movielens_filtering import (
+            ALSAlgorithmParams,
+            DataSourceParams,
+            TempFilterParams,
+            filtering_engine,
+        )
+        from predictionio_tpu.models.recommendation.engine import Query
+        from predictionio_tpu.workflow.workflow_params import WorkflowParams
+
+        make_app(mem_storage, "flt")
+        events = mem_storage.get_l_events()
+        rng = np.random.default_rng(0)
+        for uu in range(12):
+            for ii in rng.permutation(8)[:5].tolist():
+                events.insert(
+                    Event(
+                        event="rate", entity_type="user", entity_id=f"u{uu}",
+                        target_entity_type="item", target_entity_id=f"i{ii}",
+                        properties=DataMap({"rating": float(rng.integers(1, 6))}),
+                    ),
+                    1,
+                )
+        blacklist = tmp_path / "black.txt"
+        blacklist.write_text("i0\n")
+        engine = filtering_engine()
+        ep = EngineParams(
+            data_source_params=("", DataSourceParams(app_name="flt", eval_k=0)),
+            algorithm_params_list=(
+                ("als", ALSAlgorithmParams(rank=4, num_iterations=5)),
+            ),
+            serving_params=("", TempFilterParams(filepath=str(blacklist))),
+        )
+        ctx = WorkflowContext(storage=mem_storage)
+        models = engine.train(ctx, ep, WorkflowParams())
+        _, _, algorithms, serving = engine.make_components(ep)
+        q = Query(user="u0", num=8)
+        preds = [a.predict(m, q) for a, m in zip(algorithms, models)]
+        result = serving.serve(q, preds)
+        assert result.item_scores  # got recommendations
+        assert all(s.item != "i0" for s in result.item_scores)
+
+
+class TestCustomDataSource:
+    def test_file_ratings_train_and_recommend(self, tmp_path):
+        from predictionio_tpu.controller import EngineParams
+        from predictionio_tpu.models.experimental.custom_datasource import (
+            ALSAlgorithmParams,
+            FileDataSourceParams,
+            custom_datasource_engine,
+        )
+        from predictionio_tpu.models.recommendation.engine import Query
+        from predictionio_tpu.workflow.workflow_params import WorkflowParams
+
+        rng = np.random.default_rng(1)
+        lines = []
+        for uu in range(16):
+            lo = 0 if uu % 2 == 0 else 5
+            for ii in rng.permutation(5)[:4].tolist():
+                lines.append(f"u{uu}::i{lo + ii}::5")
+        path = tmp_path / "sample_movielens_data.txt"
+        path.write_text("\n".join(lines) + "\n")
+        engine = custom_datasource_engine()
+        ep = EngineParams(
+            data_source_params=("", FileDataSourceParams(filepath=str(path))),
+            algorithm_params_list=(
+                ("als", ALSAlgorithmParams(rank=4, num_iterations=8)),
+            ),
+        )
+        models = engine.train(None, ep, WorkflowParams())
+        _, _, algorithms, serving = engine.make_components(ep)
+        q = Query(user="u0", num=3)
+        result = serving.serve(q, [algorithms[0].predict(models[0], q)])
+        assert len(result.item_scores) == 3
+        # clustered data: u0 (even) should prefer the i0-i4 block
+        assert all(int(s.item[1:]) < 5 for s in result.item_scores)
+
+    def test_malformed_line_raises(self, tmp_path):
+        from predictionio_tpu.models.experimental.custom_datasource import (
+            FileDataSource,
+            FileDataSourceParams,
+        )
+
+        path = tmp_path / "bad.txt"
+        path.write_text("u1::i1\n")
+        with pytest.raises(ValueError, match="expected"):
+            FileDataSource(
+                FileDataSourceParams(filepath=str(path))
+            ).read_training(None)
+
+
+class TestRecommendationCat:
+    @pytest.fixture()
+    def cat_storage(self, mem_storage):
+        make_app(mem_storage, "cat")
+        events = mem_storage.get_l_events()
+        rng = np.random.default_rng(5)
+        for ii in range(10):
+            cats = ["sci-fi"] if ii < 5 else ["drama"]
+            events.insert(
+                Event(
+                    event="$set", entity_type="item", entity_id=f"i{ii}",
+                    properties=DataMap({"categories": cats}),
+                ),
+                1,
+            )
+        for uu in range(16):
+            events.insert(
+                Event(event="$set", entity_type="user", entity_id=f"u{uu}",
+                      properties=DataMap({})),
+                1,
+            )
+            lo = 0 if uu % 2 == 0 else 5
+            for ii in rng.permutation(5)[:4].tolist():
+                for _ in range(rng.integers(1, 4)):  # repeated views sum
+                    events.insert(
+                        Event(
+                            event="view", entity_type="user",
+                            entity_id=f"u{uu}",
+                            target_entity_type="item",
+                            target_entity_id=f"i{lo + ii}",
+                        ),
+                        1,
+                    )
+        return mem_storage
+
+    def test_train_and_filter_by_category(self, cat_storage):
+        from predictionio_tpu.controller import EngineParams
+        from predictionio_tpu.models.experimental.recommendation_cat import (
+            CatALSAlgorithmParams,
+            DataSourceParams,
+            Query,
+            recommendation_cat_engine,
+        )
+        from predictionio_tpu.workflow.workflow_params import WorkflowParams
+
+        engine = recommendation_cat_engine()
+        ep = EngineParams(
+            data_source_params=("", DataSourceParams(app_name="cat")),
+            algorithm_params_list=(
+                ("als", CatALSAlgorithmParams(rank=4, num_iterations=8)),
+            ),
+        )
+        ctx = WorkflowContext(storage=cat_storage)
+        models = engine.train(ctx, ep, WorkflowParams())
+        _, _, algorithms, serving = engine.make_components(ep)
+        algo, model = algorithms[0], models[0]
+
+        # u0 is an even (sci-fi block) user
+        out = serving.serve(
+            Query(user="u0", num=5),
+            [algo.predict(model, Query(user="u0", num=5))],
+        )
+        assert out.item_scores
+        # category filter keeps only drama items
+        out_drama = algo.predict(
+            model, Query(user="u0", num=10, categories=("drama",))
+        )
+        assert all(int(s.item[1:]) >= 5 for s in out_drama.item_scores)
+        # blackList drops named items; whiteList restricts to named ones
+        out_black = algo.predict(
+            model, Query(user="u0", num=10, black_list=("i0", "i1"))
+        )
+        assert all(s.item not in ("i0", "i1") for s in out_black.item_scores)
+        out_white = algo.predict(
+            model, Query(user="u0", num=10, white_list=("i2", "i3"))
+        )
+        assert {s.item for s in out_white.item_scores} <= {"i2", "i3"}
+
+
+class TestStock:
+    def test_indicators_shapes_and_ranges(self):
+        from predictionio_tpu.models.experimental.stock import (
+            RSIIndicator,
+            ShiftsIndicator,
+            synthetic_raw_data,
+        )
+
+        raw = synthetic_raw_data(n_days=100)
+        lp = np.log(raw.price)
+        rsi = RSIIndicator(14).get_training(lp)
+        assert rsi.shape == lp.shape
+        assert np.all((rsi >= 0) & (rsi <= 100))
+        sh = ShiftsIndicator(5).get_training(lp)
+        assert sh.shape == lp.shape
+        np.testing.assert_allclose(sh[5:], lp[5:] - lp[:-5], atol=1e-12)
+
+    def test_regression_strategy_trains_all_tickers_batched(self):
+        from predictionio_tpu.models.experimental.stock import (
+            DataSourceParams,
+            DataSource,
+            RegressionStrategy,
+            RegressionStrategyParams,
+        )
+
+        ds = DataSource(DataSourceParams(n_days=400, until_idx=380,
+                                         from_idx=350, training_window_size=200))
+        td = ds.read_training(None)
+        algo = RegressionStrategy(RegressionStrategyParams(
+            max_training_window_size=200))
+        model = algo.train(None, td)
+        assert set(model) == set(td.raw.tickers)  # all active tickers
+        for coef in model.values():
+            assert coef.shape == (5,)  # RSI + 3 shifts + intercept
+            assert np.isfinite(coef).all()
+        # predictions come back for every modeled ticker
+        view = td.view()
+        from predictionio_tpu.models.experimental.stock import Query
+
+        pred = algo.predict(
+            model, Query(td.until_idx - 1, view, td.raw.tickers, "SPY")
+        )
+        assert set(pred.data) == set(td.raw.tickers)
+
+    def test_backtest_momentum_full_loop(self):
+        from predictionio_tpu.models.experimental.stock import (
+            BacktestingParams,
+            DataSourceParams,
+            MomentumStrategy,
+            MomentumStrategyParams,
+            backtest,
+        )
+
+        result = backtest(
+            MomentumStrategy(MomentumStrategyParams(l=20, s=3)),
+            DataSourceParams(n_days=450, from_idx=350, until_idx=430,
+                             training_window_size=200, max_test_duration=40),
+            BacktestingParams(enter_threshold=0.0005, exit_threshold=0.0,
+                              max_positions=2),
+        )
+        assert result.overall.days == 80  # every day simulated once
+        assert result.daily[0].nav > 0
+        assert np.isfinite(result.overall.sharpe)
+        # NAV evolves continuously: every daily return is a real number
+        assert all(np.isfinite(d.ret) for d in result.daily)
+
+    def test_backtest_regression_strategy(self):
+        from predictionio_tpu.models.experimental.stock import (
+            BacktestingParams,
+            DataSourceParams,
+            RegressionStrategy,
+            RegressionStrategyParams,
+            backtest,
+        )
+
+        result = backtest(
+            RegressionStrategy(RegressionStrategyParams(
+                max_training_window_size=150)),
+            DataSourceParams(n_days=400, from_idx=300, until_idx=360,
+                             training_window_size=150, max_test_duration=30),
+            BacktestingParams(max_positions=2),
+        )
+        assert result.overall.days == 60
+        assert result.daily[-1].nav > 0
+
+    def test_engine_assembly(self):
+        from predictionio_tpu.models.experimental.stock import (
+            StockEngineFactory,
+            stock_engine,
+        )
+
+        assert stock_engine("momentum") is not None
+        assert StockEngineFactory().apply() is not None
+
+    def test_window_underflow_raises(self):
+        """A window reaching before the panel start must raise, not wrap
+        around to the end of the panel via a negative slice."""
+        from predictionio_tpu.models.experimental.stock import (
+            DataView,
+            synthetic_raw_data,
+        )
+
+        raw = synthetic_raw_data(n_days=50)
+        view = DataView(raw, idx=10, max_window=30)
+        with pytest.raises(ValueError, match="before the panel start"):
+            view.price_frame(21)
+        assert view.price_frame(11).shape[0] == 11  # exact fit is fine
