@@ -1,1 +1,34 @@
-"""Experimental engine examples (the reference's examples/experimental)."""
+"""Experimental engine examples (the reference's examples/experimental).
+
+Port map (reference project -> module here):
+
+- scala-local-helloworld, java-local-helloworld, java-parallel-helloworld
+  -> helloworld.py (one engine; the three reference projects are dialects
+  of the same tutorial)
+- scala-local-regression, scala-parallel-regression, java-local-regression
+  -> regression.py
+- scala-parallel-similarproduct-dimsum -> similarproduct_dimsum.py
+- scala-local-friend-recommendation + scala-parallel-friend-recommendation
+  -> friend_recommendation.py (keyword similarity, random, SimRank)
+- scala-local-movielens-evaluation -> movielens_evaluation.py
+- scala-local-movielens-filtering -> movielens_filtering.py
+- scala-parallel-recommendation-entitymap -> recommendation_entitymap.py
+- scala-parallel-recommendation-custom-datasource -> custom_datasource.py
+- scala-parallel-recommendation-cat -> recommendation_cat.py
+- scala-parallel-trim-app -> trim_app.py
+- scala-stock -> stock.py (indicators, regression + momentum strategies,
+  walk-forward backtesting; synthetic panel stands in for
+  YahooDataSource — zero-egress image)
+
+Not ported, by design:
+
+- scala-parallel-recommendation-mongo-datasource: a MongoDB client demo;
+  the pluggable-datasource pattern it teaches is custom_datasource.py,
+  and remote storage is this framework's ``http`` backend + gateway.
+- scala-parallel-similarproduct-localmodel: demonstrates Spark's L-vs-P
+  model split, which this framework collapses by design (one algorithm
+  class + ``sharded_model`` flag, SURVEY.md §7 step 2).
+- java-local-tutorial, scala-local-helloworld prototypes,
+  scala-refactor-test, scala-recommendations: JVM build/tutorial
+  scaffolding with no distinct algorithmic content.
+"""
