@@ -159,6 +159,11 @@ def bench_recommendation(device_name):
             "rmse_train": round(train_rmse, 4),
             "rmse_mllib_oracle": round(rmse_ref, 4),
             "rmse_vs_mllib": round(rmse_vs_mllib, 4),
+            # parity is vs a float64 oracle of MLlib-1.3 semantics on
+            # IDENTICAL synthetic ML-100K-shaped data (zero-egress image;
+            # real MovieLens is not redistributable here) — it validates
+            # algorithm semantics, not dataset-level reproduction
+            "rmse_data": "synthetic-ml100k-shape",
             "predict_device_compute_ms": round(device_ms, 4),
             "predict_p50_ms": round(pctl(full_lat, 50), 2),
             **rest,

@@ -520,9 +520,17 @@ def _sync_fetch(tree) -> None:
     backends ``block_until_ready`` can return before execution finishes,
     so fetch results through the real transfer path. Callers pass SMALL
     arrays only — a scalar-index fence would jit a fresh tiny executable
-    per shape, which costs seconds through a relayed backend."""
+    per shape, which costs seconds through a relayed backend.
+
+    Arrays sharded across processes can't be fetched (device_get raises
+    on non-addressable devices); they fence with block_until_ready —
+    multi-host runs aren't relayed, so the early-return caveat above
+    doesn't apply there."""
     for a in jax.tree_util.tree_leaves(tree):
-        jax.device_get(a)
+        if getattr(a, "is_fully_addressable", True):
+            jax.device_get(a)
+        else:
+            jax.block_until_ready(a)
 
 
 @dataclasses.dataclass
@@ -766,9 +774,21 @@ def train_als(
     finally:
         ckpt.close()
 
-    user_factors = np.asarray(X)[:n_users]
-    item_factors = np.asarray(Y)[:n_items]
-    return ALSModelArrays(user_factors, item_factors)
+    X_host, Y_host = _fetch_global(X), _fetch_global(Y)
+    return ALSModelArrays(X_host[:n_users], Y_host[:n_items])
+
+
+def _fetch_global(arr) -> np.ndarray:
+    """Materialize a (possibly multi-host-sharded) factor matrix on every
+    host. Single-host arrays fetch directly; on a mesh spanning processes
+    each host holds only its row shards, so the full matrix assembles via
+    an all-gather over DCN (np.asarray would crash on the
+    non-fully-addressable array)."""
+    if getattr(arr, "is_fully_addressable", True):
+        return np.asarray(arr)
+    from jax.experimental import multihost_utils
+
+    return np.asarray(multihost_utils.process_allgather(arr, tiled=True))
 
 
 # --- prediction / evaluation helpers ---
