@@ -121,7 +121,14 @@ class DeployedEngine:
                 f"{len(self.models)} models for {len(self.algorithms)} algorithms"
             )
         # compile serving executables before taking traffic (cold compiles
-        # cost seconds and would land on the first unlucky requests)
+        # cost seconds and would land on the first unlucky requests);
+        # persist them so the NEXT deploy of this engine skips the
+        # compiles entirely
+        from predictionio_tpu.utils.compilation_cache import (
+            ensure_compilation_cache,
+        )
+
+        ensure_compilation_cache()
         for algo, model in zip(self.algorithms, self.models):
             algo.warm(model)
 

@@ -13,6 +13,8 @@ from __future__ import annotations
 import dataclasses
 import importlib
 import os
+
+from predictionio_tpu.utils.fs import fs_basedir
 import pickle
 from typing import Any, Optional
 
@@ -72,7 +74,7 @@ def load_persistent_model(
 
 def _local_model_dir() -> str:
     d = os.path.join(
-        os.environ.get("PIO_FS_BASEDIR", os.path.expanduser("~/.predictionio_tpu")),
+        fs_basedir(),
         "pmodels",
     )
     os.makedirs(d, exist_ok=True)

@@ -7,6 +7,8 @@ backend — model pytrees serialized by the workflow land here.
 from __future__ import annotations
 
 import os
+
+from predictionio_tpu.utils.fs import fs_basedir
 import threading
 from typing import Dict, Optional
 
@@ -19,7 +21,7 @@ class StorageClient(base.DAOCacheMixin):
         self.config = config
         props = getattr(config, "properties", {}) or {}
         self.path = props.get("PATH") or os.path.join(
-            os.environ.get("PIO_FS_BASEDIR", os.path.expanduser("~/.predictionio_tpu")),
+            fs_basedir(),
             "models",
         )
         os.makedirs(self.path, exist_ok=True)

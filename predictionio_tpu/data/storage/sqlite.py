@@ -15,6 +15,8 @@ import dataclasses
 import datetime as _dt
 import json
 import os
+
+from predictionio_tpu.utils.fs import fs_basedir
 import sqlite3
 import threading
 from typing import Dict, Iterator, List, Optional, Sequence
@@ -85,7 +87,7 @@ class StorageClient(base.DAOCacheMixin):
         self.config = config
         props = getattr(config, "properties", {}) or {}
         path = props.get("PATH") or os.path.join(
-            os.environ.get("PIO_FS_BASEDIR", os.path.expanduser("~/.predictionio_tpu")),
+            fs_basedir(),
             "storage.db",
         )
         if path != ":memory:":

@@ -1,0 +1,15 @@
+"""Filesystem root shared by every disk-touching subsystem."""
+
+from __future__ import annotations
+
+import os
+
+
+def fs_basedir() -> str:
+    """The framework's on-disk root (``PIO_FS_BASEDIR``, default
+    ``~/.predictionio_tpu``) — sqlite/localfs storage, persistent models,
+    and the XLA compilation cache all live under it (reference
+    ``PIO_FS_BASEDIR``, conf/pio-env.sh.template)."""
+    return os.environ.get(
+        "PIO_FS_BASEDIR", os.path.expanduser("~/.predictionio_tpu")
+    )

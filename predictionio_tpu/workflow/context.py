@@ -55,7 +55,15 @@ class WorkflowContext:
     def mesh(self):
         if self._mesh is None:
             from predictionio_tpu.parallel import default_mesh
+            from predictionio_tpu.utils.compilation_cache import (
+                ensure_compilation_cache,
+            )
 
+            # first accelerator touch of the run: make compiled
+            # executables persistent so repeat trains/evals/deploys skip
+            # the multi-second XLA compile (no reference analog — the
+            # JVM substrate has no compilation step)
+            ensure_compilation_cache()
             self._mesh = default_mesh()
             logger.info(
                 "%s: created %s", self.app_name, dict(self._mesh.shape)

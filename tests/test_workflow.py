@@ -239,3 +239,78 @@ class TestFastEvalEngine:
         res_plain = plain.batch_eval(ctx, grid, WorkflowParams())
         res_fast = fast.batch_eval(ctx, grid, WorkflowParams())
         assert [r[1] for r in res_plain] == [r[1] for r in res_fast]
+
+
+class TestCompilationCache:
+    def test_cache_populates_and_is_idempotent(self, tmp_path, monkeypatch):
+        """First accelerator touch persists compiled executables under
+        PIO_COMPILATION_CACHE_DIR so later processes skip XLA compiles
+        (no reference analog — the JVM substrate has no compile step).
+        Run in a subprocess: jax compilation-cache config is global."""
+        import subprocess
+        import sys
+
+        cache_dir = tmp_path / "cc"
+        script = tmp_path / "probe.py"
+        script.write_text(
+            "import jax\n"
+            "jax.config.update('jax_platforms', 'cpu')\n"
+            "from predictionio_tpu.utils.compilation_cache import ("
+            "ensure_compilation_cache)\n"
+            "d1 = ensure_compilation_cache()\n"
+            "d2 = ensure_compilation_cache()  # idempotent\n"
+            "assert d1 == d2, (d1, d2)\n"
+            "import jax.numpy as jnp\n"
+            "f = jax.jit(lambda x: jax.lax.fori_loop("
+            "0, 50, lambda i, a: jnp.tanh(a @ a) + i, x))\n"
+            "f(jnp.ones((128, 128))).block_until_ready()\n"
+            "print('DIR', d1, flush=True)\n"
+        )
+        import os
+
+        env = {
+            **os.environ,
+            "PYTHONPATH": _repo_root(),
+            "PIO_COMPILATION_CACHE_DIR": str(cache_dir),
+        }
+        env.pop("XLA_FLAGS", None)
+        out = subprocess.run(
+            [sys.executable, str(script)],
+            capture_output=True, text=True, timeout=120, env=env,
+        )
+        assert out.returncode == 0, out.stderr
+        assert str(cache_dir) in out.stdout
+        assert list(cache_dir.iterdir()), "no cache entries written"
+
+    def test_off_disables(self, tmp_path):
+        import subprocess
+        import sys
+        import os
+
+        script = tmp_path / "probe.py"
+        script.write_text(
+            "import jax\n"
+            "jax.config.update('jax_platforms', 'cpu')\n"
+            "from predictionio_tpu.utils.compilation_cache import ("
+            "ensure_compilation_cache)\n"
+            "assert ensure_compilation_cache() is None\n"
+            "print('DISABLED OK', flush=True)\n"
+        )
+        env = {
+            **os.environ,
+            "PYTHONPATH": _repo_root(),
+            "PIO_COMPILATION_CACHE_DIR": "off",
+        }
+        env.pop("XLA_FLAGS", None)
+        out = subprocess.run(
+            [sys.executable, str(script)],
+            capture_output=True, text=True, timeout=120, env=env,
+        )
+        assert out.returncode == 0, out.stderr
+        assert "DISABLED OK" in out.stdout
+
+
+def _repo_root():
+    import os
+
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
