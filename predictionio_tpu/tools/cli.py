@@ -188,7 +188,16 @@ def cmd_eval(args) -> int:
                 "pass an EngineParamsGenerator class as the second argument"
             )
         params_list = list(params_list)
-    result = CoreWorkflow.run_evaluation(evaluation, params_list)
+    from predictionio_tpu.workflow.workflow_params import WorkflowParams
+
+    result = CoreWorkflow.run_evaluation(
+        evaluation,
+        params_list,
+        workflow_params=WorkflowParams(
+            grid_train=args.grid_train,
+            eval_parallelism=args.eval_parallelism,
+        ),
+    )
     print(result.to_one_liner())
     return 0
 
@@ -207,6 +216,9 @@ def cmd_deploy(args) -> int:
         event_server_ip=args.event_server_ip,
         event_server_port=args.event_server_port,
         access_key=args.accesskey,
+        batch_window_ms=args.batch_window_ms,
+        max_batch=args.max_batch,
+        pipeline_depth=args.pipeline_depth,
     )
     server = create_server(engine, config)
     print(f"Engine server serving on {args.ip}:{server.port}")
@@ -549,6 +561,14 @@ def build_parser() -> argparse.ArgumentParser:
     ev = sub.add_parser("eval", help="run an evaluation")
     ev.add_argument("evaluation_class")
     ev.add_argument("engine_params_generator_class", nargs="?")
+    ev.add_argument(
+        "--grid-train", choices=("auto", "always", "never"), default="auto",
+        help="device-side batched training of reg-axis grid variants",
+    )
+    ev.add_argument(
+        "--eval-parallelism", type=int, default=4,
+        help="concurrent grid variants (the reference's .par)",
+    )
     ev.set_defaults(func=cmd_eval)
 
     deploy = sub.add_parser("deploy", help="start the engine query server")
@@ -560,6 +580,19 @@ def build_parser() -> argparse.ArgumentParser:
     deploy.add_argument("--event-server-ip", default="localhost")
     deploy.add_argument("--event-server-port", type=int, default=7070)
     deploy.add_argument("--accesskey")
+    deploy.add_argument(
+        "--batch-window-ms", type=float, default=2.0,
+        help="micro-batching window for concurrent queries",
+    )
+    deploy.add_argument(
+        "--max-batch", type=int, default=128,
+        help="max queries per device batch",
+    )
+    deploy.add_argument(
+        "--pipeline-depth", type=int, default=2,
+        help="batches in flight at once (1 = strictly serial serving; "
+        "see ServerConfig.pipeline_depth for the concurrency contract)",
+    )
     deploy.set_defaults(func=cmd_deploy)
 
     undeploy = sub.add_parser("undeploy", help="stop a deployed server")

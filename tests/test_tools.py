@@ -407,3 +407,71 @@ class TestUpgradeCheck:
                 assert "could not check" in out, (i, out)
         finally:
             server.shutdown()
+
+
+class TestCLIServingAndEvalKnobs:
+    def test_eval_grid_train_flag(self, mem_storage, capsys):
+        """pio eval --grid-train/--eval-parallelism reach WorkflowParams."""
+        import numpy as np
+
+        from predictionio_tpu.data.storage.base import App
+
+        mem_storage.get_meta_data_apps().insert(App(id=0, name="default"))
+        events = mem_storage.get_l_events()
+        events.init(1)
+        rng = np.random.default_rng(11)
+        for uid in range(16):
+            base = 0 if uid % 2 == 0 else 8
+            for j in rng.permutation(8)[:5]:
+                events.insert(
+                    Event(
+                        event="rate", entity_type="user",
+                        entity_id=f"u{uid}",
+                        target_entity_type="item",
+                        target_entity_id=f"i{base + j}",
+                        properties=DataMap({"rating": 5.0}),
+                    ),
+                    1,
+                )
+        rc = cli_main([
+            "eval",
+            "predictionio_tpu.models.recommendation.evaluation.RecommendationEvaluation",
+            "predictionio_tpu.models.recommendation.evaluation.ParamsGrid",
+            "--grid-train", "never", "--eval-parallelism", "2",
+        ])
+        assert rc == 0
+        assert "Precision@10" in capsys.readouterr().out
+
+    def test_deploy_knobs_reach_server_config(self, mem_storage, tmp_path, monkeypatch):
+        """The deploy flags land on the right ServerConfig fields —
+        cmd_deploy's kwarg wiring is covered, not just argparse."""
+        import predictionio_tpu.api.engine_server as es
+
+        captured = {}
+
+        def fake_create_server(engine, config, **kw):
+            captured["config"] = config
+
+            class Dummy:
+                port = 0
+
+                def serve_forever(self):
+                    pass
+
+            return Dummy()
+
+        monkeypatch.setattr(es, "create_server", fake_create_server)
+        variant = {
+            "engineFactory": "tests.fake_engine.FakeEngineFactory",
+            "algorithms": [{"name": "a0", "params": {"id": 1}}],
+        }
+        vpath = tmp_path / "engine.json"
+        vpath.write_text(json.dumps(variant))
+        assert cli_main([
+            "deploy", "-v", str(vpath), "--pipeline-depth", "1",
+            "--batch-window-ms", "5", "--max-batch", "64",
+        ]) == 0
+        cfg = captured["config"]
+        assert cfg.pipeline_depth == 1
+        assert cfg.batch_window_ms == 5.0
+        assert cfg.max_batch == 64
