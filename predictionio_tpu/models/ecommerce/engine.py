@@ -324,6 +324,12 @@ class ECommAlgorithm(BaseAlgorithm):
                     mask[idx] = False
         return mask
 
+    def warm(self, model: ECommModel) -> None:
+        """Pre-compile the unknown-user similar-items path's cosine-sum
+        executables (the known-user path is a host matmul; see
+        BaseAlgorithm.warm)."""
+        model.scorer.warm(max_q=16)
+
     def predict(self, model: ECommModel, query: Query) -> PredictedResult:
         return self._predict_one(model, query, self._unavailable_items())
 

@@ -191,6 +191,10 @@ class ALSAlgorithmParams(Params):
     num_iterations: int = 20
     lambda_: float = 0.01
     seed: Optional[int] = None
+    # deploy-time warm-up: largest query-item count to pre-compile the
+    # cosine-sum executables for (wider queries still work but pay a
+    # one-time cold compile on live traffic)
+    warm_max_query_items: int = 16
 
 
 @dataclasses.dataclass
@@ -331,6 +335,12 @@ class ALSAlgorithm(BaseAlgorithm):
 
     def predict(self, model: SPModel, query: Query) -> PredictedResult:
         return model.similar(query)
+
+    def warm(self, model: SPModel) -> None:
+        """Compile the cosine-sum executables for every padded query-item
+        width up to warm_max_query_items before taking traffic (see
+        BaseAlgorithm.warm)."""
+        model.scorer.warm(max_q=self.params.warm_max_query_items)
 
     def result_to_json(self, result: PredictedResult):
         return {

@@ -880,14 +880,9 @@ class ServingFactors:
         seconds, which under concurrent load turns the micro-batching
         executor into a compile queue. Callers slice the padding off.
         """
-        rows = np.asarray(user_rows, np.float32)
-        b = rows.shape[0]
-        b_pad = max(8, 1 << (b - 1).bit_length())
-        if b_pad != b:
-            rows = np.concatenate(
-                [rows, np.zeros((b_pad - b, rows.shape[1]), np.float32)]
-            )
-        q = jax.device_put(rows)
+        from predictionio_tpu.ops.similarity import pad_rows_pow2
+
+        q = jax.device_put(pad_rows_pow2(user_rows, 8))
         return _topn_packed(q, self._if_dev, n)
 
     def warm(self, n: int = 16, max_batch: int = 128) -> None:

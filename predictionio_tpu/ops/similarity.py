@@ -16,6 +16,21 @@ import jax.numpy as jnp
 import numpy as np
 
 
+def pad_rows_pow2(rows: np.ndarray, min_rows: int) -> np.ndarray:
+    """Pad the leading axis with zero rows to the next power of two
+    (>= min_rows), so executables bucket by O(log) widths instead of one
+    per distinct size. Shared by the cosine-sum path here and the ALS
+    serving top-N (ops/als.py) so the bucketing rule can't drift."""
+    rows = np.asarray(rows, np.float32)
+    n = rows.shape[0]
+    n_pad = max(min_rows, 1 << (max(n, 1) - 1).bit_length())
+    if n_pad == n:
+        return rows
+    return np.concatenate(
+        [rows, np.zeros((n_pad - n, rows.shape[1]), np.float32)]
+    )
+
+
 def normalize_rows(factors: np.ndarray) -> np.ndarray:
     """L2-normalize rows; zero rows stay zero (cosine with a zero vector
     is 0 in the reference's cosine helper)."""
@@ -45,6 +60,26 @@ class SimilarityScorer:
 
     def cosine_sum(self, query_rows: np.ndarray) -> np.ndarray:
         """Sum of cosine similarities of every row of the matrix against
-        the (already-normalized) query rows: [N] scores."""
-        q = jnp.asarray(np.atleast_2d(np.asarray(query_rows, np.float32)))
-        return np.asarray(_cosine_sum(q, self._dev))
+        the (already-normalized) query rows: [N] scores.
+
+        The query axis pads to a power of two (min 4) with zero rows —
+        a zero row contributes cosine 0 to every sum, so results are
+        unchanged while serving workloads with varying query-item counts
+        share O(log max_q) compiled executables instead of one per
+        distinct count (a cold compile on live traffic costs seconds)."""
+        q = pad_rows_pow2(np.atleast_2d(query_rows), 4)
+        return np.asarray(_cosine_sum(jnp.asarray(q), self._dev))
+
+    def warm(self, max_q: int = 16) -> None:
+        """Compile every padded-query-width executable a query of up to
+        ``max_q`` items can hit — including the bucket a non-power-of-two
+        max_q pads INTO (deploy-time warm-up; see BaseAlgorithm.warm)."""
+        k = self.normed.shape[1]
+        q = 4
+        while True:
+            _cosine_sum(
+                jnp.zeros((q, k), jnp.float32), self._dev
+            ).block_until_ready()
+            if q >= max_q:
+                break
+            q *= 2

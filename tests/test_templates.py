@@ -364,3 +364,39 @@ class TestECommerceTemplate:
                 [s.score for s in scalar.item_scores],
                 rtol=1e-5,
             )
+
+
+class TestCosineSumPadding:
+    def test_padding_preserves_scores_and_buckets_compiles(self):
+        """cosine_sum pads the query axis with zero rows (cosine 0 each)
+        so varying query-item counts share pow2-bucketed executables;
+        scores must be identical to the unpadded math."""
+        import numpy as np
+
+        from predictionio_tpu.ops.similarity import (
+            SimilarityScorer,
+            normalize_rows,
+        )
+
+        rng = np.random.default_rng(0)
+        factors = rng.standard_normal((30, 8)).astype(np.float32)
+        scorer = SimilarityScorer(factors)
+        normed = normalize_rows(factors)
+        for q_count in (1, 2, 3, 5, 7):
+            q = normed[:q_count]
+            got = scorer.cosine_sum(q)
+            expect = (q @ normed.T).sum(axis=0)
+            np.testing.assert_allclose(got, expect, rtol=1e-5, atol=1e-6)
+
+    def test_warm_compiles_buckets(self):
+        import numpy as np
+
+        from predictionio_tpu.ops.similarity import SimilarityScorer
+
+        scorer = SimilarityScorer(
+            np.random.default_rng(1).standard_normal((10, 4)).astype(np.float32)
+        )
+        scorer.warm(max_q=8)  # no exception; executables now cached
+        assert scorer.cosine_sum(scorer.normed[:3]).shape == (10,)
+        # a non-pow2 bound still warms the bucket it pads INTO
+        scorer.warm(max_q=10)  # covers q=16
