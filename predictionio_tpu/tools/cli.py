@@ -396,6 +396,14 @@ def cmd_status(args) -> int:
         import jax
 
         print(f"JAX devices: {jax.devices()}")
+        from predictionio_tpu.utils.compilation_cache import (
+            ensure_compilation_cache,
+        )
+
+        cache_dir = ensure_compilation_cache()
+        print(
+            f"XLA compilation cache: {cache_dir or 'disabled'}"
+        )
     except Exception as e:  # status must not hard-fail on device probing
         print(f"JAX devices unavailable: {e}")
     if storage.verify_all_data_objects():
