@@ -162,7 +162,13 @@ def cmd_train(args) -> int:
         engine, engine_params, instance, workflow_params=workflow_params
     )
     if instance_id is None:
-        print("Training interrupted by stop-after flag.")
+        if args.host_rank:  # worker ranks compute; rank 0 records
+            print(
+                f"Training completed on worker host {args.host_rank} "
+                "(instance recorded by host 0)."
+            )
+        else:
+            print("Training interrupted by stop-after flag.")
         return 0
     print(f"Training completed. Engine instance: {instance_id}")
     return 0

@@ -45,6 +45,46 @@ from predictionio_tpu.workflow.workflow_params import WorkflowParams
 logger = logging.getLogger(__name__)
 
 
+def _is_rank_zero() -> bool:
+    """True unless this process is a non-zero rank of a multi-host
+    runtime. Storage writes (instance records, model blobs, evaluation
+    results) happen on rank 0 only — the reference's driver-writes,
+    executors-compute split."""
+    try:
+        import jax
+
+        return jax.process_index() == 0
+    except Exception:  # backend not initializable — single host
+        return True
+
+
+def _eval_engine(evaluation, engine_params_list, workflow_params):
+    """The engine a grid evaluation runs through. Multi-variant grids
+    upgrade a plain Engine to FastEvalEngine: stage results memoize
+    across shared params-prefixes and reg-axis variants train in one
+    vmapped device program (BaseAlgorithm.train_grid). Results are
+    identical to the plain engine — FastEval is the reference's own
+    eval-only engine (FastEvalEngine.scala:42-48); it leaves it opt-in
+    only because its caches cost memory (WorkflowParams.fast_eval=False
+    restores that). Every host of a multi-host run resolves the SAME
+    engine here so their collective sequences agree."""
+    engine = evaluation.engine
+    if (
+        workflow_params.fast_eval
+        and type(engine) is Engine
+        and len(engine_params_list) > 1
+    ):
+        from predictionio_tpu.controller.fast_eval import FastEvalEngine
+
+        engine = FastEvalEngine(
+            engine.data_source_class_map,
+            engine.preparator_class_map,
+            engine.algorithm_class_map,
+            engine.serving_class_map,
+        )
+    return engine
+
+
 def _utcnow() -> _dt.datetime:
     return _dt.datetime.now(_dt.timezone.utc)
 
@@ -58,12 +98,30 @@ class CoreWorkflow:
         ctx: Optional[WorkflowContext] = None,
         workflow_params: Optional[WorkflowParams] = None,
     ) -> Optional[str]:
-        """Train and persist. Returns the engine-instance id on success,
-        None when interrupted by a stop-after debug flag."""
+        """Train and persist. Returns the engine-instance id on success;
+        None when interrupted by a stop-after debug flag, or on the
+        worker (non-zero) ranks of a multi-host run, which compute but
+        leave all storage writes to rank 0."""
         workflow_params = workflow_params or WorkflowParams()
         ctx = ctx or workflow_context(
             mode="training", batch=workflow_params.batch or engine_instance.batch
         )
+        if not _is_rank_zero():
+            # Worker hosts of a multi-host run participate in rank 0's
+            # collectives by executing the same training program, but
+            # leave every storage write to rank 0 (reference: only the
+            # Spark driver writes; executors compute) — a shared store
+            # would otherwise record one duplicate instance+model blob
+            # per host.
+            try:
+                with profiling.trace(workflow_params.profile_dir):
+                    engine.train(ctx, engine_params, workflow_params)
+            except (
+                StopAfterReadInterruption,
+                StopAfterPrepareInterruption,
+            ) as e:
+                logger.info("training interrupted by %s", type(e).__name__)
+            return None
         storage = ctx.storage
         instances = storage.get_meta_data_engine_instances()
         # record the resolved params on the instance so deploy can
@@ -137,6 +195,21 @@ class CoreWorkflow:
         workflow_params = workflow_params or WorkflowParams()
         engine_params_list = list(engine_params_list)  # may be a generator
         ctx = ctx or workflow_context(mode="evaluation", batch=workflow_params.batch)
+        if not _is_rank_zero():
+            # Worker hosts compute (joining rank 0's collectives) but
+            # leave the instance record + result writes to rank 0. The
+            # engine selection MUST mirror rank 0's (shared helper): a
+            # FastEval rank 0 training each distinct variant once
+            # alongside a plain-engine worker training per variant would
+            # issue different collective sequences and deadlock the pod.
+            # batch_eval holds ALL the device work; the evaluator stage
+            # is host math with side effects (best.json, instance rows)
+            # that must happen once — workers skip it and return None.
+            engine = _eval_engine(
+                evaluation, engine_params_list, workflow_params
+            )
+            engine.batch_eval(ctx, engine_params_list, workflow_params)
+            return None
         storage = ctx.storage
         instances = storage.get_meta_data_evaluation_instances()
         if evaluation_instance is None:
@@ -152,29 +225,9 @@ class CoreWorkflow:
             dataclasses.replace(evaluation_instance, status=STATUS_EVALUATING)
         )
         try:
-            engine = evaluation.engine
-            if (
-                workflow_params.fast_eval
-                and type(engine) is Engine
-                and len(engine_params_list) > 1
-            ):
-                # Grid evaluation runs through FastEvalEngine: stage
-                # results memoize across shared params-prefixes and
-                # reg-axis variants train in one vmapped device program
-                # (BaseAlgorithm.train_grid). Results are identical to
-                # the plain engine — FastEval is the reference's own
-                # eval-only engine (FastEvalEngine.scala:42-48); it
-                # leaves it opt-in only because its caches cost memory.
-                from predictionio_tpu.controller.fast_eval import (
-                    FastEvalEngine,
-                )
-
-                engine = FastEvalEngine(
-                    engine.data_source_class_map,
-                    engine.preparator_class_map,
-                    engine.algorithm_class_map,
-                    engine.serving_class_map,
-                )
+            engine = _eval_engine(
+                evaluation, engine_params_list, workflow_params
+            )
             # EvaluationWorkflow.runEvaluation (reference :31-42)
             engine_eval_data_set = engine.batch_eval(
                 ctx, engine_params_list, workflow_params

@@ -222,3 +222,119 @@ class TestTwoProcessTraining:
             if line.startswith("CHECKSUM")
         ]
         assert len(sums) == 2 and sums[0] == sums[1], sums
+
+
+class TestTwoHostCLITrain:
+    def test_pio_train_coordinator_writes_once(self, tmp_path):
+        """The full `pio train --coordinator` story: two hosts train the
+        recommendation engine over a shared sqlite store; rank 0 records
+        ONE engine instance + model blob, rank 1 computes but does not
+        write (reference: the Spark driver writes, executors compute)."""
+        import json
+
+        db = tmp_path / "pio.db"
+        fsdir = tmp_path / "fs"
+        seed_script = tmp_path / "seed.py"
+        seed_script.write_text(
+            textwrap.dedent(
+                """
+                import numpy as np
+                from predictionio_tpu.data.storage import get_storage
+                from predictionio_tpu.data.storage.base import App
+                from predictionio_tpu.data.event import Event, DataMap
+
+                s = get_storage()
+                app_id = s.get_meta_data_apps().insert(App(id=0, name="default"))
+                le = s.get_l_events(); le.init(app_id)
+                rng = np.random.default_rng(12)
+                for uu in range(16):
+                    for ii in rng.permutation(10)[:5].tolist():
+                        le.insert(Event(
+                            event="rate", entity_type="user",
+                            entity_id=f"u{uu}",
+                            target_entity_type="item",
+                            target_entity_id=f"i{ii}",
+                            properties=DataMap({"rating": float(rng.integers(1, 6))}),
+                        ), app_id)
+                print("SEEDED", flush=True)
+                """
+            )
+        )
+        variant = {
+            "engineFactory": "predictionio_tpu.models.recommendation.RecommendationEngineFactory",
+            "id": "dist", "version": "1",
+            "datasource": {"params": {"app_name": "default", "eval_k": 0}},
+            "algorithms": [
+                {"name": "als", "params": {"rank": 4, "num_iterations": 3}}
+            ],
+        }
+        vpath = tmp_path / "engine.json"
+        vpath.write_text(json.dumps(variant))
+
+        env = {
+            **worker_env(),
+            "JAX_PLATFORMS": "cpu",
+            "PIO_FS_BASEDIR": str(fsdir),
+            "PIO_STORAGE_SOURCES_SQ_TYPE": "sqlite",
+            "PIO_STORAGE_SOURCES_SQ_PATH": str(db),
+            "PIO_STORAGE_REPOSITORIES_METADATA_NAME": "meta",
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "SQ",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_NAME": "event",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "SQ",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_NAME": "model",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "SQ",
+        }
+        seeded = subprocess.run(
+            [sys.executable, str(seed_script)],
+            capture_output=True, text=True, timeout=120, env=env,
+        )
+        assert seeded.returncode == 0, seeded.stderr
+
+        port = free_port()
+        procs = [
+            subprocess.Popen(
+                [
+                    sys.executable, "-m", "predictionio_tpu.tools.cli",
+                    "train", "-v", str(vpath),
+                    "--coordinator", f"127.0.0.1:{port}",
+                    "--num-hosts", "2", "--host-rank", str(rank),
+                ],
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True, env=env,
+            )
+            for rank in (0, 1)
+        ]
+        outs = []
+        try:
+            for p in procs:
+                out, _ = p.communicate(timeout=240)
+                outs.append(out)
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+                    p.communicate()
+        for rank, (p, out) in enumerate(zip(procs, outs)):
+            assert p.returncode == 0, f"rank {rank} failed:\n{out}"
+        assert "Training completed. Engine instance:" in outs[0]
+        assert "worker host 1" in outs[1]  # not misreported as interrupted
+        assert "stop-after" not in outs[1]
+
+        # exactly ONE instance + one model blob in the shared store
+        check = subprocess.run(
+            [sys.executable, "-c", textwrap.dedent(
+                """
+                from predictionio_tpu.data.storage import get_storage
+
+                s = get_storage()
+                insts = s.get_meta_data_engine_instances().get_all()
+                assert len(insts) == 1, [i.id for i in insts]
+                assert insts[0].status == "COMPLETED", insts[0].status
+                assert s.get_model_data_models().get(insts[0].id) is not None
+                print("STORE OK", flush=True)
+                """
+            )],
+            capture_output=True, text=True, timeout=120, env=env,
+        )
+        assert check.returncode == 0, check.stderr
+        assert "STORE OK" in check.stdout
