@@ -85,12 +85,13 @@ class ServerConfig:
     # k+1's device dispatch overlaps batch k's result fetch. CONTRACT:
     # depth > 1 means serve_batch (supplement -> batch_predict -> serve)
     # runs CONCURRENTLY on the deployed engine, so controller code must
-    # not mutate shared state without locking. The packaged templates are
-    # pure; engines that keep mutable predict-time state (a cache dict, a
-    # lazily-built index) must set pipeline_depth=1 to restore the
-    # strictly-serial behavior (which is still ahead of the reference's
-    # serial per-query loop, CreateServer.scala:497-500).
-    pipeline_depth: int = 2
+    # not mutate shared state without locking. The default is 1 — the
+    # reference serves strictly serially (CreateServer.scala:473-624),
+    # and a user engine with mutable predict-time state (a cache dict, a
+    # lazily-built index) is legal under that API and would silently race
+    # at depth 2. The packaged templates are pure: deploy them with
+    # `--pipeline-depth 2` to overlap device dispatch with result fetch.
+    pipeline_depth: int = 1
 
     def __post_init__(self):
         if self.feedback and not self.access_key:
@@ -221,22 +222,25 @@ class _BatchingExecutor:
     Request threads enqueue (query, slot) and block; one collector thread
     drains the queue — waiting up to window_ms after the first arrival —
     and hands each batch to a serve pool holding up to ``pipeline_depth``
-    batches in flight (default 2: double-buffering). While batch k's
-    result fetch is crossing host<->device (or, on a relay rig, the
-    network), batch k+1 already dispatched and batch k+2 accumulates
-    behind the semaphore — the device never idles waiting on a fetch.
-    The reference serves strictly serially (CreateServer.scala:473-624);
-    one-in-flight was this executor's round-2 shape and capped REST qps
-    at the relay round-trip rate.
+    batches in flight. The default depth is 1: strictly serial serving,
+    the reference's contract (CreateServer.scala:473-624), safe for
+    engines with mutable predict-time state. Depth 2 (opt-in, see
+    ServerConfig.pipeline_depth) double-buffers: while batch k's result
+    fetch is crossing host<->device (or, on a relay rig, the network),
+    batch k+1 already dispatched and batch k+2 accumulates behind the
+    semaphore — the device never idles waiting on a fetch.
     """
 
-    def __init__(self, window_ms: float, max_batch: int, pipeline_depth: int = 2):
+    _STOP = object()  # collector-thread shutdown sentinel
+
+    def __init__(self, window_ms: float, max_batch: int, pipeline_depth: int = 1):
         self.window_ms = window_ms
         self.max_batch = max_batch
         self.pipeline_depth = max(1, pipeline_depth)
         self._queue: "queue.Queue" = queue.Queue()
         self._worker: Optional[threading.Thread] = None
         self._lock = threading.Lock()
+        self._closed = False
         self._inflight = threading.Semaphore(self.pipeline_depth)
         self._serve_pool = concurrent.futures.ThreadPoolExecutor(
             max_workers=self.pipeline_depth, thread_name_prefix="serve"
@@ -244,22 +248,41 @@ class _BatchingExecutor:
 
     def submit(self, deployed: DeployedEngine, query: Any) -> Any:
         slot: Dict[str, Any] = {"done": threading.Event()}
-        self._ensure_worker()
-        self._queue.put((deployed, query, slot))
+        # the closed-check and the enqueue share the lock with close()'s
+        # sentinel post, so a request can never land behind _STOP in the
+        # queue (it would block its handler thread forever)
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("server is shutting down")
+            if self._worker is None or not self._worker.is_alive():
+                self._worker = threading.Thread(target=self._run, daemon=True)
+                self._worker.start()
+            self._queue.put((deployed, query, slot))
         slot["done"].wait()
         if "error" in slot:
             raise slot["error"]
         return slot["result"]
 
-    def _ensure_worker(self) -> None:
+    def close(self) -> None:
+        """Stop the collector thread and release the serve-pool workers
+        (a stopped/undeployed server must not leak threads for the
+        process lifetime). In-flight batches finish; later submits fail."""
         with self._lock:
-            if self._worker is None or not self._worker.is_alive():
-                self._worker = threading.Thread(target=self._run, daemon=True)
-                self._worker.start()
+            if self._closed:
+                return
+            self._closed = True
+            worker = self._worker
+            self._queue.put(self._STOP)
+        if worker is not None and worker.is_alive():
+            worker.join(timeout=10.0)
+        self._serve_pool.shutdown(wait=True)
 
     def _run(self) -> None:
         while True:
-            deployed, query, slot = self._queue.get()
+            first = self._queue.get()
+            if first is self._STOP:
+                return
+            deployed, query, slot = first
             batch = [(deployed, query, slot)]
             deadline = time.monotonic() + self.window_ms / 1000.0
             while len(batch) < self.max_batch:
@@ -267,9 +290,13 @@ class _BatchingExecutor:
                 if timeout <= 0:
                     break
                 try:
-                    batch.append(self._queue.get(timeout=timeout))
+                    item = self._queue.get(timeout=timeout)
                 except queue.Empty:
                     break
+                if item is self._STOP:
+                    self._queue.put(item)  # re-post for the outer loop
+                    break
+                batch.append(item)
             # group by deployed engine (a reload may be in flight)
             groups: Dict[int, List[Tuple[DeployedEngine, Any, dict]]] = {}
             for item in batch:
@@ -278,7 +305,20 @@ class _BatchingExecutor:
                 # blocks while pipeline_depth batches are in flight — the
                 # next batch keeps accumulating in self._queue meanwhile
                 self._inflight.acquire()
-                self._serve_pool.submit(self._serve_and_release, items[0][0], items)
+                try:
+                    self._serve_pool.submit(
+                        self._serve_and_release, items[0][0], items
+                    )
+                except RuntimeError as e:
+                    # pool shut down mid-close (a >join-timeout batch was
+                    # in flight): fail these slots instead of leaving
+                    # their request threads blocked forever
+                    self._inflight.release()
+                    for _, _, s in items:
+                        s["error"] = RuntimeError(
+                            f"server is shutting down: {e}"
+                        )
+                        s["done"].set()
 
     def _serve_and_release(self, dep: DeployedEngine, items) -> None:
         try:
@@ -338,9 +378,26 @@ class QueryAPI:
         self._feedback_queue: "queue.Queue" = queue.Queue()
         self._feedback_worker: Optional[threading.Thread] = None
         self._feedback_lock = threading.Lock()
+        self._feedback_closed = False
+
+    _FEEDBACK_STOP = object()
+
+    def close(self) -> None:
+        """Release serving resources (the batching executor's collector,
+        serve-pool, and feedback threads) when the server stops or
+        undeploys."""
+        self._executor.close()
+        with self._feedback_lock:
+            self._feedback_closed = True
+            worker = self._feedback_worker
+            self._feedback_queue.put(self._FEEDBACK_STOP)
+        if worker is not None and worker.is_alive():
+            worker.join(timeout=10.0)
 
     def _ensure_feedback_worker(self) -> None:
         with self._feedback_lock:
+            if self._feedback_closed:
+                return  # feedback is best-effort; server is stopping
             if self._feedback_worker is None or not self._feedback_worker.is_alive():
                 self._feedback_worker = threading.Thread(
                     target=self._drain_feedback, daemon=True
@@ -349,7 +406,10 @@ class QueryAPI:
 
     def _drain_feedback(self) -> None:
         while True:
-            url, data = self._feedback_queue.get()
+            item = self._feedback_queue.get()
+            if item is self._FEEDBACK_STOP:
+                return
+            url, data = item
             try:
                 req = urllib.request.Request(
                     url,
@@ -561,6 +621,10 @@ class EngineServer(JsonHTTPServer):
         super().__init__(
             handle, self.config.ip, self.config.port, "Engine Server"
         )
+
+    def shutdown(self) -> None:
+        super().shutdown()
+        self.api.close()
 
     def reload(self) -> None:
         """Swap in the latest completed instance of the SAME engine

@@ -92,12 +92,33 @@ class EngineParams:
         }
 
 
+def _multi_host() -> bool:
+    try:
+        import jax
+
+        return jax.process_count() > 1
+    except Exception:  # backend not initializable — single host
+        return False
+
+
 def _run_grid(items: Sequence[Any], fn, workflow_params) -> List[Any]:
     """Map fn over grid items, in order, with a thread pool when
-    workflow_params.eval_parallelism > 1."""
+    workflow_params.eval_parallelism > 1.
+
+    On a multi-host runtime the grid always runs serially: each item's
+    train issues collective device programs over the multi-process mesh,
+    and JAX multi-controller semantics require every process to enqueue
+    the same collectives in the same order — thread scheduling would
+    reorder them differently per host and deadlock the pod."""
     items = list(items)
     workers = getattr(workflow_params, "eval_parallelism", 1) or 1
     workers = min(int(workers), len(items))
+    if workers > 1 and _multi_host():
+        logger.info(
+            "multi-host run: evaluating the grid serially (collective "
+            "order must match across hosts; eval_parallelism ignored)"
+        )
+        workers = 1
     if workers <= 1 or len(items) <= 1:
         return [fn(x) for x in items]
     import concurrent.futures

@@ -27,6 +27,15 @@ class ExampleJsonConnector(JsonConnector):
                 f"Cannot extract Common field from {dict(data)!r}: "
                 "'type' is required."
             )
+        # optional fields are OMITTED when absent — the reference's json4s
+        # DSL drops None options, so emitting explicit nulls here would
+        # store properties (e.g. "context": null) the reference omits
+        def props(required: Dict[str, Any], *optional: str) -> Dict[str, Any]:
+            return dict(
+                required,
+                **{k: data[k] for k in optional if data.get(k) is not None},
+            )
+
         try:
             if kind == "userAction":
                 return {
@@ -34,11 +43,10 @@ class ExampleJsonConnector(JsonConnector):
                     "entityType": "user",
                     "entityId": data["userId"],
                     "eventTime": data["timestamp"],
-                    "properties": {
-                        "context": data.get("context"),
-                        "anotherProperty1": data["anotherProperty1"],
-                        "anotherProperty2": data.get("anotherProperty2"),
-                    },
+                    "properties": props(
+                        {"anotherProperty1": data["anotherProperty1"]},
+                        "context", "anotherProperty2",
+                    ),
                 }
             if kind == "userActionItem":
                 return {
@@ -48,11 +56,9 @@ class ExampleJsonConnector(JsonConnector):
                     "targetEntityType": "item",
                     "targetEntityId": data["itemId"],
                     "eventTime": data["timestamp"],
-                    "properties": {
-                        "context": data.get("context"),
-                        "anotherPropertyA": data.get("anotherPropertyA"),
-                        "anotherPropertyB": data.get("anotherPropertyB"),
-                    },
+                    "properties": props(
+                        {}, "context", "anotherPropertyA", "anotherPropertyB",
+                    ),
                 }
         except KeyError as e:
             raise ConnectorException(

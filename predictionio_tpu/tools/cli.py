@@ -603,9 +603,12 @@ def build_parser() -> argparse.ArgumentParser:
         help="max queries per device batch",
     )
     deploy.add_argument(
-        "--pipeline-depth", type=int, default=2,
-        help="batches in flight at once (1 = strictly serial serving; "
-        "see ServerConfig.pipeline_depth for the concurrency contract)",
+        "--pipeline-depth", type=int, default=1,
+        help="batches in flight at once (default 1 = strictly serial "
+        "serving, matching the reference contract; 2 double-buffers "
+        "device dispatch against result fetch — safe only for engines "
+        "with no mutable predict-time state, like the packaged "
+        "templates; see ServerConfig.pipeline_depth)",
     )
     deploy.set_defaults(func=cmd_deploy)
 

@@ -256,6 +256,36 @@ class TestBatchingPipeline:
         assert outcomes[3] == "error"
         assert all(outcomes[i] == i for i in range(6) if i != 3)
 
+    def test_close_stops_threads_and_rejects_submits(self):
+        """A stopped server must not leak its collector/serve-pool threads
+        (round-3 advisor): close() joins the collector, shuts the pool,
+        and later submits fail fast."""
+        from predictionio_tpu.api.engine_server import _BatchingExecutor
+
+        class Dep:
+            def serve_batch(self, queries):
+                return list(queries)
+
+        dep = Dep()
+        ex = _BatchingExecutor(window_ms=1.0, max_batch=4, pipeline_depth=2)
+        assert ex.submit(dep, 7) == 7
+        worker = ex._worker
+        assert worker is not None and worker.is_alive()
+        ex.close()
+        worker.join(timeout=5)
+        assert not worker.is_alive()
+        with pytest.raises(RuntimeError):
+            ex.submit(dep, 8)
+        ex.close()  # idempotent
+
+    def test_default_pipeline_depth_is_serial(self):
+        """Reference-parity default: serving is strictly serial unless the
+        deployer opts into pipelining (user engines may keep mutable
+        predict-time state, legal under the reference API)."""
+        from predictionio_tpu.api.engine_server import ServerConfig
+
+        assert ServerConfig(port=0).pipeline_depth == 1
+
 
 class UpperBlocker(EngineServerPlugin):
     plugin_name = "upper"

@@ -484,6 +484,26 @@ class TestExampleConnectors:
         assert event.target_entity_type == "item"
         assert event.target_entity_id == "i9"
 
+    def test_json_absent_optionals_are_omitted(self):
+        """The reference's json4s DSL drops None options — absent optional
+        fields must not appear as null-valued properties (round-3
+        advisor)."""
+        from predictionio_tpu.data.webhooks.example import ExampleJsonConnector
+
+        event = to_event(
+            ExampleJsonConnector(),
+            {
+                "type": "userAction",
+                "userId": "u1",
+                "event": "sign-up",
+                "anotherProperty1": 3,
+                "timestamp": "2015-01-02T00:30:12.984Z",
+            },
+        )
+        assert "context" not in event.properties
+        assert "anotherProperty2" not in event.properties
+        assert event.properties["anotherProperty1"] == 3
+
     def test_json_unknown_and_missing(self):
         from predictionio_tpu.data.webhooks.example import ExampleJsonConnector
 

@@ -231,6 +231,38 @@ class TestFastEvalEngine:
         # = 2.4s if fully serialized) as a regression backstop
         assert grid_s < 16 * SlowAlgo.DELAY_S, grid_s
 
+    def test_multi_host_grid_runs_serial(self, monkeypatch):
+        """On a multi-host runtime every process must enqueue collectives
+        in the same order, so the grid fan-out degrades to serial
+        regardless of eval_parallelism (round-3 advisor, high)."""
+        import threading
+        import time
+
+        from predictionio_tpu.controller import engine as engine_mod
+
+        monkeypatch.setattr(engine_mod, "_multi_host", lambda: True)
+        lock = threading.Lock()
+        state = {"running": 0, "max_running": 0}
+
+        def fn(x):
+            with lock:
+                state["running"] += 1
+                state["max_running"] = max(
+                    state["max_running"], state["running"]
+                )
+            try:
+                time.sleep(0.02)
+            finally:
+                with lock:
+                    state["running"] -= 1
+            return x * 2
+
+        out = engine_mod._run_grid(
+            list(range(6)), fn, WorkflowParams(eval_parallelism=8)
+        )
+        assert out == [0, 2, 4, 6, 8, 10]
+        assert state["max_running"] == 1, state["max_running"]
+
     def test_results_match_plain_engine(self, mem_storage):
         ctx = WorkflowContext(storage=mem_storage)
         plain = make_engine(Engine)
