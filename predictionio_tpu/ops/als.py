@@ -85,15 +85,20 @@ class ALSConfig:
 class PackedSide:
     """Host-side fixed-width segment view of one solve side, pre-shaped
     for the chunked device loop: segment arrays are [C, Sc, L] where
-    C·Sc ≥ #segments and Sc·L ≤ chunk_slots."""
+    C·Sc ≥ #segments and Sc·L ≤ chunk_slots.
+
+    There is NO per-slot validity mask: each segment's valid slots are a
+    prefix, so one count per segment (``rem``) reconstructs the mask
+    on-device as ``iota(L) < rem`` — L bytes/segment less host->HBM
+    transfer than the uint8 mask plane rounds 1-3 shipped (≈50 MB at
+    ML-20M scale through a relayed link), and one less [C, Sc, L] stream
+    in the accumulation loop."""
 
     n_rows: int  # real (unpadded) row count
     seg_rows: np.ndarray  # [C, Sc] row id of each segment (padding -> n_rows)
     cols: np.ndarray  # [C, Sc, L] column ids (padding = 0, masked)
     vals: np.ndarray  # [C, Sc, L] ratings
-    mask: np.ndarray  # [C, Sc, L] uint8, 1 where real (cast on device;
-    # uint8 cuts the host->HBM transfer, which is minutes at 20M scale
-    # through a relayed link)
+    rem: np.ndarray  # [C, Sc] int32 valid slots per segment (prefix)
     counts: np.ndarray  # [n_rows] observation counts
 
     @property
@@ -126,9 +131,52 @@ def pack_segments(
     order = np.argsort(rows, kind="stable")
     rows_s, cols_s, vals_s = rows[order], cols[order], vals[order]
     counts = np.bincount(rows_s, minlength=n_rows).astype(np.int32)
+    g = _segment_geometry(counts, n_rows, L, pad_segments_to, chunk_slots)
+
+    p_cols = np.zeros((g.total, L), dtype=np.int32)
+    p_vals = np.zeros((g.total, L), dtype=np.float32)
+    if len(rows_s):
+        offset = np.arange(len(rows_s), dtype=np.int64) - g.starts[rows_s]
+        flat = (g.seg_base[rows_s] + offset // L) * L + offset % L
+        p_cols.reshape(-1)[flat] = cols_s
+        p_vals.reshape(-1)[flat] = vals_s
+    return PackedSide(
+        n_rows=n_rows,
+        seg_rows=g.seg_rows.reshape(g.n_chunks, g.sc),
+        cols=p_cols.reshape(g.n_chunks, g.sc, L),
+        vals=p_vals.reshape(g.n_chunks, g.sc, L),
+        rem=g.rem.reshape(g.n_chunks, g.sc),
+        counts=counts,
+    )
+
+
+@dataclasses.dataclass
+class _SegGeometry:
+    """Segment-grid geometry of one solve side, computed from per-row
+    counts alone (no pass over the observations)."""
+
+    n_rows: int
+    L: int
+    counts: np.ndarray  # [n_rows] int32
+    starts: np.ndarray  # [n_rows + 1] int64 CSR offsets of the sorted COO
+    seg_base: np.ndarray  # [n_rows + 1] int64 first segment of each row
+    n_segs: int
+    sc: int
+    n_chunks: int
+    total: int  # n_chunks * sc >= n_segs
+    seg_rows: np.ndarray  # [total] row of each segment (padding -> n_rows)
+    rem: np.ndarray  # [total] valid slots per segment
+
+
+def _segment_geometry(
+    counts: np.ndarray,
+    n_rows: int,
+    L: int,
+    pad_segments_to: int,
+    chunk_slots: int,
+) -> _SegGeometry:
     starts = np.zeros(n_rows + 1, dtype=np.int64)
     np.cumsum(counts, out=starts[1:])
-
     segs_per_row = -(-counts // L)  # ceil; 0 for empty rows
     seg_base = np.zeros(n_rows + 1, dtype=np.int64)
     np.cumsum(segs_per_row, out=seg_base[1:])
@@ -140,46 +188,103 @@ def pack_segments(
     # pad out to the full chunk budget
     sc = max(1, int(chunk_slots) // L)
     sc = max(pad_segments_to, sc - sc % pad_segments_to)
-    # Bucket the needed segment count to a power-of-two multiple of the
-    # shard pad: the packed arrays' shapes feed straight into jit, and
-    # k-fold/grid evaluation produces near-identical segment counts
-    # (e.g. 402/403/408) that would otherwise each pay a full XLA
-    # compile. Pow2 bucketing collapses them onto one executable; the
-    # extra segments carry the sentinel row id and are masked out.
-    # Waste is bounded: bucketing only changes sc in the single-chunk
-    # regime (sc_needed below the chunk budget, min() below), so the
-    # extra slots never exceed one chunk budget (chunk_slots ≈ 36 MB of
-    # pack arrays at the default); budget-capped large trains (ML-20M)
+    # Bucket the needed segment count (to a multiple of the shard pad):
+    # the packed arrays' shapes feed straight into jit, and k-fold/grid
+    # evaluation produces near-identical segment counts (e.g. 402/403/
+    # 408) that would otherwise each pay a full XLA compile. Rounding up
+    # at 4-significant-bit granularity (the granule is 2^(bitlength-4))
+    # collapses them onto one executable with ≤12.5% padding — round 3
+    # bucketed to full powers of two, which cost up to 2x padded slots
+    # and measurably slowed the single-train benchmarks. The extra
+    # segments carry the sentinel row id and are masked out. Bucketing
+    # only changes sc in the single-chunk regime (sc_needed below the
+    # chunk budget, min() below); budget-capped large trains (ML-20M)
     # get the same sc as before and pad at most one trailing chunk.
     per_pad = -(-max(n_segs, 1) // pad_segments_to)
-    sc_needed = pad_segments_to * (1 << (per_pad - 1).bit_length())
+    granule = 1 << max(0, per_pad.bit_length() - 4)
+    sc_needed = pad_segments_to * (-(-per_pad // granule) * granule)
     sc = min(sc, sc_needed)
     n_chunks = max(1, -(-max(n_segs, 1) // sc))
     total = n_chunks * sc
 
     seg_rows = np.full(total, n_rows, dtype=np.int32)
-    p_cols = np.zeros((total, L), dtype=np.int32)
-    p_vals = np.zeros((total, L), dtype=np.float32)
-    p_mask = np.zeros((total, L), dtype=np.uint8)
-    if len(rows_s):
-        offset = np.arange(len(rows_s), dtype=np.int64) - starts[rows_s]
-        seg_of = seg_base[rows_s] + offset // L
-        slot_of = offset % L
-        flat = seg_of * L + slot_of
-        p_cols.reshape(-1)[flat] = cols_s
-        p_vals.reshape(-1)[flat] = vals_s
-        p_mask.reshape(-1)[flat] = 1
+    rem = np.zeros(total, dtype=np.int32)
+    if n_segs:
         seg_rows[:n_segs] = np.repeat(
             np.arange(n_rows, dtype=np.int32), segs_per_row
         )
-    return PackedSide(
-        n_rows=n_rows,
-        seg_rows=seg_rows.reshape(n_chunks, sc),
-        cols=p_cols.reshape(n_chunks, sc, L),
-        vals=p_vals.reshape(n_chunks, sc, L),
-        mask=p_mask.reshape(n_chunks, sc, L),
-        counts=counts,
+        # valid slots per segment: full L except each row's last segment
+        seg_ord = np.arange(n_segs, dtype=np.int64) - seg_base[seg_rows[:n_segs]]
+        rem[:n_segs] = np.minimum(
+            counts[seg_rows[:n_segs]].astype(np.int64) - seg_ord * L, L
+        )
+    return _SegGeometry(
+        n_rows=n_rows, L=L, counts=counts, starts=starts,
+        seg_base=seg_base, n_segs=n_segs, sc=sc, n_chunks=n_chunks,
+        total=total, seg_rows=seg_rows, rem=rem,
     )
+
+
+# --- device-side packing (single-device fast path) ---
+#
+# The padded segment arrays are up to ~3x the COO bytes; building them on
+# HOST means shipping that inflation over the host->device link, which on
+# relayed rigs runs at tens of MB/s (the dominant ML-20M phase in rounds
+# 1-3: 14-80 s). Instead the raw COO crosses the link ONCE — losslessly
+# narrowed (item ids to uint16 when they fit, half-step ratings to int8)
+# — and the device sorts (lax.sort, ~0.2 s per 20M side vs ~4 s host
+# radix sort) and scatters into the padded layout in HBM. This replaces
+# the role of the reference's region-parallel HBase scan feeding Spark
+# block shuffles (data/storage/hbase/HBPEvents.scala:84-90): the wire
+# carries the minimal representation, the accelerator does the layout.
+
+
+def _narrow_ids(idx: np.ndarray) -> np.ndarray:
+    """Ids as the narrowest lossless wire dtype (uint16 covers catalogs
+    under 64k — the item axis of every MovieLens-class dataset)."""
+    return idx.astype(np.uint16) if idx.size and idx.max() < 65536 else idx
+
+
+def _narrow_vals(vals: np.ndarray) -> Tuple[np.ndarray, float]:
+    """(wire_array, scale): ratings on half-step scales (MovieLens 1..5
+    or 0.5..5.0) travel as int8 exactly; anything else stays float32."""
+    if vals.size == 0:
+        return vals, 1.0
+    doubled = vals * 2.0
+    rounded = np.rint(doubled)
+    if (
+        np.abs(doubled - rounded).max() == 0.0
+        and np.abs(rounded).max() <= 127
+    ):
+        return rounded.astype(np.int8), 0.5
+    return vals, 1.0
+
+
+@functools.partial(jax.jit, static_argnames=("total", "L", "scale"))
+def _device_scatter_pack(keys, cols, vals, starts, seg_base, total, L, scale):
+    """Sort the COO by ``keys`` and scatter values/cols into the padded
+    [total, L] segment layout — all on device. The flat slot index of the
+    j-th sorted element is derivable from the CSR offsets alone, and is
+    strictly increasing, so the scatters are sorted unique-index writes.
+    Stable sort keeps the slot assignment identical to the host packer's
+    (bit-identical training results either path). Sentinel-padded COO
+    elements (row id == n_rows) sort last and either land in masked
+    padding segments or drop out of bounds (mode="drop")."""
+    ks, cs, vs = jax.lax.sort(
+        (keys.astype(jnp.int32), cols.astype(jnp.int32), vals),
+        num_keys=1, is_stable=True,
+    )
+    n = keys.shape[0]
+    j = jnp.arange(n, dtype=jnp.int32)
+    offset = j - starts[ks]
+    flat = (seg_base[ks] + offset // L) * L + offset % L
+    opts = dict(unique_indices=True, indices_are_sorted=True, mode="drop")
+    p_cols = jnp.zeros((total * L,), jnp.int32).at[flat].set(cs, **opts)
+    p_vals = (
+        jnp.zeros((total * L,), jnp.float32)
+        .at[flat].set(vs.astype(jnp.float32) * scale, **opts)
+    )
+    return p_cols, p_vals
 
 
 # --- device kernels ---
@@ -190,7 +295,7 @@ def _accumulate_systems(
     seg_rows: jax.Array,  # [C, Sc]
     cols: jax.Array,  # [C, Sc, L]
     vals: jax.Array,  # [C, Sc, L]
-    mask: jax.Array,  # [C, Sc, L]
+    rem: jax.Array,  # [C, Sc] valid slots per segment
     alpha,
     n_sys_rows: int,
     *,
@@ -202,10 +307,16 @@ def _accumulate_systems(
     einsums + a scatter-add. The chunk loop bounds the [Sc, L, k] gather
     buffer; the einsums are the MXU work."""
     k = Y.shape[-1]
+    L = cols.shape[-1]
     cdt = jnp.dtype(compute_dtype)
     # float32 inputs ask for full-precision MXU passes; bfloat16 trades
     # precision for MXU rate explicitly via compute_dtype
     prec = "highest" if cdt == jnp.float32 else "default"
+    # The gather is ROW-RATE bound on TPU (measured ~420M rows/s either
+    # dtype), so gathering pre-cast rows also skips a cast pass over the
+    # [Sc, L, k] buffer; the cast of Y itself is one cheap pass.
+    Yc = Y.astype(cdt)
+    iota_l = jnp.arange(L, dtype=jnp.int32)
     A0 = jnp.zeros((n_sys_rows, k, k), jnp.float32)
     b0 = jnp.zeros((n_sys_rows, k), jnp.float32)
 
@@ -214,8 +325,11 @@ def _accumulate_systems(
         rows_c = jax.lax.dynamic_index_in_dim(seg_rows, c, keepdims=False)
         cols_c = jax.lax.dynamic_index_in_dim(cols, c, keepdims=False)
         vals_c = jax.lax.dynamic_index_in_dim(vals, c, keepdims=False)
-        mask_c = jax.lax.dynamic_index_in_dim(mask, c, keepdims=False)
-        Yg = Y[cols_c].astype(cdt)  # [Sc, L, k] gather from HBM
+        rem_c = jax.lax.dynamic_index_in_dim(rem, c, keepdims=False)
+        # per-slot validity, reconstructed from the per-segment prefix
+        # count (valid slots always lead) — no [C, Sc, L] mask stream
+        mask_c = (iota_l[None, :] < rem_c[:, None]).astype(jnp.float32)
+        Yg = Yc[cols_c]  # [Sc, L, k] gather from HBM
         if implicit:
             # MLlib trainImplicit semantics (Hu-Koren-Volinsky):
             # confidence c = alpha·|r| (non-negative — keeps A
@@ -244,11 +358,68 @@ def _accumulate_systems(
     return jax.lax.fori_loop(0, seg_rows.shape[0], body, (A0, b0))
 
 
+def _spd_solve(A: jax.Array, b: jax.Array) -> jax.Array:
+    """Batched SPD solve: in-place vectorized Cholesky with the forward
+    substitution fused into the factorization sweep.
+
+    XLA's native cho_factor/cho_solve on TPU streams the [R, k, k] batch
+    through HBM dozens of times — measured 502 ms per solve at
+    R=138k, k=32 (v5e), which was HALF the ML-20M device loop. This
+    formulation is k fused steps, each one column rescale + rank-1
+    update over the whole batch (~4.5x faster measured, max rel err
+    ~6e-7 vs cho_solve on the same systems). Entries outside the lower
+    triangle are left stale rather than masked — each step's column
+    read masks them off, saving a full [R, k, k] pass per step.
+
+    Supports leading batch dims via vmap (the grid path vmaps it).
+    """
+    n = A.shape[-1]
+    idx = jnp.arange(n)
+
+    def fac_body(j, carry):
+        A, y, r, dinv = carry
+        col = jax.lax.dynamic_index_in_dim(A, j, axis=2, keepdims=False)
+        d = jax.lax.rsqrt(
+            jax.lax.dynamic_index_in_dim(col, j, axis=1, keepdims=False)
+        )
+        col = jnp.where(idx[None, :] >= j, col * d[:, None], 0.0)
+        # forward substitution, fused: y_j = r_j / L_jj, r -= L[:, j] y_j
+        yj = jax.lax.dynamic_index_in_dim(r, j, axis=1, keepdims=False) * d
+        r = r - col * yj[:, None]
+        y = jax.lax.dynamic_update_index_in_dim(y, yj, j, axis=1)
+        dinv = jax.lax.dynamic_update_index_in_dim(dinv, d, j, axis=1)
+        # rank-1 Schur update; col is zero above j, so rows/cols < j are
+        # untouched and the (never-read) upper triangle absorbs the rest
+        A = A - col[:, :, None] * col[:, None, :]
+        return (
+            jax.lax.dynamic_update_index_in_dim(A, col, j, axis=2),
+            y, r, dinv,
+        )
+
+    zeros = jnp.zeros_like(b)
+    L, y, _, dinv = jax.lax.fori_loop(
+        0, n, fac_body, (A, zeros, b, zeros)
+    )
+
+    def back_body(jj, x):
+        j = n - 1 - jj
+        lcol = jax.lax.dynamic_index_in_dim(L, j, axis=2, keepdims=False)
+        # x_j = (y_j - sum_{i>j} L_ij x_i) / L_jj ; x_i is still zero for
+        # i <= j and L_ij zero for i < j, so the full dot is the tail sum
+        s = jnp.sum(lcol * x, axis=-1)
+        xj = (
+            jax.lax.dynamic_index_in_dim(y, j, axis=1, keepdims=False) - s
+        ) * jax.lax.dynamic_index_in_dim(dinv, j, axis=1, keepdims=False)
+        return jax.lax.dynamic_update_index_in_dim(x, xj, j, axis=1)
+
+    return jax.lax.fori_loop(0, n, back_body, zeros)
+
+
 def _solve_side(
     X_prev: jax.Array,  # [R, k] previous factors (kept for zero-obs rows)
     Y: jax.Array,  # [n_cols(+pad), k] counter-side factors
     G: jax.Array,  # [k, k] shared Gramian YᵀY (implicit) or zeros
-    pack,  # (seg_rows, cols, vals, mask) pre-shaped [C, Sc(, L)]
+    pack,  # (seg_rows, cols, vals, rem) pre-shaped [C, Sc(, L)]
     lam: jax.Array,  # [R] per-row regularizer (precomputed, guarded > 0)
     has_obs: jax.Array,  # [R] bool — rows with at least one observation
     alpha,
@@ -257,16 +428,16 @@ def _solve_side(
     compute_dtype: str,
 ) -> jax.Array:
     k = Y.shape[-1]
-    seg_rows, cols, vals, mask = pack
+    seg_rows, cols, vals, rem = pack
     A, b = _accumulate_systems(
-        Y, seg_rows, cols, vals, mask, alpha, X_prev.shape[0],
+        Y, seg_rows, cols, vals, rem, alpha, X_prev.shape[0],
         implicit=implicit, compute_dtype=compute_dtype,
     )
     if implicit:
         A = A + G[None]
     A = A + lam[:, None, None] * jnp.eye(k, dtype=jnp.float32)
     # ONE batched Cholesky over every row's k x k system
-    x = jax.scipy.linalg.cho_solve(jax.scipy.linalg.cho_factor(A), b)
+    x = _spd_solve(A, b)
     # rows with no observations keep their previous factors (MLlib only
     # materializes factors for observed ids; init survives here)
     return jnp.where(has_obs[:, None], x.astype(X_prev.dtype), X_prev)
@@ -301,7 +472,7 @@ def _constrain(a: jax.Array, sharding) -> jax.Array:
 def _run_iterations(
     X: jax.Array,
     Y: jax.Array,
-    user_pack,  # (seg_rows, cols, vals, mask) each [C, Sc(, L)]
+    user_pack,  # (seg_rows, cols, vals, rem) each [C, Sc(, L)]
     item_pack,
     user_lam: jax.Array,  # [R_u] per-row regularizer
     item_lam: jax.Array,  # [R_i]
@@ -473,7 +644,7 @@ def train_als_grid(
 
     pack = lambda side: (
         jnp.asarray(side.seg_rows), jnp.asarray(side.cols),
-        jnp.asarray(side.vals), jnp.asarray(side.mask),
+        jnp.asarray(side.vals), jnp.asarray(side.rem),
     )
     X = jnp.zeros((n_variants, r_u, k), jnp.float32)
     Y = jnp.broadcast_to(jnp.asarray(Y0), (n_variants, r_i, k)) + 0.0
@@ -500,12 +671,18 @@ def _place(mesh: Optional[Mesh], arr, spec):
     return jax.device_put(arr, NamedSharding(mesh, spec))
 
 
-def auto_segment_length(idx: np.ndarray, n_rows: int, cap: int) -> int:
+def auto_segment_length(
+    idx: np.ndarray, n_rows: int, cap: int,
+    counts: Optional[np.ndarray] = None,
+) -> int:
     """Smallest power of two >= the side's mean observation count, within
     [min(8, cap), cap] — shared by train_als and train_als_grid so the
-    two paths always pack identically (see ALSConfig.segment_length)."""
+    two paths always pack identically (see ALSConfig.segment_length).
+    Pass precomputed per-row ``counts`` to skip the bincount pass."""
     floor = min(8, cap)  # honor caps below 8
-    nonempty = int((np.bincount(idx, minlength=n_rows) > 0).sum())
+    if counts is None:
+        counts = np.bincount(idx, minlength=n_rows)
+    nonempty = int((counts > 0).sum())
     if nonempty == 0:
         return floor
     mean = len(idx) / nonempty
@@ -567,14 +744,17 @@ def train_als(
     interruption (mid-training checkpoint/resume — absent in the
     reference, SURVEY.md §5).
 
-    ``timings``, if given, receives a phase breakdown: ``pack_s``,
-    ``device_put_s``, ``compile_s`` (a zero-iteration run that builds the
-    executable before the timed loop — the trip count is dynamic, so the
-    real run reuses it), ``device_loop_s`` (accumulated across checkpoint
-    chunks when checkpointing), and ``padded_slots`` (total segment-grid
-    slots both sides, the denominator for hardware-busyness numbers). At
-    ML-20M scale host prep and the ~1 GB HBM transfer are distinct from
-    the on-device solve loop, and MFU must be computed against the latter.
+    ``timings``, if given, receives a phase breakdown: ``pack_s`` (host
+    geometry/packing), ``device_put_s`` (host->device transfer —
+    single-device runs ship only the narrowed COO, ``wire_mb``; the
+    padded layout is built in HBM by _device_scatter_pack),
+    ``compile_s`` (a zero-iteration run that builds the executable
+    before the timed loop — the trip count is dynamic, so the real run
+    reuses it), ``device_loop_s`` (accumulated across checkpoint chunks
+    when checkpointing), and ``padded_slots`` (total segment-grid slots
+    both sides, the denominator for hardware-busyness numbers). At
+    ML-20M scale host prep and the transfer are distinct from the
+    on-device solve loop, and MFU must be computed against the latter.
     """
     import time as _time
 
@@ -582,24 +762,24 @@ def train_als(
     n_shards = mesh.shape[axis] if mesh is not None else 1
 
     t_phase = _time.perf_counter()
-    user_side = pack_segments(
-        user_idx, item_idx, ratings, n_users,
-        auto_segment_length(user_idx, n_users, config.segment_length),
-        n_shards, config.chunk_slots,
+    user_idx = np.asarray(user_idx, np.int32)
+    item_idx = np.asarray(item_idx, np.int32)
+    ratings_f = np.asarray(ratings, np.float32)
+    counts_u = np.bincount(user_idx, minlength=n_users).astype(np.int32)
+    counts_i = np.bincount(item_idx, minlength=n_items).astype(np.int32)
+    L_u = auto_segment_length(
+        user_idx, n_users, config.segment_length, counts=counts_u
     )
-    item_side = pack_segments(
-        item_idx, user_idx, ratings, n_items,
-        auto_segment_length(item_idx, n_items, config.segment_length),
-        n_shards, config.chunk_slots,
+    L_i = auto_segment_length(
+        item_idx, n_items, config.segment_length, counts=counts_i
     )
-    if timings is not None:
-        timings["pack_s"] = _time.perf_counter() - t_phase
+    geo_u = _segment_geometry(counts_u, n_users, L_u, n_shards, config.chunk_slots)
+    geo_i = _segment_geometry(counts_i, n_items, L_i, n_shards, config.chunk_slots)
     logger.info(
         "ALS: %d users (%d segments of %d), %d items (%d segments of %d), "
         "%d ratings, rank %d",
-        n_users, user_side.n_segments, user_side.cols.shape[2],
-        n_items, item_side.n_segments, item_side.cols.shape[2],
-        len(ratings), k,
+        n_users, geo_u.total, L_u, n_items, geo_i.total, L_i,
+        len(ratings_f), k,
     )
 
     rng = np.random.default_rng(config.seed)
@@ -625,40 +805,116 @@ def train_als(
 
     weighted = config.reg_mode == "weighted"
 
-    def lam_and_obs(side: PackedSide, n_sys_rows: int):
-        counts = np.zeros(n_sys_rows, np.float32)
-        counts[: side.n_rows] = side.counts
-        lam = config.reg * counts if weighted else np.full_like(counts, config.reg)
+    def lam_and_obs(counts: np.ndarray, n_real: int, n_sys_rows: int):
+        padded = np.zeros(n_sys_rows, np.float32)
+        padded[:n_real] = counts
+        lam = config.reg * padded if weighted else np.full_like(padded, config.reg)
         # guard zero-count/padding rows against singular systems (their
         # solutions are discarded by the has_obs select anyway)
         lam = np.maximum(lam, 1e-8).astype(np.float32)
         return (
             _place(mesh, lam, row_sharded),
-            _place(mesh, counts > 0, row_sharded),
+            _place(mesh, padded > 0, row_sharded),
         )
 
-    def put_pack(side: PackedSide):
-        return (
-            _place(mesh, side.seg_rows, seg_sharded2),
-            _place(mesh, side.cols, seg_sharded3),
-            _place(mesh, side.vals, seg_sharded3),
-            _place(mesh, side.mask, seg_sharded3),
+    if mesh is None:
+        # Device-side packing (see _device_scatter_pack): the COO crosses
+        # the link once, losslessly narrowed; sort + layout happen in HBM.
+        if timings is not None:
+            timings["pack_s"] = _time.perf_counter() - t_phase
+        t_phase = _time.perf_counter()
+        n = len(ratings_f)
+        # bucket the COO length (4 significant bits) so k-fold/grid runs
+        # with near-identical rating counts share one pack executable;
+        # padding elements carry the sentinel row id on BOTH sides and
+        # either land in masked padding segments or drop out of bounds
+        granule = 1 << max(0, n.bit_length() - 4)
+        pad = (-(-n // granule) * granule - n) if n else 1
+        uw = np.concatenate([user_idx, np.full(pad, n_users, np.int32)])
+        iw = np.concatenate([item_idx, np.full(pad, n_items, np.int32)])
+        vw = np.concatenate([ratings_f, np.zeros(pad, np.float32)])
+        uw = _narrow_ids(uw)
+        iw = _narrow_ids(iw)
+        vw, v_scale = _narrow_vals(vw)
+        u_dev = jax.device_put(uw)
+        i_dev = jax.device_put(iw)
+        v_dev = jax.device_put(vw)
+        aux = jax.device_put(
+            {
+                "su": geo_u.starts.astype(np.int32),
+                "bu": geo_u.seg_base.astype(np.int32),
+                "si": geo_i.starts.astype(np.int32),
+                "bi": geo_i.seg_base.astype(np.int32),
+            }
         )
+        if timings is not None:
+            # aux was enqueued last; fetching it (small) fences the
+            # serialized transfer queue behind the COO arrays
+            _sync_fetch(aux)
+            timings["device_put_s"] = _time.perf_counter() - t_phase
+            timings["wire_mb"] = round(
+                (uw.nbytes + iw.nbytes + vw.nbytes) / 2**20, 1
+            )
+        t_phase = _time.perf_counter()
+        pcu, pvu = _device_scatter_pack(
+            u_dev, i_dev, v_dev, aux["su"], aux["bu"],
+            total=geo_u.total, L=L_u, scale=v_scale,
+        )
+        pci, pvi = _device_scatter_pack(
+            i_dev, u_dev, v_dev, aux["si"], aux["bi"],
+            total=geo_i.total, L=L_i, scale=v_scale,
+        )
+        if timings is not None:
+            # dispatch is async; this records the (cached-after-first)
+            # pack-executable compile time, not the scatter itself
+            timings["device_pack_dispatch_s"] = _time.perf_counter() - t_phase
 
-    t_phase = _time.perf_counter()
-    user_pack = put_pack(user_side)
-    item_pack = put_pack(item_side)
-    user_lam, user_has_obs = lam_and_obs(user_side, X.shape[0])
-    item_lam, item_has_obs = lam_and_obs(item_side, Y.shape[0])
+        def geo_pack(geo: _SegGeometry, pc, pv):
+            return (
+                jnp.asarray(geo.seg_rows.reshape(geo.n_chunks, geo.sc)),
+                pc.reshape(geo.n_chunks, geo.sc, geo.L),
+                pv.reshape(geo.n_chunks, geo.sc, geo.L),
+                jnp.asarray(geo.rem.reshape(geo.n_chunks, geo.sc)),
+            )
+
+        user_pack = geo_pack(geo_u, pcu, pvu)
+        item_pack = geo_pack(geo_i, pci, pvi)
+    else:
+        # Mesh path: host-side packing + sharded placement. Multi-device
+        # meshes are local or multi-host (no relayed link), and the packed
+        # arrays must be laid out per the mesh sharding anyway.
+        user_side = pack_segments(
+            user_idx, item_idx, ratings_f, n_users, L_u,
+            n_shards, config.chunk_slots,
+        )
+        item_side = pack_segments(
+            item_idx, user_idx, ratings_f, n_items, L_i,
+            n_shards, config.chunk_slots,
+        )
+        if timings is not None:
+            timings["pack_s"] = _time.perf_counter() - t_phase
+        t_phase = _time.perf_counter()
+
+        def put_pack(side: PackedSide):
+            return (
+                _place(mesh, side.seg_rows, seg_sharded2),
+                _place(mesh, side.cols, seg_sharded3),
+                _place(mesh, side.vals, seg_sharded3),
+                _place(mesh, side.rem, seg_sharded2),
+            )
+
+        user_pack = put_pack(user_side)
+        item_pack = put_pack(item_side)
+
+    user_lam, user_has_obs = lam_and_obs(counts_u, n_users, X.shape[0])
+    item_lam, item_has_obs = lam_and_obs(counts_i, n_items, Y.shape[0])
     if timings is not None:
-        # the has_obs arrays were enqueued last; fetching them (small)
-        # fences the serialized transfer queue behind the ~GB pack arrays
-        _sync_fetch((user_has_obs, item_has_obs))
-        timings["device_put_s"] = _time.perf_counter() - t_phase
-        timings["padded_slots"] = (
-            user_side.n_segments * user_side.cols.shape[2]
-            + item_side.n_segments * item_side.cols.shape[2]
-        )
+        if mesh is not None:
+            # the has_obs arrays were enqueued last; fetching them (small)
+            # fences the serialized transfer queue behind the pack arrays
+            _sync_fetch((user_has_obs, item_has_obs))
+            timings["device_put_s"] = _time.perf_counter() - t_phase
+        timings["padded_slots"] = geo_u.total * L_u + geo_i.total * L_i
     rep_sharding = NamedSharding(mesh, rep) if mesh is not None else None
     row_sharding = NamedSharding(mesh, row_sharded) if mesh is not None else None
 
@@ -774,7 +1030,16 @@ def train_als(
     finally:
         ckpt.close()
 
-    X_host, Y_host = _fetch_global(X), _fetch_global(Y)
+    if getattr(X, "is_fully_addressable", True) and getattr(
+        Y, "is_fully_addressable", True
+    ):
+        # one device_get for both factor matrices: each separate fetch
+        # costs a full round trip on relayed rigs (~65 ms), which at
+        # ML-100K scale is a third of the train wall clock
+        X_host, Y_host = jax.device_get((X, Y))
+        X_host, Y_host = np.asarray(X_host), np.asarray(Y_host)
+    else:
+        X_host, Y_host = _fetch_global(X), _fetch_global(Y)
     return ALSModelArrays(X_host[:n_users], Y_host[:n_items])
 
 
