@@ -185,6 +185,43 @@ class StorageGatewayCore:
             return {
                 k: wire.property_map_to_wire(v) for k, v in out.items()
             }
+        if method == "insert_columns":
+            # bulk columnar import: dictionaries as JSON strings, codes
+            # and values as packed base64 (data/storage/columnar.py)
+            from predictionio_tpu.data.storage import columnar as col
+
+            import numpy as np
+
+            return le.insert_columns_encoded(
+                a["app_id"],
+                a.get("channel_id"),
+                event=a["event"],
+                entity_type=a["entity_type"],
+                target_entity_type=a["target_entity_type"],
+                entity_names=a["entity_names"],
+                entity_codes=col.array_from_b64(a["entity_codes"], np.int32),
+                target_names=a["target_names"],
+                target_codes=col.array_from_b64(a["target_codes"], np.int32),
+                values=col.array_from_b64(a["values"], np.float32),
+                value_property=a.get("value_property", "rating"),
+                event_time=wire.opt_dt_from_wire(a.get("event_time")),
+            )
+        if method == "find_columns_native":
+            from predictionio_tpu.data.storage import columnar as col
+            from predictionio_tpu.data.storage.base import UNSET
+
+            tet = a.get("target_entity_type", wire.UNSET_WIRE)
+            cols = le.find_columns_native(
+                a["app_id"],
+                a.get("channel_id"),
+                value_spec=col.spec_from_wire(a.get("value_spec")),
+                start_time=wire.opt_dt_from_wire(a.get("start_time")),
+                until_time=wire.opt_dt_from_wire(a.get("until_time")),
+                entity_type=a.get("entity_type"),
+                target_entity_type=UNSET if tet == wire.UNSET_WIRE else tet,
+                event_names=a.get("event_names"),
+            )
+            return None if cols is None else col.columnar_to_wire(cols)
         if method == "aggregate_properties_of_entity":
             pm = le.aggregate_properties_of_entity(
                 app_id=a["app_id"],

@@ -187,6 +187,136 @@ class LEvents(abc.ABC):
         """Bulk insert (reference PEvents.write:169-181)."""
         return [self.insert(e, app_id, channel_id) for e in events]
 
+    # --- columnar scan path (round 4; reference analog: the partitioned
+    # columnar scans HBPEvents.scala:84-90 / JDBCPEvents.scala:51-129) ---
+
+    def insert_columns(
+        self,
+        app_id: int,
+        channel_id: Optional[int] = None,
+        *,
+        event: str,
+        entity_type: str,
+        target_entity_type: str,
+        entity_ids: Sequence[str],
+        target_ids: Sequence[str],
+        values: Sequence[float],
+        value_property: str = "rating",
+        event_time: Optional[_dt.datetime] = None,
+    ) -> int:
+        """Bulk-append target-carrying interaction events from columns.
+
+        Backends with a columnar page store (sqlite) override this with a
+        vectorized dictionary-encoded append; this generic fallback
+        constructs one Event per row. ``event`` must be a plain
+        interaction event (not a ``$``-prefixed special event — those
+        carry property semantics the columnar form does not model).
+        Returns the number of events written.
+        """
+        if event.startswith("$"):
+            raise StorageError(
+                f"insert_columns cannot write special event {event!r}"
+            )
+        from predictionio_tpu.data.event import DataMap, Event
+
+        t = event_time or _dt.datetime.now(_dt.timezone.utc)
+        self.write(
+            (
+                Event(
+                    event=event,
+                    entity_type=entity_type,
+                    entity_id=str(e),
+                    target_entity_type=target_entity_type,
+                    target_entity_id=str(g),
+                    properties=DataMap({value_property: float(v)}),
+                    event_time=t,
+                )
+                for e, g, v in zip(entity_ids, target_ids, values)
+            ),
+            app_id,
+            channel_id,
+        )
+        return len(values)
+
+    def insert_columns_encoded(
+        self,
+        app_id: int,
+        channel_id: Optional[int] = None,
+        *,
+        event: str,
+        entity_type: str,
+        target_entity_type: str,
+        entity_names,
+        entity_codes,
+        target_names,
+        target_codes,
+        values,
+        value_property: str = "rating",
+        event_time: Optional[_dt.datetime] = None,
+    ) -> int:
+        """``insert_columns`` with pre-factorized id columns (distinct
+        name dictionaries + int32 codes) — what travels over the storage
+        gateway wire. Backends with a dictionary-encoded page store
+        (sqlite) consume this directly; this generic fallback expands the
+        codes back to id strings."""
+        import numpy as np
+
+        e_names = np.asarray(entity_names, object)
+        g_names = np.asarray(target_names, object)
+        return self.insert_columns(
+            app_id,
+            channel_id,
+            event=event,
+            entity_type=entity_type,
+            target_entity_type=target_entity_type,
+            entity_ids=e_names[np.asarray(entity_codes, np.int64)],
+            target_ids=g_names[np.asarray(target_codes, np.int64)],
+            values=values,
+            value_property=value_property,
+            event_time=event_time,
+        )
+
+    def find_columns_native(
+        self,
+        app_id: int,
+        channel_id: Optional[int] = None,
+        *,
+        value_spec=None,
+        start_time: Optional[_dt.datetime] = None,
+        until_time: Optional[_dt.datetime] = None,
+        entity_type: Optional[str] = None,
+        target_entity_type: OptFilter = UNSET,
+        event_names: Optional[Sequence[str]] = None,
+    ):
+        """Columnar scan: dictionary-encoded (entity, target, value)
+        triples of every target-carrying event matching the filters
+        (``ColumnarEvents``). ``value_spec`` (a ``columnar.ValueSpec``)
+        declares how an event becomes a value, so backends can evaluate
+        it vectorized (SQL / page decode) instead of per event.
+
+        This generic implementation columnarizes ``find()`` results
+        host-side; the sqlite backend overrides it with a binary page
+        scan and the http backend forwards it to the gateway so the wire
+        carries packed columns, not per-event JSON.
+        """
+        from predictionio_tpu.data.storage.columnar import (
+            ValueSpec,
+            from_events,
+        )
+
+        events = list(
+            self.find(
+                app_id=app_id,
+                channel_id=channel_id,
+                start_time=start_time,
+                until_time=until_time,
+                entity_type=entity_type,
+                target_entity_type=target_entity_type,
+                event_names=event_names,
+            )
+        )
+        return from_events(events, value_spec or ValueSpec())
+
 
 # --- metadata records ---
 

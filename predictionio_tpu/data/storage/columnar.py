@@ -1,0 +1,205 @@
+"""Columnar event batches — the TPU-native answer to the reference's
+partitioned event scans.
+
+The reference's production event store is scanned in parallel, columnar
+form: HBase region scans feeding RDD partitions
+(data/src/main/scala/io/prediction/data/storage/hbase/HBPEvents.scala:84-90)
+and the day-partitioned JDBC scan (jdbc/JDBCPEvents.scala:51-129). The
+training path never materializes one JVM object per event — the scan IS
+the columnar substrate.
+
+Here the same role is played by **event pages**: bulk-imported events are
+stored as dictionary-encoded numpy arrays (int32 entity/target codes, a
+small string dictionary, float32 values, int64 ms timestamps) packed into
+binary pages. A 20M-event scan is a handful of ``np.frombuffer`` calls
+plus vectorized code remapping — no per-event Python objects, no JSON
+parsing — and feeds ``jax.device_put`` directly. Per-event REST inserts
+keep landing in the row store; scans merge pages with that residual tail,
+so the two write paths stay transparently consistent.
+
+``ValueSpec`` declares how an event becomes a training value (the
+property to read, its default, and per-event-name constant overrides,
+e.g. the recommendation template's ``buy -> 4.0``), so backends can
+evaluate it vectorized instead of calling back into Python per event.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class ValueSpec:
+    """Declarative per-event training value: ``event_overrides`` wins,
+    else the numeric ``prop`` property, else ``default``."""
+
+    prop: str = "rating"
+    default: float = 1.0
+    event_overrides: Tuple[Tuple[str, float], ...] = ()
+
+    @property
+    def overrides(self) -> Dict[str, float]:
+        return dict(self.event_overrides)
+
+    def value_of(self, event) -> float:
+        """Per-event fallback (generic scan path)."""
+        ov = self.overrides.get(event.event)
+        if ov is not None:
+            return float(ov)
+        return float(event.properties.get_or_else(self.prop, self.default))
+
+
+@dataclasses.dataclass
+class ColumnarEvents:
+    """Dictionary-encoded (entity, target, value) triples.
+
+    ``entity_names[entity_codes[j]]`` is the j-th event's entity id. The
+    name arrays are deduplicated and the codes dense (0..len(names)-1).
+    """
+
+    entity_names: np.ndarray  # [n_entities] str (object dtype)
+    target_names: np.ndarray  # [n_targets] str
+    entity_codes: np.ndarray  # [n] int32
+    target_codes: np.ndarray  # [n] int32
+    values: np.ndarray  # [n] float32
+
+    @property
+    def n(self) -> int:
+        return len(self.values)
+
+    @staticmethod
+    def empty() -> "ColumnarEvents":
+        return ColumnarEvents(
+            entity_names=np.empty(0, object),
+            target_names=np.empty(0, object),
+            entity_codes=np.empty(0, np.int32),
+            target_codes=np.empty(0, np.int32),
+            values=np.empty(0, np.float32),
+        )
+
+    @staticmethod
+    def concat(parts: Sequence["ColumnarEvents"]) -> "ColumnarEvents":
+        """Merge batches, re-encoding codes against a deduplicated name
+        dictionary (vectorized; names are catalog-sized, not event-sized)."""
+        parts = [p for p in parts if p.n or len(p.entity_names)]
+        if not parts:
+            return ColumnarEvents.empty()
+        if len(parts) == 1:
+            return parts[0]
+
+        def merge(names_list, codes_list):
+            all_names = np.concatenate(
+                [np.asarray(n, object) for n in names_list]
+            )
+            uniq, inverse = np.unique(all_names, return_inverse=True)
+            out_codes = []
+            offset = 0
+            for names, codes in zip(names_list, codes_list):
+                lut = inverse[offset : offset + len(names)].astype(np.int32)
+                out_codes.append(lut[codes])
+                offset += len(names)
+            return uniq, np.concatenate(out_codes)
+
+        e_names, e_codes = merge(
+            [p.entity_names for p in parts], [p.entity_codes for p in parts]
+        )
+        t_names, t_codes = merge(
+            [p.target_names for p in parts], [p.target_codes for p in parts]
+        )
+        return ColumnarEvents(
+            entity_names=e_names,
+            target_names=t_names,
+            entity_codes=e_codes,
+            target_codes=t_codes,
+            values=np.concatenate([p.values for p in parts]).astype(
+                np.float32
+            ),
+        )
+
+
+def encode_strings(ids: Sequence[str]) -> Tuple[np.ndarray, np.ndarray]:
+    """Factorize string ids: (names [distinct, sorted], codes int32).
+
+    Fixed-width numpy string arrays stay in their native dtype — their
+    np.unique is a C-speed sort, vs object arrays whose sort compares
+    Python strings one pair at a time (~20x slower at 20M ids)."""
+    arr = np.asarray(ids)
+    if arr.dtype.kind not in ("U", "S"):
+        arr = np.asarray([str(x) for x in ids], dtype="U")
+    names, codes = np.unique(arr, return_inverse=True)
+    return names, codes.astype(np.int32)
+
+
+def array_to_b64(arr: np.ndarray) -> str:
+    """Packed little-endian bytes, base64 — how numeric columns cross the
+    storage-gateway JSON wire (33% overhead vs raw, no per-element JSON)."""
+    import base64
+
+    return base64.b64encode(np.ascontiguousarray(arr).tobytes()).decode()
+
+
+def array_from_b64(s: str, dtype) -> np.ndarray:
+    import base64
+
+    return np.frombuffer(base64.b64decode(s), dtype=dtype)
+
+
+def spec_to_wire(spec: ValueSpec) -> Dict:
+    return {
+        "prop": spec.prop,
+        "default": spec.default,
+        "overrides": [[k, v] for k, v in spec.event_overrides],
+    }
+
+
+def spec_from_wire(w: Optional[Dict]) -> ValueSpec:
+    if not w:
+        return ValueSpec()
+    return ValueSpec(
+        prop=w.get("prop", "rating"),
+        default=float(w.get("default", 1.0)),
+        event_overrides=tuple(
+            (str(k), float(v)) for k, v in (w.get("overrides") or [])
+        ),
+    )
+
+
+def columnar_to_wire(cols: ColumnarEvents) -> Dict:
+    return {
+        "entity_names": [str(n) for n in cols.entity_names],
+        "target_names": [str(n) for n in cols.target_names],
+        "entity_codes": array_to_b64(cols.entity_codes),
+        "target_codes": array_to_b64(cols.target_codes),
+        "values": array_to_b64(cols.values),
+    }
+
+
+def columnar_from_wire(w: Dict) -> ColumnarEvents:
+    e_names = np.empty(len(w["entity_names"]), object)
+    e_names[:] = w["entity_names"]
+    t_names = np.empty(len(w["target_names"]), object)
+    t_names[:] = w["target_names"]
+    return ColumnarEvents(
+        entity_names=e_names,
+        target_names=t_names,
+        entity_codes=array_from_b64(w["entity_codes"], np.int32),
+        target_codes=array_from_b64(w["target_codes"], np.int32),
+        values=array_from_b64(w["values"], np.float32),
+    )
+
+
+def from_events(events: List, spec: ValueSpec) -> ColumnarEvents:
+    """Columnarize in-memory Event objects (the generic fallback and the
+    memory backend's path — per-event Python, fine at in-memory scale)."""
+    kept = [e for e in events if e.target_entity_id is not None]
+    if not kept:
+        return ColumnarEvents.empty()
+    e_names, e_codes = encode_strings([e.entity_id for e in kept])
+    t_names, t_codes = encode_strings([e.target_entity_id for e in kept])
+    values = np.fromiter(
+        (spec.value_of(e) for e in kept), np.float32, count=len(kept)
+    )
+    return ColumnarEvents(e_names, t_names, e_codes, t_codes, values)

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import dataclasses
 import datetime as _dt
+import threading
 from typing import Dict, Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -157,29 +158,65 @@ class PEventStore:
 
     # --- columnar view: events -> device-ready arrays ---
 
+    _NATIVE_FILTERS = frozenset(
+        (
+            "channel_name", "start_time", "until_time", "entity_type",
+            "target_entity_type", "event_names",
+        )
+    )
+
     def find_columns(
         self,
         app_name: str,
         value_of=None,
         entity_index: Optional[BiMap] = None,
         target_index: Optional[BiMap] = None,
+        value_spec=None,
         **find_kwargs,
     ) -> EventColumns:
         """Scan events and columnarize (entityId, targetEntityId, value).
 
-        ``value_of(event) -> float`` extracts the numeric value (default:
-        the ``rating`` property, else 1.0 — the implicit-feedback case).
-        Events without a target entity are skipped. Existing BiMaps may be
-        passed to keep indices aligned across scans (e.g. train vs eval).
+        The value rule is declarative by default (``value_spec``, a
+        ``columnar.ValueSpec`` — property name, default, and per-event
+        constant overrides like the recommendation template's buy->4.0),
+        which lets the backend run its NATIVE columnar scan: binary page
+        decode + SQL-evaluated residual on sqlite, packed columns over
+        the wire on the http backend — no per-event Python objects
+        (reference HBPEvents.scala:84-90's partitioned scan). On that
+        path the returned ``events`` list is empty.
+
+        Passing a ``value_of(event) -> float`` callable (or filters the
+        native scan does not support, e.g. ``entity_id``) falls back to
+        the per-event path, where ``events`` carries the scanned Events.
+        Existing BiMaps may be passed to keep indices aligned across
+        scans (e.g. train vs eval); both paths honor them and index
+        distinct ids in sorted order.
         """
+        from predictionio_tpu.data.storage.columnar import ValueSpec
+
+        if value_of is None and set(find_kwargs) <= self._NATIVE_FILTERS:
+            spec = value_spec or ValueSpec()
+            kwargs = dict(find_kwargs)
+            app_id, channel_id = app_name_to_id(
+                app_name, kwargs.pop("channel_name", None), self.storage
+            )
+            cols = self.storage.get_p_events().find_columns_native(
+                app_id=app_id,
+                channel_id=channel_id,
+                value_spec=spec,
+                **kwargs,
+            )
+            if cols is not None:
+                return self._from_columnar(cols, entity_index, target_index)
+
         events = [
             e
             for e in self.find(app_name, **find_kwargs)
             if e.target_entity_id is not None
         ]
         if value_of is None:
-            def value_of(e: Event) -> float:
-                return float(e.properties.get_or_else("rating", 1.0))
+            spec = value_spec or ValueSpec()
+            value_of = spec.value_of
 
         if entity_index is None:
             entity_index = BiMap.string_int(e.entity_id for e in events)
@@ -208,14 +245,126 @@ class PEventStore:
             events=kept,
         )
 
+    @staticmethod
+    def _from_columnar(
+        cols,
+        entity_index: Optional[BiMap],
+        target_index: Optional[BiMap],
+    ) -> EventColumns:
+        """ColumnarEvents -> EventColumns: build BiMaps from the (sorted)
+        name dictionaries, or remap onto caller-provided BiMaps with a
+        vectorized lookup table, dropping rows with unknown ids."""
+
+        def index_and_map(names, codes, provided: Optional[BiMap]):
+            if provided is None:
+                index = BiMap(
+                    {str(n): j for j, n in enumerate(names)}
+                )
+                return index, codes, None
+            lut = np.array(
+                [provided.get(str(n), -1) for n in names], np.int32
+            )
+            mapped = lut[codes] if len(codes) else codes
+            return provided, mapped, mapped >= 0
+
+        e_index, e_idx, e_ok = index_and_map(
+            cols.entity_names, cols.entity_codes, entity_index
+        )
+        t_index, t_idx, t_ok = index_and_map(
+            cols.target_names, cols.target_codes, target_index
+        )
+        values = cols.values
+        if e_ok is not None or t_ok is not None:
+            keep = np.ones(len(values), bool)
+            if e_ok is not None:
+                keep &= e_ok
+            if t_ok is not None:
+                keep &= t_ok
+            e_idx, t_idx, values = e_idx[keep], t_idx[keep], values[keep]
+        return EventColumns(
+            entity_index=e_index,
+            target_index=t_index,
+            entity_idx=e_idx.astype(np.int32),
+            target_idx=t_idx.astype(np.int32),
+            values=values.astype(np.float32),
+            events=[],
+        )
+
+
+class _DaemonLookupPool:
+    """Bounded pool of DAEMON worker threads for deadline-enforced
+    serving lookups. A timed-out lookup's worker keeps running until the
+    backend returns — with a fully stuck backend up to max_workers
+    threads wedge and later lookups spend their deadline in the queue,
+    still raising TimeoutError on schedule (the reference's Await.result
+    behaves the same way: the HBase client call keeps running after the
+    TimeoutException, LEventStore.scala:146-230). Daemon threads matter:
+    concurrent.futures' workers are non-daemon and joined at interpreter
+    exit, so one truly-stuck backend call would hang process shutdown
+    forever."""
+
+    def __init__(self, max_workers: int = 8):
+        import queue
+
+        self._q: "queue.Queue" = queue.Queue()
+        self._lock = threading.Lock()
+        self._spawned = 0
+        self._max = max_workers
+
+    def _worker(self) -> None:
+        while True:
+            fn, box, done = self._q.get()
+            try:
+                box["result"] = fn()
+            except BaseException as e:  # delivered to the caller
+                box["error"] = e
+            done.set()
+
+    def submit(self, fn):
+        with self._lock:
+            if self._spawned < self._max:
+                self._spawned += 1
+                threading.Thread(
+                    target=self._worker,
+                    daemon=True,
+                    name=f"levents-{self._spawned}",
+                ).start()
+        box: dict = {}
+        done = threading.Event()
+        self._q.put((fn, box, done))
+        return box, done
+
+
+_LOOKUP_POOL = _DaemonLookupPool(max_workers=8)
+
+
+def _with_deadline(fn, timeout_seconds: Optional[float]):
+    """Run ``fn`` under a wall-clock deadline; raises TimeoutError.
+    ``timeout_seconds`` of None/0/negative means no deadline (inline)."""
+    if not timeout_seconds or timeout_seconds <= 0:
+        return fn()
+    box, done = _LOOKUP_POOL.submit(fn)
+    if not done.wait(timeout_seconds):
+        raise TimeoutError(
+            f"LEventStore lookup exceeded {timeout_seconds}s; a slow "
+            "backend must not stall the serving hot path"
+        )
+    if "error" in box:
+        raise box["error"]
+    return box["result"]
+
 
 class LEventStore:
     """Serving-time entity reads (reference LEventStore.scala:146-230).
 
-    The reference enforces a wall-clock timeout on these lookups because a
-    slow HBase read stalls the serving hot path; the embedded backends here
-    are local and fast, so the timeout parameter is accepted for parity and
-    currently unenforced.
+    The wall-clock ``timeout_seconds`` is ENFORCED (round 4): with the
+    ``http`` storage backend in the loop a slow gateway can stall the
+    serving hot path, exactly the failure the reference's
+    Await.result(timeout) guards against. The lookup materializes on a
+    worker thread and raises ``TimeoutError`` past the deadline; serving
+    engines catch it and degrade (e.g. ecommerce's rule reads fall back
+    to empty sets). Pass ``timeout_seconds=None`` (or <= 0) to run
+    inline without a deadline.
     """
 
     def __init__(self, storage: Optional[Storage] = None):
@@ -238,31 +387,47 @@ class LEventStore:
         until_time: Optional[_dt.datetime] = None,
         limit: Optional[int] = None,
         latest: bool = True,
-        timeout_seconds: float = 10.0,
+        timeout_seconds: Optional[float] = 10.0,
     ) -> Iterator[Event]:
-        app_id, channel_id = app_name_to_id(app_name, channel_name, self.storage)
-        return self.storage.get_l_events().find(
-            app_id=app_id,
-            channel_id=channel_id,
-            start_time=start_time,
-            until_time=until_time,
-            entity_type=entity_type,
-            entity_id=entity_id,
-            event_names=event_names,
-            target_entity_type=target_entity_type,
-            target_entity_id=target_entity_id,
-            limit=limit,
-            reversed=latest,
-        )
+        def lookup() -> List[Event]:
+            app_id, channel_id = app_name_to_id(
+                app_name, channel_name, self.storage
+            )
+            # materialize inside the deadline: the backend may hand back
+            # a lazy iterator whose cost lands on first next()
+            return list(
+                self.storage.get_l_events().find(
+                    app_id=app_id,
+                    channel_id=channel_id,
+                    start_time=start_time,
+                    until_time=until_time,
+                    entity_type=entity_type,
+                    entity_id=entity_id,
+                    event_names=event_names,
+                    target_entity_type=target_entity_type,
+                    target_entity_id=target_entity_id,
+                    limit=limit,
+                    reversed=latest,
+                )
+            )
+
+        return iter(_with_deadline(lookup, timeout_seconds))
 
     def find(
         self,
         app_name: str,
         channel_name: Optional[str] = None,
-        timeout_seconds: float = 10.0,
+        timeout_seconds: Optional[float] = 10.0,
         **find_kwargs,
     ) -> Iterator[Event]:
-        app_id, channel_id = app_name_to_id(app_name, channel_name, self.storage)
-        return self.storage.get_l_events().find(
-            app_id=app_id, channel_id=channel_id, **find_kwargs
-        )
+        def lookup() -> List[Event]:
+            app_id, channel_id = app_name_to_id(
+                app_name, channel_name, self.storage
+            )
+            return list(
+                self.storage.get_l_events().find(
+                    app_id=app_id, channel_id=channel_id, **find_kwargs
+                )
+            )
+
+        return iter(_with_deadline(lookup, timeout_seconds))

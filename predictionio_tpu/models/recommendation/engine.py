@@ -116,15 +116,23 @@ class DataSourceParams(Params):
     seed: int = 3
 
 
+from predictionio_tpu.data.storage.columnar import ValueSpec
+
+# The template's event->rating mapping, declaratively: explicit 'rate'
+# events carry a rating property; 'buy' events become rating 4.0
+# (reference DataSource.scala implicit mapping). Declarative so the
+# store's NATIVE columnar scan evaluates it vectorized (binary pages /
+# SQL) instead of calling Python per event. Shared with the
+# sliding-window evaluator (models/experimental/movielens_evaluation.py)
+# so both always score the same rating scheme.
+RATING_SPEC = ValueSpec(
+    prop="rating", default=1.0, event_overrides=(("buy", 4.0),)
+)
+
+
 def rating_of_event(e) -> float:
-    """The template's event->rating mapping: explicit 'rate' events carry a
-    rating property; 'buy' events become rating 4.0 (reference
-    DataSource.scala implicit mapping). Shared with the sliding-window
-    evaluator (models/experimental/movielens_evaluation.py) so both always
-    score the same rating scheme."""
-    if e.event == "buy":
-        return 4.0
-    return float(e.properties.get_or_else("rating", 1.0))
+    """Per-event form of RATING_SPEC (callers that hold Event objects)."""
+    return RATING_SPEC.value_of(e)
 
 
 class DataSource(BaseDataSource):
@@ -138,7 +146,7 @@ class DataSource(BaseDataSource):
         store = PEventStore(ctx.storage)
         return store.find_columns(
             self.params.app_name,
-            value_of=rating_of_event,
+            value_spec=RATING_SPEC,
             channel_name=self.params.channel_name,
             entity_type="user",
             target_entity_type="item",

@@ -1,0 +1,397 @@
+"""Columnar event store tests: the binary page path (sqlite), the packed
+wire path (gateway), and PEventStore's native-scan integration — the TPU
+build's answer to the reference's partitioned columnar scans
+(hbase/HBPEvents.scala:84-90, jdbc/JDBCPEvents.scala:51-129)."""
+
+import datetime as dt
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.data.event import DataMap, Event
+from predictionio_tpu.data.storage import memory_storage
+from predictionio_tpu.data.storage.base import App, StorageError
+from tests.test_storage import sqlite_storage
+from predictionio_tpu.data.storage.columnar import (
+    ColumnarEvents,
+    ValueSpec,
+    columnar_from_wire,
+    columnar_to_wire,
+    spec_from_wire,
+    spec_to_wire,
+)
+
+
+def _triples(cols: ColumnarEvents):
+    """Order-independent multiset view {(entity, target): sorted values}."""
+    out = {}
+    for e, g, v in zip(
+        cols.entity_names[cols.entity_codes],
+        cols.target_names[cols.target_codes],
+        cols.values,
+    ):
+        out.setdefault((str(e), str(g)), []).append(round(float(v), 4))
+    return {k: sorted(v) for k, v in out.items()}
+
+
+def _bulk(n=500, seed=0):
+    rng = np.random.default_rng(seed)
+    users = [f"u{x}" for x in rng.integers(0, 40, n)]
+    items = [f"i{x}" for x in rng.integers(0, 25, n)]
+    vals = (rng.integers(1, 11, n) * 0.5).astype(np.float32)
+    return users, items, vals
+
+
+@pytest.fixture
+def sq(tmp_path):
+    s = sqlite_storage(tmp_path)
+    s.get_meta_data_apps().insert(App(id=0, name="app"))
+    le = s.get_l_events()
+    le.init(1)
+    return s, le
+
+
+class TestSqlitePages:
+    def test_bulk_import_and_native_scan_roundtrip(self, sq):
+        _, le = sq
+        users, items, vals = _bulk()
+        wrote = le.insert_columns(
+            1, event="rate", entity_type="user", target_entity_type="item",
+            entity_ids=users, target_ids=items, values=vals,
+        )
+        assert wrote == len(vals)
+        cols = le.find_columns_native(1, value_spec=ValueSpec())
+        assert cols.n == len(vals)
+        expect = {}
+        for u, i, v in zip(users, items, vals):
+            expect.setdefault((u, i), []).append(round(float(v), 4))
+        assert _triples(cols) == {k: sorted(v) for k, v in expect.items()}
+
+    def test_matches_generic_scan(self, sq):
+        """The page scan must agree with the per-event generic scan over
+        the SAME mixed data (pages + row-store events)."""
+        from predictionio_tpu.data.storage.columnar import from_events
+
+        _, le = sq
+        users, items, vals = _bulk(200)
+        le.insert_columns(
+            1, event="rate", entity_type="user", target_entity_type="item",
+            entity_ids=users, target_ids=items, values=vals,
+        )
+        # a REST-posted residual tail, one of them a buy (override case)
+        for j, (ev, val) in enumerate([("rate", 2.5), ("buy", 99.0)]):
+            le.insert(
+                Event(
+                    event=ev, entity_type="user", entity_id=f"u{j}",
+                    target_entity_type="item", target_entity_id="i0",
+                    properties=DataMap({"rating": val}),
+                ),
+                1,
+            )
+        spec = ValueSpec(event_overrides=(("buy", 4.0),))
+        native = le.find_columns_native(1, value_spec=spec)
+        generic = from_events(list(le.find(app_id=1)), spec)
+        assert native.n == generic.n == len(vals) + 2
+        assert _triples(native) == _triples(generic)
+        # the buy override applied (not the stored 99.0)
+        assert 4.0 in _triples(native)[("u1", "i0")]
+
+    def test_filters_pushed_to_pages(self, sq):
+        _, le = sq
+        t0 = dt.datetime(2020, 1, 1, tzinfo=dt.timezone.utc)
+        t1 = dt.datetime(2021, 1, 1, tzinfo=dt.timezone.utc)
+        le.insert_columns(
+            1, event="rate", entity_type="user", target_entity_type="item",
+            entity_ids=["a"], target_ids=["x"], values=[1.0], event_time=t0,
+        )
+        le.insert_columns(
+            1, event="view", entity_type="user", target_entity_type="item",
+            entity_ids=["b"], target_ids=["y"], values=[2.0], event_time=t1,
+        )
+        by_name = le.find_columns_native(1, event_names=["view"])
+        assert _triples(by_name) == {("b", "y"): [2.0]}
+        by_time = le.find_columns_native(
+            1, until_time=dt.datetime(2020, 6, 1, tzinfo=dt.timezone.utc)
+        )
+        assert _triples(by_time) == {("a", "x"): [1.0]}
+        none = le.find_columns_native(1, event_names=[])
+        assert none.n == 0
+
+    def test_find_merges_page_events(self, sq):
+        """The legacy find() view stays complete: bulk-imported events
+        decode into Event objects alongside row-store events."""
+        _, le = sq
+        le.insert_columns(
+            1, event="rate", entity_type="user", target_entity_type="item",
+            entity_ids=["pa", "pb"], target_ids=["x", "y"],
+            values=[3.0, 4.5],
+        )
+        le.insert(
+            Event(
+                event="rate", entity_type="user", entity_id="rc",
+                target_entity_type="item", target_entity_id="z",
+                properties=DataMap({"rating": 5.0}),
+            ),
+            1,
+        )
+        evs = list(le.find(app_id=1))
+        assert {e.entity_id for e in evs} == {"pa", "pb", "rc"}
+        pa = next(e for e in evs if e.entity_id == "pa")
+        assert pa.properties["rating"] == 3.0
+        assert pa.target_entity_id == "x"
+        # entity_id filter reaches into pages
+        only = list(le.find(app_id=1, entity_id="pb"))
+        assert len(only) == 1 and only[0].properties["rating"] == 4.5
+
+    def test_get_and_delete_page_events(self, sq):
+        _, le = sq
+        le.insert_columns(
+            1, event="rate", entity_type="user", target_entity_type="item",
+            entity_ids=["a", "b", "c"], target_ids=["x", "y", "z"],
+            values=[1.0, 2.0, 3.0],
+        )
+        evs = list(le.find(app_id=1))
+        target = next(e for e in evs if e.entity_id == "b")
+        assert target.event_id.startswith("pg-")
+        got = le.get(target.event_id, 1)
+        assert got is not None and got.entity_id == "b"
+        assert le.delete(target.event_id, 1)
+        left = list(le.find(app_id=1))
+        assert {e.entity_id for e in left} == {"a", "c"}
+        cols = le.find_columns_native(1)
+        assert cols.n == 2
+
+    def test_page_ids_stable_after_delete(self, sq):
+        """Deletes tombstone rather than compact: the surviving rows'
+        positional ids must keep addressing the SAME events (a
+        compaction would shift pg-1-2 into pg-1-1's slot and a second
+        delete would remove the wrong event)."""
+        _, le = sq
+        le.insert_columns(
+            1, event="rate", entity_type="user", target_entity_type="item",
+            entity_ids=["a", "b", "c"], target_ids=["x", "y", "z"],
+            values=[1.0, 2.0, 3.0],
+        )
+        ids = {e.entity_id: e.event_id for e in le.find(app_id=1)}
+        assert le.delete(ids["a"], 1)
+        # b and c still resolve by their ORIGINAL ids
+        assert le.get(ids["b"], 1).entity_id == "b"
+        assert le.get(ids["c"], 1).entity_id == "c"
+        # deleting a again is a no-op; its id does not alias another row
+        assert not le.delete(ids["a"], 1)
+        assert le.get(ids["a"], 1) is None
+        assert le.delete(ids["c"], 1)
+        assert {e.entity_id for e in le.find(app_id=1)} == {"b"}
+        assert le.find_columns_native(1).n == 1
+        # deleting the last live row drops the page entirely
+        assert le.delete(ids["b"], 1)
+        assert le.find_columns_native(1).n == 0
+
+    def test_find_by_entity_filter_uses_dict_codes(self, sq):
+        """entity_id filters over pages match via int dict codes (the
+        serving path must stay vectorized); unknown ids match nothing."""
+        _, le = sq
+        le.insert_columns(
+            1, event="rate", entity_type="user", target_entity_type="item",
+            entity_ids=["u1", "u2", "u1"], target_ids=["x", "y", "z"],
+            values=[1.0, 2.0, 3.0],
+        )
+        got = list(le.find(app_id=1, entity_id="u1"))
+        assert {e.target_entity_id for e in got} == {"x", "z"}
+        assert list(le.find(app_id=1, entity_id="nope")) == []
+        got = list(le.find(app_id=1, target_entity_id="y"))
+        assert len(got) == 1 and got[0].entity_id == "u2"
+
+    def test_special_events_rejected(self, sq):
+        _, le = sq
+        with pytest.raises(StorageError, match="special event"):
+            le.insert_columns(
+                1, event="$set", entity_type="user",
+                target_entity_type="item", entity_ids=["a"],
+                target_ids=["x"], values=[1.0],
+            )
+
+    def test_remove_drops_page_tables(self, sq):
+        _, le = sq
+        le.insert_columns(
+            1, event="rate", entity_type="user", target_entity_type="item",
+            entity_ids=["a"], target_ids=["x"], values=[1.0],
+        )
+        assert le.remove(1)
+        le.init(1)
+        assert le.find_columns_native(1).n == 0
+
+
+class TestWire:
+    def test_columnar_wire_roundtrip(self):
+        users, items, vals = _bulk(50)
+        from predictionio_tpu.data.storage.columnar import from_events
+
+        evs = [
+            Event(
+                event="rate", entity_type="user", entity_id=u,
+                target_entity_type="item", target_entity_id=i,
+                properties=DataMap({"rating": float(v)}),
+            )
+            for u, i, v in zip(users, items, vals)
+        ]
+        cols = from_events(evs, ValueSpec())
+        back = columnar_from_wire(columnar_to_wire(cols))
+        assert _triples(back) == _triples(cols)
+
+    def test_spec_wire_roundtrip(self):
+        spec = ValueSpec(
+            prop="count", default=2.0, event_overrides=(("buy", 4.0),)
+        )
+        assert spec_from_wire(spec_to_wire(spec)) == spec
+        assert spec_from_wire(None) == ValueSpec()
+
+
+class TestGatewayColumnar:
+    @pytest.fixture
+    def via_gateway(self, tmp_path):
+        from predictionio_tpu.api.storage_gateway import StorageGatewayServer
+        from predictionio_tpu.data.storage import Storage
+
+        backing = sqlite_storage(tmp_path)
+        backing.get_meta_data_apps().insert(App(id=0, name="app"))
+        backing.get_l_events().init(1)
+        server = StorageGatewayServer(backing, port=0).start()
+        client = Storage(
+            {
+                "PIO_STORAGE_SOURCES_GW_TYPE": "http",
+                "PIO_STORAGE_SOURCES_GW_URL": f"http://localhost:{server.port}",
+                "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "GW",
+                "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "GW",
+                "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "GW",
+            }
+        )
+        try:
+            yield backing, client
+        finally:
+            server.shutdown()
+
+    def test_bulk_import_and_scan_through_gateway(self, via_gateway):
+        backing, client = via_gateway
+        users, items, vals = _bulk(300, seed=5)
+        le = client.get_l_events()
+        wrote = le.insert_columns(
+            1, event="rate", entity_type="user", target_entity_type="item",
+            entity_ids=users, target_ids=items, values=vals,
+        )
+        assert wrote == 300
+        # landed as PAGES in the backing store (not 300 row inserts)
+        direct = backing.get_l_events().find_columns_native(1)
+        assert direct.n == 300
+        # and scans back through the packed wire identically
+        via = le.find_columns_native(1, value_spec=ValueSpec())
+        assert _triples(via) == _triples(direct)
+
+    def test_pevent_store_native_through_gateway(self, via_gateway):
+        _, client = via_gateway
+        from predictionio_tpu.data.store import PEventStore
+
+        users, items, vals = _bulk(100, seed=7)
+        client.get_l_events().insert_columns(
+            1, event="rate", entity_type="user", target_entity_type="item",
+            entity_ids=users, target_ids=items, values=vals,
+        )
+        cols = PEventStore(client).find_columns("app")
+        assert cols.n == 100
+        assert cols.events == []  # columnar path carries no Event objects
+        # indices agree with the BiMaps
+        for j in range(0, 100, 17):
+            assert cols.entity_index.inverse()[int(cols.entity_idx[j])] == users[j]
+            assert cols.target_index.inverse()[int(cols.target_idx[j])] == items[j]
+
+
+class TestPEventStoreNative:
+    def test_native_path_used_for_sqlite(self, tmp_path, monkeypatch):
+        from predictionio_tpu.data.store import PEventStore
+        from predictionio_tpu.data.storage import sqlite as sqlite_mod
+
+        s = sqlite_storage(tmp_path)
+        s.get_meta_data_apps().insert(App(id=0, name="app"))
+        le = s.get_l_events()
+        le.init(1)
+        users, items, vals = _bulk(120, seed=3)
+        le.insert_columns(
+            1, event="rate", entity_type="user", target_entity_type="item",
+            entity_ids=users, target_ids=items, values=vals,
+        )
+        calls = []
+        orig = sqlite_mod.SQLiteLEvents.find_columns_native
+
+        def spy(self, *a, **kw):
+            calls.append(1)
+            return orig(self, *a, **kw)
+
+        monkeypatch.setattr(sqlite_mod.SQLiteLEvents, "find_columns_native", spy)
+        cols = PEventStore(s).find_columns("app")
+        assert calls, "sqlite native columnar scan was not used"
+        assert cols.n == 120
+
+    def test_value_of_callable_falls_back(self, tmp_path):
+        from predictionio_tpu.data.store import PEventStore
+
+        s = sqlite_storage(tmp_path)
+        s.get_meta_data_apps().insert(App(id=0, name="app"))
+        le = s.get_l_events()
+        le.init(1)
+        le.insert_columns(
+            1, event="rate", entity_type="user", target_entity_type="item",
+            entity_ids=["a"], target_ids=["x"], values=[2.0],
+        )
+        cols = PEventStore(s).find_columns(
+            "app", value_of=lambda e: 7.0
+        )
+        assert cols.n == 1 and cols.values[0] == 7.0
+        assert len(cols.events) == 1  # generic path carries Events
+
+    def test_provided_bimaps_respected(self, tmp_path):
+        from predictionio_tpu.data.bimap import BiMap
+        from predictionio_tpu.data.store import PEventStore
+
+        s = sqlite_storage(tmp_path)
+        s.get_meta_data_apps().insert(App(id=0, name="app"))
+        le = s.get_l_events()
+        le.init(1)
+        le.insert_columns(
+            1, event="rate", entity_type="user", target_entity_type="item",
+            entity_ids=["a", "b", "zz"], target_ids=["x", "y", "y"],
+            values=[1.0, 2.0, 3.0],
+        )
+        # 'zz' is unknown to the provided map -> its row drops
+        e_index = BiMap({"a": 5, "b": 9})
+        cols = PEventStore(s).find_columns("app", entity_index=e_index)
+        assert cols.n == 2
+        assert set(cols.entity_idx.tolist()) == {5, 9}
+        assert cols.entity_index is e_index
+
+    def test_memory_backend_generic_default(self, ):
+        """The memory backend uses the trait's generic find()-based
+        columnarization — same results, no pages."""
+        from predictionio_tpu.data.store import PEventStore
+
+        s = memory_storage()
+        s.get_meta_data_apps().insert(App(id=0, name="app"))
+        le = s.get_l_events()
+        le.init(1)
+        le.insert_columns(
+            1, event="rate", entity_type="user", target_entity_type="item",
+            entity_ids=["a", "b"], target_ids=["x", "y"], values=[1.0, 2.5],
+        )
+        cols = PEventStore(s).find_columns("app")
+        assert cols.n == 2
+        assert _triples_ec(cols) == {("a", "x"): [1.0], ("b", "y"): [2.5]}
+
+
+def _triples_ec(cols):
+    inv_e = cols.entity_index.inverse()
+    inv_t = cols.target_index.inverse()
+    out = {}
+    for e, g, v in zip(cols.entity_idx, cols.target_idx, cols.values):
+        out.setdefault((inv_e[int(e)], inv_t[int(g)]), []).append(
+            round(float(v), 4)
+        )
+    return {k: sorted(v) for k, v in out.items()}
