@@ -518,7 +518,9 @@ def _run_iterations(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("implicit", "compute_dtype"),
+    static_argnames=(
+        "implicit", "compute_dtype", "rep_sharding", "row_sharding",
+    ),
     donate_argnums=(0, 1),
 )
 def _run_iterations_grid(
@@ -535,34 +537,71 @@ def _run_iterations_grid(
     *,
     implicit: bool,
     compute_dtype: str,
+    rep_sharding=None,  # NamedSharding(P(None, None, None)) or None
+    row_sharding=None,  # NamedSharding(P(None, axis, None)) or None
 ) -> Tuple[jax.Array, jax.Array]:
     """The reg-grid training loop as ONE vmapped XLA program: V variants
     that share data/rank/iterations and differ only in the regularizer
     train together, so one dispatch covers the whole grid axis and the
     per-variant einsums batch onto the MXU instead of running as V
     serial programs (the reference's grid is host-thread `.par`,
-    MetricEvaluator.scala:221-230 — there is no device-side analog)."""
+    MetricEvaluator.scala:221-230 — there is no device-side analog).
 
-    def single(X1, Y1, ul, il):
-        k = X1.shape[-1]
-        zeros_g = jnp.zeros((k, k), jnp.float32)
+    On a mesh, rows/segments shard over the mesh axis exactly as the
+    single-variant program does (the variant axis is unsharded — every
+    device trains all variants over its row shard); there the fori loop
+    sits OUTSIDE the vmap so the replicate/row-shard constraints apply
+    to the whole [V, R, k] batch each half-iteration. Single-device
+    grids keep the r3 vmap-outside structure, which tracks serial
+    train_als runs most closely — equivalence is float-level (~1e-5
+    factor noise from differing XLA fusion), not bit-exact, the same
+    nondeterminism class as the reference's `.par` thread-pool grid."""
 
-        def half(Xs, Ys, pack, lam, has_obs):
-            G = _gramian(Ys) if implicit else zeros_g
-            return _solve_side(
-                Xs, Ys, G, pack, lam, has_obs, alpha,
+    if rep_sharding is None and row_sharding is None:
+
+        def single(X1, Y1, ul, il):
+            k = X1.shape[-1]
+            zeros_g = jnp.zeros((k, k), jnp.float32)
+
+            def half1(Xs, Ys, pack, lam, has_obs):
+                G = _gramian(Ys) if implicit else zeros_g
+                return _solve_side(
+                    Xs, Ys, G, pack, lam, has_obs, alpha,
+                    implicit=implicit, compute_dtype=compute_dtype,
+                )
+
+            def body1(_, carry):
+                Xc, Yc = carry
+                Xc = half1(Xc, Yc, user_pack, ul, user_has_obs)
+                Yc = half1(Yc, Xc, item_pack, il, item_has_obs)
+                return (Xc, Yc)
+
+            return jax.lax.fori_loop(0, n_iters, body1, (X1, Y1))
+
+        return jax.vmap(single)(X, Y, user_lam, item_lam)
+
+    def half(X, Y, pack, lam, has_obs):
+        if implicit:
+            G = jax.vmap(_gramian)(Y)
+        else:
+            k = X.shape[-1]
+            G = jnp.zeros((X.shape[0], k, k), jnp.float32)
+        Y_rep = _constrain(Y, rep_sharding)
+        X = jax.vmap(
+            lambda Xv, Yv, Gv, lamv: _solve_side(
+                Xv, Yv, Gv, pack, lamv, has_obs, alpha,
                 implicit=implicit, compute_dtype=compute_dtype,
             )
+        )(X, Y_rep, G, lam)
+        return _constrain(X, row_sharding)
 
-        def body(_, carry):
-            Xc, Yc = carry
-            Xc = half(Xc, Yc, user_pack, ul, user_has_obs)
-            Yc = half(Yc, Xc, item_pack, il, item_has_obs)
-            return (Xc, Yc)
+    def body(_, carry):
+        Xc, Yc = carry
+        Xc = half(Xc, Yc, user_pack, user_lam, user_has_obs)
+        Yc = half(Yc, Xc, item_pack, item_lam, item_has_obs)
+        return (Xc, Yc)
 
-        return jax.lax.fori_loop(0, n_iters, body, (X1, Y1))
-
-    return jax.vmap(single)(X, Y, user_lam, item_lam)
+    return jax.lax.fori_loop(0, n_iters, body, (X, Y))
 
 
 def train_als_grid(
@@ -581,48 +620,42 @@ def train_als_grid(
     shared: data is packed once, initial factors are identical, and the
     iteration loop is vmapped over the reg axis).
 
-    Returns one ALSModelArrays per reg, in order — numerically identical
-    to ``train_als`` with ``config.reg = regs[i]`` run one at a time.
-    With a multi-device mesh the batched axis would need per-variant
-    sharding specs; the grid path is an eval-time optimization for
-    single-chip tuning runs, so it falls back to serial sharded training
-    there. A one-device mesh (the default workflow context) uses the
-    grid path — there is nothing to shard.
+    Returns one ALSModelArrays per reg, in order — numerically matching
+    ``train_als`` with ``config.reg = regs[i]`` run one at a time. On a
+    multi-device mesh (round-4 upgrade; rounds 1-3 fell back to serial
+    per-variant training there) rows/segments shard over ``axis`` with
+    the variant axis unsharded, so the whole grid still runs as ONE
+    device program with the same collective pattern as train_als.
     """
     if mesh is not None and mesh.size == 1:
         mesh = None
-    if mesh is not None:
-        return [
-            train_als(
-                user_idx, item_idx, ratings, n_users, n_items,
-                dataclasses.replace(config, reg=float(r)),
-                mesh=mesh, axis=axis,
-            )
-            for r in regs
-        ]
     k = config.rank
     n_variants = len(regs)
     if n_variants == 0:
         return []
+    n_shards = mesh.shape[axis] if mesh is not None else 1
 
     user_side = pack_segments(
         user_idx, item_idx, ratings, n_users,
         auto_segment_length(user_idx, n_users, config.segment_length),
-        1, config.chunk_slots,
+        n_shards, config.chunk_slots,
     )
     item_side = pack_segments(
         item_idx, user_idx, ratings, n_items,
         auto_segment_length(item_idx, n_items, config.segment_length),
-        1, config.chunk_slots,
+        n_shards, config.chunk_slots,
     )
     logger.info(
         "ALS grid: %d reg variants x (%d users, %d items, %d ratings, "
-        "rank %d) in one vmapped program",
+        "rank %d) in one vmapped program%s",
         n_variants, n_users, n_items, len(ratings), k,
+        f" over a {n_shards}-way mesh" if mesh is not None else "",
     )
 
     rng = np.random.default_rng(config.seed)
-    r_u, r_i = n_users + 1, n_items + 1  # +1 sentinel row
+    # +1 sentinel row, padded so the row dim shards evenly over the mesh
+    r_u = pad_to_multiple(n_users + 1, n_shards)
+    r_i = pad_to_multiple(n_items + 1, n_shards)
     Y0 = np.zeros((r_i, k), np.float32)
     Y0[:n_items] = np.abs(rng.standard_normal((n_items, k))) / math.sqrt(k)
 
@@ -642,23 +675,39 @@ def train_als_grid(
         counts[: side.n_rows] = side.counts
         return counts > 0
 
+    vrow = P(None, axis, None) if mesh is not None else P()
+    vlam = P(None, axis) if mesh is not None else P()
+    seg2 = P(None, axis) if mesh is not None else P()
+    seg3 = P(None, axis, None) if mesh is not None else P()
+    row1 = P(axis) if mesh is not None else P()
     pack = lambda side: (
-        jnp.asarray(side.seg_rows), jnp.asarray(side.cols),
-        jnp.asarray(side.vals), jnp.asarray(side.rem),
+        _place(mesh, side.seg_rows, seg2),
+        _place(mesh, side.cols, seg3),
+        _place(mesh, side.vals, seg3),
+        _place(mesh, side.rem, seg2),
     )
-    X = jnp.zeros((n_variants, r_u, k), jnp.float32)
-    Y = jnp.broadcast_to(jnp.asarray(Y0), (n_variants, r_i, k)) + 0.0
+    X = _place(mesh, np.zeros((n_variants, r_u, k), np.float32), vrow)
+    Y = _place(
+        mesh, np.broadcast_to(Y0, (n_variants, r_i, k)).copy(), vrow
+    )
     X, Y = _run_iterations_grid(
         X, Y, pack(user_side), pack(item_side),
-        jnp.asarray(lam_grid(user_side, r_u)),
-        jnp.asarray(lam_grid(item_side, r_i)),
-        jnp.asarray(obs(user_side, r_u)),
-        jnp.asarray(obs(item_side, r_i)),
+        _place(mesh, lam_grid(user_side, r_u), vlam),
+        _place(mesh, lam_grid(item_side, r_i), vlam),
+        _place(mesh, obs(user_side, r_u), row1),
+        _place(mesh, obs(item_side, r_i), row1),
         config.alpha, jnp.int32(config.iterations),
         implicit=config.implicit_prefs,
         compute_dtype=config.compute_dtype,
+        rep_sharding=(
+            NamedSharding(mesh, P(None, None, None))
+            if mesh is not None else None
+        ),
+        row_sharding=(
+            NamedSharding(mesh, vrow) if mesh is not None else None
+        ),
     )
-    X_host, Y_host = np.asarray(X), np.asarray(Y)
+    X_host, Y_host = _fetch_global(X), _fetch_global(Y)
     return [
         ALSModelArrays(X_host[v, :n_users], Y_host[v, :n_items])
         for v in range(n_variants)
@@ -690,6 +739,23 @@ def auto_segment_length(
     while L < cap and L < mean:
         L *= 2
     return L
+
+
+def _fence(tree) -> None:
+    """Wait for the computation producing ``tree`` WITHOUT fetching it:
+    device_get of a 1-element slice of each leaf. The slice executes
+    after its producer, and fetching its single element round-trips real
+    data (so the relayed-backend early-return caveat of
+    block_until_ready does not apply) while moving 4 bytes instead of
+    the array — fetching the ML-20M factor matrices (21 MB) through a
+    ~15 MB/s relay would otherwise bill ~1.5 s of link time to the
+    device-loop phase. Costs one tiny cached executable per leaf shape;
+    multi-process-sharded leaves fall back to block_until_ready."""
+    for a in jax.tree_util.tree_leaves(tree):
+        if getattr(a, "is_fully_addressable", True):
+            jax.device_get(jnp.ravel(a)[:1])
+        else:
+            jax.block_until_ready(a)
 
 
 def _sync_fetch(tree) -> None:
@@ -935,7 +1001,7 @@ def train_als(
         # Donation consumes its inputs, so feed it copies of the factor
         # arrays (cheap HBM-side copies).
         t_phase = _time.perf_counter()
-        _sync_fetch(run_iters(X + 0, Y + 0, 0))
+        _fence(run_iters(X + 0, Y + 0, 0))
         timings["compile_s"] = _time.perf_counter() - t_phase
 
     from predictionio_tpu.workflow.checkpoint import StepCheckpointer
@@ -990,7 +1056,7 @@ def train_als(
                 t_phase = _time.perf_counter()
                 X, Y = run_iters(X, Y, config.iterations - start_it)
                 if timings is not None:
-                    _sync_fetch((X, Y))
+                    _fence((X, Y))
                     timings["device_loop_s"] = _time.perf_counter() - t_phase
         else:
             # chunk the fused loop at the checkpoint cadence
@@ -1000,7 +1066,7 @@ def train_als(
                 t_phase = _time.perf_counter()
                 X, Y = run_iters(X, Y, chunk)
                 if timings is not None:
-                    _sync_fetch((X, Y))
+                    _fence((X, Y))
                     timings["device_loop_s"] = timings.get(
                         "device_loop_s", 0.0
                     ) + (_time.perf_counter() - t_phase)

@@ -378,18 +378,43 @@ class TestGridALS:
         assert len(out) == 2
         assert spy.call_count == 1  # one batched program, not serial falls
 
-    def test_multi_device_mesh_falls_back_serially(self):
-        from predictionio_tpu.ops.als import train_als_grid
+    def test_multi_device_mesh_trains_grid_in_one_program(self):
+        """VERDICT r3 #6: 4 reg variants on an 8-device mesh train in ONE
+        vmapped program (rounds 1-3 fell back to serial per-variant
+        training there), numerically equal to serial single-device."""
+        import dataclasses
+        from unittest import mock
+
+        from predictionio_tpu.ops.als import (
+            _run_iterations_grid,
+            train_als_grid,
+        )
         from predictionio_tpu.parallel import make_mesh
 
         import jax
 
-        if len(jax.devices()) < 2:
-            pytest.skip("needs the virtual multi-device CPU platform")
-        mesh = make_mesh({"data": 2}, jax.devices()[:2])
-        u, i, r = synthetic()
-        cfg = ALSConfig(rank=4, iterations=2)
-        out = train_als_grid(u, i, r, 60, 40, cfg, [0.01, 0.1], mesh=mesh)
-        assert len(out) == 2
-        for m in out:
-            assert np.isfinite(m.user_factors).all()
+        if len(jax.devices()) < 8:
+            pytest.skip("needs the virtual 8-device CPU platform")
+        mesh = make_mesh({"data": 8}, jax.devices()[:8])
+        u, i, r = synthetic(noise=0.1)
+        regs = [0.01, 0.05, 0.1, 1.0]
+        cfg = ALSConfig(rank=4, iterations=3)
+        with mock.patch(
+            "predictionio_tpu.ops.als._run_iterations_grid",
+            wraps=_run_iterations_grid,
+        ) as spy:
+            grid = train_als_grid(u, i, r, 60, 40, cfg, regs, mesh=mesh)
+        assert spy.call_count == 1  # one program for the whole grid
+        assert len(grid) == 4
+        for v, reg in enumerate(regs):
+            single = train_als(
+                u, i, r, 60, 40, dataclasses.replace(cfg, reg=reg)
+            )
+            np.testing.assert_allclose(
+                grid[v].user_factors, single.user_factors,
+                rtol=2e-4, atol=2e-5,
+            )
+            np.testing.assert_allclose(
+                grid[v].item_factors, single.item_factors,
+                rtol=2e-4, atol=2e-5,
+            )
