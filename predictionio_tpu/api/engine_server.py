@@ -81,6 +81,13 @@ class ServerConfig:
     # micro-batching knobs (TPU addition)
     batch_window_ms: float = 2.0
     max_batch: int = 128
+    # Daily self upgrade check (reference CreateServer.scala:253-260 runs
+    # UpgradeCheckRunner every 1 day): best-effort, on a background
+    # thread, never blocks serving; status.json reports the last result.
+    # 0 disables. The first check waits initial_delay so short-lived
+    # servers (tests, benches) never place the outbound call at all.
+    upgrade_check_interval_s: float = 86400.0
+    upgrade_check_initial_delay_s: float = 10.0
     # Batches allowed in flight at once: 2 = double-buffering, so batch
     # k+1's device dispatch overlaps batch k's result fetch. CONTRACT:
     # depth > 1 means serve_batch (supplement -> batch_predict -> serve)
@@ -379,13 +386,37 @@ class QueryAPI:
         self._feedback_worker: Optional[threading.Thread] = None
         self._feedback_lock = threading.Lock()
         self._feedback_closed = False
+        # daily upgrade self-check (reference CreateServer.scala:253-260)
+        self._upgrade_status: Optional[str] = None
+        self._upgrade_checked_at: Optional[str] = None
+        self._upgrade_stop = threading.Event()
+        if self.config.upgrade_check_interval_s > 0:
+            threading.Thread(
+                target=self._upgrade_check_loop, daemon=True
+            ).start()
+
+    def _upgrade_check_loop(self) -> None:
+        from predictionio_tpu.tools.upgrade import check_for_upgrade
+
+        if self._upgrade_stop.wait(self.config.upgrade_check_initial_delay_s):
+            return
+        while not self._upgrade_stop.is_set():
+            status = check_for_upgrade()
+            with self._stats_lock:
+                self._upgrade_status = status
+                self._upgrade_checked_at = _dt.datetime.now(
+                    _dt.timezone.utc
+                ).isoformat()
+            logger.info("upgrade check: %s", status)
+            self._upgrade_stop.wait(self.config.upgrade_check_interval_s)
 
     _FEEDBACK_STOP = object()
 
     def close(self) -> None:
         """Release serving resources (the batching executor's collector,
-        serve-pool, and feedback threads) when the server stops or
-        undeploys."""
+        serve-pool, feedback, and upgrade-check threads) when the server
+        stops or undeploys."""
+        self._upgrade_stop.set()
         self._executor.close()
         with self._feedback_lock:
             self._feedback_closed = True
@@ -570,6 +601,9 @@ class QueryAPI:
                 "requestCount": self.request_count,
                 "avgServingSec": self.avg_serving_sec,
                 "lastServingSec": self.last_serving_sec,
+                # daily self-check (reference CreateServer.scala:253-260)
+                "upgradeStatus": self._upgrade_status,
+                "upgradeLastChecked": self._upgrade_checked_at,
             }
 
     def _status_html(self) -> str:
