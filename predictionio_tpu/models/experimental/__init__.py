@@ -19,15 +19,17 @@ Port map (reference project -> module here):
 - scala-stock -> stock.py (indicators, regression + momentum strategies,
   walk-forward backtesting; synthetic panel stands in for
   YahooDataSource — zero-egress image)
-
-Not ported, by design:
-
-- scala-parallel-recommendation-mongo-datasource: a MongoDB client demo;
-  the pluggable-datasource pattern it teaches is custom_datasource.py,
-  and remote storage is this framework's ``http`` backend + gateway.
-- scala-parallel-similarproduct-localmodel: demonstrates Spark's L-vs-P
-  model split, which this framework collapses by design (one algorithm
-  class + ``sharded_model`` flag, SURVEY.md §7 step 2).
+- scala-parallel-recommendation-mongo-datasource -> mongo_datasource.py
+  (external remote datastore as a DataSource; the storage gateway plays
+  the MongoDB tier, and the columnar RPC plays the Hadoop connector)
+- scala-parallel-similarproduct-localmodel ->
+  similarproduct_localmodel.py (the P2L "collectAsMap" local model:
+  plain host dictionaries + numpy cosine predict)
+- scala-recommendations -> standalone_recommendations.py (the 0.8-era
+  workflow-API engine: file DataSource, PersistentModel factors, bare
+  (user, item) tuple queries, run via the workflow entry directly)
+- scala-refactor-test -> refactor_test.py (the vanilla DASE plumbing
+  engine + custom low-level VanillaEvaluator)
 - java-local-tutorial, scala-local-helloworld prototypes,
   scala-refactor-test, scala-recommendations: JVM build/tutorial
   scaffolding with no distinct algorithmic content.

@@ -1,0 +1,103 @@
+"""Recommendation with a DataSource over an EXTERNAL remote datastore.
+
+Reference mapping (examples/experimental/
+scala-parallel-recommendation-mongo-datasource/): the recommendation
+template with DataSource.readTraining swapped to read ratings from a
+remote database — MongoDB via the Hadoop connector, configured by
+``DataSourceParams(host, port, db, collection)`` and mapping each BSON
+document's ``uid``/``iid``/``rating`` fields (DataSource.scala:29-53).
+Everything downstream (Preparator/ALS/Serving) is unchanged — the
+example teaches that a DataSource is just another pluggable component.
+
+The TPU framework's client-server datastore is the storage gateway
+(api/storage_gateway.py — the HBase/Mongo tier role), so the analog
+reads ratings from a REMOTE gateway configured by host/port/secret,
+through the ``http`` storage backend's columnar scan: the wire carries
+packed id/value columns, not one document per rating. The
+``value_property`` param plays the BSON ``rating`` field name.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from predictionio_tpu.controller import EngineFactory, FirstServing, Params
+from predictionio_tpu.controller.base import BaseDataSource
+from predictionio_tpu.controller.engine import Engine
+from predictionio_tpu.data.storage import Storage
+from predictionio_tpu.data.storage.columnar import ValueSpec
+from predictionio_tpu.data.store import PEventStore
+from predictionio_tpu.models.recommendation.engine import (  # noqa: F401
+    ALSAlgorithm,
+    ALSAlgorithmParams,
+    PredictedResult,
+    Preparator,
+    Query,
+    TrainingData,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class RemoteStoreDataSourceParams(Params):
+    """Reference DataSourceParams(host, port, db, collection)
+    (DataSource.scala:21-26): host/port address the remote store;
+    app_name plays the db/collection pair; value_property is the
+    document field holding the rating (BSON ``rating``)."""
+
+    host: str = "localhost"
+    port: int = 7077
+    app_name: str = "default"
+    secret: str = ""
+    value_property: str = "rating"
+    event_names: tuple = ("rate", "buy")
+
+
+class RemoteStoreDataSource(BaseDataSource):
+    """Reads rating columns from a remote storage gateway
+    (DataSource.scala:33-53's mongoRDD -> Rating mapping; here the
+    gateway's columnar RPC returns the packed columns directly)."""
+
+    params_class = RemoteStoreDataSourceParams
+
+    def _storage(self) -> Storage:
+        cfg = {
+            "PIO_STORAGE_SOURCES_REMOTE_TYPE": "http",
+            "PIO_STORAGE_SOURCES_REMOTE_URL": (
+                f"http://{self.params.host}:{self.params.port}"
+            ),
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "REMOTE",
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "REMOTE",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "REMOTE",
+        }
+        if self.params.secret:
+            cfg["PIO_STORAGE_SOURCES_REMOTE_SECRET"] = self.params.secret
+        return Storage(cfg)
+
+    def read_training(self, ctx) -> TrainingData:
+        cols = PEventStore(self._storage()).find_columns(
+            self.params.app_name,
+            value_spec=ValueSpec(prop=self.params.value_property),
+            event_names=list(self.params.event_names),
+        )
+        return TrainingData(
+            user_idx=cols.entity_idx,
+            item_idx=cols.target_idx,
+            ratings=cols.values,
+            user_index=cols.entity_index,
+            item_index=cols.target_index,
+        )
+
+
+def mongo_datasource_engine() -> Engine:
+    return Engine(
+        data_source_classes=RemoteStoreDataSource,
+        preparator_classes=Preparator,
+        algorithm_classes={"als": ALSAlgorithm},
+        serving_classes=FirstServing,
+    )
+
+
+class MongoDataSourceEngineFactory(EngineFactory):
+    def apply(self) -> Engine:
+        return mongo_datasource_engine()

@@ -1,0 +1,220 @@
+"""The 0.8-era standalone workflow-API recommendation engine.
+
+Reference mapping (examples/experimental/scala-recommendations/
+src/main/scala/Run.scala): an engine assembled and run DIRECTLY through
+the Workflow APIs — no console, no template scaffold:
+
+- ``DataSource(filepath)`` parses ``user::item::rate`` lines
+  (Run.scala:29-49), emitting both the training ratings and the
+  (user, item) -> rating feature/target pairs for evaluation.
+- ``PIdentityPreparator`` (the ratings pass through untouched).
+- ``ALSAlgorithm`` wraps MLlib ALS; its ``PMatrixFactorizationModel``
+  is an ``IPersistentModel`` that saves factor files itself when
+  ``params.persist_model`` is set and reloads them at deploy
+  (Run.scala:57-82).
+- ``LFirstServing``, and a custom query serializer for the bare
+  ``(user, item)`` tuple queries (Run.scala:117 Tuple2IntSerializer).
+- ``Run.main`` calls ``Workflow.runEngine`` with 3 ALS variants
+  (Run.scala:120-160); here ``run_standalone`` drives
+  CoreWorkflow.run_train the same way.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from predictionio_tpu.controller import (
+    EngineFactory,
+    FirstServing,
+    IdentityPreparator,
+    Params,
+)
+from predictionio_tpu.controller.base import BaseAlgorithm, BaseDataSource
+from predictionio_tpu.controller.engine import Engine, EngineParams
+from predictionio_tpu.controller.persistent_model import (
+    LocalFileSystemPersistentModel,
+)
+from predictionio_tpu.ops.als import ALSConfig, predict_ratings, train_als
+
+
+@dataclasses.dataclass(frozen=True)
+class FileDataSourceParams(Params):
+    """Reference DataSourceParams(filepath) (Run.scala:29)."""
+
+    filepath: str = ""
+
+
+@dataclasses.dataclass
+class RatingsData:
+    """Integer-id COO ratings (the reference's RDD[Rating] of int ids —
+    this example predates string entity ids)."""
+
+    user_idx: np.ndarray  # [n] int32
+    item_idx: np.ndarray  # [n] int32
+    ratings: np.ndarray  # [n] float32
+
+
+class FileDataSource(BaseDataSource):
+    """``user::item::rate`` lines -> integer-id ratings (Run.scala:35-49).
+    read_eval returns each (user, item) pair as a query with its rating
+    as the actual (the featureTargets RDD)."""
+
+    params_class = FileDataSourceParams
+
+    def _read(self) -> RatingsData:
+        users, items, rates = [], [], []
+        with open(self.params.filepath) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                u, i, r = line.split("::")
+                users.append(int(u))
+                items.append(int(i))
+                rates.append(float(r))
+        return RatingsData(
+            user_idx=np.asarray(users, np.int32),
+            item_idx=np.asarray(items, np.int32),
+            ratings=np.asarray(rates, np.float32),
+        )
+
+    def read_training(self, ctx) -> RatingsData:
+        return self._read()
+
+    def read_eval(self, ctx):
+        data = self._read()
+        queries = [
+            ((int(u), int(i)), float(r))
+            for u, i, r in zip(data.user_idx, data.item_idx, data.ratings)
+        ]
+        return [(data, None, queries)]
+
+
+@dataclasses.dataclass(frozen=True)
+class AlgorithmParams(Params):
+    """Reference AlgorithmParams (Run.scala:51-55)."""
+
+    rank: int = 10
+    num_iterations: int = 20
+    lambda_: float = 0.01
+    persist_model: bool = False
+
+
+@dataclasses.dataclass
+class PMatrixFactorizationModel(LocalFileSystemPersistentModel):
+    """Reference PMatrixFactorizationModel (Run.scala:57-82): opts into
+    persisting itself (factor arrays) when params.persist_model is set,
+    returning False otherwise to fall back to default pickling."""
+
+    rank: int = 0
+    user_features: Optional[np.ndarray] = None
+    product_features: Optional[np.ndarray] = None
+
+    def save(self, id: str, params: AlgorithmParams, ctx) -> bool:
+        if not params.persist_model:
+            return False  # default pickling path (Run.scala:63-69)
+        return super().save(id, params, ctx)
+
+
+class ALSAlgorithm(BaseAlgorithm):
+    """Reference ALSAlgorithm (Run.scala:84-117): MLlib ALS.train with
+    explicit feedback; queries are bare (user, item) int tuples and the
+    prediction is the scalar rating."""
+
+    params_class = AlgorithmParams
+
+    def train(self, ctx, data: RatingsData) -> PMatrixFactorizationModel:
+        n_users = int(data.user_idx.max()) + 1 if len(data.user_idx) else 0
+        n_items = int(data.item_idx.max()) + 1 if len(data.item_idx) else 0
+        arrays = train_als(
+            data.user_idx,
+            data.item_idx,
+            data.ratings,
+            n_users=n_users,
+            n_items=n_items,
+            config=ALSConfig(
+                rank=self.params.rank,
+                iterations=self.params.num_iterations,
+                reg=self.params.lambda_,
+            ),
+            mesh=ctx.mesh if ctx is not None else None,
+        )
+        return PMatrixFactorizationModel(
+            rank=self.params.rank,
+            user_features=arrays.user_factors,
+            product_features=arrays.item_factors,
+        )
+
+    def predict(
+        self, model: PMatrixFactorizationModel, query: Tuple[int, int]
+    ) -> float:
+        u, i = query
+        from predictionio_tpu.ops.als import ALSModelArrays
+
+        return float(
+            predict_ratings(
+                ALSModelArrays(model.user_features, model.product_features),
+                np.asarray([u]),
+                np.asarray([i]),
+            )[0]
+        )
+
+    # the reference's Tuple2IntSerializer (Run.scala:117, 163-173):
+    # queries travel as a bare [user, item] JSON array
+    def query_from_json(self, json_obj) -> Tuple[int, int]:
+        u, i = json_obj
+        return int(u), int(i)
+
+    def result_to_json(self, result: float):
+        return result
+
+
+def standalone_recommendations_engine() -> Engine:
+    return Engine(
+        data_source_classes=FileDataSource,
+        preparator_classes=IdentityPreparator,
+        algorithm_classes={"als": ALSAlgorithm},
+        serving_classes=FirstServing,
+    )
+
+
+class StandaloneRecommendationsEngineFactory(EngineFactory):
+    def apply(self) -> Engine:
+        return standalone_recommendations_engine()
+
+
+def run_standalone(
+    filepath: str,
+    rank: int = 6,
+    num_iterations: int = 5,
+    lambda_: float = 0.01,
+    persist_model: bool = False,
+    ctx=None,
+) -> List:
+    """The example's ``Run.main`` (Run.scala:120-160): build the engine
+    params and drive training through the workflow APIs directly."""
+    engine = standalone_recommendations_engine()
+    params = EngineParams(
+        data_source_params=("", FileDataSourceParams(filepath=filepath)),
+        preparator_params=("", Params()),
+        algorithm_params_list=(
+            (
+                "als",
+                AlgorithmParams(
+                    rank=rank,
+                    num_iterations=num_iterations,
+                    lambda_=lambda_,
+                    persist_model=persist_model,
+                ),
+            ),
+        ),
+        serving_params=("", Params()),
+    )
+    from predictionio_tpu.workflow.context import WorkflowContext
+    from predictionio_tpu.workflow.workflow_params import WorkflowParams
+
+    ctx = ctx or WorkflowContext(mode="training")
+    return engine.train(ctx, params, WorkflowParams())

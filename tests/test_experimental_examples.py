@@ -815,3 +815,269 @@ class TestStock:
         with pytest.raises(ValueError, match="before the panel start"):
             view.price_frame(21)
         assert view.price_frame(11).shape[0] == 11  # exact fit is fine
+
+
+class TestMongoDataSource:
+    """scala-parallel-recommendation-mongo-datasource analog: the
+    DataSource reads ratings from a REMOTE storage gateway (the MongoDB
+    tier role) through the columnar RPC."""
+
+    def test_reads_from_remote_gateway_and_trains(self, tmp_path):
+        from predictionio_tpu.api.storage_gateway import StorageGatewayServer
+        from predictionio_tpu.controller import EngineParams
+        from predictionio_tpu.data.storage import memory_storage
+        from predictionio_tpu.data.storage.base import App
+        from predictionio_tpu.models.experimental.mongo_datasource import (
+            ALSAlgorithmParams,
+            RemoteStoreDataSourceParams,
+            mongo_datasource_engine,
+        )
+        from predictionio_tpu.models.recommendation.engine import Query
+        from predictionio_tpu.workflow.workflow_params import WorkflowParams
+
+        backing = memory_storage()
+        backing.get_meta_data_apps().insert(App(id=0, name="remoteapp"))
+        le = backing.get_l_events()
+        le.init(1)
+        rng = np.random.default_rng(4)
+        users, items, vals = [], [], []
+        for uu in range(16):
+            lo = 0 if uu % 2 == 0 else 5
+            for ii in rng.permutation(5)[:4].tolist():
+                users.append(f"u{uu}")
+                items.append(f"i{lo + ii}")
+                vals.append(5.0)
+        le.insert_columns(
+            1, event="rate", entity_type="user", target_entity_type="item",
+            entity_ids=users, target_ids=items, values=vals,
+        )
+        server = StorageGatewayServer(backing, port=0).start()
+        try:
+            engine = mongo_datasource_engine()
+            ep = EngineParams(
+                data_source_params=(
+                    "",
+                    RemoteStoreDataSourceParams(
+                        host="localhost",
+                        port=server.port,
+                        app_name="remoteapp",
+                    ),
+                ),
+                algorithm_params_list=(
+                    ("als", ALSAlgorithmParams(rank=4, num_iterations=8)),
+                ),
+            )
+            models = engine.train(None, ep, WorkflowParams())
+            _, _, algorithms, serving = engine.make_components(ep)
+            q = Query(user="u0", num=3)
+            result = serving.serve(
+                q, [algorithms[0].predict(models[0], q)]
+            )
+            assert len(result.item_scores) == 3
+            assert all(int(s.item[1:]) < 5 for s in result.item_scores)
+        finally:
+            server.shutdown()
+
+
+class TestSimilarProductLocalModel:
+    def _prepared(self):
+        from predictionio_tpu.models.experimental.similarproduct_localmodel import (
+            Item,
+            PreparedData,
+            TrainingData,
+        )
+        from predictionio_tpu.models.similarproduct.engine import ViewEvent
+
+        rng = np.random.default_rng(11)
+        views = []
+        for uu in range(40):
+            grp = uu % 2
+            lo = 0 if grp == 0 else 10
+            for it in rng.choice(10, size=6, replace=False):
+                views.append(
+                    ViewEvent(user=f"u{uu}", item=f"i{lo + it}", t=0.0)
+                )
+        td = TrainingData(
+            users={f"u{j}": {} for j in range(40)},
+            items={
+                f"i{j}": Item(categories=("odd" if j % 2 else "even",))
+                for j in range(20)
+            },
+            view_events=views,
+        )
+        return PreparedData(td=td)
+
+    def test_local_model_is_host_dicts_and_scores(self):
+        from predictionio_tpu.models.experimental.similarproduct_localmodel import (
+            ALSLocalAlgorithm,
+            ALSLocalModel,
+            ALSAlgorithmParams,
+            Query,
+        )
+
+        algo = ALSLocalAlgorithm(
+            ALSAlgorithmParams(rank=8, num_iterations=8, lambda_=0.01, seed=1)
+        )
+        model = algo.train(None, self._prepared())
+        assert isinstance(model, ALSLocalModel)
+        assert isinstance(model.product_features, dict)
+        assert isinstance(
+            model.product_features[0], np.ndarray
+        )  # plain host arrays (the collectAsMap analog)
+        res = algo.predict(model, Query(items=("i3",), num=5))
+        assert len(res.item_scores) == 5
+        # within-group similarity: i3 lives in the 0-9 view group
+        hits = sum(int(s.item[1:]) < 10 for s in res.item_scores)
+        assert hits >= 4
+        # query item itself never recommended
+        assert all(s.item != "i3" for s in res.item_scores)
+
+    def test_filters(self):
+        from predictionio_tpu.models.experimental.similarproduct_localmodel import (
+            ALSLocalAlgorithm,
+            ALSAlgorithmParams,
+            Query,
+        )
+
+        algo = ALSLocalAlgorithm(
+            ALSAlgorithmParams(rank=8, num_iterations=6, lambda_=0.01, seed=1)
+        )
+        model = algo.train(None, self._prepared())
+        res = algo.predict(
+            model, Query(items=("i3",), num=5, categories=("even",))
+        )
+        assert all(int(s.item[1:]) % 2 == 0 for s in res.item_scores)
+        res = algo.predict(
+            model,
+            Query(items=("i3",), num=5, white_list=("i1", "i5"),
+                  black_list=("i1",)),
+        )
+        assert [s.item for s in res.item_scores] == ["i5"]
+
+    def test_full_pipeline(self):
+        from predictionio_tpu.controller import EngineParams, Params
+        from predictionio_tpu.models.experimental.similarproduct_localmodel import (
+            ALSAlgorithmParams,
+            DataSourceParams,
+            similarproduct_localmodel_engine,
+        )
+
+        # pipeline assembly parity; the engine shares the template's
+        # DataSource (event store) so just assemble components
+        engine = similarproduct_localmodel_engine()
+        ep = EngineParams(
+            data_source_params=("", DataSourceParams(app_name="x")),
+            algorithm_params_list=(
+                ("als", ALSAlgorithmParams(rank=4, num_iterations=2)),
+            ),
+            serving_params=("", Params()),
+        )
+        _, _, algorithms, serving = engine.make_components(ep)
+        assert len(algorithms) == 1
+
+
+class TestStandaloneRecommendations:
+    def _write_ratings(self, tmp_path):
+        rng = np.random.default_rng(9)
+        lines = []
+        for uu in range(12):
+            lo = 0 if uu % 2 == 0 else 4
+            for ii in rng.permutation(4)[:3].tolist():
+                lines.append(f"{uu}::{lo + ii}::4.5")
+        path = tmp_path / "ratings.txt"
+        path.write_text("\n".join(lines) + "\n")
+        return path
+
+    def test_run_standalone_trains_and_predicts(self, tmp_path):
+        from predictionio_tpu.models.experimental.standalone_recommendations import (
+            run_standalone,
+        )
+
+        models = run_standalone(
+            str(self._write_ratings(tmp_path)), rank=4, num_iterations=6
+        )
+        assert len(models) == 1
+        model = models[0]
+        assert model.user_features.shape[1] == 4
+
+    def test_tuple_query_serializer_and_predict(self, tmp_path):
+        from predictionio_tpu.models.experimental.standalone_recommendations import (
+            AlgorithmParams,
+            ALSAlgorithm,
+            run_standalone,
+        )
+
+        model = run_standalone(
+            str(self._write_ratings(tmp_path)), rank=4, num_iterations=8
+        )[0]
+        algo = ALSAlgorithm(AlgorithmParams(rank=4))
+        # queries travel as bare [user, item] arrays (Tuple2IntSerializer)
+        q = algo.query_from_json([0, 1])
+        assert q == (0, 1)
+        pred = algo.predict(model, q)
+        assert isinstance(pred, float)
+        assert pred == pytest.approx(4.5, abs=1.5)  # observed pair
+        assert algo.result_to_json(pred) == pred
+
+    def test_persistent_model_save_and_reload(self, tmp_path, monkeypatch):
+        from predictionio_tpu.models.experimental.standalone_recommendations import (
+            AlgorithmParams,
+            PMatrixFactorizationModel,
+            run_standalone,
+        )
+
+        monkeypatch.setenv("PIO_FS_BASEDIR", str(tmp_path / "fs"))
+        model = run_standalone(
+            str(self._write_ratings(tmp_path)), rank=4, num_iterations=4,
+            persist_model=True,
+        )[0]
+        # persist_model=False falls back to default pickling
+        assert model.save("sr-no", AlgorithmParams(persist_model=False), None) is False
+        assert model.save("sr-1", AlgorithmParams(persist_model=True), None) is True
+        loaded = PMatrixFactorizationModel.load(
+            "sr-1", AlgorithmParams(persist_model=True), None
+        )
+        np.testing.assert_array_equal(
+            loaded.user_features, model.user_features
+        )
+
+
+class TestRefactorTest:
+    def test_train_and_predict(self):
+        from predictionio_tpu.models.experimental.refactor_test import (
+            default_engine_params,
+            refactor_test_engine,
+        )
+        from predictionio_tpu.workflow.workflow_params import WorkflowParams
+
+        engine = refactor_test_engine()
+        ep = default_engine_params(mult=2)
+        models = engine.train(None, ep, WorkflowParams())
+        assert models[0].mc == sum(range(100)) * 2  # 9900
+        _, _, algorithms, serving = engine.make_components(ep)
+        from predictionio_tpu.models.experimental.refactor_test import Query
+
+        out = serving.serve(
+            Query(q=5), [algorithms[0].predict(models[0], Query(q=5))]
+        )
+        assert out.p == 9905
+
+    def test_vanilla_evaluator_over_low_level_path(self):
+        """unit = q - p = -mc for every query; set = 20 * -mc; all sums
+        the 3 folds (Evaluator.scala:7-21)."""
+        from predictionio_tpu.models.experimental.refactor_test import (
+            VanillaEvaluator,
+            default_engine_params,
+            refactor_test_engine,
+        )
+        from predictionio_tpu.workflow.workflow_params import WorkflowParams
+
+        engine = refactor_test_engine()
+        ep = default_engine_params(mult=1)
+        wp = WorkflowParams()
+        data_set = engine.batch_eval(None, [ep], wp)
+        result = VanillaEvaluator().evaluate_base(None, None, data_set, wp)
+        mc = sum(range(100))
+        assert result.n_sets == 3
+        assert result.total == 3 * sum(-mc for _ in range(20))
+        assert result.to_one_liner() == f"VanillaEvaluator(3, {result.total})"
