@@ -64,6 +64,52 @@ PEAK_BF16_FLOPS = {
     "TPU v6e": 918e12,
 }
 
+# Published per-chip HBM bandwidth, the denominator of the device-loop
+# roofline analysis (ALS is memory-bound, not FLOP-bound — see
+# bench_ml20m).
+PEAK_HBM_GBPS = {
+    "TPU v5 lite": 819,  # v5e
+    "TPU v5e": 819,
+    "TPU v4": 1228,
+    "TPU v5p": 2765,
+    "TPU v6 lite": 1640,
+    "TPU v6e": 1640,
+}
+
+
+def measure_gather_ceiling_mrows(n_rows=26_744, k=32, m=4_194_304, iters=16):
+    """Measured per-chip ceiling of the op that fundamentally bounds ALS
+    on TPU: an [m]-index row gather from an [n_rows, k] factor table.
+    TPU has no hardware gather — XLA lowers it to a row-rate-bound loop
+    (~420 Mrows/s on v5e regardless of row dtype), far below HBM byte
+    peak. The device loop's gather phase should be judged against THIS
+    roofline, not the HBM number. Chained on-device iterations cancel
+    the relay round trip."""
+    import jax
+    import jax.numpy as jnp
+
+    idx = jax.device_put(
+        np.random.default_rng(0).integers(0, n_rows, m).astype(np.int32)
+    )
+    table = jax.device_put(np.ones((n_rows, k), np.float32))
+
+    @jax.jit
+    def chain(idx, table, n):
+        def body(j, acc):
+            t = table * (1.0 + acc * 1e-30)
+            return acc + jnp.sum(t[idx].astype(jnp.float32)) * 1e-30
+        return jax.lax.fori_loop(0, n, body, 0.0)
+
+    jax.device_get(chain(idx, table, jnp.int32(1)))
+    t0 = time.perf_counter()
+    jax.device_get(chain(idx, table, jnp.int32(1)))
+    t1 = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    jax.device_get(chain(idx, table, jnp.int32(iters)))
+    tk = time.perf_counter() - t0
+    per = max((tk - t1) / (iters - 1), 1e-9)
+    return m / per / 1e6
+
 
 def synth_ml100k(seed=7):
     rng = np.random.default_rng(seed)
@@ -142,11 +188,30 @@ def bench_recommendation(device_name):
     # ±20 ms relay-round-trip jitter or the subtraction estimate drowns
     device_ms = serving.measure_compute_ms(rows, 10, iters=4096)
     serving.topn_by_user(users, 10)  # compile
-    full_lat = []
-    for _ in range(50):
+
+    # The serving hot path costs exactly ONE blocking device round trip:
+    # the query upload (jax.device_put) and the top-N dispatch are both
+    # async; the only wait is fetching the single packed result buffer
+    # (ops/als.py _topn_packed packs scores+ids into one buffer for this
+    # reason). Measure the bare dispatch+fetch round trip of a trivial
+    # 8-float program — the floor ANY result-returning call pays on this
+    # rig — interleaved with the predict loop so link drift doesn't skew
+    # the subtraction.
+    import jax
+    import jax.numpy as jnp
+
+    tiny = jax.device_put(np.zeros(8, np.float32))
+    rtt_probe = jax.jit(lambda x, j: x + j)
+    jax.device_get(rtt_probe(tiny, 0.0))
+    full_lat, rtt_lat = [], []
+    for j in range(50):
         t0 = time.perf_counter()
         serving.topn_by_user(users, 10)
         full_lat.append((time.perf_counter() - t0) * 1000)
+        t0 = time.perf_counter()
+        jax.device_get(rtt_probe(tiny, float(j)))
+        rtt_lat.append((time.perf_counter() - t0) * 1000)
+    rtt_p50 = pctl(rtt_lat, 50)
 
     rest = bench_rest_serving(u, i, r)
 
@@ -166,6 +231,15 @@ def bench_recommendation(device_name):
             "rmse_data": "synthetic-ml100k-shape",
             "predict_device_compute_ms": round(device_ms, 4),
             "predict_p50_ms": round(pctl(full_lat, 50), 2),
+            # one documented relay round trip (async upload + async
+            # dispatch + ONE blocking result fetch); the bare-RTT floor
+            # is measured interleaved, and the remainder is the true
+            # device+host serving cost
+            "relay_rtt_p50_ms": round(rtt_p50, 2),
+            "predict_p50_ms_minus_rtt": round(
+                max(pctl(full_lat, 50) - rtt_p50, 0.0), 2
+            ),
+            "predict_device_round_trips": 1,
             **rest,
             "device": device_name,
         }
@@ -346,6 +420,31 @@ def bench_ml20m(device_name):
     achieved = model_flops / loop_s
     peak = PEAK_BF16_FLOPS.get(jax.devices()[0].device_kind)
 
+    # Memory-bound roofline for the device loop. ALS at rank 32 does
+    # ~2k^2 FLOPs per 128-byte gathered row — arithmetic intensity ~16
+    # FLOP/byte against an MXU that needs ~240 at bf16 peak, so the loop
+    # is bound by data movement, and MFU is structurally tiny no matter
+    # how well it runs. The two dominant movers, with their own ceilings:
+    #   gather: every slot gathers one factor row per iteration; TPU
+    #     gathers are row-rate bound (measured live below; ~420 Mrows/s
+    #     on v5e, ~6% of HBM byte peak — a lowering property, not a
+    #     tuning gap).
+    #   solve:  the in-place batched Cholesky makes k passes over the
+    #     [R, k, k] systems per side per iteration (read + write)
+    #     — pure streaming, judged against HBM peak. Measured in
+    #     isolation it runs at ~310 GB/s = ~38% of v5e peak.
+    gather_ceiling_mrows = measure_gather_ceiling_mrows(n_items + 1, rank)
+    gather_floor_s = slots * iters / (gather_ceiling_mrows * 1e6)
+    hbm_peak = PEAK_HBM_GBPS.get(jax.devices()[0].device_kind)
+    solve_bytes = (
+        iters * rank * 2 * 4  # k passes, read+write, f32
+        * ((n_users + 1) + (n_items + 1)) * rank * rank
+    )
+    solve_floor_s = solve_bytes / (hbm_peak * 1e9) if hbm_peak else None
+    roofline_s = (
+        gather_floor_s + solve_floor_s if solve_floor_s is not None else None
+    )
+
     try:
         stats = jax.local_devices()[0].memory_stats() or {}
         peak_hbm_gb = round(stats.get("peak_bytes_in_use", 0) / 2**30, 3)
@@ -392,7 +491,21 @@ def bench_ml20m(device_name):
             "pack_s": round(timings.get("pack_s", 0.0), 3),
             "compile_s": round(timings.get("compile_s", 0.0), 3),
             "device_put_s": round(timings.get("device_put_s", 0.0), 3),
+            "wire_mb": timings.get("wire_mb"),
+            "device_pack_dispatch_s": round(
+                timings.get("device_pack_dispatch_s", 0.0), 3
+            ),
             "device_loop_s": round(loop_s, 3),
+            # memory-bound roofline (see comments above): modeled floor =
+            # gather rows at the live-measured gather ceiling + Cholesky
+            # streaming at HBM peak. loop_vs_roofline ~1 would mean the
+            # loop runs at the hardware's own per-op limits.
+            "gather_ceiling_mrows_per_s": round(gather_ceiling_mrows),
+            "loop_gather_mrows_per_s": round(slots * iters / loop_s / 1e6),
+            "loop_roofline_s": round(roofline_s, 2) if roofline_s else None,
+            "loop_vs_roofline": (
+                round(loop_s / roofline_s, 2) if roofline_s else None
+            ),
             "model_tflops": round(model_flops / 1e12, 2),
             "achieved_tflops_per_s": round(achieved / 1e12, 2),
             "mfu": round(achieved / peak, 4) if peak else None,
@@ -408,6 +521,197 @@ def bench_ml20m(device_name):
             "device": device_name,
         }
     )
+
+
+# --- config 6b: the flagship flow THROUGH THE EVENT STORE ---
+
+
+def bench_ml20m_store(device_name):
+    """ML-20M through the real framework path: bulk-import 20M rate
+    events into the sqlite event store (columnar pages,
+    LEvents.insert_columns), scan them back as device-ready columns
+    (PEventStore.find_columns -> the binary page scan,
+    data/storage/columnar.py), then train ALS — the role of the
+    reference's HBase-scan-feeds-Spark flagship flow
+    (hbase/HBPEvents.scala:84-90). Rounds 1-3 never exercised this at
+    scale: the per-event path would spend minutes building 20M Python
+    Event objects before the kernel saw a byte.
+
+    value = store_scan_s + train_s (what `pio train` costs with data at
+    rest); import_s is the one-time `pio import` ingestion, reported
+    alongside."""
+    import shutil
+    import tempfile
+
+    from predictionio_tpu.data.storage import Storage
+    from predictionio_tpu.data.storage.base import App
+    from predictionio_tpu.data.store import PEventStore
+    from predictionio_tpu.models.recommendation.engine import RATING_SPEC
+    from predictionio_tpu.ops.als import ALSConfig, train_als
+
+    n_users, n_items = 138_493, 26_744
+    n_ratings = int(
+        os.environ.get(
+            "BENCH_ML20M_STORE_RATINGS",
+            os.environ.get("BENCH_ML20M_RATINGS", 20_000_000),
+        )
+    )
+    u, i, r = synth_ml20m(n_users, n_items, n_ratings)
+    users = np.char.add("u", u.astype("U7"))
+    items = np.char.add("i", i.astype("U6"))
+
+    tmp = tempfile.mkdtemp(prefix="bench_store_")
+    try:
+        storage = Storage(
+            {
+                "PIO_STORAGE_SOURCES_SQLITE_TYPE": "sqlite",
+                "PIO_STORAGE_SOURCES_SQLITE_PATH": os.path.join(tmp, "s.db"),
+                "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "SQLITE",
+                "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "SQLITE",
+                "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "SQLITE",
+            }
+        )
+        storage.get_meta_data_apps().insert(App(id=0, name="bench"))
+        events = storage.get_l_events()
+        events.init(1)
+
+        t0 = time.perf_counter()
+        events.insert_columns(
+            1, event="rate", entity_type="user", target_entity_type="item",
+            entity_ids=users, target_ids=items, values=r,
+        )
+        import_s = time.perf_counter() - t0
+
+        t0 = time.perf_counter()
+        cols = PEventStore(storage).find_columns(
+            "bench",
+            value_spec=RATING_SPEC,
+            entity_type="user",
+            target_entity_type="item",
+            event_names=["rate", "buy"],
+        )
+        store_scan_s = time.perf_counter() - t0
+        assert cols.n == n_ratings, (cols.n, n_ratings)
+
+        config = ALSConfig(
+            rank=32, iterations=10, reg=0.05, compute_dtype="bfloat16"
+        )
+        timings = {}
+        t0 = time.perf_counter()
+        train_als(
+            cols.entity_idx, cols.target_idx, cols.values,
+            len(cols.entity_index), len(cols.target_index),
+            config, timings=timings,
+        )
+        train_s = time.perf_counter() - t0
+        emit(
+            {
+                "metric": "als_ml20m_store_to_model_wall_clock",
+                "value": round(store_scan_s + train_s, 3),
+                "unit": "s",
+                "vs_baseline": round(
+                    SPARK_LOCAL_ALS_ML20M_S / (store_scan_s + train_s), 2
+                ),
+                "n_ratings": n_ratings,
+                "import_s": round(import_s, 3),
+                "store_scan_s": round(store_scan_s, 3),
+                "train_s": round(train_s, 3),
+                "train_device_loop_s": round(
+                    timings.get("device_loop_s", 0.0), 3
+                ),
+                "events_scanned_per_s": round(n_ratings / store_scan_s),
+                "device": device_name,
+            }
+        )
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+# --- config 7: Event Server ingestion throughput ---
+
+
+def bench_ingestion(device_name):
+    """POST /events.json throughput under concurrent clients — the Event
+    Server is the reference's front door (EventServer.scala:502) and its
+    write path (auth -> validation -> storage insert) is pure host work.
+    Memory-backed storage isolates server overhead from disk."""
+    from predictionio_tpu.api.event_server import (
+        EventServer,
+        EventServerConfig,
+    )
+    from predictionio_tpu.data import storage as storage_mod
+    from predictionio_tpu.data.storage.base import AccessKey, App
+
+    storage = storage_mod.memory_storage()
+    app_id = storage.get_meta_data_apps().insert(App(id=0, name="bench"))
+    storage.get_meta_data_access_keys().insert(
+        AccessKey(key="benchkey", appid=app_id, events=())
+    )
+    storage.get_l_events().init(app_id)
+    server = EventServer(
+        storage=storage, config=EventServerConfig(port=0)
+    ).start()
+    try:
+        import http.client
+
+        n_clients, n_per_client = 16, 150
+
+        def client(worker):
+            conn = http.client.HTTPConnection("localhost", server.port)
+            lat = []
+            try:
+                for j in range(n_per_client):
+                    body = json.dumps(
+                        {
+                            "event": "rate",
+                            "entityType": "user",
+                            "entityId": f"u{worker}-{j}",
+                            "targetEntityType": "item",
+                            "targetEntityId": f"i{j % 97}",
+                            "properties": {"rating": float(j % 5 + 1)},
+                        }
+                    )
+                    t0 = time.perf_counter()
+                    conn.request(
+                        "POST",
+                        "/events.json?accessKey=benchkey",
+                        body,
+                        {"Content-Type": "application/json"},
+                    )
+                    resp = conn.getresponse()
+                    resp.read()
+                    assert resp.status == 201, resp.status
+                    lat.append((time.perf_counter() - t0) * 1000)
+            finally:
+                conn.close()
+            return lat
+
+        client(999)  # warm (threads, code paths)
+        lat = []
+        t0 = time.perf_counter()
+        with concurrent.futures.ThreadPoolExecutor(
+            max_workers=n_clients
+        ) as pool:
+            for chunk in pool.map(client, range(n_clients)):
+                lat.extend(chunk)
+        wall = time.perf_counter() - t0
+        emit(
+            {
+                "metric": "eventserver_ingest_events_per_sec",
+                "value": round(len(lat) / wall, 1),
+                "unit": "events/s",
+                # the reference publishes no ingestion numbers; a
+                # single-node spray/HBase event server is commonly cited
+                # around ~1k events/s — conservative stand-in
+                "vs_baseline": round(len(lat) / wall / 1000.0, 2),
+                "ingest_p50_ms": round(pctl(lat, 50), 2),
+                "ingest_p99_ms": round(pctl(lat, 99), 2),
+                "clients": n_clients,
+                "device": device_name,
+            }
+        )
+    finally:
+        server.shutdown()
 
 
 # --- config 2: classification NaiveBayes ---
@@ -659,6 +963,8 @@ BENCHES = {
     "ecommerce": bench_ecommerce,
     "kfold_cv": bench_kfold_cv,
     "ml20m": bench_ml20m,
+    "ml20m_store": bench_ml20m_store,
+    "ingestion": bench_ingestion,
 }
 
 
