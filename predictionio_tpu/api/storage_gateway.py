@@ -205,6 +205,11 @@ class StorageGatewayCore:
                 values=col.array_from_b64(a["values"], np.float32),
                 value_property=a.get("value_property", "rating"),
                 event_time=wire.opt_dt_from_wire(a.get("event_time")),
+                event_times_ms=(
+                    None
+                    if a.get("event_times_ms") is None
+                    else col.array_from_b64(a["event_times_ms"], np.int64)
+                ),
             )
         if method == "find_columns_native":
             from predictionio_tpu.data.storage import columnar as col

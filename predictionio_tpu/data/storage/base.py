@@ -203,6 +203,7 @@ class LEvents(abc.ABC):
         values: Sequence[float],
         value_property: str = "rating",
         event_time: Optional[_dt.datetime] = None,
+        event_times_ms: Optional[Sequence[int]] = None,
     ) -> int:
         """Bulk-append target-carrying interaction events from columns.
 
@@ -211,15 +212,29 @@ class LEvents(abc.ABC):
         constructs one Event per row. ``event`` must be a plain
         interaction event (not a ``$``-prefixed special event — those
         carry property semantics the columnar form does not model).
-        Returns the number of events written.
+        ``event_times_ms`` gives per-row millisecond timestamps (import
+        round-trips); otherwise every row gets ``event_time`` (default
+        now). Returns the number of events written.
         """
         if event.startswith("$"):
             raise StorageError(
                 f"insert_columns cannot write special event {event!r}"
             )
+        if event_times_ms is not None and len(event_times_ms) != len(values):
+            # validate BEFORE the lazy generator: a short array failing
+            # mid-write would leave a partial import behind
+            raise ValueError("event_times_ms length differs")
         from predictionio_tpu.data.event import DataMap, Event
 
         t = event_time or _dt.datetime.now(_dt.timezone.utc)
+
+        def when(j: int) -> _dt.datetime:
+            if event_times_ms is None:
+                return t
+            return _dt.datetime.fromtimestamp(
+                event_times_ms[j] / 1000.0, _dt.timezone.utc
+            )
+
         self.write(
             (
                 Event(
@@ -229,9 +244,11 @@ class LEvents(abc.ABC):
                     target_entity_type=target_entity_type,
                     target_entity_id=str(g),
                     properties=DataMap({value_property: float(v)}),
-                    event_time=t,
+                    event_time=when(j),
                 )
-                for e, g, v in zip(entity_ids, target_ids, values)
+                for j, (e, g, v) in enumerate(
+                    zip(entity_ids, target_ids, values)
+                )
             ),
             app_id,
             channel_id,
@@ -253,6 +270,7 @@ class LEvents(abc.ABC):
         values,
         value_property: str = "rating",
         event_time: Optional[_dt.datetime] = None,
+        event_times_ms=None,
     ) -> int:
         """``insert_columns`` with pre-factorized id columns (distinct
         name dictionaries + int32 codes) — what travels over the storage
@@ -274,6 +292,7 @@ class LEvents(abc.ABC):
             values=values,
             value_property=value_property,
             event_time=event_time,
+            event_times_ms=event_times_ms,
         )
 
     def find_columns_native(

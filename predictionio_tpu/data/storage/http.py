@@ -322,6 +322,7 @@ class HTTPLEvents(_RemoteDAO, base.LEvents):
         values,
         value_property: str = "rating",
         event_time: Optional[_dt.datetime] = None,
+        event_times_ms=None,
     ) -> int:
         """Bulk import through the gateway: the id columns factorize
         CLIENT-side, so the wire carries each distinct id string once
@@ -348,6 +349,13 @@ class HTTPLEvents(_RemoteDAO, base.LEvents):
                 values=col.array_to_b64(np.asarray(values, np.float32)),
                 value_property=value_property,
                 event_time=wire.opt_dt_to_wire(event_time),
+                event_times_ms=(
+                    None
+                    if event_times_ms is None
+                    else col.array_to_b64(
+                        np.asarray(event_times_ms, np.int64)
+                    )
+                ),
             )
         except StorageError as e:
             if "unknown levents method" not in str(e):
@@ -357,7 +365,7 @@ class HTTPLEvents(_RemoteDAO, base.LEvents):
                 target_entity_type=target_entity_type,
                 entity_ids=entity_ids, target_ids=target_ids,
                 values=values, value_property=value_property,
-                event_time=event_time,
+                event_time=event_time, event_times_ms=event_times_ms,
             )
 
     def find_columns_native(

@@ -114,6 +114,26 @@ class SQLiteLEvents(base.LEvents):
     def __init__(self, client: StorageClient, config=None, namespace: str = ""):
         self._c = client
         self._ns = namespace or "pio"
+        self._pages_schema_ok: set = set()
+
+    def _ensure_pages_schema(self, t: str) -> None:
+        """Migrate page tables created before a column existed (ALTER is
+        additive-only; memoized per table)."""
+        if t in self._pages_schema_ok:
+            return
+        with self._c.lock:
+            if not self._exists(f"{t}_pages"):
+                return  # created fresh (with the full schema) on init
+            cols = {
+                row[1]
+                for row in self._c.execute(
+                    f"PRAGMA table_info({t}_pages)"
+                ).fetchall()
+            }
+            if "dead" not in cols:
+                self._c.execute(f"ALTER TABLE {t}_pages ADD COLUMN dead BLOB")
+                self._c.commit()
+            self._pages_schema_ok.add(t)
 
     def _events_table(self, app_id: int, channel_id: Optional[int]) -> str:
         name = _table_name(self._ns, f"events_{int(app_id)}")
@@ -254,6 +274,7 @@ class SQLiteLEvents(base.LEvents):
     ) -> Optional[Event]:
         import numpy as np
 
+        self._ensure_pages_schema(t)
         with self._c.lock:
             if not self._exists(f"{t}_pages"):
                 return None
@@ -308,6 +329,7 @@ class SQLiteLEvents(base.LEvents):
         delete remove the wrong event. A fully-dead page is dropped."""
         import numpy as np
 
+        self._ensure_pages_schema(t)
         with self._c.lock:
             if not self._exists(f"{t}_pages"):
                 return False
@@ -488,6 +510,7 @@ class SQLiteLEvents(base.LEvents):
         values,
         value_property: str = "rating",
         event_time: Optional[_dt.datetime] = None,
+        event_times_ms=None,
     ) -> int:
         from predictionio_tpu.data.storage.columnar import encode_strings
 
@@ -506,6 +529,7 @@ class SQLiteLEvents(base.LEvents):
             values=values,
             value_property=value_property,
             event_time=event_time,
+            event_times_ms=event_times_ms,
         )
 
     def insert_columns_encoded(
@@ -523,11 +547,14 @@ class SQLiteLEvents(base.LEvents):
         values,
         value_property: str = "rating",
         event_time: Optional[_dt.datetime] = None,
+        event_times_ms=None,
     ) -> int:
         """Vectorized bulk append: dictionary-encode the (pre-factorized)
         id columns and store numpy blobs as pages — 20M events import in
         seconds where the row path takes minutes (the role of the
-        reference's HBase bulk region writes)."""
+        reference's HBase bulk region writes). ``event_times_ms`` keeps
+        per-row timestamps (import round-trips); otherwise every row gets
+        ``event_time`` (default now)."""
         import numpy as np
 
         if event.startswith("$"):
@@ -548,12 +575,18 @@ class SQLiteLEvents(base.LEvents):
             return 0
         e_glob = self._dict_encode(t, entity_names)[e_codes]
         g_glob = self._dict_encode(t, target_names)[g_codes]
-        tms = _ms(event_time or _dt.datetime.now(_dt.timezone.utc))
-        times = np.full(n, tms, np.int64)
+        if event_times_ms is not None:
+            times = np.asarray(event_times_ms, np.int64)
+            if len(times) != n:
+                raise ValueError("event_times_ms length differs")
+        else:
+            tms = _ms(event_time or _dt.datetime.now(_dt.timezone.utc))
+            times = np.full(n, tms, np.int64)
         with self._c.lock:
             for s in range(0, n, self._PAGE_ROWS):
                 e = slice(s, min(s + self._PAGE_ROWS, n))
                 cnt = e.stop - e.start
+                ts = times[e]
                 self._c.conn.execute(
                     f"INSERT INTO {t}_pages (event, entity_type, "
                     "target_entity_type, prop, n, min_ms, max_ms, "
@@ -561,9 +594,9 @@ class SQLiteLEvents(base.LEvents):
                     "VALUES (?,?,?,?,?,?,?,?,?,?,?)",
                     (
                         event, entity_type, target_entity_type,
-                        value_property, cnt, tms, tms,
+                        value_property, cnt, int(ts.min()), int(ts.max()),
                         e_glob[e].tobytes(), g_glob[e].tobytes(),
-                        vals[e].tobytes(), times[e].tobytes(),
+                        vals[e].tobytes(), ts.tobytes(),
                     ),
                 )
             self._c.conn.commit()
@@ -578,6 +611,7 @@ class SQLiteLEvents(base.LEvents):
         IS NULL filter matches none."""
         if target_entity_type is None:  # explicit "no target" filter
             return []
+        self._ensure_pages_schema(t)
         clauses, params = [], []
         if event_names is not None:
             if not event_names:

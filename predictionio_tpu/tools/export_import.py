@@ -84,7 +84,16 @@ def file_to_events(
     with open(path, "rb") as f:
         is_parquet = f.read(4) == b"PAR1"
     if is_parquet:
-        events = _read_parquet(path)
+        _, pq = _require_pyarrow()
+        table = pq.read_table(path)  # read ONCE; both paths share it
+        n = _try_columnar_import(table, storage, app_id, channel_id)
+        if n is not None:
+            logger.info(
+                "imported %d events into app %s (columnar bulk path)",
+                n, app_name,
+            )
+            return n
+        events = _events_from_table(table)
     else:
         events = []
         with open(path) as f:
@@ -101,6 +110,131 @@ def file_to_events(
     storage.get_p_events().write(events, app_id, channel_id)
     logger.info("imported %d events into app %s", len(events), app_name)
     return len(events)
+
+
+def _try_columnar_import(table, storage, app_id, channel_id):
+    """Bulk path for HOMOGENEOUS parquet files: one event name, one
+    entity/target type pair, no tags/prId, millisecond-representable
+    event times, and every property bag exactly ``{"<prop>": <number>}``
+    with a shared key — the shape rating exports have. Routes through
+    LEvents.insert_columns (binary event pages on sqlite; packed columns
+    over the gateway wire) so a 20M-event import takes seconds, not the
+    minutes of the one-Event-object-per-row path. Returns None when the
+    file does not qualify — heterogeneous events, sub-millisecond
+    timestamps (the page store keeps ms; the bulk path must not truncate
+    what the generic reader round-trips), empty/varied property bags, or
+    ANY probing error on a foreign file — and the generic reader runs
+    instead. Checks are vectorized pyarrow compute, so disqualifying a
+    large mixed file is cheap too."""
+    try:
+        return _columnar_import_qualified(table, storage, app_id, channel_id)
+    except Exception as e:
+        # qualification is best-effort over possibly-foreign files: any
+        # unexpected column type / cast error means "does not qualify"
+        logger.debug("columnar import path disqualified: %s", e)
+        return None
+
+
+def _columnar_import_qualified(table, storage, app_id, channel_id):
+    import re as _re
+
+    import numpy as np
+
+    pa, _ = _require_pyarrow()
+    import pyarrow.compute as pc
+
+    n = table.num_rows
+    if n == 0:
+        return None
+    cols = {name: table.column(name) for name in table.column_names}
+    required = {
+        "event", "entityType", "entityId", "targetEntityType",
+        "targetEntityId", "properties", "eventTime",
+    }
+    if not required <= set(cols):
+        return None
+
+    def single_value(name):
+        uniq = pc.unique(cols[name].combine_chunks())
+        if len(uniq) != 1 or not uniq[0].is_valid:
+            return None
+        return uniq[0].as_py()
+
+    event = single_value("event")
+    entity_type = single_value("entityType")
+    target_entity_type = single_value("targetEntityType")
+    if not event or event.startswith("$") or not entity_type:
+        return None
+    if not target_entity_type:
+        return None
+    for name in ("entityId", "targetEntityId", "eventTime"):
+        if pc.sum(pc.cast(pc.is_null(cols[name]), pa.int64())).as_py():
+            return None
+    if "prId" in cols and pc.sum(
+        pc.cast(pc.is_valid(cols["prId"]), pa.int64())
+    ).as_py():
+        return None
+    if "tags" in cols:
+        lens = pc.fill_null(pc.list_value_length(cols["tags"]), 0)
+        if pc.sum(lens).as_py():
+            return None
+
+    # property bags: all exactly {"<key>": <number>} sharing one key.
+    # All-empty bags fall back too — the bulk form would have to invent
+    # a value where the generic reader faithfully stores an empty bag.
+    props = cols["properties"].combine_chunks()
+    first = next((v.as_py() for v in props if v.is_valid), None)
+    if first is None:
+        return None
+    parsed = json.loads(first)
+    if not (
+        isinstance(parsed, dict)
+        and len(parsed) == 1
+        and isinstance(next(iter(parsed.values())), (int, float))
+        and not isinstance(next(iter(parsed.values())), bool)
+    ):
+        return None
+    prop_key = next(iter(parsed))
+    if pc.sum(pc.cast(pc.is_null(props), pa.int64())).as_py():
+        return None  # mixed empty/non-empty bags: fall back
+    pattern = (
+        '^\\{"'
+        + _re.escape(prop_key)
+        + '": (?P<v>-?[0-9]+(?:\\.[0-9]+)?(?:[eE][-+]?[0-9]+)?)\\}$'
+    )
+    extracted = pc.extract_regex(props, pattern)
+    if pc.sum(pc.cast(pc.is_null(extracted), pa.int64())).as_py():
+        return None  # some bag deviates: fall back
+    values = np.asarray(
+        pc.struct_field(extracted, "v").to_numpy(zero_copy_only=False),
+        dtype="U32",
+    ).astype(np.float32)
+
+    times = cols["eventTime"].combine_chunks()
+    if not pa.types.is_timestamp(times.type):
+        return None
+    # safe cast: sub-millisecond timestamps raise -> caught by the
+    # wrapper -> generic path keeps their full precision
+    times_ms = (
+        pc.cast(times, pa.timestamp("ms", tz="UTC"))
+        .cast(pa.int64())
+        .to_numpy(zero_copy_only=False)
+        .astype(np.int64)
+    )
+    entity_ids = cols["entityId"].to_numpy(zero_copy_only=False)
+    target_ids = cols["targetEntityId"].to_numpy(zero_copy_only=False)
+    return storage.get_p_events().insert_columns(
+        app_id,
+        channel_id,
+        event=event,
+        entity_type=entity_type,
+        target_entity_type=target_entity_type,
+        entity_ids=entity_ids,
+        target_ids=target_ids,
+        values=values,
+        value_property=prop_key,
+        event_times_ms=times_ms,
+    )
 
 
 # --- parquet columnar layout ---
@@ -183,10 +317,13 @@ def _write_parquet(path: str, events) -> int:
 
 
 def _read_parquet(path: str) -> List[Event]:
+    _, pq = _require_pyarrow()
+    return _events_from_table(pq.read_table(path))
+
+
+def _events_from_table(table) -> List[Event]:
     import datetime as _dt
 
-    pa, pq = _require_pyarrow()
-    table = pq.read_table(path)
     rows = table.to_pylist()
     events = []
     for row in rows:

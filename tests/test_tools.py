@@ -475,3 +475,81 @@ class TestCLIServingAndEvalKnobs:
         assert cfg.pipeline_depth == 1
         assert cfg.batch_window_ms == 5.0
         assert cfg.max_batch == 64
+
+
+class TestColumnarParquetImport:
+    """Homogeneous rating exports import through the columnar bulk path
+    (LEvents.insert_columns — binary pages on sqlite); heterogeneous
+    files fall back to the generic per-event reader."""
+
+    def _export_ratings(self, mem_storage, tmp_path, n=200):
+        pytest.importorskip("pyarrow")
+        client = CommandClient(mem_storage)
+        d = client.app_new("colsrc")
+        events = mem_storage.get_l_events()
+        t0 = dt.datetime(2026, 1, 1, tzinfo=dt.timezone.utc)
+        for k in range(n):
+            events.insert(
+                Event(
+                    event="rate", entity_type="user", entity_id=f"u{k % 23}",
+                    target_entity_type="item", target_entity_id=f"i{k % 17}",
+                    properties=DataMap({"rating": (k % 9) * 0.5 + 0.5}),
+                    event_time=t0 + dt.timedelta(minutes=k),
+                ),
+                d.app.id,
+            )
+        path = tmp_path / "ratings.parquet"
+        assert events_to_file(
+            "colsrc", str(path), storage=mem_storage, format="parquet"
+        ) == n
+        return path, t0
+
+    def test_homogeneous_file_uses_bulk_path(self, mem_storage, tmp_path):
+        from tests.test_storage import sqlite_storage
+
+        path, t0 = self._export_ratings(mem_storage, tmp_path)
+        dest = sqlite_storage(tmp_path)
+        CommandClient(dest).app_new("coldst")
+        assert file_to_events("coldst", str(path), storage=dest) == 200
+        app_id = dest.get_meta_data_apps().get_by_name("coldst").id
+        le = dest.get_l_events()
+        # landed as PAGES, not 200 row inserts
+        pages = le._c.execute(
+            f"SELECT COUNT(*), SUM(n) FROM {le._events_table(app_id, None)}_pages"
+        ).fetchone()
+        assert pages == (1, 200)
+        # per-row event times round-tripped (ms precision)
+        got = sorted(
+            le.find(app_id=app_id, entity_id="u5"),
+            key=lambda e: e.event_time,
+        )
+        assert got[0].event_time == t0 + dt.timedelta(minutes=5)
+        assert got[0].properties["rating"] == pytest.approx(3.0)
+        # and the training scan sees everything
+        assert le.find_columns_native(app_id).n == 200
+
+    def test_heterogeneous_file_falls_back(self, mem_storage, tmp_path):
+        pytest.importorskip("pyarrow")
+        client = CommandClient(mem_storage)
+        d = client.app_new("hetsrc")
+        events = mem_storage.get_l_events()
+        t = dt.datetime(2026, 1, 1, tzinfo=dt.timezone.utc)
+        events.insert(
+            Event(event="rate", entity_type="user", entity_id="u1",
+                  target_entity_type="item", target_entity_id="i1",
+                  properties=DataMap({"rating": 4.0}), event_time=t),
+            d.app.id,
+        )
+        events.insert(  # $set + rich properties disqualify the bulk path
+            Event(event="$set", entity_type="user", entity_id="u2",
+                  properties=DataMap({"x": {"nested": True}}), event_time=t),
+            d.app.id,
+        )
+        path = tmp_path / "mixed.parquet"
+        events_to_file("hetsrc", str(path), storage=mem_storage, format="parquet")
+        client.app_new("hetdst")
+        assert file_to_events("hetdst", str(path), storage=mem_storage) == 2
+        app_id = mem_storage.get_meta_data_apps().get_by_name("hetdst").id
+        got = {e.entity_id: e for e in mem_storage.get_l_events().find(app_id=app_id)}
+        assert got["u2"].properties["x"] == {"nested": True}
+        assert got["u1"].properties["rating"] == 4.0
