@@ -1,4 +1,4 @@
-"""Benchmarks for all five BASELINE.json configs.
+"""Benchmarks for the BASELINE.json configs plus the scale/serving tiers.
 
 Prints ONE JSON line per config, headline first:
 
@@ -14,18 +14,27 @@ Prints ONE JSON line per config, headline first:
      predict_device_compute_ms  amortized per-call device time of the
                           serving op (chained on-device loop; cancels the
                           relay round trip that even block_until_ready pays)
-     predict_p50_ms       p50 including the device->host result fetch —
-                          on this rig that is one loopback-relay round
-                          trip (~65-120 ms), not compute
-     rest_p50_ms/p99      end-to-end POST /queries.json through the
+     predict_p50_ms       p50 including the device->host result fetch
+     relay_rtt_p50_ms     the bare dispatch+fetch round trip this rig
+                          charges ANY result-returning call (measured
+                          interleaved with the predict loop)
+     predict_p50_ms_minus_rtt  true device+host serving cost beyond the
+                          single documented round trip (<10 ms north star)
+     rest_p50_ms/p99/qps  end-to-end POST /queries.json through the
                           EngineServer micro-batching executor under 32
                           concurrent clients (includes the relay fetch)
-     rest_qps             aggregate throughput during that run
 2. nb_classification_train_wall_clock — NaiveBayes over user properties.
 3. similarproduct_train_wall_clock — implicit ALS + cosine top-N.
 4. ecommerce_train_wall_clock — explicit ALS + predict-time rules.
 5. kfold_cv_eval_wall_clock — MetricEvaluator grid (2 ranks x 2 regs,
    3 folds) through CoreWorkflow.run_evaluation.
+6. als_ml20m_train_wall_clock — north-star scale (138k x 27k x 20M,
+   rank 32), phase-split with a measured memory-bound roofline (see
+   bench_ml20m).
+7. als_ml20m_store_to_model_wall_clock — the flagship flow THROUGH the
+   event store: bulk import -> columnar scan -> train.
+8. eventserver_ingest_events_per_sec — Event Server write-path
+   throughput under concurrent clients.
 
 vs_baseline divides a conservative Spark-1.3-local wall-clock estimate for
 the same config by the measured time (the reference publishes no numbers,

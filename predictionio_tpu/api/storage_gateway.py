@@ -185,7 +185,7 @@ class StorageGatewayCore:
             return {
                 k: wire.property_map_to_wire(v) for k, v in out.items()
             }
-        if method == "insert_columns":
+        if method in ("insert_columns", "insert_columns_v2"):
             # bulk columnar import: dictionaries as JSON strings, codes
             # and values as packed base64 (data/storage/columnar.py)
             from predictionio_tpu.data.storage import columnar as col

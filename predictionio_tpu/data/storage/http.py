@@ -334,9 +334,19 @@ class HTTPLEvents(_RemoteDAO, base.LEvents):
 
         e_names, e_codes = col.encode_strings(entity_ids)
         g_names, g_codes = col.encode_strings(target_ids)
+        # per-row timestamps use a VERSIONED method name: a gateway
+        # predating the field would otherwise accept "insert_columns",
+        # ignore the unknown argument, and silently stamp every row with
+        # its own clock — corrupting every time-windowed scan. An old
+        # gateway rejects the v2 name and the client falls back to the
+        # batched row write, which preserves per-event times.
+        method = (
+            "insert_columns" if event_times_ms is None
+            else "insert_columns_v2"
+        )
         try:
             return self._call(
-                "insert_columns",
+                method,
                 app_id=app_id,
                 channel_id=channel_id,
                 event=event,

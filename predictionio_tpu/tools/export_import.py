@@ -114,9 +114,12 @@ def file_to_events(
 
 def _try_columnar_import(table, storage, app_id, channel_id):
     """Bulk path for HOMOGENEOUS parquet files: one event name, one
-    entity/target type pair, no tags/prId, millisecond-representable
-    event times, and every property bag exactly ``{"<prop>": <number>}``
-    with a shared key — the shape rating exports have. Routes through
+    entity/target type pair, no tags/prId, event ids absent or
+    page-synthetic (real ids must be preserved, and only the generic
+    reader's keyed inserts stay idempotent across re-imports),
+    millisecond-representable event times, and every property bag
+    exactly ``{"<prop>": <number>}`` with a shared key — the shape
+    bulk-rating exports have. Routes through
     LEvents.insert_columns (binary event pages on sqlite; packed columns
     over the gateway wire) so a 20M-event import takes seconds, not the
     minutes of the one-Event-object-per-row path. Returns None when the
@@ -127,15 +130,21 @@ def _try_columnar_import(table, storage, app_id, channel_id):
     instead. Checks are vectorized pyarrow compute, so disqualifying a
     large mixed file is cheap too."""
     try:
-        return _columnar_import_qualified(table, storage, app_id, channel_id)
+        prepared = _columnar_import_qualify(table)
     except Exception as e:
         # qualification is best-effort over possibly-foreign files: any
-        # unexpected column type / cast error means "does not qualify"
+        # unexpected column type / cast error means "does not qualify".
+        # The WRITE below stays outside this net: a failed/ambiguous bulk
+        # write must surface, not silently fall through to the generic
+        # reader and double-import whatever already landed.
         logger.debug("columnar import path disqualified: %s", e)
         return None
+    if prepared is None:
+        return None
+    return storage.get_p_events().insert_columns(app_id, channel_id, **prepared)
 
 
-def _columnar_import_qualified(table, storage, app_id, channel_id):
+def _columnar_import_qualify(table):
     import re as _re
 
     import numpy as np
@@ -170,6 +179,19 @@ def _columnar_import_qualified(table, storage, app_id, channel_id):
     for name in ("entityId", "targetEntityId", "eventTime"):
         if pc.sum(pc.cast(pc.is_null(cols[name]), pa.int64())).as_py():
             return None
+    # event ids must be absent or page-synthetic ("pg-<page>-<idx>" —
+    # source-local positional handles with no meaning in another store).
+    # Files carrying REAL event ids take the generic path, which
+    # preserves them and stays idempotent across re-imports (INSERT OR
+    # REPLACE keyed on id); the bulk path is append-only.
+    if "eventId" in cols:
+        ids = cols["eventId"].combine_chunks()
+        n_real = pc.sum(pc.cast(pc.is_valid(ids), pa.int64())).as_py() or 0
+        if n_real:
+            synthetic = pc.match_substring_regex(ids, "^pg-[0-9]+-[0-9]+$")
+            ok = pc.sum(pc.cast(synthetic, pa.int64())).as_py() or 0
+            if ok != n_real:
+                return None
     if "prId" in cols and pc.sum(
         pc.cast(pc.is_valid(cols["prId"]), pa.int64())
     ).as_py():
@@ -221,16 +243,12 @@ def _columnar_import_qualified(table, storage, app_id, channel_id):
         .to_numpy(zero_copy_only=False)
         .astype(np.int64)
     )
-    entity_ids = cols["entityId"].to_numpy(zero_copy_only=False)
-    target_ids = cols["targetEntityId"].to_numpy(zero_copy_only=False)
-    return storage.get_p_events().insert_columns(
-        app_id,
-        channel_id,
+    return dict(
         event=event,
         entity_type=entity_type,
         target_entity_type=target_entity_type,
-        entity_ids=entity_ids,
-        target_ids=target_ids,
+        entity_ids=cols["entityId"].to_numpy(zero_copy_only=False),
+        target_ids=cols["targetEntityId"].to_numpy(zero_copy_only=False),
         values=values,
         value_property=prop_key,
         event_times_ms=times_ms,

@@ -202,6 +202,48 @@ class TestSqlitePages:
         got = list(le.find(app_id=1, target_entity_id="y"))
         assert len(got) == 1 and got[0].entity_id == "u2"
 
+    def test_channel_scoped_pages(self, sq):
+        """Pages live per (app, channel) table like row events — a
+        channel's bulk import is invisible to the default channel."""
+        _, le = sq
+        le.init(1, 7)
+        le.insert_columns(
+            1, 7, event="rate", entity_type="user",
+            target_entity_type="item", entity_ids=["ca"],
+            target_ids=["cx"], values=[2.0],
+        )
+        assert le.find_columns_native(1, 7).n == 1
+        assert le.find_columns_native(1).n == 0
+        assert [e.entity_id for e in le.find(app_id=1, channel_id=7)] == ["ca"]
+        assert list(le.find(app_id=1)) == []
+
+    def test_per_row_event_times(self, sq):
+        """insert_columns with event_times_ms keeps per-row timestamps
+        (imports round-trip; time filters work inside one page)."""
+        _, le = sq
+        base_ms = 1_700_000_000_000
+        le.insert_columns(
+            1, event="rate", entity_type="user", target_entity_type="item",
+            entity_ids=["a", "b", "c"], target_ids=["x", "y", "z"],
+            values=[1.0, 2.0, 3.0],
+            event_times_ms=[base_ms, base_ms + 60_000, base_ms + 120_000],
+        )
+        cut = dt.datetime.fromtimestamp(
+            (base_ms + 30_000) / 1000.0, dt.timezone.utc
+        )
+        assert _triples(le.find_columns_native(1, until_time=cut)) == {
+            ("a", "x"): [1.0]
+        }
+        got = sorted(le.find(app_id=1), key=lambda e: e.event_time)
+        assert [e.entity_id for e in got] == ["a", "b", "c"]
+        assert int(got[1].event_time.timestamp() * 1000) == base_ms + 60_000
+        with pytest.raises(ValueError, match="length"):
+            le.insert_columns(
+                1, event="rate", entity_type="user",
+                target_entity_type="item", entity_ids=["d"],
+                target_ids=["w"], values=[1.0], event_times_ms=[1, 2],
+            )
+
     def test_special_events_rejected(self, sq):
         _, le = sq
         with pytest.raises(StorageError, match="special event"):

@@ -482,32 +482,37 @@ class TestColumnarParquetImport:
     (LEvents.insert_columns — binary pages on sqlite); heterogeneous
     files fall back to the generic per-event reader."""
 
-    def _export_ratings(self, mem_storage, tmp_path, n=200):
+    def _export_bulk_ratings(self, tmp_path, n=200):
+        """Source data in a sqlite PAGE store (synthetic pg-* event ids —
+        the shape whose exports qualify for bulk re-import)."""
+        import numpy as np
+
+        from tests.test_storage import sqlite_storage
+
         pytest.importorskip("pyarrow")
-        client = CommandClient(mem_storage)
-        d = client.app_new("colsrc")
-        events = mem_storage.get_l_events()
+        src = sqlite_storage(tmp_path / "src")
+        CommandClient(src).app_new("colsrc")
+        app_id = src.get_meta_data_apps().get_by_name("colsrc").id
         t0 = dt.datetime(2026, 1, 1, tzinfo=dt.timezone.utc)
-        for k in range(n):
-            events.insert(
-                Event(
-                    event="rate", entity_type="user", entity_id=f"u{k % 23}",
-                    target_entity_type="item", target_entity_id=f"i{k % 17}",
-                    properties=DataMap({"rating": (k % 9) * 0.5 + 0.5}),
-                    event_time=t0 + dt.timedelta(minutes=k),
-                ),
-                d.app.id,
-            )
+        base_ms = int(t0.timestamp() * 1000)
+        src.get_l_events().insert_columns(
+            app_id, event="rate", entity_type="user",
+            target_entity_type="item",
+            entity_ids=[f"u{k % 23}" for k in range(n)],
+            target_ids=[f"i{k % 17}" for k in range(n)],
+            values=np.asarray([(k % 9) * 0.5 + 0.5 for k in range(n)]),
+            event_times_ms=[base_ms + 60_000 * k for k in range(n)],
+        )
         path = tmp_path / "ratings.parquet"
         assert events_to_file(
-            "colsrc", str(path), storage=mem_storage, format="parquet"
+            "colsrc", str(path), storage=src, format="parquet"
         ) == n
         return path, t0
 
-    def test_homogeneous_file_uses_bulk_path(self, mem_storage, tmp_path):
+    def test_homogeneous_file_uses_bulk_path(self, tmp_path):
         from tests.test_storage import sqlite_storage
 
-        path, t0 = self._export_ratings(mem_storage, tmp_path)
+        path, t0 = self._export_bulk_ratings(tmp_path)
         dest = sqlite_storage(tmp_path)
         CommandClient(dest).app_new("coldst")
         assert file_to_events("coldst", str(path), storage=dest) == 200
@@ -527,6 +532,45 @@ class TestColumnarParquetImport:
         assert got[0].properties["rating"] == pytest.approx(3.0)
         # and the training scan sees everything
         assert le.find_columns_native(app_id).n == 200
+
+    def test_real_event_ids_take_generic_idempotent_path(
+        self, mem_storage, tmp_path
+    ):
+        """Files carrying REAL (non-synthetic) event ids must go through
+        the generic reader: it preserves the ids and re-imports stay
+        idempotent (INSERT OR REPLACE), where the bulk page path is
+        append-only."""
+        from tests.test_storage import sqlite_storage
+
+        pytest.importorskip("pyarrow")
+        client = CommandClient(mem_storage)
+        d = client.app_new("uuidsrc")
+        events = mem_storage.get_l_events()
+        t = dt.datetime(2026, 1, 1, tzinfo=dt.timezone.utc)
+        for k in range(5):
+            events.insert(
+                Event(
+                    event="rate", entity_type="user", entity_id=f"u{k}",
+                    target_entity_type="item", target_entity_id=f"i{k}",
+                    properties=DataMap({"rating": float(k + 1)}),
+                    event_time=t,
+                ),
+                d.app.id,
+            )
+        path = tmp_path / "uuid.parquet"
+        events_to_file("uuidsrc", str(path), storage=mem_storage, format="parquet")
+        dest = sqlite_storage(tmp_path)
+        CommandClient(dest).app_new("uuiddst")
+        assert file_to_events("uuiddst", str(path), storage=dest) == 5
+        assert file_to_events("uuiddst", str(path), storage=dest) == 5
+        app_id = dest.get_meta_data_apps().get_by_name("uuiddst").id
+        le = dest.get_l_events()
+        # idempotent: still 5 events, no pages
+        assert len(list(le.find(app_id=app_id))) == 5
+        pages = le._c.execute(
+            f"SELECT COUNT(*) FROM {le._events_table(app_id, None)}_pages"
+        ).fetchone()
+        assert pages == (0,)
 
     def test_heterogeneous_file_falls_back(self, mem_storage, tmp_path):
         pytest.importorskip("pyarrow")
