@@ -255,9 +255,18 @@ def bench_recommendation(device_name):
     )
 
 
-def bench_rest_serving(u, i, r):
-    """End-to-end POST /queries.json p50/p99 under 32 concurrent clients
-    through the micro-batching executor (api/engine_server.py)."""
+def bench_rest_serving(u, i, r, pipeline_depth=4, clients=32, n_requests=12):
+    """End-to-end POST /queries.json p50/p99 under concurrent clients
+    through the micro-batching executor (api/engine_server.py).
+
+    Throughput here is pipeline-shaped: every batch costs one relay
+    round trip (~90-120 ms on this rig), so qps ~= clients / latency
+    with latency ~= RTT + queue wait. Depth 4 keeps four batches in
+    flight, which hides most of the queue wait; it is the documented
+    opt-in for pure engines like the packaged templates. Measured sweep
+    on this rig (see docs/PERF.md): depth 2/32 clients = 142 qps
+    (p50 213 ms); depth 4/32 = 220 qps (p50 133 ms); depth 8/64
+    clients = 475 qps (p50 121 ms, p99 164 ms)."""
     from predictionio_tpu.api.engine_server import EngineServer, ServerConfig
     from predictionio_tpu.data import storage as storage_mod
     from predictionio_tpu.data.event import DataMap, Event
@@ -302,12 +311,12 @@ def bench_rest_serving(u, i, r):
         ),
         ctx=WorkflowContext(mode="training", storage=storage),
     )
-    # pipeline_depth=2 is the documented opt-in for pure engines (the
-    # packaged templates): overlaps batch k+1's dispatch with batch k's
-    # result fetch. The default is 1 (reference-parity serial serving).
+    # pipeline_depth > 1 is the documented opt-in for pure engines (the
+    # packaged templates): overlaps batch dispatches with result
+    # fetches. The default is 1 (reference-parity serial serving).
     server = EngineServer(
         recommendation_engine(),
-        ServerConfig(port=0, pipeline_depth=2),
+        ServerConfig(port=0, pipeline_depth=pipeline_depth),
         storage=storage,
     ).start()
     try:
@@ -325,13 +334,13 @@ def bench_rest_serving(u, i, r):
             assert resp.status == 200, resp.status
             return (time.perf_counter() - t0) * 1000
 
-        def client(worker, n_requests=12):
+        def client(worker, n=n_requests):
             # one persistent HTTP/1.1 connection per client
             conn = http.client.HTTPConnection("localhost", server.port)
             try:
                 return [
                     one_request(conn, (worker * 31 + j) % N_USERS)
-                    for j in range(n_requests)
+                    for j in range(n)
                 ]
             finally:
                 conn.close()
@@ -339,15 +348,18 @@ def bench_rest_serving(u, i, r):
         client(0, 2)  # warm the serving path
         lat = []
         t0 = time.perf_counter()
-        with concurrent.futures.ThreadPoolExecutor(max_workers=32) as pool:
-            for chunk in pool.map(client, range(32)):
+        with concurrent.futures.ThreadPoolExecutor(
+            max_workers=clients
+        ) as pool:
+            for chunk in pool.map(client, range(clients)):
                 lat.extend(chunk)
         wall = time.perf_counter() - t0
         return {
             "rest_p50_ms": round(pctl(lat, 50), 2),
             "rest_p99_ms": round(pctl(lat, 99), 2),
             "rest_qps": round(len(lat) / wall, 1),
-            "rest_clients": 32,
+            "rest_clients": clients,
+            "rest_pipeline_depth": pipeline_depth,
         }
     finally:
         server.shutdown()

@@ -297,6 +297,65 @@ class TestTransport:
         assert props["u1"]["a"] == 1
         assert le.aggregate_properties_of_entity(1, "user", "u1")["a"] == 1
 
+    def test_insert_columns_v2_per_row_times_roundtrip(self, gateway):
+        """Per-row timestamps cross the wire as packed int64 b64 under
+        the VERSIONED method name and come back intact on scans."""
+        import datetime as dt
+
+        s = Storage(gw_config(gateway.port))
+        le = s.get_l_events()
+        le.init(1)
+        base_ms = 1_700_000_000_000
+        wrote = le.insert_columns(
+            1, event="rate", entity_type="user", target_entity_type="item",
+            entity_ids=["a", "b"], target_ids=["x", "y"],
+            values=[1.0, 2.0],
+            event_times_ms=[base_ms, base_ms + 60_000],
+        )
+        assert wrote == 2
+        got = sorted(le.find(app_id=1), key=lambda e: e.event_time)
+        assert [
+            int(e.event_time.timestamp() * 1000) for e in got
+        ] == [base_ms, base_ms + 60_000]
+        cut = dt.datetime.fromtimestamp(
+            (base_ms + 30_000) / 1000.0, dt.timezone.utc
+        )
+        early = list(le.find(app_id=1, until_time=cut))
+        assert [e.entity_id for e in early] == ["a"]
+
+    def test_insert_columns_v2_falls_back_against_old_gateway(
+        self, gateway, monkeypatch
+    ):
+        """A gateway predating insert_columns_v2 rejects the method; the
+        client must fall back to the batched ROW write — which preserves
+        the per-row timestamps — never silently dropping them."""
+        s = Storage(gw_config(gateway.port))
+        le = s.get_l_events()
+        le.init(1)
+        real_call = gateway.core.call
+
+        def old_gateway(dao, method, args):
+            if method == "insert_columns_v2":
+                raise KeyError(f"unknown levents method {method!r}")
+            return real_call(dao, method, args)
+
+        monkeypatch.setattr(gateway.core, "call", old_gateway)
+        base_ms = 1_700_000_000_000
+        wrote = le.insert_columns(
+            1, event="rate", entity_type="user", target_entity_type="item",
+            entity_ids=["fa", "fb"], target_ids=["x", "y"],
+            values=[3.0, 4.0],
+            event_times_ms=[base_ms, base_ms + 1000],
+        )
+        assert wrote == 2
+        got = sorted(le.find(app_id=1), key=lambda e: e.entity_id)
+        assert [e.entity_id for e in got] == ["fa", "fb"]
+        # timestamps survived the fallback path
+        assert [
+            int(e.event_time.timestamp() * 1000) for e in got
+        ] == [base_ms, base_ms + 1000]
+        assert got[0].properties["rating"] == 3.0
+
     def test_status_route(self, gateway):
         import json
         import urllib.request
