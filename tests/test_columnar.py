@@ -437,3 +437,46 @@ def _triples_ec(cols):
             round(float(v), 4)
         )
     return {k: sorted(v) for k, v in out.items()}
+
+
+class TestEncodeStrings:
+    """The packed-uint64 fast tier must agree exactly with the generic
+    np.unique tier (names order AND codes) — PEventStore's BiMap parity
+    depends on sorted-name order being identical."""
+
+    def _slow(self, ids):
+        arr = np.asarray(ids)
+        if arr.dtype.kind not in ("U", "S"):
+            arr = np.asarray([str(x) for x in ids], dtype="U")
+        names, codes = np.unique(arr, return_inverse=True)
+        return names, codes.astype(np.int32)
+
+    @pytest.mark.parametrize(
+        "ids",
+        [
+            ["u1", "u10", "u2", "u1", ""],
+            ["x"] * 5,
+            [f"u{j}" for j in range(1000)],
+            ["exactly8", "exactly8", "short"],
+            ["ninechars", "sorts", "after"],  # itemsize > 8 -> slow tier
+            ["ümlaut", "ascii"],  # non-ASCII -> slow tier
+            [],
+        ],
+    )
+    def test_parity_with_generic_tier(self, ids):
+        from predictionio_tpu.data.storage.columnar import encode_strings
+
+        n1, c1 = self._slow(ids)
+        n2, c2 = encode_strings(ids)
+        assert [str(x) for x in n1] == [str(x) for x in n2]
+        assert np.array_equal(c1, c2)
+
+    def test_random_bulk_parity(self):
+        from predictionio_tpu.data.storage.columnar import encode_strings
+
+        rng = np.random.default_rng(1)
+        ids = np.char.add("u", rng.integers(0, 5000, 50_000).astype("U5"))
+        n1, c1 = self._slow(ids)
+        n2, c2 = encode_strings(ids)
+        assert np.array_equal(n1.astype("U8"), n2.astype("U8"))
+        assert np.array_equal(c1, c2)
