@@ -720,6 +720,84 @@ class SQLiteLEvents(base.LEvents):
                 )
         return out
 
+    def iter_row_events(
+        self, app_id: int, channel_id: Optional[int] = None
+    ) -> Iterator[Event]:
+        """Row-store events ONLY (no page merge) — the export path pairs
+        this with iter_export_pages so neither side is double-counted."""
+        t = self._events_table(app_id, channel_id)
+        with self._c.lock:
+            if not self._exists(t):
+                raise StorageError(f"events table {t} not initialized")
+            rows = self._c.execute(
+                f"SELECT * FROM {t} ORDER BY event_time_ms ASC"
+            ).fetchall()
+        return (self._row_to_event(r) for r in rows)
+
+    def iter_export_pages(
+        self, app_id: int, channel_id: Optional[int] = None
+    ) -> Iterator[dict]:
+        """Bulk-export view of the page store: one dict of decoded numpy
+        columns per page (live rows only), for vectorized writers —
+        exporting 20M events must not build 20M Event objects any more
+        than importing them does. Keys: event, entity_type,
+        target_entity_type, prop, event_ids, entity_ids, target_ids,
+        values, times_ms."""
+        import numpy as np
+
+        t = self._events_table(app_id, channel_id)
+        with self._c.lock:
+            if not self._exists(t):
+                raise StorageError(f"events table {t} not initialized")
+        self._ensure_pages_schema(t)
+        with self._c.lock:
+            if not self._exists(f"{t}_pages"):
+                return
+            page_ids = [
+                r[0]
+                for r in self._c.execute(
+                    f"SELECT page FROM {t}_pages ORDER BY page"
+                ).fetchall()
+            ]
+        if not page_ids:
+            return
+        names = self._dict_names(t)
+        for page_id in page_ids:
+            # one page's blobs at a time: peak memory is one page and
+            # the connection lock releases between pages
+            row = self._c.execute(
+                f"SELECT page, event, entity_type, target_entity_type, "
+                f"prop, n, entities, targets, vals, times, dead "
+                f"FROM {t}_pages WHERE page=?",
+                (page_id,),
+            ).fetchone()
+            if row is None:
+                continue  # deleted since listing
+            (page, ev, et, tet, prop, n, eb, gb, vb, tb, db) = row
+            alive = (
+                np.nonzero(np.frombuffer(db, np.uint8) == 0)[0]
+                if db is not None
+                else np.arange(n)
+            )
+            if not len(alive):
+                continue
+            # positional ids stay stable across tombstones: the index in
+            # the id is the ORIGINAL slot, not the live rank
+            event_ids = np.char.add(
+                f"pg-{page}-", alive.astype("U10")
+            ).astype(object)
+            yield {
+                "event": ev,
+                "entity_type": et,
+                "target_entity_type": tet,
+                "prop": prop,
+                "event_ids": event_ids,
+                "entity_ids": names[np.frombuffer(eb, np.int32)[alive]],
+                "target_ids": names[np.frombuffer(gb, np.int32)[alive]],
+                "values": np.frombuffer(vb, np.float32)[alive],
+                "times_ms": np.frombuffer(tb, np.int64)[alive],
+            }
+
     def find_columns_native(
         self,
         app_id: int,

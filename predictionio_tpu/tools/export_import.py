@@ -54,9 +54,23 @@ def events_to_file(
         raise ValueError(f"unknown export format {format!r}; pick {FORMATS}")
     storage = storage or get_storage()
     app_id, channel_id = app_name_to_id(app_name, channel_name, storage)
-    events_iter = storage.get_p_events().find(
-        app_id=app_id, channel_id=channel_id
-    )
+    le = storage.get_p_events()
+    if format == "parquet" and hasattr(le, "iter_export_pages"):
+        # split export: row-store events through the generic batch
+        # writer, bulk pages as vectorized column batches (exporting 20M
+        # events must not build 20M Event objects any more than
+        # importing them does)
+        n = _write_parquet(
+            path,
+            le.iter_row_events(app_id, channel_id),
+            page_columns=le.iter_export_pages(app_id, channel_id),
+        )
+        logger.info(
+            "exported %d events of app %s to %s (parquet, columnar pages)",
+            n, app_name, path,
+        )
+        return n
+    events_iter = le.find(app_id=app_id, channel_id=channel_id)
     if format == "parquet":
         n = _write_parquet(path, events_iter)
     else:
@@ -84,16 +98,30 @@ def file_to_events(
     with open(path, "rb") as f:
         is_parquet = f.read(4) == b"PAR1"
     if is_parquet:
+        # qualify and import PER ROW GROUP: the split exporter writes
+        # row events and each bulk page as separate groups, so a mixed
+        # file's homogeneous page groups still take the bulk path while
+        # only the heterogeneous groups fall back to per-event reads —
+        # and peak memory is one group, not the file
         _, pq = _require_pyarrow()
-        table = pq.read_table(path)  # read ONCE; both paths share it
-        n = _try_columnar_import(table, storage, app_id, channel_id)
-        if n is not None:
-            logger.info(
-                "imported %d events into app %s (columnar bulk path)",
-                n, app_name,
-            )
-            return n
-        events = _events_from_table(table)
+        pf = pq.ParquetFile(path)
+        total = bulk = 0
+        le = storage.get_p_events()
+        for g in range(pf.num_row_groups):
+            table = pf.read_row_group(g)
+            n = _try_columnar_import(table, storage, app_id, channel_id)
+            if n is None:
+                group_events = _events_from_table(table)
+                le.write(group_events, app_id, channel_id)
+                n = len(group_events)
+            else:
+                bulk += n
+            total += n
+        logger.info(
+            "imported %d events into app %s (%d via the columnar bulk "
+            "path)", total, app_name, bulk,
+        )
+        return total
     else:
         events = []
         with open(path) as f:
@@ -272,9 +300,57 @@ _PARQUET_STRING_COLS = (
 _PARQUET_BATCH_ROWS = 65_536
 
 
-def _write_parquet(path: str, events) -> int:
+def _page_columns_to_table(pa, schema, ts, page: dict):
+    """One bulk page -> one pyarrow table, all columns vectorized.
+
+    Values render as %.9g (round-trips float32 exactly) inside the
+    single-key JSON shape the columnar importer recognizes, so a page
+    export re-imports through the bulk path byte-faithfully."""
+    import numpy as np
+
+    n = len(page["values"])
+    const = lambda v: pa.array([v] * n, type=pa.string())  # noqa: E731
+    values = page["values"]
+    vals_str = np.char.mod("%.9g", values)
+    bad = np.nonzero(~np.isfinite(values))[0]
+    for j in bad:  # rare: render the tokens json.loads accepts
+        v = float(values[j])
+        vals_str[j] = (
+            "NaN" if v != v else ("Infinity" if v > 0 else "-Infinity")
+        )
+    # the key goes through json.dumps so quotes/backslashes/control
+    # chars escape correctly
+    props = np.char.add(
+        np.char.add("{%s: " % json.dumps(page["prop"]), vals_str), "}"
+    )
+    times = pa.array(page["times_ms"] * 1000, type=pa.int64()).cast(ts)
+    cols = {
+        "eventId": pa.array(page["event_ids"], type=pa.string()),
+        "event": const(page["event"]),
+        "entityType": const(page["entity_type"]),
+        # pyarrow converts numpy str arrays directly (no per-element
+        # Python round trip); np.str_ is a str subclass
+        "entityId": pa.array(
+            np.asarray(page["entity_ids"], object), type=pa.string()
+        ),
+        "targetEntityType": const(page["target_entity_type"]),
+        "targetEntityId": pa.array(
+            np.asarray(page["target_ids"], object), type=pa.string()
+        ),
+        "prId": pa.array([None] * n, type=pa.string()),
+        "properties": pa.array(props.tolist(), type=pa.string()),
+        "tags": pa.array([[]] * n, type=pa.list_(pa.string())),
+        "eventTime": times,
+        "creationTime": times,
+    }
+    return pa.table(cols, schema=schema)
+
+
+def _write_parquet(path: str, events, page_columns=None) -> int:
     """Streams row-group batches through a ParquetWriter — like the JSON
-    path, peak memory is one batch, not the whole event history."""
+    path, peak memory is one batch, not the whole event history.
+    ``page_columns`` (bulk pages as decoded numpy columns) append as
+    vectorized tables after the row events."""
     import itertools
 
     pa, pq = _require_pyarrow()
@@ -331,6 +407,12 @@ def _write_parquet(path: str, events) -> int:
             n += len(batch)
             if len(batch) < _PARQUET_BATCH_ROWS:
                 break
+        if page_columns is not None:
+            for page in page_columns:
+                writer.write_table(
+                    _page_columns_to_table(pa, schema, ts, page)
+                )
+                n += len(page["values"])
     return n
 
 

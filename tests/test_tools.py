@@ -597,3 +597,94 @@ class TestColumnarParquetImport:
         got = {e.entity_id: e for e in mem_storage.get_l_events().find(app_id=app_id)}
         assert got["u2"].properties["x"] == {"nested": True}
         assert got["u1"].properties["rating"] == 4.0
+
+
+class TestColumnarParquetExport:
+    """Exports from a sqlite page store stream pages as vectorized
+    column batches (no per-event Python objects) and round-trip through
+    the bulk import path value-exactly."""
+
+    def test_pages_and_rows_export_and_roundtrip(self, tmp_path):
+        import numpy as np
+
+        from tests.test_storage import sqlite_storage
+
+        pytest.importorskip("pyarrow")
+        src = sqlite_storage(tmp_path / "src")
+        CommandClient(src).app_new("pexp")
+        app_id = src.get_meta_data_apps().get_by_name("pexp").id
+        le = src.get_l_events()
+        # awkward f32 values: %.9g must round-trip binary32 exactly
+        vals = np.array([0.1, 1 / 3, 1e-7, 123456.78, 4.5], np.float32)
+        base_ms = 1_700_000_000_000
+        le.insert_columns(
+            app_id, event="rate", entity_type="user",
+            target_entity_type="item",
+            entity_ids=[f"u{j}" for j in range(5)],
+            target_ids=[f"i{j}" for j in range(5)],
+            values=vals,
+            event_times_ms=[base_ms + 1000 * j for j in range(5)],
+        )
+        # a tombstoned row must NOT export
+        dead = next(
+            e.event_id for e in le.find(app_id=app_id)
+            if e.entity_id == "u2"
+        )
+        le.delete(dead, app_id)
+        # plus one row-store event
+        le.insert(
+            Event(
+                event="rate", entity_type="user", entity_id="rowu",
+                target_entity_type="item", target_entity_id="rowi",
+                properties=DataMap({"rating": 2.5}),
+            ),
+            app_id,
+        )
+        path = tmp_path / "pexp.parquet"
+        assert events_to_file(
+            "pexp", str(path), storage=src, format="parquet"
+        ) == 5  # 4 live page rows + 1 row event
+
+        # page part re-imports; values byte-exact
+        dest = sqlite_storage(tmp_path / "dst")
+        CommandClient(dest).app_new("pimp")
+        assert file_to_events("pimp", str(path), storage=dest) == 5
+        dst_id = dest.get_meta_data_apps().get_by_name("pimp").id
+        got = {
+            e.entity_id: e for e in dest.get_l_events().find(app_id=dst_id)
+        }
+        assert set(got) == {"u0", "u1", "u3", "u4", "rowu"}
+        for j in (0, 1, 3, 4):
+            assert np.float32(got[f"u{j}"].properties["rating"]) == vals[j]
+            assert (
+                int(got[f"u{j}"].event_time.timestamp() * 1000)
+                == base_ms + 1000 * j
+            )
+        assert got["rowu"].properties["rating"] == 2.5
+
+    def test_export_uses_vectorized_page_path(self, tmp_path, monkeypatch):
+        """The export must NOT decode pages into Event objects."""
+        from predictionio_tpu.data.storage import sqlite as sqlite_mod
+        from tests.test_storage import sqlite_storage
+
+        pytest.importorskip("pyarrow")
+        src = sqlite_storage(tmp_path)
+        CommandClient(src).app_new("vex")
+        app_id = src.get_meta_data_apps().get_by_name("vex").id
+        src.get_l_events().insert_columns(
+            app_id, event="rate", entity_type="user",
+            target_entity_type="item",
+            entity_ids=["a", "b"], target_ids=["x", "y"],
+            values=[1.0, 2.0],
+        )
+
+        def boom(*a, **kw):
+            raise AssertionError(
+                "export decoded pages into Event objects"
+            )
+
+        monkeypatch.setattr(sqlite_mod.SQLiteLEvents, "_page_events", boom)
+        path = tmp_path / "vex.parquet"
+        assert events_to_file(
+            "vex", str(path), storage=src, format="parquet"
+        ) == 2
