@@ -282,7 +282,14 @@ class _BatchingExecutor:
             self._queue.put(self._STOP)
         if worker is not None and worker.is_alive():
             worker.join(timeout=10.0)
-        self._serve_pool.shutdown(wait=True)
+        # wait=False so a wedged serve_batch (a stuck device/relay call)
+        # cannot hang THIS call forever, mirroring the bounded collector
+        # join above. The guarantee is only that close() returns: a truly
+        # wedged batch still blocks its request threads (their slots
+        # never resolve) and, since pool workers are non-daemon, still
+        # blocks interpreter exit — same as the reference's in-flight
+        # Futures on undeploy.
+        self._serve_pool.shutdown(wait=False)
 
     def _run(self) -> None:
         while True:

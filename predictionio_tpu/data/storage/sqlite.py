@@ -117,13 +117,30 @@ class SQLiteLEvents(base.LEvents):
         self._pages_schema_ok: set = set()
 
     def _ensure_pages_schema(self, t: str) -> None:
-        """Migrate page tables created before a column existed (ALTER is
-        additive-only; memoized per table)."""
+        """Migrate page tables from older layouts (memoized per table):
+        databases whose events table predates the page store get the
+        _pages/_dict tables created here (init() never re-runs for an
+        existing app), and page tables created before a column existed
+        are ALTERed (additive-only)."""
         if t in self._pages_schema_ok:
             return
         with self._c.lock:
-            if not self._exists(f"{t}_pages"):
-                return  # created fresh (with the full schema) on init
+            if not self._exists(t):
+                # app never init()ed — read paths must stay read-only and
+                # must not plant orphan page tables (do not memoize: the
+                # app may be init()ed later)
+                return
+            try:
+                # IF NOT EXISTS both statements: a no-op on an up-to-date
+                # database, and self-heals one where only part of the
+                # page schema was ever committed
+                self._create_page_tables(t)
+                self._c.commit()
+            except sqlite3.OperationalError:
+                # e.g. a read-only database file: reads proceed
+                # (page-path callers guard on table existence);
+                # writes surface sqlite's own error at INSERT time
+                return
             cols = {
                 row[1]
                 for row in self._c.execute(
@@ -167,36 +184,39 @@ class SQLiteLEvents(base.LEvents):
                 f"CREATE INDEX IF NOT EXISTS {t}_entity ON {t} "
                 f"(entity_type, entity_id, event_time_ms)"
             )
-            # Columnar page store (see data/storage/columnar.py): bulk
-            # imports land here as dictionary-encoded numpy blobs — the
-            # role of the reference's HBase regions feeding partitioned
-            # columnar scans (hbase/HBPEvents.scala:84-90). Single-event
-            # inserts keep using the row table; scans merge both.
-            self._c.execute(
-                f"""CREATE TABLE IF NOT EXISTS {t}_pages (
-                    page INTEGER PRIMARY KEY AUTOINCREMENT,
-                    event TEXT NOT NULL,
-                    entity_type TEXT NOT NULL,
-                    target_entity_type TEXT NOT NULL,
-                    prop TEXT NOT NULL,
-                    n INTEGER NOT NULL,
-                    min_ms INTEGER NOT NULL,
-                    max_ms INTEGER NOT NULL,
-                    entities BLOB NOT NULL,
-                    targets BLOB NOT NULL,
-                    vals BLOB NOT NULL,
-                    times BLOB NOT NULL,
-                    dead BLOB
-                )"""
-            )
-            self._c.execute(
-                f"""CREATE TABLE IF NOT EXISTS {t}_dict (
-                    id INTEGER PRIMARY KEY AUTOINCREMENT,
-                    name TEXT UNIQUE NOT NULL
-                )"""
-            )
+            self._create_page_tables(t)
             self._c.commit()
         return True
+
+    def _create_page_tables(self, t: str) -> None:
+        """Columnar page store DDL (see data/storage/columnar.py): bulk
+        imports land here as dictionary-encoded numpy blobs — the role of
+        the reference's HBase regions feeding partitioned columnar scans
+        (hbase/HBPEvents.scala:84-90). Single-event inserts keep using
+        the row table; scans merge both. Caller holds the lock."""
+        self._c.execute(
+            f"""CREATE TABLE IF NOT EXISTS {t}_pages (
+                page INTEGER PRIMARY KEY AUTOINCREMENT,
+                event TEXT NOT NULL,
+                entity_type TEXT NOT NULL,
+                target_entity_type TEXT NOT NULL,
+                prop TEXT NOT NULL,
+                n INTEGER NOT NULL,
+                min_ms INTEGER NOT NULL,
+                max_ms INTEGER NOT NULL,
+                entities BLOB NOT NULL,
+                targets BLOB NOT NULL,
+                vals BLOB NOT NULL,
+                times BLOB NOT NULL,
+                dead BLOB
+            )"""
+        )
+        self._c.execute(
+            f"""CREATE TABLE IF NOT EXISTS {t}_dict (
+                id INTEGER PRIMARY KEY AUTOINCREMENT,
+                name TEXT UNIQUE NOT NULL
+            )"""
+        )
 
     def remove(self, app_id: int, channel_id: Optional[int] = None) -> bool:
         t = self._events_table(app_id, channel_id)
@@ -565,6 +585,8 @@ class SQLiteLEvents(base.LEvents):
         with self._c.lock:
             if not self._exists(t):
                 raise StorageError(f"events table {t} not initialized")
+        # pre-page-store databases lack the _pages/_dict tables entirely
+        self._ensure_pages_schema(t)
         vals = np.asarray(values, np.float32)
         e_codes = np.asarray(entity_codes, np.int32)
         g_codes = np.asarray(target_codes, np.int32)
@@ -912,22 +934,40 @@ class SQLiteLEvents(base.LEvents):
         clauses.append("target_entity_id IS NOT NULL")
         case_sql = ""
         case_params: list = []
+        null_case_sql = ""
+        null_case_params: list = []
         for ev_name, const in spec.overrides.items():
             case_sql += "WHEN ? THEN ? "
             case_params.extend([ev_name, float(const)])
+            # override events never read the property — mask their type
+            # so junk values there stay permitted (value_of skips them)
+            null_case_sql += "WHEN ? THEN NULL "
+            null_case_params.append(ev_name)
         # json path via parameter; quoted so property names with dots
         # stay one key
         value_sql = (
             "CAST(COALESCE(json_extract(properties, ?), ?) AS REAL)"
         )
+        type_sql = "json_type(properties, ?)"
+        raw_sql = "json_extract(properties, ?)"
         if case_sql:
             value_sql = f"CASE event {case_sql}ELSE {value_sql} END"
+            # mask BOTH helper columns for override events — their
+            # properties are never read, so malformed JSON there must not
+            # fail the scan (the value CASE short-circuits past it too)
+            type_sql = f"CASE event {null_case_sql}ELSE {type_sql} END"
+            raw_sql = f"CASE event {null_case_sql}ELSE {raw_sql} END"
         sql = (
-            f"SELECT entity_id, target_entity_id, {value_sql} FROM {t} "
+            f"SELECT entity_id, target_entity_id, {value_sql}, "
+            f"{type_sql}, {raw_sql} FROM {t} "
             "WHERE " + " AND ".join(clauses)
         )
         prop_path = '$."' + spec.prop.replace('"', '""') + '"'
-        all_params = case_params + [prop_path, float(spec.default)] + params
+        all_params = (
+            case_params + [prop_path, float(spec.default)]
+            + null_case_params + [prop_path]
+            + null_case_params + [prop_path] + params
+        )
         with self._c.lock:
             rows = self._c.execute(sql, all_params).fetchall()
         if rows:
@@ -935,13 +975,31 @@ class SQLiteLEvents(base.LEvents):
 
             e_names, e_codes = encode_strings([r[0] for r in rows])
             g_names, g_codes = encode_strings([r[1] for r in rows])
+            # CAST diverges from the per-event path on non-numeric
+            # property values (unparseable text silently becomes 0.0;
+            # 'nan'/'inf' strings parse in Python but not in CAST) — for
+            # the rare rows whose json_type is not numeric, apply the
+            # same float() rule ValueSpec.value_of uses, so bad events
+            # surface (raise) and parseable text agrees exactly.
+            # json null / missing keep the COALESCE default, as value_of
+            # keeps its default.
+            values = np.fromiter(
+                (
+                    r[2]
+                    if r[3] in (None, "null", "integer", "real", "true", "false")
+                    else float(r[4])
+                    for r in rows
+                ),
+                np.float32,
+                count=len(rows),
+            )
             parts.append(
                 ColumnarEvents(
                     entity_names=e_names,
                     target_names=g_names,
                     entity_codes=e_codes,
                     target_codes=g_codes,
-                    values=np.array([r[2] for r in rows], np.float32),
+                    values=values,
                 )
             )
         return ColumnarEvents.concat(parts)

@@ -313,11 +313,16 @@ def _page_columns_to_table(pa, schema, ts, page: dict):
     values = page["values"]
     vals_str = np.char.mod("%.9g", values)
     bad = np.nonzero(~np.isfinite(values))[0]
-    for j in bad:  # rare: render the tokens json.loads accepts
-        v = float(values[j])
-        vals_str[j] = (
-            "NaN" if v != v else ("Infinity" if v > 0 else "-Infinity")
-        )
+    if bad.size:
+        # the fixed-width U array is sized by the widest finite rendering;
+        # "-Infinity" (9 chars) would silently truncate without widening
+        if vals_str.dtype.itemsize < np.dtype("U9").itemsize:
+            vals_str = vals_str.astype("U9")
+        for j in bad:  # rare: render the tokens json.loads accepts
+            v = float(values[j])
+            vals_str[j] = (
+                "NaN" if v != v else ("Infinity" if v > 0 else "-Infinity")
+            )
     # the key goes through json.dumps so quotes/backslashes/control
     # chars escape correctly
     props = np.char.add(

@@ -253,6 +253,88 @@ class TestSqlitePages:
                 target_ids=["x"], values=[1.0],
             )
 
+    def test_bulk_import_into_pre_page_store_db(self, tmp_path):
+        """Bulk import into a database whose event tables were created
+        before the page store existed (round-4 advisor): the _pages/_dict
+        DDL must run on demand, not only in init()."""
+        s = sqlite_storage(tmp_path)
+        s.get_meta_data_apps().insert(App(id=0, name="app"))
+        le = s.get_l_events()
+        le.init(1)
+        # simulate a pre-round-4 database: the events table exists but
+        # the page-store tables were never created
+        t = le._events_table(1, None)
+        with le._c.lock:
+            le._c.execute(f"DROP TABLE {t}_pages")
+            le._c.execute(f"DROP TABLE {t}_dict")
+            le._c.commit()
+        le2 = sqlite_storage(tmp_path).get_l_events()  # fresh memoization
+        wrote = le2.insert_columns(
+            1, event="rate", entity_type="user", target_entity_type="item",
+            entity_ids=["a", "b"], target_ids=["x", "y"], values=[1.0, 2.0],
+        )
+        assert wrote == 2
+        assert _triples(le2.find_columns_native(1)) == {
+            ("a", "x"): [1.0], ("b", "y"): [2.0],
+        }
+
+    def test_non_numeric_rating_surfaces_not_zero(self, sq):
+        """The SQL residual must not CAST an unparseable rating to 0.0
+        where the per-event path raises (round-4 advisor): bad row-store
+        data surfaces; numeric strings still parse like float() does."""
+        _, le = sq
+        le.insert(
+            Event(
+                event="rate", entity_type="user", entity_id="ok",
+                target_entity_type="item", target_entity_id="x",
+                properties=DataMap({"rating": "3.5"}),  # numeric string
+            ),
+            1,
+        )
+        assert _triples(le.find_columns_native(1)) == {("ok", "x"): [3.5]}
+        # 'nan' parses in Python but CASTs to 0.0 in SQL — the scan must
+        # return the float() result, not the CAST one
+        le.insert(
+            Event(
+                event="rate", entity_type="user", entity_id="nn",
+                target_entity_type="item", target_entity_id="x",
+                properties=DataMap({"rating": "nan"}),
+            ),
+            1,
+        )
+        # a json-null rating falls back to the spec default (1.0), like
+        # the per-event path's get_or_else
+        le.insert(
+            Event(
+                event="rate", entity_type="user", entity_id="nil",
+                target_entity_type="item", target_entity_id="x",
+                properties=DataMap({"rating": None}),
+            ),
+            1,
+        )
+        cols = le.find_columns_native(1)
+        t3 = _triples(cols)
+        assert t3[("nil", "x")] == [1.0]
+        assert np.isnan(t3[("nn", "x")]).any()
+        le.insert(
+            Event(
+                event="rate", entity_type="user", entity_id="bad",
+                target_entity_type="item", target_entity_id="x",
+                properties=DataMap({"rating": "not-a-number"}),
+            ),
+            1,
+        )
+        with pytest.raises(ValueError):
+            le.find_columns_native(1)
+        # an override event never reads the property, so a junk value
+        # there stays permitted (value_of skips it the same way)
+        spec = ValueSpec(event_overrides=(("rate", 4.0),))
+        cols = le.find_columns_native(1, value_spec=spec)
+        assert _triples(cols) == {
+            ("bad", "x"): [4.0], ("ok", "x"): [4.0],
+            ("nn", "x"): [4.0], ("nil", "x"): [4.0],
+        }
+
     def test_remove_drops_page_tables(self, sq):
         _, le = sq
         le.insert_columns(

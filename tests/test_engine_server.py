@@ -278,6 +278,32 @@ class TestBatchingPipeline:
             ex.submit(dep, 8)
         ex.close()  # idempotent
 
+    def test_close_returns_despite_wedged_serve(self):
+        """A serve_batch stuck on a dead device/relay call must not hang
+        close() (round-4 advisor): the pool shutdown is non-blocking; the
+        in-flight slot stays pending but the server shuts down."""
+        import time
+
+        from predictionio_tpu.api.engine_server import _BatchingExecutor
+
+        release = threading.Event()
+
+        class WedgedDep:
+            def serve_batch(self, queries):
+                release.wait(30.0)  # a stuck backend call
+                return list(queries)
+
+        dep = WedgedDep()
+        ex = _BatchingExecutor(window_ms=1.0, max_batch=1, pipeline_depth=1)
+        t = threading.Thread(target=lambda: ex.submit(dep, 1), daemon=True)
+        t.start()
+        time.sleep(0.1)  # let the batch reach the wedged serve call
+        t0 = time.perf_counter()
+        ex.close()
+        assert time.perf_counter() - t0 < 5.0
+        release.set()  # unwedge so the worker exits before interpreter join
+        t.join(timeout=5)
+
     def test_daily_upgrade_check_records_status(self, mem_storage, monkeypatch):
         """VERDICT r3 #10 (reference CreateServer.scala:253-260): the
         deployed server self-checks for upgrades on a timer and reports

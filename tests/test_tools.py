@@ -232,6 +232,38 @@ class TestExportImport:
             assert got.tags == orig.tags
             assert got.pr_id == orig.pr_id
 
+    def test_parquet_export_nonfinite_page_values(self, tmp_path):
+        """-inf/inf/nan page values must export as the full JSON tokens
+        (round-4 advisor: the fixed-width string array truncated
+        '-Infinity', leaving the file unreadable on re-import)."""
+        pytest.importorskip("pyarrow")
+        from tests.test_storage import sqlite_storage
+
+        storage = sqlite_storage(tmp_path)
+        client = CommandClient(storage)
+        d = client.app_new("nfapp")
+        storage.get_l_events().insert_columns(
+            d.app.id, event="rate", entity_type="user",
+            target_entity_type="item",
+            entity_ids=["a", "b", "c", "d"], target_ids=["w", "x", "y", "z"],
+            values=[float("-inf"), float("inf"), float("nan"), 2.0],
+        )
+        path = tmp_path / "events.parquet"
+        assert events_to_file(
+            "nfapp", str(path), storage=storage, format="parquet"
+        ) == 4
+        client.app_new("nfimp")
+        assert file_to_events("nfimp", str(path), storage=storage) == 4
+        app_id = storage.get_meta_data_apps().get_by_name("nfimp").id
+        vals = {
+            e.entity_id: float(e.properties["rating"])
+            for e in storage.get_l_events().find(app_id=app_id)
+        }
+        assert vals["a"] == float("-inf")
+        assert vals["b"] == float("inf")
+        assert vals["c"] != vals["c"]  # NaN
+        assert vals["d"] == 2.0
+
     def test_export_unknown_format_raises(self, mem_storage, tmp_path):
         CommandClient(mem_storage).app_new("fmtapp")
         with pytest.raises(ValueError, match="unknown export format"):
