@@ -26,8 +26,10 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from predictionio_tpu.data.bimap import BiMap
+from predictionio_tpu.parallel.mesh import pad_to_multiple
 
 NEG_INF = float("-inf")
 
@@ -45,8 +47,12 @@ class LabeledPoint:
 
 @functools.partial(jax.jit, static_argnames=("n_keys",))
 def _count_flat(keys, n_keys):
-    # scatter-add of ones over flattened (slot, label, value) keys
-    return jnp.zeros(n_keys, jnp.float32).at[keys].add(1.0)
+    # scatter-add of ones over flattened (slot, label, value) keys; with a
+    # mesh the keys arrive sharded and XLA all-reduces per-device partial
+    # counts over ICI (the TPU analog of the reference's combineByKey over
+    # RDD partitions). Out-of-range keys (the mesh-padding sentinel
+    # n_keys) drop, not clamp.
+    return jnp.zeros(n_keys, jnp.float32).at[keys].add(1.0, mode="drop")
 
 
 @dataclasses.dataclass
@@ -162,7 +168,15 @@ class CategoricalNaiveBayes:
     """Trainer (reference object CategoricalNaiveBayes :29-80)."""
 
     @staticmethod
-    def train(points: Sequence[LabeledPoint]) -> CategoricalNaiveBayesModel:
+    def train(
+        points: Sequence[LabeledPoint],
+        mesh: Optional[Mesh] = None,
+        axis: str = "data",
+    ) -> CategoricalNaiveBayesModel:
+        """Train; with a ``mesh`` the flattened count keys shard over its
+        ``axis`` and per-device partial counts all-reduce (see module
+        docstring). Counts are exact integers either way, so the model is
+        bitwise identical across mesh shapes."""
         if not points:
             raise ValueError("cannot train on an empty dataset")
         S = len(points[0].features)
@@ -190,9 +204,22 @@ class CategoricalNaiveBayes:
             )
             flat_keys[pos : pos + len(points)] = (s * L + labels) * V + values
             pos += len(points)
-        counts = np.asarray(
-            _count_flat(jnp.asarray(flat_keys), S * L * V)
-        ).reshape(S, L, V)
+        n_keys = S * L * V
+        if mesh is not None and mesh.shape[axis] > 1:
+            # pad with the out-of-range sentinel (dropped by the scatter)
+            # so the key vector shards evenly, then place it sharded
+            padded = pad_to_multiple(max(len(flat_keys), 1), mesh.shape[axis])
+            if padded != len(flat_keys):
+                flat_keys = np.concatenate(
+                    [flat_keys,
+                     np.full(padded - len(flat_keys), n_keys, np.int32)]
+                )
+            keys_dev = jax.device_put(
+                flat_keys, NamedSharding(mesh, P(axis))
+            )
+        else:
+            keys_dev = jnp.asarray(flat_keys)
+        counts = np.asarray(_count_flat(keys_dev, n_keys)).reshape(S, L, V)
 
         label_counts = np.bincount(labels, minlength=L).astype(np.float64)
         log_priors = np.log(label_counts / len(points)).astype(np.float32)

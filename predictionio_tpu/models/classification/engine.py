@@ -166,8 +166,11 @@ class NaiveBayesAlgorithm(BaseAlgorithm):
     query_class = Query
 
     def train(self, ctx, pd: PreparedData) -> NaiveBayesModelArrays:
+        # rows shard over the workflow mesh; per-class sums all-reduce over
+        # ICI (the reference's NB is likewise cluster-distributed via MLlib)
         return train_naive_bayes(
-            pd.td.features, pd.td.labels, lam=self.params.lambda_
+            pd.td.features, pd.td.labels, lam=self.params.lambda_,
+            mesh=ctx.mesh if ctx is not None else None,
         )
 
     def predict(self, model: NaiveBayesModelArrays, query: Query) -> PredictedResult:

@@ -14,17 +14,29 @@ TPU-first design: the per-class feature sums are ONE [C, n] x [n, F]
 matmul (one-hot labels against the feature matrix — MXU work, not a
 combineByKey shuffle), and batch prediction is scores = X @ theta.T + pi,
 again a single matmul. All shapes static; float32 accumulation.
+
+Multi-chip: with a ``mesh``, the [n, F] feature matrix and the label
+vector shard rows over the mesh's data axis; the one-hot contraction
+reduces over that sharded axis, so XLA lowers the [C, F] per-class sums
+to per-shard matmuls + an all-reduce over ICI — the TPU analog of the
+reference's cluster-distributed MLlib ``NaiveBayes.train`` (a
+combineByKey over RDD partitions). Row padding carries label index C
+(matching no class), so padded rows contribute nothing; the true row
+count is recovered on device as ``class_counts.sum()``.
 """
 
 from __future__ import annotations
 
 import dataclasses
 import functools
-from typing import Tuple
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from predictionio_tpu.parallel.mesh import pad_to_multiple, shard_batch
 
 
 @dataclasses.dataclass
@@ -42,15 +54,15 @@ class NaiveBayesModelArrays:
 
 @functools.partial(jax.jit, static_argnames=("n_classes",))
 def _fit(features, label_idx, lam, n_classes):
-    n = features.shape[0]
     one_hot = jnp.asarray(
         label_idx[None, :] == jnp.arange(n_classes)[:, None], jnp.float32
     )  # [C, n]
     class_counts = one_hot.sum(axis=1)  # [C]
+    # true row count: padded rows carry label index n_classes, matching no
+    # class, so they drop out of every count (exact integer sum)
+    n = class_counts.sum()
     sums = jnp.dot(one_hot, features, preferred_element_type=jnp.float32)  # [C, F]
-    pi = jnp.log(class_counts + lam) - jnp.log(
-        jnp.float32(n) + lam * n_classes
-    )
+    pi = jnp.log(class_counts + lam) - jnp.log(n + lam * n_classes)
     theta = jnp.log(sums + lam) - jnp.log(
         sums.sum(axis=1, keepdims=True) + lam * features.shape[1]
     )
@@ -66,9 +78,18 @@ def _scores(features, pi, theta):
 
 
 def train_naive_bayes(
-    features: np.ndarray, labels: np.ndarray, lam: float = 1.0
+    features: np.ndarray,
+    labels: np.ndarray,
+    lam: float = 1.0,
+    mesh: Optional[Mesh] = None,
+    axis: str = "data",
 ) -> NaiveBayesModelArrays:
-    """Train on [n, F] nonnegative features with arbitrary scalar labels."""
+    """Train on [n, F] nonnegative features with arbitrary scalar labels.
+
+    With a ``mesh``, rows shard over its ``axis`` and the per-class sums
+    all-reduce over ICI (see module docstring); results are bitwise
+    independent of the mesh shape up to float summation order.
+    """
     features = np.asarray(features, np.float32)
     labels = np.asarray(labels)
     if features.ndim != 2 or len(features) != len(labels):
@@ -78,11 +99,28 @@ def train_naive_bayes(
     if (features < 0).any():
         raise ValueError("multinomial NB requires nonnegative features")
     classes, label_idx = np.unique(labels, return_inverse=True)
+    label_idx = label_idx.astype(np.int32)
+    if mesh is not None and mesh.shape[axis] == 1:
+        mesh = None
+    if mesh is None:
+        feats_dev = jnp.asarray(features)
+        labels_dev = jnp.asarray(label_idx)
+    else:
+        # rows pad so they shard evenly (zero feature rows); padding
+        # labels index n_classes (no one-hot match) so the padded rows
+        # vanish from every sum — labels can't use shard_batch's zero
+        # padding, which would inflate class 0's counts
+        n = len(labels)
+        padded = pad_to_multiple(n, mesh.shape[axis])
+        if padded != n:
+            label_idx = np.pad(
+                label_idx, (0, padded - n),
+                constant_values=np.int32(len(classes)),
+            )
+        feats_dev, _ = shard_batch(mesh, features, axis)
+        labels_dev = jax.device_put(label_idx, NamedSharding(mesh, P(axis)))
     pi, theta = _fit(
-        jnp.asarray(features),
-        jnp.asarray(label_idx.astype(np.int32)),
-        jnp.float32(lam),
-        n_classes=len(classes),
+        feats_dev, labels_dev, jnp.float32(lam), n_classes=len(classes)
     )
     return NaiveBayesModelArrays(
         pi=np.asarray(pi), theta=np.asarray(theta), labels=classes
@@ -90,11 +128,24 @@ def train_naive_bayes(
 
 
 def predict_naive_bayes(
-    model: NaiveBayesModelArrays, features: np.ndarray
+    model: NaiveBayesModelArrays,
+    features: np.ndarray,
+    mesh: Optional[Mesh] = None,
+    axis: str = "data",
 ) -> np.ndarray:
-    """Predicted label for each row of [B, F] (batch = one matmul)."""
+    """Predicted label for each row of [B, F] (batch = one matmul).
+
+    With a ``mesh``, the query batch shards over its ``axis`` (pure data
+    parallelism — each shard scores its rows against the replicated
+    model); padding rows are sliced off the result.
+    """
     features = np.atleast_2d(np.asarray(features, np.float32))
+    b = features.shape[0]
+    if mesh is not None and mesh.shape[axis] > 1:
+        feats_dev, _ = shard_batch(mesh, features, axis)
+    else:
+        feats_dev = jnp.asarray(features)
     scores = _scores(
-        jnp.asarray(features), jnp.asarray(model.pi), jnp.asarray(model.theta)
+        feats_dev, jnp.asarray(model.pi), jnp.asarray(model.theta)
     )
-    return model.labels[np.asarray(jnp.argmax(scores, axis=1))]
+    return model.labels[np.asarray(jnp.argmax(scores, axis=1))[:b]]

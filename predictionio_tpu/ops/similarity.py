@@ -7,13 +7,25 @@ cosine(queryFactor, candidateFactor)``, computed there as an RDD
 mapValues over every product). Here the factor matrix is L2-normalized
 once at model build, so a whole query batch scores as ONE [Q, k] x [k, N]
 MXU matmul summed over the query axis.
+
+Multi-chip: with a ``mesh``, the [N, k] candidate matrix shards rows over
+the mesh's data axis (the catalog is the big operand); the small query
+block replicates, each device scores its candidate shard locally, and the
+[N] score vector comes back row-sharded — no collective on the hot path.
+This is the TPU analog of the reference scoring candidates with an RDD
+mapValues over cluster partitions.
 """
 
 from __future__ import annotations
 
+from typing import Optional
+
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from predictionio_tpu.parallel.mesh import shard_batch
 
 
 def pad_rows_pow2(rows: np.ndarray, min_rows: int) -> np.ndarray:
@@ -48,11 +60,26 @@ def _cosine_sum(query_normed, all_normed):
 
 class SimilarityScorer:
     """Device-resident normalized factors; each call ships only the query
-    rows up and one score vector down."""
+    rows up and one score vector down.
 
-    def __init__(self, factors: np.ndarray):
+    With a ``mesh``, the candidate matrix is row-sharded over the mesh's
+    ``axis`` (zero-padded so rows divide the axis size — zero rows score
+    cosine 0 and are sliced off the result)."""
+
+    def __init__(
+        self,
+        factors: np.ndarray,
+        mesh: Optional[Mesh] = None,
+        axis: str = "data",
+    ):
         self.normed = normalize_rows(factors)
-        self._dev = jax.device_put(jnp.asarray(self.normed))
+        if mesh is not None and mesh.shape[axis] == 1:
+            mesh = None
+        self.mesh = mesh
+        if mesh is None:
+            self._dev = jax.device_put(jnp.asarray(self.normed))
+        else:
+            self._dev, _ = shard_batch(mesh, self.normed, axis)
 
     @property
     def n(self) -> int:
@@ -68,18 +95,24 @@ class SimilarityScorer:
         share O(log max_q) compiled executables instead of one per
         distinct count (a cold compile on live traffic costs seconds)."""
         q = pad_rows_pow2(np.atleast_2d(query_rows), 4)
-        return np.asarray(_cosine_sum(jnp.asarray(q), self._dev))
+        if self.mesh is not None:
+            q_dev = jax.device_put(q, NamedSharding(self.mesh, P(None, None)))
+        else:
+            q_dev = jnp.asarray(q)
+        return np.asarray(_cosine_sum(q_dev, self._dev))[: self.n]
 
     def warm(self, max_q: int = 16) -> None:
         """Compile every padded-query-width executable a query of up to
         ``max_q`` items can hit — including the bucket a non-power-of-two
-        max_q pads INTO (deploy-time warm-up; see BaseAlgorithm.warm)."""
+        max_q pads INTO (deploy-time warm-up; see BaseAlgorithm.warm).
+        Routes through ``cosine_sum`` so the warmed executables carry the
+        SAME input shardings serving traffic will present (a direct
+        `_cosine_sum` call with an uncommitted query would warm a
+        different jit cache entry on mesh-backed scorers)."""
         k = self.normed.shape[1]
         q = 4
         while True:
-            _cosine_sum(
-                jnp.zeros((q, k), jnp.float32), self._dev
-            ).block_until_ready()
+            self.cosine_sum(np.zeros((q, k), np.float32))
             if q >= max_q:
                 break
             q *= 2

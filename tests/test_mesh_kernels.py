@@ -1,0 +1,226 @@
+"""Multi-chip parity for every non-ALS kernel family.
+
+Round-5 widening of the multi-chip test tier (SURVEY.md §4 — the tier the
+reference left empty): NaiveBayes, the e2 categorical NB count reduction,
+the similarity cosine-sum, and the serving top-N each run on an 8-virtual-
+device mesh and must match a single-device run numerically. Row counts
+deliberately do not divide the device count, exercising the padding paths.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from predictionio_tpu.parallel import make_mesh
+
+
+@pytest.fixture(scope="module")
+def mesh8():
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    return make_mesh({"data": 8}, jax.devices()[:8])
+
+
+class TestNaiveBayesMesh:
+    def test_fit_parity(self, mesh8):
+        from predictionio_tpu.ops.naive_bayes import train_naive_bayes
+
+        rng = np.random.default_rng(0)
+        X = rng.uniform(0, 3, (67, 12)).astype(np.float32)
+        y = rng.integers(0, 3, 67).astype(np.float64)
+        sharded = train_naive_bayes(X, y, lam=0.7, mesh=mesh8)
+        single = train_naive_bayes(X, y, lam=0.7)
+        np.testing.assert_allclose(sharded.pi, single.pi, rtol=1e-5)
+        np.testing.assert_allclose(sharded.theta, single.theta, rtol=1e-5)
+        np.testing.assert_array_equal(sharded.labels, single.labels)
+
+    def test_fit_parity_rows_divide(self, mesh8):
+        from predictionio_tpu.ops.naive_bayes import train_naive_bayes
+
+        rng = np.random.default_rng(1)
+        X = rng.uniform(0, 1, (64, 5)).astype(np.float32)
+        y = rng.integers(0, 2, 64).astype(np.float64)
+        sharded = train_naive_bayes(X, y, mesh=mesh8)
+        single = train_naive_bayes(X, y)
+        np.testing.assert_allclose(sharded.theta, single.theta, rtol=1e-5)
+
+    def test_predict_parity(self, mesh8):
+        from predictionio_tpu.ops.naive_bayes import (
+            predict_naive_bayes, train_naive_bayes,
+        )
+
+        rng = np.random.default_rng(2)
+        X = rng.uniform(0, 3, (50, 8)).astype(np.float32)
+        y = rng.integers(0, 3, 50).astype(np.float64)
+        model = train_naive_bayes(X, y)
+        q = rng.uniform(0, 3, (13, 8)).astype(np.float32)
+        np.testing.assert_array_equal(
+            predict_naive_bayes(model, q, mesh=mesh8),
+            predict_naive_bayes(model, q),
+        )
+
+    def test_trivial_mesh_is_single_device(self):
+        from predictionio_tpu.ops.naive_bayes import train_naive_bayes
+
+        mesh1 = make_mesh({"data": 1}, jax.devices()[:1])
+        X = np.ones((3, 2), np.float32)
+        y = np.asarray([0.0, 1.0, 0.0])
+        m = train_naive_bayes(X, y, mesh=mesh1)
+        assert m.pi.shape == (2,)
+
+
+class TestCategoricalNBMesh:
+    def test_count_parity_bitwise(self, mesh8):
+        from predictionio_tpu.e2.naive_bayes import (
+            CategoricalNaiveBayes, LabeledPoint,
+        )
+
+        rng = np.random.default_rng(3)
+        pts = [
+            LabeledPoint(
+                str(rng.integers(0, 3)),
+                (str(rng.integers(0, 5)), str(rng.integers(0, 4)),
+                 str(rng.integers(0, 2))),
+            )
+            for _ in range(41)
+        ]
+        sharded = CategoricalNaiveBayes.train(pts, mesh=mesh8)
+        single = CategoricalNaiveBayes.train(pts)
+        # counts are exact integers -> bitwise identity across mesh shapes
+        np.testing.assert_array_equal(
+            sharded.log_priors, single.log_priors
+        )
+        np.testing.assert_array_equal(
+            sharded.log_likelihoods, single.log_likelihoods
+        )
+        assert sharded.predict(pts[0].features) == single.predict(
+            pts[0].features
+        )
+
+    def test_fewer_points_than_devices(self, mesh8):
+        from predictionio_tpu.e2.naive_bayes import (
+            CategoricalNaiveBayes, LabeledPoint,
+        )
+
+        pts = [LabeledPoint("a", ("x",)), LabeledPoint("b", ("y",))]
+        sharded = CategoricalNaiveBayes.train(pts, mesh=mesh8)
+        single = CategoricalNaiveBayes.train(pts)
+        np.testing.assert_array_equal(
+            sharded.log_likelihoods, single.log_likelihoods
+        )
+
+
+class TestSimilarityMesh:
+    def test_cosine_sum_parity(self, mesh8):
+        from predictionio_tpu.ops.similarity import SimilarityScorer
+
+        rng = np.random.default_rng(4)
+        F = rng.standard_normal((45, 8)).astype(np.float32)
+        sharded = SimilarityScorer(F, mesh=mesh8)
+        single = SimilarityScorer(F)
+        q = single.normed[:3]
+        out_sharded = sharded.cosine_sum(q)
+        out_single = single.cosine_sum(q)
+        assert out_sharded.shape == (45,) == out_single.shape
+        np.testing.assert_allclose(
+            out_sharded, out_single, rtol=1e-5, atol=1e-6
+        )
+
+    def test_candidates_actually_sharded(self, mesh8):
+        from predictionio_tpu.ops.similarity import SimilarityScorer
+
+        F = np.eye(12, 4, dtype=np.float32)
+        scorer = SimilarityScorer(F, mesh=mesh8)
+        assert not scorer._dev.sharding.is_fully_replicated
+        assert len(scorer._dev.sharding.device_set) == 8
+        # padded to 16 rows -> 2 per device
+        assert {s.data.shape[0] for s in scorer._dev.addressable_shards} == {2}
+
+    def test_warm_on_mesh(self, mesh8):
+        from predictionio_tpu.ops.similarity import SimilarityScorer
+
+        rng = np.random.default_rng(5)
+        scorer = SimilarityScorer(
+            rng.standard_normal((9, 4)).astype(np.float32), mesh=mesh8
+        )
+        scorer.warm(max_q=8)
+
+
+class TestServingMesh:
+    def test_topn_parity(self, mesh8):
+        from predictionio_tpu.ops.als import ServingFactors
+
+        rng = np.random.default_rng(6)
+        uf = rng.standard_normal((67, 8)).astype(np.float32)
+        if_ = rng.standard_normal((45, 8)).astype(np.float32)
+        sharded = ServingFactors(uf, if_, mesh=mesh8)
+        single = ServingFactors(uf, if_)
+        s1, i1 = sharded.topn_by_rows(uf[:5], 7)
+        s0, i0 = single.topn_by_rows(uf[:5], 7)
+        np.testing.assert_allclose(s1, s0, rtol=1e-5)
+        np.testing.assert_array_equal(i1, i0)
+
+    def test_catalog_replicated_queries_sharded(self, mesh8):
+        from predictionio_tpu.ops.als import ServingFactors
+
+        rng = np.random.default_rng(7)
+        srv = ServingFactors(
+            rng.standard_normal((16, 4)).astype(np.float32),
+            rng.standard_normal((20, 4)).astype(np.float32),
+            mesh=mesh8,
+        )
+        assert srv._if_dev.sharding.is_fully_replicated
+        packed = srv.topn_packed_device(srv.user_factors[:3], 5)
+        assert not packed.sharding.is_fully_replicated
+
+    def test_measure_compute_ms_on_mesh(self, mesh8):
+        """The latency-measurement chain must run with mesh-committed
+        operands (regression: an uncommitted query + replicated catalog
+        raised 'incompatible devices')."""
+        from predictionio_tpu.ops.als import ServingFactors
+
+        rng = np.random.default_rng(10)
+        srv = ServingFactors(
+            rng.standard_normal((16, 4)).astype(np.float32),
+            rng.standard_normal((20, 4)).astype(np.float32),
+            mesh=mesh8,
+        )
+        ms = srv.measure_compute_ms(srv.user_factors[:8], 5, iters=4, reps=1)
+        # tiny CPU kernels time below clock noise, so only finiteness is
+        # asserted — the regression was a crash, not a value
+        assert np.isfinite(ms)
+
+    def test_topn_by_user_on_mesh(self, mesh8):
+        from predictionio_tpu.ops.als import ServingFactors
+
+        rng = np.random.default_rng(8)
+        uf = rng.standard_normal((30, 4)).astype(np.float32)
+        if_ = rng.standard_normal((25, 4)).astype(np.float32)
+        sharded = ServingFactors(uf, if_, mesh=mesh8)
+        single = ServingFactors(uf, if_)
+        s1, i1 = sharded.topn_by_user([0, 7, 29], 5)
+        s0, i0 = single.topn_by_user([0, 7, 29], 5)
+        np.testing.assert_allclose(s1, s0, rtol=1e-5)
+        np.testing.assert_array_equal(i1, i0)
+
+
+class TestClassificationEngineMesh:
+    def test_engine_train_uses_workflow_mesh(self, mesh8, mem_storage):
+        """The classification template's NB train runs sharded end to end
+        when the workflow context carries a multi-device mesh."""
+        from predictionio_tpu.models.classification.engine import (
+            NaiveBayesAlgorithm, NaiveBayesAlgorithmParams, PreparedData,
+            TrainingData,
+        )
+        from predictionio_tpu.workflow.context import workflow_context
+
+        rng = np.random.default_rng(9)
+        td = TrainingData(
+            features=rng.uniform(0, 4, (51, 6)).astype(np.float32),
+            labels=rng.integers(0, 3, 51).astype(np.float64),
+        )
+        algo = NaiveBayesAlgorithm(NaiveBayesAlgorithmParams(lambda_=1.0))
+        ctx = workflow_context(mode="train", mesh=mesh8)
+        sharded = algo.train(ctx, PreparedData(td=td))
+        single = algo.train(None, PreparedData(td=td))
+        np.testing.assert_allclose(sharded.theta, single.theta, rtol=1e-5)
