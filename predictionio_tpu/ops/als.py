@@ -230,13 +230,16 @@ def _segment_geometry(
 # The padded segment arrays are up to ~3x the COO bytes; building them on
 # HOST means shipping that inflation over the host->device link, which on
 # relayed rigs runs at tens of MB/s (the dominant ML-20M phase in rounds
-# 1-3: 14-80 s). Instead the raw COO crosses the link ONCE — losslessly
+# 1-3: 14-80 s). Instead the COO crosses the link ONCE, losslessly
 # narrowed (item ids to uint16 when they fit, half-step ratings to int8)
-# — and the device sorts (lax.sort, ~0.2 s per 20M side vs ~4 s host
-# radix sort) and scatters into the padded layout in HBM. This replaces
-# the role of the reference's region-parallel HBase scan feeding Spark
-# block shuffles (data/storage/hbase/HBPEvents.scala:84-90): the wire
-# carries the minimal representation, the accelerator does the layout.
+# and — since round 5 — WITHOUT its row-id plane: the host stable-sorts
+# by user, the CSR offsets (already needed for the scatter) encode the
+# row ids, and _device_pack_presorted rebuilds them in HBM with one
+# cumsum pass. ML-20M wire: ~60 MB vs ~140 MB with the int32 row plane.
+# This replaces the role of the reference's region-parallel HBase scan
+# feeding Spark block shuffles (data/storage/hbase/HBPEvents.scala:84-90):
+# the wire carries the minimal representation, the accelerator does the
+# layout.
 
 
 def _narrow_ids(idx: np.ndarray) -> np.ndarray:
@@ -261,15 +264,51 @@ def _narrow_vals(vals: np.ndarray) -> Tuple[np.ndarray, float]:
 
 
 @functools.partial(jax.jit, static_argnames=("total", "L", "scale"))
+def _device_pack_presorted(cols, vals, starts, seg_base, total, L, scale):
+    """Pack a HOST-presorted (by row id) COO side WITHOUT the row-id
+    plane on the wire: row ids rebuild on device from the CSR offsets by
+    an indicator-cumsum (one memory-bound pass over [n]), then the
+    scatter layout is identical to _device_scatter_pack's post-sort
+    layout — but with no 20M-row device sort and, at ML-20M, ~80 MB less
+    host->device traffic (the int32 row plane compresses to the CSR
+    offsets already shipped for the scatter). Sentinel-padded tail
+    elements get row ids past the last real row; their gathers clamp to
+    the CSR edge values, so they land in masked padding segments or drop
+    (mode="drop"), exactly like the sorted path. Returns the rebuilt row
+    ids — the counter side's pack consumes them as its column values."""
+    n = cols.shape[0]
+    j = jnp.arange(n, dtype=jnp.int32)
+    marks = (
+        jnp.zeros((n + 1,), jnp.int32).at[starts[1:]].add(1, mode="drop")
+    )
+    keys = jnp.cumsum(marks[:n], dtype=jnp.int32)
+    offset = j - starts[keys]
+    flat = (seg_base[keys] + offset // L) * L + offset % L
+    opts = dict(unique_indices=True, indices_are_sorted=True, mode="drop")
+    p_cols = (
+        jnp.zeros((total * L,), jnp.int32)
+        .at[flat].set(cols.astype(jnp.int32), **opts)
+    )
+    p_vals = (
+        jnp.zeros((total * L,), jnp.float32)
+        .at[flat].set(vals.astype(jnp.float32) * scale, **opts)
+    )
+    return keys, p_cols, p_vals
+
+
+@functools.partial(jax.jit, static_argnames=("total", "L", "scale"))
 def _device_scatter_pack(keys, cols, vals, starts, seg_base, total, L, scale):
     """Sort the COO by ``keys`` and scatter values/cols into the padded
     [total, L] segment layout — all on device. The flat slot index of the
     j-th sorted element is derivable from the CSR offsets alone, and is
     strictly increasing, so the scatters are sorted unique-index writes.
-    Stable sort keeps the slot assignment identical to the host packer's
-    (bit-identical training results either path). Sentinel-padded COO
-    elements (row id == n_rows) sort last and either land in masked
-    padding segments or drop out of bounds (mode="drop")."""
+    The stable sort makes slot assignment deterministic for a given input
+    order (since round 5 the input arrives user-sorted, so within-row
+    slot order differs from the host packer's insertion order by a
+    permutation — same masked sums, float-rounding-level differences
+    only). Sentinel-padded COO elements (row id == n_rows) sort last and
+    either land in masked padding segments or drop out of bounds
+    (mode="drop")."""
     ks, cs, vs = jax.lax.sort(
         (keys.astype(jnp.int32), cols.astype(jnp.int32), vals),
         num_keys=1, is_stable=True,
@@ -653,9 +692,11 @@ def train_als_grid(
     )
 
     rng = np.random.default_rng(config.seed)
-    # +1 sentinel row, padded so the row dim shards evenly over the mesh
-    r_u = pad_to_multiple(n_users + 1, n_shards)
-    r_i = pad_to_multiple(n_items + 1, n_shards)
+    # +1 sentinel row, bucketed (_bucket_count) so near-identical
+    # cardinalities share one executable, padded so the row dim shards
+    # evenly over the mesh
+    r_u = pad_to_multiple(_bucket_count(n_users + 1), n_shards)
+    r_i = pad_to_multiple(_bucket_count(n_items + 1), n_shards)
     Y0 = np.zeros((r_i, k), np.float32)
     Y0[:n_items] = np.abs(rng.standard_normal((n_items, k))) / math.sqrt(k)
 
@@ -726,6 +767,22 @@ def _place(mesh: Optional[Mesh], arr, spec):
     if mesh is None:
         return jnp.asarray(arr)
     return jax.device_put(arr, NamedSharding(mesh, spec))
+
+
+def _bucket_count(n: int) -> int:
+    """Round a count up at 4-significant-bit granularity (≤6.25% padding).
+
+    Every jit-visible dimension derived from data cardinalities buckets
+    through this so near-identical inputs share one compiled executable:
+    segment grids already did (see _segment_geometry); round 5 extends it
+    to the system-ROW dimension, because a retrain after new users arrive
+    — or the store-scan path seeing 138,432 distinct users where the
+    direct path passed 138,493 — otherwise recompiles the whole iteration
+    program over a 0.04% shape change (a multi-second XLA pause that
+    showed up as the round-4 store→train seam)."""
+    n = int(n)
+    granule = 1 << max(0, n.bit_length() - 4)
+    return -(-n // granule) * granule
 
 
 def auto_segment_length(
@@ -859,9 +916,10 @@ def train_als(
     rng = np.random.default_rng(config.seed)
 
     def padded_rows(n: int) -> int:
-        # +1 sentinel row for segment padding, rounded up so the row dim
-        # shards evenly over the mesh
-        return pad_to_multiple(n + 1, n_shards)
+        # +1 sentinel row for segment padding, bucketed so near-identical
+        # cardinalities share one executable (see _bucket_count), rounded
+        # up so the row dim shards evenly over the mesh
+        return pad_to_multiple(_bucket_count(n + 1), n_shards)
 
     # MLlib-style init: nonnegative scaled normals on the item side;
     # sentinel/padding rows zero
@@ -892,33 +950,46 @@ def train_als(
         )
 
     if mesh is None:
-        # Device-side packing (see _device_scatter_pack): the COO crosses
-        # the link once, losslessly narrowed; sort + layout happen in HBM.
-        if timings is not None:
-            timings["pack_s"] = _time.perf_counter() - t_phase
-        t_phase = _time.perf_counter()
+        # Device-side packing: the COO crosses the link once WITHOUT its
+        # row-id plane — the host stable-sorts by user (radix, ~1 s at
+        # 20M), so user ids rebuild on device from the CSR offsets
+        # (_device_pack_presorted) and only the narrowed item ids +
+        # ratings travel. At ML-20M that is ~60 MB on the wire instead
+        # of ~140 MB, and ONE device sort instead of two (the item side
+        # still lax.sorts by item key, consuming the rebuilt user ids).
         n = len(ratings_f)
+        order = np.argsort(user_idx, kind="stable")
         # bucket the COO length (4 significant bits) so k-fold/grid runs
         # with near-identical rating counts share one pack executable;
         # padding elements carry the sentinel row id on BOTH sides and
         # either land in masked padding segments or drop out of bounds
-        granule = 1 << max(0, n.bit_length() - 4)
-        pad = (-(-n // granule) * granule - n) if n else 1
-        uw = np.concatenate([user_idx, np.full(pad, n_users, np.int32)])
-        iw = np.concatenate([item_idx, np.full(pad, n_items, np.int32)])
-        vw = np.concatenate([ratings_f, np.zeros(pad, np.float32)])
-        uw = _narrow_ids(uw)
+        pad = (_bucket_count(n) - n) if n else 1
+        iw = np.concatenate(
+            [item_idx[order], np.full(pad, n_items, np.int32)]
+        )
+        vw = np.concatenate([ratings_f[order], np.zeros(pad, np.float32)])
         iw = _narrow_ids(iw)
         vw, v_scale = _narrow_vals(vw)
-        u_dev = jax.device_put(uw)
+        if timings is not None:
+            timings["pack_s"] = _time.perf_counter() - t_phase
+        t_phase = _time.perf_counter()
         i_dev = jax.device_put(iw)
         v_dev = jax.device_put(vw)
+        def aux_pad(arr: np.ndarray) -> np.ndarray:
+            # bucket the CSR-offset length (indexed only by row ids
+            # <= n_rows, so edge-padding is inert) — keeps the pack
+            # executable shared across near-identical cardinalities,
+            # matching the row-dim bucketing of the iteration program
+            out = np.full(_bucket_count(len(arr)), arr[-1], np.int32)
+            out[: len(arr)] = arr
+            return out
+
         aux = jax.device_put(
             {
-                "su": geo_u.starts.astype(np.int32),
-                "bu": geo_u.seg_base.astype(np.int32),
-                "si": geo_i.starts.astype(np.int32),
-                "bi": geo_i.seg_base.astype(np.int32),
+                "su": aux_pad(geo_u.starts.astype(np.int32)),
+                "bu": aux_pad(geo_u.seg_base.astype(np.int32)),
+                "si": aux_pad(geo_i.starts.astype(np.int32)),
+                "bi": aux_pad(geo_i.seg_base.astype(np.int32)),
             }
         )
         if timings is not None:
@@ -927,15 +998,19 @@ def train_als(
             _sync_fetch(aux)
             timings["device_put_s"] = _time.perf_counter() - t_phase
             timings["wire_mb"] = round(
-                (uw.nbytes + iw.nbytes + vw.nbytes) / 2**20, 1
+                (
+                    iw.nbytes + vw.nbytes
+                    + sum(int(a.nbytes) for a in aux.values())
+                ) / 2**20,
+                1,
             )
         t_phase = _time.perf_counter()
-        pcu, pvu = _device_scatter_pack(
-            u_dev, i_dev, v_dev, aux["su"], aux["bu"],
+        u_keys, pcu, pvu = _device_pack_presorted(
+            i_dev, v_dev, aux["su"], aux["bu"],
             total=geo_u.total, L=L_u, scale=v_scale,
         )
         pci, pvi = _device_scatter_pack(
-            i_dev, u_dev, v_dev, aux["si"], aux["bi"],
+            i_dev, u_keys, v_dev, aux["si"], aux["bi"],
             total=geo_i.total, L=L_i, scale=v_scale,
         )
         if timings is not None:
@@ -1022,7 +1097,10 @@ def train_als(
         # run identity: same data + same config (iteration count aside) may
         # resume; anything else starts fresh. Guards against silently
         # reusing a finished run's factors after new events arrive, and
-        # against shape mismatches from changed user/item counts.
+        # against shape mismatches from changed user/item counts — the
+        # PADDED row dims are part of the identity, so a checkpoint
+        # written under a different padding rule (e.g. pre-row-bucketing)
+        # restarts cleanly instead of crashing resume on a shape mismatch
         fingerprint = np.frombuffer(
             hashlib.sha256(
                 user_idx.tobytes()
@@ -1030,6 +1108,7 @@ def train_als(
                 + np.asarray(ratings, np.float32).tobytes()
                 + repr(dataclasses.replace(config, iterations=0)).encode()
                 + f"{n_users},{n_items},{n_shards}".encode()
+                + f";rows={X.shape[0]},{Y.shape[0]}".encode()
             ).digest(),
             dtype=np.uint8,
         )

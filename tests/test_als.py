@@ -298,6 +298,30 @@ class TestPackShapeBucketing:
         assert side.seg_rows.shape[1] % 8 == 0
         assert int(dense_mask(side).sum()) == 100
 
+    def test_near_equal_cardinalities_share_iteration_executable(self):
+        """The system-ROW dimension buckets too (round 5): a store scan
+        seeing 0.04% fewer distinct users than the direct path — or a
+        retrain after new signups — must reuse the compiled iteration
+        program instead of paying a multi-second XLA pause (the round-4
+        store->train seam)."""
+        from predictionio_tpu.ops.als import _bucket_count, _run_iterations
+
+        assert _bucket_count(138_493 + 1) == _bucket_count(138_432 + 1)
+
+        rng = np.random.default_rng(5)
+        cfg = ALSConfig(rank=4, iterations=2, reg=0.1)
+
+        def train(nu):
+            u = rng.integers(0, nu, 3000).astype(np.int32)
+            i = rng.integers(0, 200, 3000).astype(np.int32)
+            r = np.ones(3000, np.float32)
+            train_als(u, i, r, nu, 200, cfg)
+
+        train(1000)
+        before = _run_iterations._cache_size()
+        train(997)  # same 4-significant-bit bucket as 1000
+        assert _run_iterations._cache_size() == before
+
 
 class TestSpdSolve:
     """_spd_solve replaced XLA's cho_solve in round 4 (502 ms/solve at
