@@ -201,8 +201,7 @@ def _segment_geometry(
     # chunk budget, min() below); budget-capped large trains (ML-20M)
     # get the same sc as before and pad at most one trailing chunk.
     per_pad = -(-max(n_segs, 1) // pad_segments_to)
-    granule = 1 << max(0, per_pad.bit_length() - 4)
-    sc_needed = pad_segments_to * (-(-per_pad // granule) * granule)
+    sc_needed = pad_segments_to * _bucket_count(per_pad)
     sc = min(sc, sc_needed)
     n_chunks = max(1, -(-max(n_segs, 1) // sc))
     total = n_chunks * sc
@@ -428,12 +427,18 @@ def _spd_solve(A: jax.Array, b: jax.Array) -> jax.Array:
         y = jax.lax.dynamic_update_index_in_dim(y, yj, j, axis=1)
         dinv = jax.lax.dynamic_update_index_in_dim(dinv, d, j, axis=1)
         # rank-1 Schur update; col is zero above j, so rows/cols < j are
-        # untouched and the (never-read) upper triangle absorbs the rest
-        A = A - col[:, :, None] * col[:, None, :]
-        return (
-            jax.lax.dynamic_update_index_in_dim(A, col, j, axis=2),
-            y, r, dinv,
+        # untouched and the (never-read) upper triangle absorbs the rest.
+        # The scaled column lands in A[:, :, j] via the SAME fused pass (a
+        # select on the column index) — a separate dynamic_update_slice
+        # here materialized a full [R, k, k] data-formatting copy per
+        # pass, doubling solve HBM traffic (trace: copy.80/copy.110 ~
+        # equal bytes to the multiply-subtract itself).
+        A = jnp.where(
+            idx[None, None, :] == j,
+            col[:, :, None],
+            A - col[:, :, None] * col[:, None, :],
         )
+        return (A, y, r, dinv)
 
     zeros = jnp.zeros_like(b)
     L, y, _, dinv = jax.lax.fori_loop(
@@ -770,7 +775,8 @@ def _place(mesh: Optional[Mesh], arr, spec):
 
 
 def _bucket_count(n: int) -> int:
-    """Round a count up at 4-significant-bit granularity (≤6.25% padding).
+    """Round a count up at 4-significant-bit granularity (≤12.5% padding
+    worst-case, just above a power of two; ~6% typical).
 
     Every jit-visible dimension derived from data cardinalities buckets
     through this so near-identical inputs share one compiled executable:
@@ -862,6 +868,7 @@ def train_als(
     checkpoint_dir: Optional[str] = None,
     checkpoint_every: int = 5,
     timings: Optional[dict] = None,
+    profile_dir: Optional[str] = None,
 ) -> ALSModelArrays:
     """Train ALS factors from COO ratings.
 
@@ -1136,50 +1143,64 @@ def train_als(
                 Y = _place(mesh, np.asarray(state["Y"], np.float32), row_sharded)
                 logger.info("resuming ALS from iteration %d", start_it)
 
+    from predictionio_tpu.utils.profiling import trace as _profiler_trace
+
+    # per-op observability of the hot loop (SURVEY.md §5): with a
+    # profile_dir, EXACTLY the timed device loop(s) run under
+    # jax.profiler.trace — no pack/transfer/compile events mixed in
+    # (bench.py --trace-loop reduces the trace to docs/ALS_LOOP_TRACE.json).
+    # Covers both the single-program path and the checkpoint-chunked loop.
     try:
-        if not ckpt.enabled:
-            # the entire loop is one device program
-            if config.iterations > start_it:
-                t_phase = _time.perf_counter()
-                X, Y = run_iters(X, Y, config.iterations - start_it)
-                if timings is not None:
-                    _fence((X, Y))
-                    timings["device_loop_s"] = _time.perf_counter() - t_phase
-        else:
-            # chunk the fused loop at the checkpoint cadence
-            it = start_it
-            while it < config.iterations:
-                chunk = min(checkpoint_every, config.iterations - it)
-                t_phase = _time.perf_counter()
-                X, Y = run_iters(X, Y, chunk)
-                if timings is not None:
-                    _fence((X, Y))
-                    timings["device_loop_s"] = timings.get(
-                        "device_loop_s", 0.0
-                    ) + (_time.perf_counter() - t_phase)
-                it += chunk
-                logger.debug(
-                    "ALS iteration %d/%d done", it, config.iterations
-                )
-                # hand the (possibly mesh-sharded) factor arrays to orbax
-                # as-is: StandardSave handles sharded jax.Arrays natively,
-                # and np.asarray would both crash on non-fully-addressable
-                # multi-host arrays and force a device->host copy per chunk
-                ckpt.maybe_save(
-                    it,
-                    {
-                        "iteration": it,
-                        "X": X,
-                        "Y": Y,
-                        "fingerprint": fingerprint,
-                    },
-                    force=True,  # chunk boundaries ARE the cadence
-                )
-                # The next run_iters call DONATES X/Y (donate_argnums),
-                # overwriting these buffers in place; orbax's save may
-                # still be copying them device->host. Block until the
-                # save has committed before handing the buffers back.
-                ckpt.wait_until_finished()
+        with _profiler_trace(profile_dir):
+            if not ckpt.enabled:
+                # the entire loop is one device program
+                if config.iterations > start_it:
+                    t_phase = _time.perf_counter()
+                    X, Y = run_iters(X, Y, config.iterations - start_it)
+                    if timings is not None or profile_dir is not None:
+                        _fence((X, Y))
+                    if timings is not None:
+                        # recorded before the tracer exits so trace
+                        # collection overhead never inflates the loop time
+                        timings["device_loop_s"] = (
+                            _time.perf_counter() - t_phase
+                        )
+            else:
+                # chunk the fused loop at the checkpoint cadence
+                it = start_it
+                while it < config.iterations:
+                    chunk = min(checkpoint_every, config.iterations - it)
+                    t_phase = _time.perf_counter()
+                    X, Y = run_iters(X, Y, chunk)
+                    if timings is not None:
+                        _fence((X, Y))
+                        timings["device_loop_s"] = timings.get(
+                            "device_loop_s", 0.0
+                        ) + (_time.perf_counter() - t_phase)
+                    it += chunk
+                    logger.debug(
+                        "ALS iteration %d/%d done", it, config.iterations
+                    )
+                    # hand the (possibly mesh-sharded) factor arrays to
+                    # orbax as-is: StandardSave handles sharded jax.Arrays
+                    # natively, and np.asarray would both crash on
+                    # non-fully-addressable multi-host arrays and force a
+                    # device->host copy per chunk
+                    ckpt.maybe_save(
+                        it,
+                        {
+                            "iteration": it,
+                            "X": X,
+                            "Y": Y,
+                            "fingerprint": fingerprint,
+                        },
+                        force=True,  # chunk boundaries ARE the cadence
+                    )
+                    # The next run_iters call DONATES X/Y (donate_argnums),
+                    # overwriting these buffers in place; orbax's save may
+                    # still be copying them device->host. Block until the
+                    # save has committed before handing the buffers back.
+                    ckpt.wait_until_finished()
     finally:
         ckpt.close()
 
