@@ -654,6 +654,11 @@ def trace_als_loop(device_name, out_path="docs/ALS_LOOP_TRACE.json"):
         if e.get("ph") == "M" and e.get("name") == "process_name"
     }
     tpu_pids = {p for p, n in pids.items() if "TPU" in str(n)}
+    if not tpu_pids:
+        raise RuntimeError(
+            f"no TPU device lane in the trace (processes: {pids}) — "
+            "--trace-loop must run on TPU hardware"
+        )
     agg = defaultdict(lambda: [0.0, 0, 0, 0])
     for e in events:
         args = e.get("args", {})
@@ -676,6 +681,11 @@ def trace_als_loop(device_name, out_path="docs/ALS_LOOP_TRACE.json"):
     leaf_ms = sum(
         v[0] for (c, n), v in agg.items() if not nested(c, n)
     )
+    if not agg or leaf_ms <= 0.0:
+        raise RuntimeError(
+            "trace captured no attributable device op time — refusing to "
+            "write an empty attribution table"
+        )
     ops = []
     for (cat, name), (ms, cnt, b, fl) in sorted(
         agg.items(), key=lambda kv: -kv[1][0]
@@ -843,6 +853,55 @@ def bench_ml20m_store(device_name):
 # --- config 7: Event Server ingestion throughput ---
 
 
+def _run_ingest_clients(port: int, n_clients: int, n_per_client: int):
+    """Shared POST-client harness for the ingestion configs: warm one
+    client, then fan out ``n_clients`` concurrent clients posting
+    ``n_per_client`` events each. Returns (latencies_ms, wall_s). Kept in
+    one place so the scan-free and scan-in-flight configs can never drift
+    into measuring different protocols."""
+    import http.client
+
+    def client(worker):
+        conn = http.client.HTTPConnection("localhost", port)
+        lat = []
+        try:
+            for j in range(n_per_client):
+                body = json.dumps(
+                    {
+                        "event": "rate",
+                        "entityType": "user",
+                        "entityId": f"u{worker}-{j}",
+                        "targetEntityType": "item",
+                        "targetEntityId": f"i{j % 97}",
+                        "properties": {"rating": float(j % 5 + 1)},
+                    }
+                )
+                t0 = time.perf_counter()
+                conn.request(
+                    "POST",
+                    "/events.json?accessKey=benchkey",
+                    body,
+                    {"Content-Type": "application/json"},
+                )
+                resp = conn.getresponse()
+                resp.read()
+                assert resp.status == 201, resp.status
+                lat.append((time.perf_counter() - t0) * 1000)
+        finally:
+            conn.close()
+        return lat
+
+    client(999)  # warm (threads, code paths)
+    lat = []
+    t0 = time.perf_counter()
+    with concurrent.futures.ThreadPoolExecutor(
+        max_workers=n_clients
+    ) as pool:
+        for chunk in pool.map(client, range(n_clients)):
+            lat.extend(chunk)
+    return lat, time.perf_counter() - t0
+
+
 def bench_ingestion(device_name):
     """POST /events.json throughput under concurrent clients — the Event
     Server is the reference's front door (EventServer.scala:502) and its
@@ -865,49 +924,8 @@ def bench_ingestion(device_name):
         storage=storage, config=EventServerConfig(port=0)
     ).start()
     try:
-        import http.client
-
         n_clients, n_per_client = 16, 150
-
-        def client(worker):
-            conn = http.client.HTTPConnection("localhost", server.port)
-            lat = []
-            try:
-                for j in range(n_per_client):
-                    body = json.dumps(
-                        {
-                            "event": "rate",
-                            "entityType": "user",
-                            "entityId": f"u{worker}-{j}",
-                            "targetEntityType": "item",
-                            "targetEntityId": f"i{j % 97}",
-                            "properties": {"rating": float(j % 5 + 1)},
-                        }
-                    )
-                    t0 = time.perf_counter()
-                    conn.request(
-                        "POST",
-                        "/events.json?accessKey=benchkey",
-                        body,
-                        {"Content-Type": "application/json"},
-                    )
-                    resp = conn.getresponse()
-                    resp.read()
-                    assert resp.status == 201, resp.status
-                    lat.append((time.perf_counter() - t0) * 1000)
-            finally:
-                conn.close()
-            return lat
-
-        client(999)  # warm (threads, code paths)
-        lat = []
-        t0 = time.perf_counter()
-        with concurrent.futures.ThreadPoolExecutor(
-            max_workers=n_clients
-        ) as pool:
-            for chunk in pool.map(client, range(n_clients)):
-                lat.extend(chunk)
-        wall = time.perf_counter() - t0
+        lat, wall = _run_ingest_clients(server.port, n_clients, n_per_client)
         emit(
             {
                 "metric": "eventserver_ingest_events_per_sec",
@@ -927,6 +945,127 @@ def bench_ingestion(device_name):
         )
     finally:
         server.shutdown()
+
+
+# --- config 7b: ingestion racing a training scan (sqlite WAL) ---
+
+
+def bench_concurrent_ingest(device_name):
+    """POST /events.json throughput while a training scan loops over the
+    same sqlite-backed store — the concurrency contract of the
+    reference's HBase tier (ingest and region-parallel scans proceed
+    together, hbase/StorageClient.scala:40). Measures the WAL
+    snapshot-read design: scans run on per-thread read connections, so
+    ingest throughput under a scan should hold near the scan-free rate."""
+    import shutil
+    import tempfile
+    import threading
+
+    from predictionio_tpu.api.event_server import (
+        EventServer,
+        EventServerConfig,
+    )
+    from predictionio_tpu.data.storage import Storage
+    from predictionio_tpu.data.storage.base import AccessKey, App
+    from predictionio_tpu.data.store import PEventStore
+    from predictionio_tpu.models.recommendation.engine import RATING_SPEC
+
+    tmp = tempfile.mkdtemp(prefix="bench_conc_")
+    try:
+        storage = Storage(
+            {
+                "PIO_STORAGE_SOURCES_SQLITE_TYPE": "sqlite",
+                "PIO_STORAGE_SOURCES_SQLITE_PATH": os.path.join(tmp, "s.db"),
+                "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "SQLITE",
+                "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "SQLITE",
+                "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "SQLITE",
+            }
+        )
+        app_id = storage.get_meta_data_apps().insert(
+            App(id=0, name="bench")
+        )
+        storage.get_meta_data_access_keys().insert(
+            AccessKey(key="benchkey", appid=app_id, events=())
+        )
+        events = storage.get_l_events()
+        events.init(app_id)
+        # pre-seed bulk pages so the in-flight scan does real work
+        rng = np.random.default_rng(7)
+        n_seed = 1_000_000
+        events.insert_columns(
+            app_id, event="rate", entity_type="user",
+            target_entity_type="item",
+            entity_ids=np.char.add(
+                "u", rng.integers(0, 20_000, n_seed).astype("U6")
+            ),
+            target_ids=np.char.add(
+                "i", rng.integers(0, 2_000, n_seed).astype("U5")
+            ),
+            values=(np.round(rng.uniform(1, 10, n_seed)) / 2).astype(
+                np.float32
+            ),
+        )
+        server = EventServer(
+            storage=storage, config=EventServerConfig(port=0)
+        ).start()
+        try:
+            n_clients, n_per_client = 16, 100
+            stop = threading.Event()
+            scans = {"count": 0, "events": 0}
+            scan_errors = []
+
+            def scanner():
+                p = PEventStore(storage)
+                try:
+                    while not stop.is_set():
+                        cols = p.find_columns(
+                            "bench",
+                            value_spec=RATING_SPEC,
+                            entity_type="user",
+                            target_entity_type="item",
+                            event_names=["rate", "buy"],
+                        )
+                        scans["count"] += 1
+                        scans["events"] += cols.n
+                except Exception as e:
+                    scan_errors.append(e)
+
+            scan_t = threading.Thread(target=scanner)
+            scan_t.start()
+            lat, wall = _run_ingest_clients(
+                server.port, n_clients, n_per_client
+            )
+            stop.set()
+            scan_t.join(timeout=60)
+            # the config exists to measure ingest UNDER scans: a dead or
+            # never-completing scanner would silently measure the
+            # scan-free rate instead
+            if scan_errors:
+                raise RuntimeError(f"in-flight scan failed: {scan_errors[0]}")
+            assert scans["count"] > 0, "no scan completed during ingest"
+            emit(
+                {
+                    "metric": "concurrent_ingest_events_per_sec",
+                    "value": round(len(lat) / wall, 1),
+                    "unit": "events/s",
+                    # same conservative single-node stand-in as the
+                    # scan-free ingestion config
+                    "vs_baseline": round(len(lat) / wall / 1000.0, 2),
+                    "baseline_events_per_sec": 1000,
+                    "baseline_estimated": True,
+                    "ingest_p50_ms": round(pctl(lat, 50), 2),
+                    "ingest_p99_ms": round(pctl(lat, 99), 2),
+                    "clients": n_clients,
+                    "scans_completed_in_flight": scans["count"],
+                    "events_scanned_in_flight": scans["events"],
+                    "seeded_events": n_seed,
+                    "device": device_name,
+                }
+            )
+        finally:
+            server.shutdown()
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
 
 
 # --- config 2: classification NaiveBayes ---
@@ -1184,6 +1323,7 @@ BENCHES = {
     "ml20m": bench_ml20m,
     "ml20m_store": bench_ml20m_store,
     "ingestion": bench_ingestion,
+    "concurrent_ingest": bench_concurrent_ingest,
 }
 
 

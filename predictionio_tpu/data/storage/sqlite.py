@@ -81,7 +81,12 @@ class _LockedCursor:
 class StorageClient(base.DAOCacheMixin):
     """Shared sqlite connection per source (reference caches clients per
     source name, Storage.scala:202-208). ``check_same_thread=False`` plus a
-    lock serializes access from REST worker threads."""
+    lock serializes WRITE access from REST worker threads; bulk reads run
+    on per-thread WAL snapshot connections (``read_execute``), so a
+    training scan never blocks ingest and ingest never stalls a scan —
+    the concurrency role of the reference's HBase client pool +
+    region-parallel reads (hbase/StorageClient.scala:40,
+    HBPEvents.scala:84-90)."""
 
     def __init__(self, config=None):
         self.config = config
@@ -94,12 +99,50 @@ class StorageClient(base.DAOCacheMixin):
             os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
         self.path = path
         self.conn = sqlite3.connect(path, check_same_thread=False)
+        # WAL: readers on other connections see a consistent snapshot
+        # while one writer proceeds — the mode every concurrent path here
+        # assumes. busy_timeout covers multi-process writers (gateway +
+        # CLI) briefly contending for the single WAL write slot.
         self.conn.execute("PRAGMA journal_mode=WAL")
+        self.conn.execute("PRAGMA busy_timeout=5000")
+        # WAL's standard production pairing: commits append to the WAL
+        # without an fsync each (integrity is preserved on crash; only
+        # the tail of very recent commits may be lost on power failure).
+        # Per-event REST ingest is commit-bound — FULL measured ~380
+        # events/s vs ~thousands with NORMAL on the same rig.
+        self.conn.execute("PRAGMA synchronous=NORMAL")
         self.lock = threading.RLock()
+        self._read_local = threading.local()
         self._init_dao_cache(self.lock)
 
     def execute(self, sql: str, params=()) -> _LockedCursor:
         return _LockedCursor(self, sql, params)
+
+    def read_execute(self, sql: str, params=()):
+        """Run a read-only statement on a thread-local WAL connection —
+        no writer lock held, so long scans and concurrent writes overlap.
+        Returns a live cursor (fetchone/fetchall). :memory: databases are
+        not shareable across connections and fall back to the locked
+        shared connection.
+
+        Because the existence check and the read no longer share one lock
+        scope, a concurrent table drop (app delete) can surface here as
+        sqlite's raw OperationalError — it is re-raised as StorageError so
+        read paths keep their documented error contract."""
+        if self.path == ":memory:":
+            return self.execute(sql, params)
+        conn = getattr(self._read_local, "conn", None)
+        if conn is None:
+            conn = sqlite3.connect(self.path)
+            conn.execute("PRAGMA busy_timeout=5000")
+            conn.execute("PRAGMA query_only=ON")
+            self._read_local.conn = conn
+        try:
+            return conn.execute(sql, params)
+        except sqlite3.OperationalError as e:
+            if "no such table" in str(e):
+                raise StorageError(str(e)) from e
+            raise
 
     def commit(self) -> None:
         with self.lock:
@@ -462,7 +505,9 @@ class SQLiteLEvents(base.LEvents):
         with self._c.lock:
             if not self._exists(t):
                 raise StorageError(f"events table {t} not initialized")
-            rows = self._c.execute(sql, params).fetchall()
+        # the potentially-large scan runs on the snapshot connection, so
+        # concurrent ingest proceeds while this fetch streams
+        rows = self._c.read_execute(sql, params).fetchall()
         row_events = [self._row_to_event(r) for r in rows]
         # merge bulk-imported page events (rare on this legacy path — the
         # training scan is find_columns_native; here pages decode into
@@ -510,7 +555,9 @@ class SQLiteLEvents(base.LEvents):
         """Global dictionary as an id-indexed name array."""
         import numpy as np
 
-        rows = self._c.execute(f"SELECT id, name FROM {t}_dict").fetchall()
+        rows = self._c.read_execute(
+            f"SELECT id, name FROM {t}_dict"
+        ).fetchall()
         size = (max(r[0] for r in rows) + 1) if rows else 0
         arr = np.empty(size, object)
         for i, name in rows:
@@ -664,7 +711,7 @@ class SQLiteLEvents(base.LEvents):
         with self._c.lock:
             if not self._exists(f"{t}_pages"):
                 return []
-            return self._c.execute(sql, params).fetchall()
+        return self._c.read_execute(sql, params).fetchall()
 
     def _page_events(
         self, t, start_time, until_time, entity_type, entity_id,
@@ -751,9 +798,9 @@ class SQLiteLEvents(base.LEvents):
         with self._c.lock:
             if not self._exists(t):
                 raise StorageError(f"events table {t} not initialized")
-            rows = self._c.execute(
-                f"SELECT * FROM {t} ORDER BY event_time_ms ASC"
-            ).fetchall()
+        rows = self._c.read_execute(
+            f"SELECT * FROM {t} ORDER BY event_time_ms ASC"
+        ).fetchall()
         return (self._row_to_event(r) for r in rows)
 
     def iter_export_pages(
@@ -775,19 +822,19 @@ class SQLiteLEvents(base.LEvents):
         with self._c.lock:
             if not self._exists(f"{t}_pages"):
                 return
-            page_ids = [
-                r[0]
-                for r in self._c.execute(
-                    f"SELECT page FROM {t}_pages ORDER BY page"
-                ).fetchall()
-            ]
+        page_ids = [
+            r[0]
+            for r in self._c.read_execute(
+                f"SELECT page FROM {t}_pages ORDER BY page"
+            ).fetchall()
+        ]
         if not page_ids:
             return
         names = self._dict_names(t)
         for page_id in page_ids:
-            # one page's blobs at a time: peak memory is one page and
-            # the connection lock releases between pages
-            row = self._c.execute(
+            # one page's blobs at a time: peak memory stays one page, and
+            # the snapshot connection never touches the writer lock
+            row = self._c.read_execute(
                 f"SELECT page, event, entity_type, target_entity_type, "
                 f"prop, n, entities, targets, vals, times, dead "
                 f"FROM {t}_pages WHERE page=?",
@@ -968,8 +1015,7 @@ class SQLiteLEvents(base.LEvents):
             + null_case_params + [prop_path]
             + null_case_params + [prop_path] + params
         )
-        with self._c.lock:
-            rows = self._c.execute(sql, all_params).fetchall()
+        rows = self._c.read_execute(sql, all_params).fetchall()
         if rows:
             from predictionio_tpu.data.storage.columnar import encode_strings
 
