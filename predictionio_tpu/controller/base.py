@@ -135,6 +135,15 @@ class BaseAlgorithm(Controller, Generic[PD, M, Q, P]):
     # back to thread-parallel per-variant training.
     GRID_AXES: Tuple[str, ...] = ()
 
+    # Whether predict/batch_predict dispatches device programs over a
+    # multi-process mesh. False (every current algorithm: serving runs
+    # local single-device programs) lets a fully grid-pretrained
+    # multi-host evaluation thread-parallelize its serving stages; an
+    # algorithm that serves THROUGH mesh collectives must set True so
+    # the multi-host grid keeps its collective-order-safe serialization
+    # (controller/engine.py _run_grid).
+    MESH_SERVING: bool = False
+
     def train(self, ctx, prepared_data: PD) -> M:
         raise NotImplementedError
 

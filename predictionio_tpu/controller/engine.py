@@ -101,19 +101,26 @@ def _multi_host() -> bool:
         return False
 
 
-def _run_grid(items: Sequence[Any], fn, workflow_params) -> List[Any]:
+def _run_grid(
+    items: Sequence[Any], fn, workflow_params, collective_free: bool = False
+) -> List[Any]:
     """Map fn over grid items, in order, with a thread pool when
     workflow_params.eval_parallelism > 1.
 
-    On a multi-host runtime the grid always runs serially: each item's
-    train issues collective device programs over the multi-process mesh,
-    and JAX multi-controller semantics require every process to enqueue
-    the same collectives in the same order — thread scheduling would
-    reorder them differently per host and deadlock the pod."""
+    On a multi-host runtime the grid runs serially UNLESS the caller
+    attests ``collective_free``: by default each item's train issues
+    collective device programs over the multi-process mesh, and JAX
+    multi-controller semantics require every process to enqueue the same
+    collectives in the same order — thread scheduling would reorder them
+    differently per host and deadlock the pod. FastEvalEngine lifts this
+    by training the whole grid in ONE batched program first (order-safe
+    by construction) and passing collective_free=True for the remaining
+    per-variant host stages — the `.par` the reference runs regardless of
+    cluster shape (MetricEvaluator.scala:221-230)."""
     items = list(items)
     workers = getattr(workflow_params, "eval_parallelism", 1) or 1
     workers = min(int(workers), len(items))
-    if workers > 1 and _multi_host():
+    if workers > 1 and not collective_free and _multi_host():
         logger.info(
             "multi-host run: evaluating the grid serially (collective "
             "order must match across hosts; eval_parallelism ignored)"

@@ -136,10 +136,15 @@ class FastEvalEngineWorkflow:
         if mode == "auto":
             import jax
 
-            if jax.default_backend() == "cpu":
+            if jax.default_backend() == "cpu" and jax.process_count() == 1:
                 # CPU dispatch is cheap and the vmapped program serializes
                 # the variants anyway — measured slower than per-variant
-                # trains with shared (bucketed-shape) executables
+                # trains with shared (bucketed-shape) executables. On a
+                # MULTI-HOST runtime the grid runs regardless of backend:
+                # one batched program is collective-order-safe by
+                # construction, which is what lets batch_eval lift the
+                # per-variant serialization (reference `.par` parity,
+                # MetricEvaluator.scala:221-230)
                 return 0
 
         # group by (ds, prep, algo name, params-with-axes-normalized)
@@ -296,8 +301,31 @@ class FastEvalEngine(Engine):
         # algorithm's GRID_AXES train in one vmapped program; whatever
         # it can't batch runs through the thread-parallel fallback below
         workflow.prefill_grid_models(engine_params_list)
+        # when the grid pass covered EVERY variant AND no algorithm
+        # serves through mesh collectives (MESH_SERVING), the remaining
+        # map is serving/metric host work plus local-device programs —
+        # no multi-process collectives — so the multi-host serialization
+        # (collective ordering) no longer applies and threads are safe
+        def _serving_meshless(ep: EngineParams) -> bool:
+            for name, _ in ep.algorithm_params_list:
+                try:
+                    cls = self._lookup(
+                        self.algorithm_class_map, name, "Algorithm"
+                    )
+                except (KeyError, ValueError):
+                    return False
+                if getattr(cls, "MESH_SERVING", False):
+                    return False
+            return True
+
+        collective_free = all(
+            workflow._models_key(ep) in workflow.algorithms_cache
+            and _serving_meshless(ep)
+            for ep in engine_params_list
+        )
         return _run_grid(
             engine_params_list,
             lambda ep: (ep, workflow.get_results(ep)),
             workflow_params,
+            collective_free=collective_free,
         )
