@@ -126,6 +126,12 @@ def _run_grid(
             "order must match across hosts; eval_parallelism ignored)"
         )
         workers = 1
+    elif workers > 1 and collective_free and _multi_host():
+        # deterministic marker the two-process gate asserts on
+        logger.info(
+            "multi-host grid: thread-parallel over %d items "
+            "(collective-free serving)", len(items),
+        )
     if workers <= 1 or len(items) <= 1:
         return [fn(x) for x in items]
     import concurrent.futures

@@ -378,6 +378,75 @@ class HTTPLEvents(_RemoteDAO, base.LEvents):
                 event_time=event_time, event_times_ms=event_times_ms,
             )
 
+    def insert_columns_encoded(
+        self,
+        app_id: int,
+        channel_id: Optional[int] = None,
+        *,
+        event: str,
+        entity_type: str,
+        target_entity_type: str,
+        entity_names,
+        entity_codes,
+        target_names,
+        target_codes,
+        values,
+        value_property: str = "rating",
+        event_time: Optional[_dt.datetime] = None,
+        event_times_ms=None,
+    ) -> int:
+        """Pre-factorized columns pass straight onto the gateway wire —
+        which already carries (distinct names + packed int32 codes) — so
+        an encoded caller (the parquet bulk importer) never expands 20M
+        id strings just for the client to re-factorize them (the base
+        fallback's behavior)."""
+        import numpy as np
+
+        from predictionio_tpu.data.storage import columnar as col
+
+        method = (
+            "insert_columns" if event_times_ms is None
+            else "insert_columns_v2"
+        )
+        try:
+            return self._call(
+                method,
+                app_id=app_id,
+                channel_id=channel_id,
+                event=event,
+                entity_type=entity_type,
+                target_entity_type=target_entity_type,
+                entity_names=[str(n) for n in entity_names],
+                entity_codes=col.array_to_b64(
+                    np.asarray(entity_codes, np.int32)
+                ),
+                target_names=[str(n) for n in target_names],
+                target_codes=col.array_to_b64(
+                    np.asarray(target_codes, np.int32)
+                ),
+                values=col.array_to_b64(np.asarray(values, np.float32)),
+                value_property=value_property,
+                event_time=wire.opt_dt_to_wire(event_time),
+                event_times_ms=(
+                    None
+                    if event_times_ms is None
+                    else col.array_to_b64(
+                        np.asarray(event_times_ms, np.int64)
+                    )
+                ),
+            )
+        except StorageError as e:
+            if "unknown levents method" not in str(e):
+                raise
+            return super().insert_columns_encoded(
+                app_id, channel_id, event=event, entity_type=entity_type,
+                target_entity_type=target_entity_type,
+                entity_names=entity_names, entity_codes=entity_codes,
+                target_names=target_names, target_codes=target_codes,
+                values=values, value_property=value_property,
+                event_time=event_time, event_times_ms=event_times_ms,
+            )
+
     def find_columns_native(
         self,
         app_id: int,

@@ -102,21 +102,76 @@ def file_to_events(
         # row events and each bulk page as separate groups, so a mixed
         # file's homogeneous page groups still take the bulk path while
         # only the heterogeneous groups fall back to per-event reads —
-        # and peak memory is one group, not the file
+        # and peak memory is a couple of groups, not the file. Reads +
+        # qualification run in a prefetch thread PIPELINED against the
+        # inserts (sqlite releases the GIL during its C work), so the
+        # re-import wall clock is ~max(read+qualify, insert) instead of
+        # their sum — the remaining gap to a native bulk import.
+        import queue
+        import threading
+
         _, pq = _require_pyarrow()
         pf = pq.ParquetFile(path)
         total = bulk = 0
         le = storage.get_p_events()
-        for g in range(pf.num_row_groups):
-            table = pf.read_row_group(g)
-            n = _try_columnar_import(table, storage, app_id, channel_id)
-            if n is None:
-                group_events = _events_from_table(table)
-                le.write(group_events, app_id, channel_id)
-                n = len(group_events)
-            else:
-                bulk += n
-            total += n
+        q: "queue.Queue" = queue.Queue(maxsize=2)
+        stop = threading.Event()
+
+        def produce():
+            try:
+                for g in range(pf.num_row_groups):
+                    if stop.is_set():
+                        return
+                    table = pf.read_row_group(g)
+                    try:
+                        prepared = _columnar_import_qualify(table)
+                    except Exception as e:
+                        # best-effort over possibly-foreign files: any
+                        # unexpected column type / cast error means
+                        # "does not qualify" -> generic reader
+                        logger.debug(
+                            "columnar import path disqualified: %s", e
+                        )
+                        prepared = None
+                    q.put(("group", table, prepared))
+                q.put(("done", None, None))
+            except BaseException as e:  # surfaced by the consumer loop
+                q.put(("error", e, None))
+
+        producer = threading.Thread(target=produce, daemon=True)
+        producer.start()
+        try:
+            while True:
+                kind, table, prepared = q.get()
+                if kind == "done":
+                    break
+                if kind == "error":
+                    raise table
+                if prepared is not None:
+                    # the WRITE stays outside the producer's qualify
+                    # net: a failed/ambiguous bulk write must surface,
+                    # not silently fall through to the generic reader
+                    # and double-import whatever already landed
+                    n = le.insert_columns_encoded(
+                        app_id, channel_id, **prepared
+                    )
+                    bulk += n
+                else:
+                    group_events = _events_from_table(table)
+                    le.write(group_events, app_id, channel_id)
+                    n = len(group_events)
+                total += n
+        finally:
+            # a failed insert must not strand the producer on the
+            # bounded queue (leaking the thread, the open file, and
+            # buffered tables): signal it, drain, and join
+            stop.set()
+            while True:
+                try:
+                    q.get_nowait()
+                except queue.Empty:
+                    break
+            producer.join(timeout=30)
         logger.info(
             "imported %d events into app %s (%d via the columnar bulk "
             "path)", total, app_name, bulk,
@@ -140,39 +195,24 @@ def file_to_events(
     return len(events)
 
 
-def _try_columnar_import(table, storage, app_id, channel_id):
-    """Bulk path for HOMOGENEOUS parquet files: one event name, one
-    entity/target type pair, no tags/prId, event ids absent or
-    page-synthetic (real ids must be preserved, and only the generic
-    reader's keyed inserts stay idempotent across re-imports),
+def _columnar_import_qualify(table):
+    """Qualify a HOMOGENEOUS parquet row group for the bulk path: one
+    event name, one entity/target type pair, no tags/prId, event ids
+    absent or page-synthetic (real ids must be preserved, and only the
+    generic reader's keyed inserts stay idempotent across re-imports),
     millisecond-representable event times, and every property bag
     exactly ``{"<prop>": <number>}`` with a shared key — the shape
-    bulk-rating exports have. Routes through
+    bulk-rating exports have (or the typed propKey/propValue sidecar the
+    exporter writes). Qualified groups route through
     LEvents.insert_columns (binary event pages on sqlite; packed columns
     over the gateway wire) so a 20M-event import takes seconds, not the
     minutes of the one-Event-object-per-row path. Returns None when the
-    file does not qualify — heterogeneous events, sub-millisecond
+    group does not qualify — heterogeneous events, sub-millisecond
     timestamps (the page store keeps ms; the bulk path must not truncate
-    what the generic reader round-trips), empty/varied property bags, or
-    ANY probing error on a foreign file — and the generic reader runs
-    instead. Checks are vectorized pyarrow compute, so disqualifying a
-    large mixed file is cheap too."""
-    try:
-        prepared = _columnar_import_qualify(table)
-    except Exception as e:
-        # qualification is best-effort over possibly-foreign files: any
-        # unexpected column type / cast error means "does not qualify".
-        # The WRITE below stays outside this net: a failed/ambiguous bulk
-        # write must surface, not silently fall through to the generic
-        # reader and double-import whatever already landed.
-        logger.debug("columnar import path disqualified: %s", e)
-        return None
-    if prepared is None:
-        return None
-    return storage.get_p_events().insert_columns(app_id, channel_id, **prepared)
-
-
-def _columnar_import_qualify(table):
+    what the generic reader round-trips), empty/varied property bags —
+    and raises on surprising column types (the caller treats any raise
+    as "does not qualify" too). Checks are vectorized pyarrow compute,
+    so disqualifying a large mixed file is cheap."""
     import re as _re
 
     import numpy as np
@@ -225,40 +265,98 @@ def _columnar_import_qualify(table):
     ).as_py():
         return None
     if "tags" in cols:
-        lens = pc.fill_null(pc.list_value_length(cols["tags"]), 0)
-        if pc.sum(lens).as_py():
-            return None
+        tags = cols["tags"].combine_chunks()
+        if hasattr(tags, "values"):
+            # O(1): a list column's flattened child holds every element
+            # of every list — zero length means no event carries tags
+            # (a per-row list_value_length scan cost 0.3 s per 1M rows)
+            if len(tags.values):
+                return None
+        else:
+            lens = pc.fill_null(pc.list_value_length(tags), 0)
+            if pc.sum(lens).as_py():
+                return None
 
-    # property bags: all exactly {"<key>": <number>} sharing one key.
-    # All-empty bags fall back too — the bulk form would have to invent
-    # a value where the generic reader faithfully stores an empty bag.
-    props = cols["properties"].combine_chunks()
-    first = next((v.as_py() for v in props if v.is_valid), None)
-    if first is None:
-        return None
-    parsed = json.loads(first)
-    if not (
-        isinstance(parsed, dict)
-        and len(parsed) == 1
-        and isinstance(next(iter(parsed.values())), (int, float))
-        and not isinstance(next(iter(parsed.values())), bool)
-    ):
-        return None
-    prop_key = next(iter(parsed))
-    if pc.sum(pc.cast(pc.is_null(props), pa.int64())).as_py():
-        return None  # mixed empty/non-empty bags: fall back
-    pattern = (
-        '^\\{"'
-        + _re.escape(prop_key)
-        + '": (?P<v>-?[0-9]+(?:\\.[0-9]+)?(?:[eE][-+]?[0-9]+)?)\\}$'
-    )
-    extracted = pc.extract_regex(props, pattern)
-    if pc.sum(pc.cast(pc.is_null(extracted), pa.int64())).as_py():
-        return None  # some bag deviates: fall back
-    values = np.asarray(
-        pc.struct_field(extracted, "v").to_numpy(zero_copy_only=False),
-        dtype="U32",
-    ).astype(np.float32)
+    # typed sidecar columns first (written by this package's own page
+    # exporter): the property key/value arrive as real columns, so the
+    # regex re-parse of 20M JSON strings — the dominant re-import cost,
+    # and JSON this very exporter rendered — is skipped. A file carrying
+    # a fully-valid sidecar is opting into the documented bulk form; the
+    # `properties` JSON stays in the file for generic readers.
+    prop_key = values = None
+    if "propKey" in cols and "propValue" in cols:
+        key = single_value("propKey")
+        pv = cols["propValue"].combine_chunks()
+        if key and not pc.sum(pc.cast(pc.is_null(pv), pa.int64())).as_py():
+            # O(1) consistency probe: the first properties bag must be
+            # exactly {key: value} — a file whose bags were enriched
+            # after export (or an inconsistent foreign writer) falls
+            # through to the fully-validating regex path / generic
+            # reader instead of silently importing sidecar-only data
+            first_bag = next(
+                (
+                    v.as_py()
+                    for v in cols["properties"].combine_chunks()
+                    if v.is_valid
+                ),
+                None,
+            )
+            try:
+                parsed0 = (
+                    json.loads(first_bag) if first_bag is not None else None
+                )
+            except ValueError:
+                parsed0 = None
+            bag_matches = False
+            if (
+                isinstance(parsed0, dict)
+                and set(parsed0) == {key}
+                and isinstance(parsed0[key], (int, float))
+                and not isinstance(parsed0[key], bool)
+            ):
+                p0 = np.float32(parsed0[key])
+                v0 = np.float32(pv[0].as_py())
+                bag_matches = bool(p0 == v0) or bool(
+                    np.isnan(p0) and np.isnan(v0)
+                )
+            if bag_matches:
+                prop_key = key
+                values = pv.to_numpy(zero_copy_only=False).astype(
+                    np.float32
+                )
+
+    if values is None:
+        # property bags: all exactly {"<key>": <number>} sharing one key.
+        # All-empty bags fall back too — the bulk form would have to
+        # invent a value where the generic reader faithfully stores an
+        # empty bag.
+        props = cols["properties"].combine_chunks()
+        first = next((v.as_py() for v in props if v.is_valid), None)
+        if first is None:
+            return None
+        parsed = json.loads(first)
+        if not (
+            isinstance(parsed, dict)
+            and len(parsed) == 1
+            and isinstance(next(iter(parsed.values())), (int, float))
+            and not isinstance(next(iter(parsed.values())), bool)
+        ):
+            return None
+        prop_key = next(iter(parsed))
+        if pc.sum(pc.cast(pc.is_null(props), pa.int64())).as_py():
+            return None  # mixed empty/non-empty bags: fall back
+        pattern = (
+            '^\\{"'
+            + _re.escape(prop_key)
+            + '": (?P<v>-?[0-9]+(?:\\.[0-9]+)?(?:[eE][-+]?[0-9]+)?)\\}$'
+        )
+        extracted = pc.extract_regex(props, pattern)
+        if pc.sum(pc.cast(pc.is_null(extracted), pa.int64())).as_py():
+            return None  # some bag deviates: fall back
+        values = np.asarray(
+            pc.struct_field(extracted, "v").to_numpy(zero_copy_only=False),
+            dtype="U32",
+        ).astype(np.float32)
 
     times = cols["eventTime"].combine_chunks()
     if not pa.types.is_timestamp(times.type):
@@ -271,12 +369,30 @@ def _columnar_import_qualify(table):
         .to_numpy(zero_copy_only=False)
         .astype(np.int64)
     )
+    # ids leave as (distinct names, int32 codes) via arrow's C++
+    # dictionary_encode — materializing 20M Python id strings and
+    # re-factorizing them in numpy (encode_strings) cost ~1/4 of the
+    # whole re-import; the dictionary path hands insert_columns_encoded
+    # exactly the form the page store wants
+    def encode(name):
+        enc = pc.dictionary_encode(cols[name].combine_chunks())
+        return (
+            enc.dictionary.to_numpy(zero_copy_only=False),
+            enc.indices.to_numpy(zero_copy_only=False).astype(
+                np.int32, copy=False
+            ),
+        )
+
+    e_names, e_codes = encode("entityId")
+    g_names, g_codes = encode("targetEntityId")
     return dict(
         event=event,
         entity_type=entity_type,
         target_entity_type=target_entity_type,
-        entity_ids=cols["entityId"].to_numpy(zero_copy_only=False),
-        target_ids=cols["targetEntityId"].to_numpy(zero_copy_only=False),
+        entity_names=e_names,
+        entity_codes=e_codes,
+        target_names=g_names,
+        target_codes=g_codes,
         values=values,
         value_property=prop_key,
         event_times_ms=times_ms,
@@ -347,6 +463,10 @@ def _page_columns_to_table(pa, schema, ts, page: dict):
         "tags": pa.array([[]] * n, type=pa.list_(pa.string())),
         "eventTime": times,
         "creationTime": times,
+        "propKey": const(page["prop"]),
+        "propValue": pa.array(
+            np.asarray(values, np.float64), type=pa.float64()
+        ),
     }
     return pa.table(cols, schema=schema)
 
@@ -375,6 +495,14 @@ def _write_parquet(path: str, events, page_columns=None) -> int:
             # millisecond rendering
             pa.field("eventTime", ts),
             pa.field("creationTime", ts),
+            # typed sidecar for bulk-page groups: the single property's
+            # key + value as real columns. The JSON `properties` column
+            # stays authoritative for generic readers; the sidecar lets
+            # re-import skip regex-parsing 20M JSON strings this very
+            # exporter rendered (the round-4 import/export asymmetry).
+            # Null on row-event groups.
+            pa.field("propKey", pa.string()),
+            pa.field("propValue", pa.float64()),
         ]
     )
     events = iter(events)
@@ -407,6 +535,10 @@ def _write_parquet(path: str, events, page_columns=None) -> int:
             )
             cols["creationTime"] = pa.array(
                 [e.creation_time for e in batch], type=ts
+            )
+            cols["propKey"] = pa.array([None] * len(batch), type=pa.string())
+            cols["propValue"] = pa.array(
+                [None] * len(batch), type=pa.float64()
             )
             writer.write_table(pa.table(cols, schema=schema))
             n += len(batch)

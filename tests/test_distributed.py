@@ -434,8 +434,11 @@ class TestTwoProcessEvaluation:
 
 GRID_EVAL_WORKER = textwrap.dedent(
     """
+    import logging
     import sys
     import time
+
+    logging.basicConfig(level=logging.INFO)
 
     import jax
 
@@ -537,13 +540,19 @@ class TestTwoProcessVmappedGrid:
             assert f"GRIDWORKER{rank} OK" in out, out
         joined = "\n".join(outs)
         assert "SCORES MATCH" in joined
+        # deterministic marker: the lifted (thread-parallel,
+        # collective-free) path actually ran on both hosts — this, not a
+        # timing bound, is the regression signal (a re-serialization
+        # would log the serial clamp instead)
+        for rank, out in enumerate(outs):
+            assert "collective-free serving" in out, f"rank {rank}:\n{out}"
         walls = [
             line for out in outs for line in out.splitlines()
             if line.startswith("WALL")
         ]
         assert len(walls) == 1
         parts = dict(p.split("=") for p in walls[0].split()[1:])
-        # 5% tolerance absorbs scheduler noise without letting a real
-        # regression (the lifted path re-serializing: ~1.5x slower)
-        # through
-        assert float(parts["grid"]) < float(parts["serial"]) * 1.05, walls[0]
+        # generous bound: absorbs scheduler noise on loaded machines
+        # while still evidencing the lifted path isn't pathological
+        # (measured 4.5s vs 7.1s on the build rig)
+        assert float(parts["grid"]) < float(parts["serial"]) * 1.3, walls[0]
