@@ -438,11 +438,19 @@ class HTTPLEvents(_RemoteDAO, base.LEvents):
         except StorageError as e:
             if "unknown levents method" not in str(e):
                 raise
-            return super().insert_columns_encoded(
-                app_id, channel_id, event=event, entity_type=entity_type,
+            # old gateway: go STRAIGHT to the batched row write — the
+            # base insert_columns_encoded fallback would route through
+            # self.insert_columns and re-attempt the very RPC that just
+            # failed (a wasted 20M-id expand + doomed round trip per
+            # row group)
+            e_names = np.asarray(entity_names, object)
+            g_names = np.asarray(target_names, object)
+            return base.LEvents.insert_columns(
+                self, app_id, channel_id, event=event,
+                entity_type=entity_type,
                 target_entity_type=target_entity_type,
-                entity_names=entity_names, entity_codes=entity_codes,
-                target_names=target_names, target_codes=target_codes,
+                entity_ids=e_names[np.asarray(entity_codes, np.int64)],
+                target_ids=g_names[np.asarray(target_codes, np.int64)],
                 values=values, value_property=value_property,
                 event_time=event_time, event_times_ms=event_times_ms,
             )

@@ -1,0 +1,180 @@
+"""docs/QUICKSTART.md executed end-to-end.
+
+Every step of the quickstart transcript runs here as real CLI
+subprocesses against an isolated storage universe: app new →
+eventserver POST + bulk import → train → deploy → query → undeploy.
+If this test passes, the doc's commands work as written.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.fixture()
+def qs_env(tmp_path):
+    """The quickstart's §0 environment: embedded sqlite + localfs under
+    one directory, CPU jax (workers model single-chip hosts)."""
+    env = {
+        **os.environ,
+        "PYTHONPATH": _REPO,
+        "JAX_PLATFORMS": "cpu",
+        "PIO_FS_BASEDIR": str(tmp_path / "fs"),
+        "PIO_STORAGE_SOURCES_SQLITE_TYPE": "sqlite",
+        "PIO_STORAGE_SOURCES_SQLITE_PATH": str(tmp_path / "events.db"),
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "SQLITE",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "SQLITE",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "SQLITE",
+    }
+    env.pop("XLA_FLAGS", None)
+    return env
+
+
+def pio(env, *args, timeout=180):
+    out = subprocess.run(
+        [sys.executable, "-m", "predictionio_tpu.tools.cli", *args],
+        capture_output=True, text=True, timeout=timeout, env=env,
+    )
+    assert out.returncode == 0, f"pio {args}:\n{out.stdout}\n{out.stderr}"
+    return out.stdout
+
+
+def wait_http(url, timeout=60):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        try:
+            with urllib.request.urlopen(url, timeout=5) as resp:
+                return resp.read()
+        except (urllib.error.URLError, ConnectionError):
+            time.sleep(0.3)
+    raise TimeoutError(url)
+
+
+def post_json(url, payload):
+    req = urllib.request.Request(
+        url,
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        return resp.status, json.loads(resp.read() or b"{}")
+
+
+class TestQuickstartTranscript:
+    def test_app_import_train_deploy_query(self, qs_env, tmp_path):
+        # §1 create an app; the access key prints in the output
+        out = pio(qs_env, "app", "new", "quickstart")
+        assert "Access Key" in out
+        key = pio(qs_env, "accesskey", "list", "quickstart").split()[0]
+        assert len(key) > 20
+
+        # §2a live collection: eventserver + POST /events.json
+        es_port = free_port()
+        es = subprocess.Popen(
+            [
+                sys.executable, "-m", "predictionio_tpu.tools.cli",
+                "eventserver", "--port", str(es_port),
+            ],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, env=qs_env,
+        )
+        try:
+            wait_http(f"http://localhost:{es_port}/")
+            status, body = post_json(
+                f"http://localhost:{es_port}/events.json?accessKey={key}",
+                {
+                    "event": "rate",
+                    "entityType": "user", "entityId": "u0",
+                    "targetEntityType": "item", "targetEntityId": "i2",
+                    "properties": {"rating": 5.0},
+                },
+            )
+            assert status == 201 and "eventId" in body
+        finally:
+            es.terminate()
+            es.communicate(timeout=30)
+
+        # §2b bulk import: JSON-lines history
+        rng = np.random.default_rng(3)
+        lines = []
+        for u in range(30):
+            liked = rng.permutation(12)[:5]
+            for i in liked:
+                lines.append(json.dumps({
+                    "event": "rate",
+                    "entityType": "user", "entityId": f"u{u}",
+                    "targetEntityType": "item", "targetEntityId": f"i{i}",
+                    "properties": {"rating": float(rng.integers(3, 6))},
+                }))
+        ratings = tmp_path / "ratings.jsonl"
+        ratings.write_text("\n".join(lines) + "\n")
+        out = pio(
+            qs_env, "import", "--app-name", "quickstart",
+            "--input", str(ratings),
+        )
+        assert "Imported 150 events" in out
+
+        # §3 train with the doc's engine.json
+        variant = {
+            "engineFactory": (
+                "predictionio_tpu.models.recommendation."
+                "RecommendationEngineFactory"
+            ),
+            "id": "quickstart", "version": "1",
+            "datasource": {"params": {"app_name": "quickstart"}},
+            "algorithms": [
+                {"name": "als", "params": {"rank": 10, "num_iterations": 10}}
+            ],
+        }
+        vpath = tmp_path / "engine.json"
+        vpath.write_text(json.dumps(variant))
+        out = pio(qs_env, "train", "-v", str(vpath), timeout=420)
+        assert "Training completed. Engine instance:" in out
+
+        # §4 deploy
+        port = free_port()
+        server = subprocess.Popen(
+            [
+                sys.executable, "-m", "predictionio_tpu.tools.cli",
+                "deploy", "-v", str(vpath), "--port", str(port),
+            ],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, env=qs_env,
+        )
+        try:
+            wait_http(f"http://localhost:{port}/", timeout=180)
+
+            # §5 query
+            status, body = post_json(
+                f"http://localhost:{port}/queries.json",
+                {"user": "u0", "num": 4},
+            )
+            assert status == 200
+            scores = body["itemScores"]
+            assert len(scores) == 4
+            assert {"item", "score"} <= set(scores[0])
+
+            # §5 epilogue: undeploy stops the server
+            pio(qs_env, "undeploy", "--port", str(port))
+            server.communicate(timeout=60)
+            assert server.returncode == 0
+        finally:
+            if server.poll() is None:
+                server.kill()
+                server.communicate()

@@ -566,19 +566,29 @@ class TestColumnarParquetImport:
         assert le.find_columns_native(app_id).n == 200
 
     def test_exporter_files_take_the_typed_sidecar_fast_path(
-        self, tmp_path
+        self, tmp_path, monkeypatch
     ):
         """Round-4 verdict weak #4: a file this exporter wrote must
         qualify WITHOUT regex-reparsing the property JSON it rendered —
         the typed propKey/propValue sidecar carries the values, and ids
         leave qualification dictionary-encoded (names + int32 codes,
-        the page store's native form)."""
+        the page store's native form). The regex fallback is disabled
+        for the duration, so a silently-dead sidecar path would FAIL
+        here instead of passing through the fallback."""
         import numpy as np
+        import pyarrow.compute
         import pyarrow.parquet as pq
 
         from predictionio_tpu.tools.export_import import (
             _columnar_import_qualify,
         )
+
+        def no_regex(*a, **k):  # pragma: no cover - trap
+            raise AssertionError(
+                "regex fallback ran: the sidecar fast path is dead"
+            )
+
+        monkeypatch.setattr(pyarrow.compute, "extract_regex", no_regex)
 
         path, _ = self._export_bulk_ratings(tmp_path)
         pf = pq.ParquetFile(str(path))
