@@ -79,6 +79,11 @@ class EventServerConfig:
     port: int = 7070
     plugins: str = "plugins"
     stats: bool = False
+    # bind with SO_REUSEPORT so several worker PROCESSES share the port
+    # (kernel-balanced accepts) — the ingest scale-out past one
+    # GIL-bound accept loop; requires multi-process-shared storage
+    # (sqlite WAL file / gateway), NOT the in-memory backend
+    reuse_port: bool = False
 
 
 def _message(status: int, message: str) -> Tuple[int, dict]:
@@ -101,8 +106,31 @@ class EventAPI:
         self._events = self.storage.get_l_events()
         self._access_keys = self.storage.get_meta_data_access_keys()
         self._channels = self.storage.get_meta_data_channels()
+        # access-key lookups hit the metadata store on EVERY request; on
+        # a file-backed store that is a per-event SELECT contending with
+        # the ingest writer (measured: most of the sqlite-vs-memory REST
+        # throughput gap). Keys change rarely — a short TTL bounds how
+        # long a revoked key keeps working (the reference re-reads per
+        # request but against an in-JVM HBase client cache).
+        self._auth_cache: Dict[str, Tuple[float, Any]] = {}
+        self._AUTH_TTL_S = 5.0
 
     # --- auth (reference withAccessKey, EventServer.scala:81-107) ---
+
+    def _lookup_access_key(self, key: str):
+        import time as _time
+
+        now = _time.monotonic()
+        hit = self._auth_cache.get(key)
+        if hit is not None and now - hit[0] < self._AUTH_TTL_S:
+            return hit[1]
+        access_key = self._access_keys.get(key)
+        # bound the cache: unauthenticated floods of random keys must
+        # not grow it without limit
+        if len(self._auth_cache) > 10_000:
+            self._auth_cache.clear()
+        self._auth_cache[key] = (now, access_key)
+        return access_key
 
     def _authenticate(
         self, query: Dict[str, str]
@@ -111,7 +139,7 @@ class EventAPI:
         key = query.get("accessKey")
         if not key:
             return None, _message(401, "Missing accessKey.")
-        access_key = self._access_keys.get(key)
+        access_key = self._lookup_access_key(key)
         if access_key is None:
             return None, _message(401, "Invalid accessKey.")
         channel_name = query.get("channel")
@@ -331,7 +359,8 @@ class EventServer(JsonHTTPServer):
         self.config = config or EventServerConfig()
         self.api = EventAPI(storage, self.config, plugin_context)
         super().__init__(
-            self.api.handle, self.config.ip, self.config.port, "Event Server"
+            self.api.handle, self.config.ip, self.config.port,
+            "Event Server", reuse_port=self.config.reuse_port,
         )
 
 

@@ -31,6 +31,24 @@ class _Server(ThreadingHTTPServer):
     request_queue_size = 128
 
 
+class _ReusePortServer(_Server):
+    allow_reuse_port = True  # honored on Python 3.11+
+
+    def server_bind(self):
+        import socket as _socket
+
+        try:
+            self.socket.setsockopt(
+                _socket.SOL_SOCKET, _socket.SO_REUSEPORT, 1
+            )
+        except (AttributeError, OSError) as e:
+            raise OSError(
+                "SO_REUSEPORT is unavailable on this platform; "
+                "multi-worker port sharing cannot work"
+            ) from e
+        super().server_bind()
+
+
 class _Handler(BaseHTTPRequestHandler):
     handle_fn: HandleFn  # bound by JsonHTTPServer
 
@@ -106,14 +124,32 @@ class JsonHTTPServer:
     BIND_RETRIES = 3
     BIND_RETRY_DELAY_S = 1.0
 
-    def __init__(self, handle_fn: HandleFn, ip: str, port: int, name: str):
+    def __init__(
+        self,
+        handle_fn: HandleFn,
+        ip: str,
+        port: int,
+        name: str,
+        reuse_port: bool = False,
+    ):
         self.name = name
         self.ip = ip
         handler = type("BoundHandler", (_Handler,), {"handle_fn": staticmethod(handle_fn)})
+        # SO_REUSEPORT (``reuse_port``): several server PROCESSES bind the
+        # same port and the kernel load-balances accepted connections —
+        # the scale-out path past one GIL-bound accept loop (pio
+        # eventserver --workers N). The storage behind the workers must
+        # be multi-process-shared (sqlite WAL file or the gateway).
+        # Set via setsockopt directly (socketserver's allow_reuse_port
+        # attribute only exists on Python 3.11+, silently ignored
+        # before) and fail LOUDLY where the platform lacks the option —
+        # a worker that silently bound without it would steal the port
+        # from its siblings.
+        server_cls = _ReusePortServer if reuse_port else _Server
         last_error: Optional[OSError] = None
         for attempt in range(self.BIND_RETRIES):
             try:
-                self.httpd = _Server((ip, port), handler)
+                self.httpd = server_cls((ip, port), handler)
                 break
             except OSError as e:
                 last_error = e

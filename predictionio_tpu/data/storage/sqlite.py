@@ -158,6 +158,8 @@ class SQLiteLEvents(base.LEvents):
         self._c = client
         self._ns = namespace or "pio"
         self._pages_schema_ok: set = set()
+        # positive _exists results memoized for hot write paths
+        self._known_tables: set = set()
 
     def _ensure_pages_schema(self, t: str) -> None:
         """Migrate page tables from older layouts (memoized per table):
@@ -268,6 +270,7 @@ class SQLiteLEvents(base.LEvents):
             self._c.execute(f"DROP TABLE IF EXISTS {t}_pages")
             self._c.execute(f"DROP TABLE IF EXISTS {t}_dict")
             self._c.commit()
+            self._known_tables.discard(t)
         return True
 
     def close(self) -> None:
@@ -279,11 +282,25 @@ class SQLiteLEvents(base.LEvents):
         )
         return cur.fetchone() is not None
 
+    def _exists_memo(self, table: str) -> bool:
+        """_exists with positive-result memoization for hot write paths:
+        the per-event sqlite_master probe was a measurable share of REST
+        ingest. Only positive results memoize (a table created later must
+        be seen); remove() invalidates. A table dropped by ANOTHER
+        process after memoization surfaces as StorageError from the
+        statement itself rather than this probe."""
+        if table in self._known_tables:
+            return True
+        if self._exists(table):
+            self._known_tables.add(table)
+            return True
+        return False
+
     def insert(self, event: Event, app_id: int, channel_id: Optional[int] = None) -> str:
         t = self._events_table(app_id, channel_id)
         eid = event.event_id or new_event_id()
         with self._c.lock:
-            if not self._exists(t):
+            if not self._exists_memo(t):
                 raise StorageError(f"events table {t} not initialized")
             self._c.execute(
                 f"INSERT OR REPLACE INTO {t} VALUES (?,?,?,?,?,?,?,?,?,?,?,?)",

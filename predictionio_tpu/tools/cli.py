@@ -250,8 +250,79 @@ def cmd_eventserver(args) -> int:
         create_event_server,
     )
 
+    workers = max(1, int(getattr(args, "workers", 1) or 1))
+    if workers > 1:
+        # scale-out past one GIL-bound accept loop: N worker PROCESSES
+        # bind the same port with SO_REUSEPORT; the kernel balances
+        # accepted connections. The configured storage must be shared
+        # across processes (sqlite WAL file or the storage gateway —
+        # NOT the in-memory backend, which each worker would own alone).
+        import signal
+        import subprocess
+        import sys
+        import time as _time
+
+        if args.port == 0:
+            # each worker would kernel-assign a DIFFERENT ephemeral port
+            # — no shared accept group, no single advertised address
+            print(
+                "eventserver: --workers requires a fixed --port "
+                "(port 0 would give every worker its own ephemeral port)",
+                file=sys.stderr,
+            )
+            return 2
+        cmd = [
+            sys.executable, "-m", "predictionio_tpu.tools.cli",
+            "eventserver", "--ip", args.ip, "--port", str(args.port),
+            "--workers", "1", "--reuse-port",
+        ]
+        if args.stats:
+            cmd.append("--stats")
+        procs = [subprocess.Popen(cmd) for _ in range(workers)]
+
+        def forward(signum, frame):
+            for p in procs:
+                p.terminate()
+
+        signal.signal(signal.SIGTERM, forward)
+        signal.signal(signal.SIGINT, forward)
+        # grace check: a worker that failed to bind (port held by a
+        # non-reuse listener, missing SO_REUSEPORT) dies within its bind
+        # retries — report a partial fleet instead of printing success
+        # over it
+        from predictionio_tpu.api.http import JsonHTTPServer
+
+        _time.sleep(
+            1.0
+            + JsonHTTPServer.BIND_RETRIES * JsonHTTPServer.BIND_RETRY_DELAY_S
+        )
+        dead = [p for p in procs if p.poll() is not None]
+        if dead:
+            for p in procs:
+                if p.poll() is None:
+                    p.terminate()
+            for p in procs:
+                p.wait()
+            print(
+                f"eventserver: {len(dead)}/{workers} workers failed to "
+                "start (see tracebacks above); aborting",
+                file=sys.stderr,
+            )
+            return 1
+        print(
+            f"Event server: {workers} workers sharing {args.ip}:{args.port} "
+            "(SO_REUSEPORT)"
+        )
+        rc = 0
+        for p in procs:
+            rc = p.wait() or rc
+        return rc
+
     server = create_event_server(
-        EventServerConfig(ip=args.ip, port=args.port, stats=args.stats)
+        EventServerConfig(
+            ip=args.ip, port=args.port, stats=args.stats,
+            reuse_port=bool(getattr(args, "reuse_port", False)),
+        )
     )
     print(f"Event server serving on {args.ip}:{server.port}")
     server.serve_forever()
@@ -622,6 +693,15 @@ def build_parser() -> argparse.ArgumentParser:
     es.add_argument("--ip", default="localhost")
     es.add_argument("--port", type=int, default=7070)
     es.add_argument("--stats", action="store_true")
+    es.add_argument(
+        "--workers", type=int, default=1,
+        help="worker processes sharing the port via SO_REUSEPORT "
+        "(requires multi-process-shared storage: sqlite file or gateway)",
+    )
+    es.add_argument(
+        "--reuse-port", action="store_true",
+        help="bind with SO_REUSEPORT (set automatically for workers)",
+    )
     es.set_defaults(func=cmd_eventserver)
 
     gw = sub.add_parser(

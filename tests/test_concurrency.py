@@ -218,6 +218,97 @@ class TestWritersVsScans:
         assert cols.n == 4 * 6 * 500
 
 
+class TestReusePortScaleOut:
+    def test_two_servers_share_a_port_and_a_store(self, sqlite_events):
+        """The ingest scale-out path: two Event Server instances bind ONE
+        port via SO_REUSEPORT (kernel-balanced accepts) over one shared
+        sqlite WAL store — every POSTed event lands exactly once."""
+        import http.client
+        import json as _json
+        import socket
+
+        if not hasattr(socket, "SO_REUSEPORT"):
+            pytest.skip("platform without SO_REUSEPORT")
+
+        from predictionio_tpu.api.event_server import (
+            EventServer,
+            EventServerConfig,
+        )
+        from predictionio_tpu.data.storage.base import AccessKey
+
+        storage, ev = sqlite_events
+        storage.get_meta_data_access_keys().insert(
+            AccessKey(key="k", appid=1, events=())
+        )
+        s1 = EventServer(
+            storage=storage,
+            config=EventServerConfig(port=0, reuse_port=True),
+        ).start()
+        s2 = EventServer(
+            storage=storage,
+            config=EventServerConfig(port=s1.port, reuse_port=True),
+        ).start()
+        try:
+            assert s1.port == s2.port
+
+            def post(w):
+                conn = http.client.HTTPConnection("localhost", s1.port)
+                for j in range(40):
+                    conn.request(
+                        "POST", "/events.json?accessKey=k",
+                        _json.dumps({
+                            "event": "rate",
+                            "entityType": "user", "entityId": f"w{w}-{j}",
+                            "targetEntityType": "item",
+                            "targetEntityId": f"i{j % 5}",
+                            "properties": {"rating": 3.0},
+                        }),
+                        {"Content-Type": "application/json"},
+                    )
+                    r = conn.getresponse()
+                    r.read()
+                    assert r.status == 201
+                conn.close()
+
+            threads = [
+                threading.Thread(target=post, args=(w,)) for w in range(6)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=120)
+            got = list(ev.find(app_id=1, event_names=["rate"]))
+            assert len(got) == 6 * 40
+        finally:
+            s1.shutdown()
+            s2.shutdown()
+
+    def test_same_port_without_reuse_fails(self, sqlite_events):
+        from predictionio_tpu.api.event_server import (
+            EventServer,
+            EventServerConfig,
+        )
+        from predictionio_tpu.api.http import JsonHTTPServer
+
+        storage, _ = sqlite_events
+        s1 = EventServer(
+            storage=storage, config=EventServerConfig(port=0)
+        ).start()
+        try:
+            old_retries = JsonHTTPServer.BIND_RETRIES
+            JsonHTTPServer.BIND_RETRIES = 1
+            try:
+                with pytest.raises(OSError):
+                    EventServer(
+                        storage=storage,
+                        config=EventServerConfig(port=s1.port),
+                    )
+            finally:
+                JsonHTTPServer.BIND_RETRIES = old_retries
+        finally:
+            s1.shutdown()
+
+
 class TestReadConnection:
     def test_read_execute_is_query_only(self, sqlite_events):
         import sqlite3
