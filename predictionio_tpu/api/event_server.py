@@ -125,11 +125,14 @@ class EventAPI:
         if hit is not None and now - hit[0] < self._AUTH_TTL_S:
             return hit[1]
         access_key = self._access_keys.get(key)
-        # bound the cache: unauthenticated floods of random keys must
-        # not grow it without limit
-        if len(self._auth_cache) > 10_000:
-            self._auth_cache.clear()
-        self._auth_cache[key] = (now, access_key)
+        # only POSITIVE results cache: a just-created key must work
+        # immediately, not 401 for a TTL (and unauthenticated floods of
+        # random keys can't grow the cache — misses pay the store read,
+        # exactly the pre-cache behavior)
+        if access_key is not None:
+            if len(self._auth_cache) > 10_000:
+                self._auth_cache.clear()
+            self._auth_cache[key] = (now, access_key)
         return access_key
 
     def _authenticate(

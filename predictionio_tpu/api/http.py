@@ -31,6 +31,11 @@ class _Server(ThreadingHTTPServer):
     request_queue_size = 128
 
 
+class ReusePortUnavailable(OSError):
+    """SO_REUSEPORT missing on this platform — permanent, never retried
+    (a plain bind OSError is treated as a transient port conflict)."""
+
+
 class _ReusePortServer(_Server):
     allow_reuse_port = True  # honored on Python 3.11+
 
@@ -42,7 +47,7 @@ class _ReusePortServer(_Server):
                 _socket.SOL_SOCKET, _socket.SO_REUSEPORT, 1
             )
         except (AttributeError, OSError) as e:
-            raise OSError(
+            raise ReusePortUnavailable(
                 "SO_REUSEPORT is unavailable on this platform; "
                 "multi-worker port sharing cannot work"
             ) from e
@@ -151,6 +156,8 @@ class JsonHTTPServer:
             try:
                 self.httpd = server_cls((ip, port), handler)
                 break
+            except ReusePortUnavailable:
+                raise  # permanent: retrying cannot make the option appear
             except OSError as e:
                 last_error = e
                 logger.warning(

@@ -184,6 +184,15 @@ class Storage:
 
     # --- public accessors (reference Storage.scala:350-384) ---
 
+    def repository_type(self, repo: str) -> str:
+        """Backend TYPE behind a repository (e.g. 'memory', 'sqlite',
+        'http') — lets callers reason about sharing semantics (a
+        multi-process deployment needs a multi-process-shared store)."""
+        r = self._repos.get(repo.upper())
+        if r is None or "SOURCE" not in r:
+            raise StorageError(f"repository {repo} is not configured")
+        return self._source_conf(r["SOURCE"])["TYPE"]
+
     def get_l_events(self):
         return self._repo_object("EVENTDATA", "LEvents")
 

@@ -271,6 +271,20 @@ def cmd_eventserver(args) -> int:
                 file=sys.stderr,
             )
             return 2
+        from predictionio_tpu.data.storage import get_storage
+
+        # a per-process store would silently SCATTER events across N
+        # private universes (every POST 201s, training sees ~1/N)
+        ev_type = get_storage().repository_type("EVENTDATA")
+        if ev_type == "memory":
+            print(
+                "eventserver: --workers needs a multi-process-shared "
+                "EVENTDATA store (sqlite file or http gateway); the "
+                "'memory' backend would give each worker a private "
+                "store and silently scatter events",
+                file=sys.stderr,
+            )
+            return 2
         cmd = [
             sys.executable, "-m", "predictionio_tpu.tools.cli",
             "eventserver", "--ip", args.ip, "--port", str(args.port),

@@ -8,6 +8,7 @@ that contract with WAL snapshot reads on per-thread connections
 training scan and a serving find while asserting nothing is lost or torn.
 """
 
+import os
 import threading
 
 import numpy as np
@@ -216,6 +217,119 @@ class TestWritersVsScans:
             event_names=["rate"],
         )
         assert cols.n == 4 * 6 * 500
+
+
+class TestCrossProcessWriters:
+    def test_two_processes_write_one_store_concurrently(self, tmp_path):
+        """Two OS processes (the reference's multi-client HBase story)
+        write the same sqlite file concurrently — row inserts racing a
+        bulk columnar import — while this process scans. WAL +
+        busy_timeout must serialize the writers without losing or
+        corrupting anything."""
+        import subprocess
+        import sys
+        import textwrap
+
+        db = tmp_path / "s.db"
+        from predictionio_tpu.data.storage import Storage
+        from predictionio_tpu.data.storage.base import App
+
+        conf = {
+            "PIO_STORAGE_SOURCES_SQLITE_TYPE": "sqlite",
+            "PIO_STORAGE_SOURCES_SQLITE_PATH": str(db),
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "SQLITE",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "SQLITE",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "SQLITE",
+        }
+        storage = Storage(conf)
+        storage.get_meta_data_apps().insert(App(id=0, name="x"))
+        storage.get_l_events().init(1)
+
+        worker = textwrap.dedent(
+            """
+            import sys
+            import numpy as np
+            from predictionio_tpu.data.storage import Storage
+            from predictionio_tpu.data.event import Event
+
+            mode, db = sys.argv[1], sys.argv[2]
+            s = Storage({
+                "PIO_STORAGE_SOURCES_SQLITE_TYPE": "sqlite",
+                "PIO_STORAGE_SOURCES_SQLITE_PATH": db,
+                "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "SQLITE",
+                "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "SQLITE",
+                "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "SQLITE",
+            })
+            ev = s.get_l_events()
+            if mode == "rows":
+                for j in range(300):
+                    ev.insert(Event(
+                        event="rate", entity_type="user",
+                        entity_id=f"row{j}",
+                        target_entity_type="item", target_entity_id="i0",
+                        properties={"rating": 1.0},
+                    ), 1)
+            else:
+                rng = np.random.default_rng(0)
+                for _ in range(5):
+                    n = 400
+                    ev.insert_columns(
+                        1, event="rate", entity_type="user",
+                        target_entity_type="item",
+                        entity_ids=np.char.add(
+                            "blk", rng.integers(0, 40, n).astype("U3")
+                        ),
+                        target_ids=np.char.add(
+                            "i", rng.integers(0, 9, n).astype("U2")
+                        ),
+                        values=np.full(n, 2.0, np.float32),
+                    )
+            print("DONE", flush=True)
+            """
+        )
+        script = tmp_path / "writer.py"
+        script.write_text(worker)
+        env = {**os.environ}
+        env["PYTHONPATH"] = os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))
+        )
+        procs = [
+            subprocess.Popen(
+                [sys.executable, str(script), mode, str(db)],
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True, env=env,
+            )
+            for mode in ("rows", "pages")
+        ]
+        # scan from THIS process while both writers run
+        from predictionio_tpu.data.store import PEventStore
+        from predictionio_tpu.data.storage.columnar import ValueSpec
+
+        p = PEventStore(storage)
+        seen = []
+        while any(q.poll() is None for q in procs):
+            cols = p.find_columns(
+                "x",
+                value_spec=ValueSpec(prop="rating", default=0.0),
+                entity_type="user", target_entity_type="item",
+                event_names=["rate"],
+            )
+            seen.append(cols.n)
+        outs = [q.communicate(timeout=60)[0] for q in procs]
+        for q, out in zip(procs, outs):
+            assert q.returncode == 0 and "DONE" in out, out
+        cols = p.find_columns(
+            "x",
+            value_spec=ValueSpec(prop="rating", default=0.0),
+            entity_type="user", target_entity_type="item",
+            event_names=["rate"],
+        )
+        assert cols.n == 300 + 5 * 400
+        # value integrity: rows wrote 1.0, pages wrote 2.0
+        import numpy as np
+
+        assert float(cols.values.sum()) == 300 * 1.0 + 2000 * 2.0
+        assert seen == sorted(seen), "scan counts went backwards"
 
 
 class TestReusePortScaleOut:
