@@ -172,6 +172,15 @@ class BaseAlgorithm(Controller, Generic[PD, M, Q, P]):
         with a vectorized device predict for the TPU fast path."""
         return [(i, self.predict(model, q)) for i, q in queries]
 
+    def prepare_serving(self, ctx, model: M) -> M:
+        """Deploy-time hook between model resolution and warm-up
+        (Engine.prepare_deploy calls it per algorithm): attach serving
+        resources to the model — e.g. the workflow mesh, so top-N
+        serving runs data-parallel over every attached device instead of
+        chip 0 only. Default: model unchanged. No reference analog (one
+        JVM, no accelerator topology to bind)."""
+        return model
+
     def warm(self, model: M) -> None:
         """Deploy-time warm-up hook (no reference analog — JIT frameworks
         need it): compile the serving executables NOW so the first real

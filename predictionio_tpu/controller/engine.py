@@ -326,6 +326,7 @@ class Engine(BaseEngine):
                 f"persisted {len(persisted_models)} models for "
                 f"{len(algorithms)} algorithms"
             )
+        pd = None
         if any(m is None for m in persisted_models):
             # sharded/unserialized models are re-trained on deploy
             # (reference Engine.scala:208-230)
@@ -333,13 +334,14 @@ class Engine(BaseEngine):
             data_source, preparator, _, _ = self.make_components(engine_params)
             td = data_source.read_training(ctx)
             pd = preparator.prepare(ctx, td)
-            return [
-                algo.train(ctx, pd) if m is None else m
-                for algo, m in zip(algorithms, persisted_models)
-            ]
         out = []
         for algo, m in zip(algorithms, persisted_models):
-            if isinstance(m, PersistentModelManifest):
+            if m is None:
+                out.append(algo.train(ctx, pd))
+            elif isinstance(m, PersistentModelManifest):
+                # manifests load in EVERY deploy path — a mixed engine
+                # (one re-training algorithm + one persistent-model
+                # algorithm) must not hand the raw manifest to serving
                 out.append(
                     load_persistent_model(
                         m, engine_instance_id, algo.params, ctx
@@ -347,7 +349,12 @@ class Engine(BaseEngine):
                 )
             else:
                 out.append(m)
-        return out
+        # serving-resource attachment (e.g. the device mesh for
+        # data-parallel top-N) — runs for every deploy path
+        return [
+            algo.prepare_serving(ctx, m)
+            for algo, m in zip(algorithms, out)
+        ]
 
     def make_serializable_models(
         self, ctx, engine_instance_id: str, engine_params: EngineParams,

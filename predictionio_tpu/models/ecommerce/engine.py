@@ -190,17 +190,29 @@ class ECommModel:
     _inv_item: Optional[BiMap] = dataclasses.field(
         default=None, repr=False, compare=False
     )
+    # deploy-time mesh (BaseAlgorithm.prepare_serving). Device state;
+    # never pickled.
+    _serving_mesh: Optional[object] = dataclasses.field(
+        default=None, repr=False, compare=False
+    )
 
     def __getstate__(self):
         state = self.__dict__.copy()
         state["_scorer"] = None
         state["_inv_item"] = None
+        state["_serving_mesh"] = None
         return state
+
+    def attach_serving_mesh(self, mesh) -> None:
+        self._serving_mesh = mesh
+        self._scorer = None
 
     @property
     def scorer(self) -> SimilarityScorer:
         if self._scorer is None:
-            self._scorer = SimilarityScorer(self.item_factors)
+            self._scorer = SimilarityScorer(
+                self.item_factors, mesh=self._serving_mesh
+            )
         return self._scorer
 
     @property
@@ -323,6 +335,13 @@ class ECommAlgorithm(BaseAlgorithm):
                 if item is None or not cats.intersection(item.categories):
                     mask[idx] = False
         return mask
+
+    def prepare_serving(self, ctx, model: ECommModel) -> ECommModel:
+        """Row-shard the candidate matrix over the workflow mesh at
+        deploy (see SimilarityScorer's mesh mode)."""
+        if ctx is not None:
+            model.attach_serving_mesh(ctx.mesh)
+        return model
 
     def warm(self, model: ECommModel) -> None:
         """Pre-compile the unknown-user similar-items path's cosine-sum

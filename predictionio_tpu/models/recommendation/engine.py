@@ -250,18 +250,32 @@ class ALSModel:
     _inv_item: Optional[BiMap] = dataclasses.field(
         default=None, repr=False, compare=False
     )
+    # deploy-time mesh (BaseAlgorithm.prepare_serving): query batches
+    # shard over it, catalog replicated — data-parallel top-N. Device
+    # state; never pickled.
+    _serving_mesh: Optional[object] = dataclasses.field(
+        default=None, repr=False, compare=False
+    )
 
     def __getstate__(self):
         state = self.__dict__.copy()
         state["_serving"] = None
         state["_inv_item"] = None
+        state["_serving_mesh"] = None
         return state
+
+    def attach_serving_mesh(self, mesh) -> None:
+        """Bind serving to a device mesh (drops any single-device state
+        already built, so the next predict uses the sharded factors)."""
+        self._serving_mesh = mesh
+        self._serving = None
 
     @property
     def serving(self) -> ServingFactors:
         if self._serving is None:
             self._serving = ServingFactors(
-                self.arrays.user_factors, self.arrays.item_factors
+                self.arrays.user_factors, self.arrays.item_factors,
+                mesh=self._serving_mesh,
             )
         return self._serving
 
@@ -380,6 +394,14 @@ class ALSAlgorithm(BaseAlgorithm):
         return ALSModel(
             arrays=arrays, user_index=td.user_index, item_index=td.item_index
         )
+
+    def prepare_serving(self, ctx, model: ALSModel) -> ALSModel:
+        """Bind deploy-time serving to the workflow mesh: query batches
+        shard over its data axis (catalog replicated), so a multi-chip
+        deployment serves at N x the single-chip batch throughput."""
+        if ctx is not None:
+            model.attach_serving_mesh(ctx.mesh)
+        return model
 
     def predict(self, model: ALSModel, query: Query) -> PredictedResult:
         return model.recommend(query.user, query.num)

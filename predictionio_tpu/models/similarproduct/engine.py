@@ -211,17 +211,29 @@ class SPModel:
     _inv_index: Optional[BiMap] = dataclasses.field(
         default=None, repr=False, compare=False
     )
+    # deploy-time mesh (BaseAlgorithm.prepare_serving): the candidate
+    # matrix row-shards over it. Device state; never pickled.
+    _serving_mesh: Optional[object] = dataclasses.field(
+        default=None, repr=False, compare=False
+    )
 
     def __getstate__(self):
         state = self.__dict__.copy()
         state["_scorer"] = None
         state["_inv_index"] = None
+        state["_serving_mesh"] = None
         return state
+
+    def attach_serving_mesh(self, mesh) -> None:
+        self._serving_mesh = mesh
+        self._scorer = None
 
     @property
     def scorer(self) -> SimilarityScorer:
         if self._scorer is None:
-            self._scorer = SimilarityScorer(self.item_factors)
+            self._scorer = SimilarityScorer(
+                self.item_factors, mesh=self._serving_mesh
+            )
         return self._scorer
 
     @property
@@ -335,6 +347,13 @@ class ALSAlgorithm(BaseAlgorithm):
 
     def predict(self, model: SPModel, query: Query) -> PredictedResult:
         return model.similar(query)
+
+    def prepare_serving(self, ctx, model: SPModel) -> SPModel:
+        """Row-shard the candidate matrix over the workflow mesh at
+        deploy (see SimilarityScorer's mesh mode)."""
+        if ctx is not None:
+            model.attach_serving_mesh(ctx.mesh)
+        return model
 
     def warm(self, model: SPModel) -> None:
         """Compile the cosine-sum executables for every padded query-item

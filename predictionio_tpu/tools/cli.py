@@ -273,18 +273,21 @@ def cmd_eventserver(args) -> int:
             return 2
         from predictionio_tpu.data.storage import get_storage
 
-        # a per-process store would silently SCATTER events across N
-        # private universes (every POST 201s, training sees ~1/N)
-        ev_type = get_storage().repository_type("EVENTDATA")
-        if ev_type == "memory":
-            print(
-                "eventserver: --workers needs a multi-process-shared "
-                "EVENTDATA store (sqlite file or http gateway); the "
-                "'memory' backend would give each worker a private "
-                "store and silently scatter events",
-                file=sys.stderr,
-            )
-            return 2
+        # a per-process store would silently break the fleet: memory
+        # EVENTDATA scatters events across N private universes (every
+        # POST 201s, training sees ~1/N); memory METADATA gives every
+        # worker an empty access-key table (all POSTs 401)
+        storage = get_storage()
+        for repo in ("EVENTDATA", "METADATA"):
+            if storage.repository_type(repo) == "memory":
+                print(
+                    f"eventserver: --workers needs a multi-process-shared "
+                    f"{repo} store (sqlite file or http gateway); the "
+                    "'memory' backend would give each worker a private "
+                    "store",
+                    file=sys.stderr,
+                )
+                return 2
         cmd = [
             sys.executable, "-m", "predictionio_tpu.tools.cli",
             "eventserver", "--ip", args.ip, "--port", str(args.port),

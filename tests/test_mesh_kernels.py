@@ -204,6 +204,63 @@ class TestServingMesh:
         np.testing.assert_array_equal(i1, i0)
 
 
+class TestDeployTimeMeshServing:
+    def test_prepare_deploy_attaches_mesh_and_serves_identically(
+        self, mesh8, mem_storage
+    ):
+        """Engine.prepare_deploy binds serving to the workflow mesh
+        (BaseAlgorithm.prepare_serving): the deployed model's top-N runs
+        data-parallel over 8 devices and matches single-device results."""
+        import copy
+
+        from predictionio_tpu.models.recommendation.engine import (
+            ALSModel, Query, recommendation_engine,
+        )
+        from predictionio_tpu.ops.als import ALSModelArrays
+        from predictionio_tpu.data.bimap import BiMap
+        from predictionio_tpu.workflow.context import workflow_context
+
+        rng = np.random.default_rng(11)
+        n_u, n_i, k = 30, 20, 4
+        model = ALSModel(
+            arrays=ALSModelArrays(
+                user_factors=rng.standard_normal((n_u, k)).astype(
+                    np.float32
+                ),
+                item_factors=rng.standard_normal((n_i, k)).astype(
+                    np.float32
+                ),
+            ),
+            user_index=BiMap({f"u{j}": j for j in range(n_u)}),
+            item_index=BiMap({f"i{j}": j for j in range(n_i)}),
+        )
+        engine = recommendation_engine()
+        params = engine.jvalue_to_engine_params(
+            {
+                "datasource": {"params": {"app_name": "x"}},
+                "algorithms": [{"name": "als", "params": {}}],
+            }
+        )
+        ctx = workflow_context(mode="Serving", mesh=mesh8)
+        baseline = copy.deepcopy(model).recommend("u3", 5)
+        [deployed] = engine.prepare_deploy(
+            ctx, params, "inst", [model], None
+        )
+        assert deployed._serving_mesh is mesh8
+        sharded = deployed.recommend("u3", 5)
+        # mesh mode active: catalog replicated on all 8 devices, query
+        # batches row-sharded (see ServingFactors)
+        assert deployed.serving.mesh is mesh8
+        assert [s.item for s in sharded.item_scores] == [
+            s.item for s in baseline.item_scores
+        ]
+        np.testing.assert_allclose(
+            [s.score for s in sharded.item_scores],
+            [s.score for s in baseline.item_scores],
+            rtol=1e-5,
+        )
+
+
 class TestClassificationEngineMesh:
     def test_engine_train_uses_workflow_mesh(self, mesh8, mem_storage):
         """The classification template's NB train runs sharded end to end
