@@ -11,17 +11,26 @@ TPU-first design: the kept transitions live as dense [n_states, top_n]
 (target-index, probability) arrays — a static shape XLA can tile — and
 predict is one scatter-add device program instead of a per-row RDD map +
 driver-side column sums.
+
+Multi-chip: with a ``mesh``, the [n_states, top_n] transition rows and
+the current-state vector shard over the mesh's data axis; each device
+scatter-adds its states' outgoing probability mass into a local
+next-state vector and XLA all-reduces the partials over ICI (the TPU
+analog of the reference's per-row RDD map + driver column sums).
 """
 
 from __future__ import annotations
 
 import dataclasses
 import functools
-from typing import Dict, List, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import Mesh
+
+from predictionio_tpu.parallel.mesh import shard_batch
 
 
 @dataclasses.dataclass
@@ -32,6 +41,16 @@ class MarkovChainModel:
     n: int  # top-N kept per state
     targets: np.ndarray  # [n_states, n] int32 (self-loop padding w/ 0 prob)
     probs: np.ndarray  # [n_states, n] float32
+    # device-resident transition arrays, placed once per (mesh, axis)
+    # and reused across predicts (device state; never pickled)
+    _placed: Optional[tuple] = dataclasses.field(
+        default=None, repr=False, compare=False
+    )
+
+    def __getstate__(self):
+        state = self.__dict__.copy()
+        state["_placed"] = None
+        return state
 
     def transition_map(self) -> Dict[int, List[Tuple[int, float]]]:
         """Per-state kept transitions as {state: [(target, prob)]}, sorted
@@ -47,21 +66,58 @@ class MarkovChainModel:
                 out[i] = sorted(entries)
         return out
 
-    def predict(self, current_state: Sequence[float]) -> List[float]:
-        """Probabilities of the next state (reference predict :68-88)."""
-        cur = jnp.asarray(np.asarray(current_state, np.float32))
-        out = _step(
-            cur, jnp.asarray(self.targets), jnp.asarray(self.probs),
-            self.n_states,
-        )
+    def predict(
+        self,
+        current_state: Sequence[float],
+        mesh: Optional[Mesh] = None,
+        axis: str = "data",
+    ) -> List[float]:
+        """Probabilities of the next state (reference predict :68-88).
+
+        With a ``mesh``, source states shard over its ``axis`` and each
+        device's partial next-state vector all-reduces over ICI; padding
+        rows carry zero probability, so results are mesh-shape
+        independent up to float summation order."""
+        cur = np.asarray(current_state, np.float32)
+        if mesh is not None and mesh.shape[axis] == 1:
+            mesh = None
+        t_dev, p_dev = self._device_transitions(mesh, axis)
+        if mesh is None:
+            cur_dev = jnp.asarray(cur)
+        else:
+            cur_dev, _ = shard_batch(mesh, cur, axis)
+        out = _step(cur_dev, t_dev, p_dev, self.n_states)
         return [float(x) for x in np.asarray(out)]
+
+    def _device_transitions(self, mesh: Optional[Mesh], axis: str):
+        """Transition arrays on device, placed ONCE per (mesh, axis) and
+        cached — repeat predicts ship only the [n_states] state vector
+        (same pattern as SimilarityScorer's device-resident factors).
+        shard_batch zero-pads the state rows to divide the mesh axis;
+        padded rows carry zero probability, so they drop from the sum."""
+        key = None if mesh is None else (id(mesh), axis)
+        if self._placed is not None and self._placed[0] == key:
+            return self._placed[1], self._placed[2]
+        if mesh is None:
+            t_dev = jnp.asarray(self.targets)
+            p_dev = jnp.asarray(self.probs)
+        else:
+            t_dev, _ = shard_batch(mesh, self.targets, axis)
+            p_dev, _ = shard_batch(mesh, self.probs, axis)
+        self._placed = (key, t_dev, p_dev)
+        return t_dev, p_dev
 
 
 @functools.partial(jax.jit, static_argnames=("n_states",))
 def _step(cur, targets, probs, n_states):
-    # next[j] = sum_i cur[i] * P[i, j] over kept transitions
-    contrib = probs * cur[:, None]  # [n_states, n]
-    return jnp.zeros(n_states, jnp.float32).at[targets].add(contrib)
+    # next[j] = sum_i cur[i] * P[i, j] over kept transitions; with a
+    # mesh the rows arrive sharded and XLA all-reduces per-device
+    # partial vectors over ICI. Padding rows carry zero probs (their
+    # target index 0 contributes 0.0).
+    contrib = probs * cur[:, None]  # [n_states(+pad), n]
+    return jnp.zeros(n_states, jnp.float32).at[targets].add(
+        contrib, mode="drop"
+    )
 
 
 class MarkovChain:

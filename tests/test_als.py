@@ -298,6 +298,23 @@ class TestPackShapeBucketing:
         assert side.seg_rows.shape[1] % 8 == 0
         assert int(dense_mask(side).sum()) == 100
 
+    def test_nibble_wire_round_trip(self):
+        """Half-step ratings in [0, 7.5] travel two-per-byte; the device
+        unpack restores them exactly. Negatives and >7.5 fall back."""
+        from predictionio_tpu.ops.als import (
+            _nibble_packable, _pack_nibbles_host, _unpack_nibbles,
+        )
+
+        rng = np.random.default_rng(6)
+        vw = rng.integers(0, 16, 1000).astype(np.int8)
+        assert _nibble_packable(vw)
+        packed = _pack_nibbles_host(vw)
+        assert packed.nbytes == 500
+        np.testing.assert_array_equal(np.asarray(_unpack_nibbles(packed)), vw)
+        assert not _nibble_packable(np.array([1, -2], np.int8))  # dislike
+        assert not _nibble_packable(np.array([1, 16], np.int8))  # > 7.5
+        assert not _nibble_packable(np.array([1, 2, 3], np.int8))  # odd
+
     def test_near_equal_cardinalities_share_iteration_executable(self):
         """The system-ROW dimension buckets too (round 5): a store scan
         seeing 0.04% fewer distinct users than the direct path — or a

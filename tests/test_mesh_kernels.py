@@ -110,6 +110,26 @@ class TestCategoricalNBMesh:
         )
 
 
+class TestMarkovChainMesh:
+    def test_predict_parity(self, mesh8):
+        from predictionio_tpu.e2.markov_chain import MarkovChain
+
+        rng = np.random.default_rng(12)
+        n_states = 21  # does not divide 8 (padding path)
+        entries = [
+            (int(rng.integers(0, n_states)), int(rng.integers(0, n_states)),
+             float(rng.integers(1, 9)))
+            for _ in range(200)
+        ]
+        model = MarkovChain.train(entries, n_states, top_n=3)
+        cur = rng.dirichlet(np.ones(n_states)).astype(np.float32)
+        np.testing.assert_allclose(
+            model.predict(cur, mesh=mesh8),
+            model.predict(cur),
+            rtol=1e-5, atol=1e-7,
+        )
+
+
 class TestSimilarityMesh:
     def test_cosine_sum_parity(self, mesh8):
         from predictionio_tpu.ops.similarity import SimilarityScorer
