@@ -234,7 +234,8 @@ def _segment_geometry(
 # and — since round 5 — WITHOUT its row-id plane: the host stable-sorts
 # by user, the CSR offsets (already needed for the scatter) encode the
 # row ids, and _device_pack_presorted rebuilds them in HBM with one
-# cumsum pass. ML-20M wire: ~60 MB vs ~140 MB with the int32 row plane.
+# cumsum pass — and half-step ratings nibble-pack two per byte.
+# ML-20M wire: ~51 MB vs ~140 MB with the int32 row plane.
 # This replaces the role of the reference's region-parallel HBase scan
 # feeding Spark block shuffles (data/storage/hbase/HBPEvents.scala:84-90):
 # the wire carries the minimal representation, the accelerator does the
@@ -992,7 +993,8 @@ def train_als(
         # row-id plane — the host stable-sorts by user (radix, ~1 s at
         # 20M), so user ids rebuild on device from the CSR offsets
         # (_device_pack_presorted) and only the narrowed item ids +
-        # ratings travel. At ML-20M that is ~60 MB on the wire instead
+        # ratings (nibble-packed when half-step) travel. At ML-20M that is
+        # ~51 MB on the wire instead
         # of ~140 MB, and ONE device sort instead of two (the item side
         # still lax.sorts by item key, consuming the rebuilt user ids).
         n = len(ratings_f)
