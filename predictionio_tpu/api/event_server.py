@@ -31,6 +31,7 @@ import dataclasses
 import json
 import logging
 import urllib.parse
+import weakref
 from typing import Any, Dict, Optional, Tuple
 
 from predictionio_tpu.api.http import JsonHTTPServer
@@ -84,10 +85,30 @@ class EventServerConfig:
     # GIL-bound accept loop; requires multi-process-shared storage
     # (sqlite WAL file / gateway), NOT the in-memory backend
     reuse_port: bool = False
+    # positive-result access-key cache TTL. Bounds how long a key
+    # revoked by ANOTHER process keeps authenticating (same-process
+    # deletes invalidate immediately via invalidate_access_key); 0
+    # disables caching — every request reads the metadata store, the
+    # reference's per-request behavior.
+    auth_ttl_s: float = 5.0
 
 
 def _message(status: int, message: str) -> Tuple[int, dict]:
     return status, {"message": message}
+
+
+# every live EventAPI, so the admin delete path can revoke a key from
+# all in-process servers' auth caches immediately (ADVICE.md: the TTL
+# alone left a same-process revocation authenticating for up to 5 s)
+_LIVE_APIS: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def invalidate_access_key(key: Optional[str] = None) -> None:
+    """Drop ``key`` (all keys when None) from every live in-process
+    EventAPI's auth cache. Called by the access-key/app delete commands;
+    cross-process servers still age revoked keys out at their TTL."""
+    for api in list(_LIVE_APIS):
+        api.invalidate_access_key(key)
 
 
 class EventAPI:
@@ -109,17 +130,31 @@ class EventAPI:
         # access-key lookups hit the metadata store on EVERY request; on
         # a file-backed store that is a per-event SELECT contending with
         # the ingest writer (measured: most of the sqlite-vs-memory REST
-        # throughput gap). Keys change rarely — a short TTL bounds how
-        # long a revoked key keeps working (the reference re-reads per
-        # request but against an in-JVM HBase client cache).
+        # throughput gap). Keys change rarely — a short TTL
+        # (config.auth_ttl_s; 0 disables) bounds how long a key revoked
+        # by another process keeps working (the reference re-reads per
+        # request but against an in-JVM HBase client cache); same-process
+        # deletes invalidate immediately (invalidate_access_key below).
         self._auth_cache: Dict[str, Tuple[float, Any]] = {}
-        self._AUTH_TTL_S = 5.0
+        self._AUTH_TTL_S = float(self.config.auth_ttl_s)
+        _LIVE_APIS.add(self)
 
     # --- auth (reference withAccessKey, EventServer.scala:81-107) ---
+
+    def invalidate_access_key(self, key: Optional[str] = None) -> None:
+        """Drop ``key`` (all keys when None) from the auth cache, so a
+        just-revoked key stops authenticating NOW instead of at TTL
+        expiry."""
+        if key is None:
+            self._auth_cache.clear()
+        else:
+            self._auth_cache.pop(key, None)
 
     def _lookup_access_key(self, key: str):
         import time as _time
 
+        if self._AUTH_TTL_S <= 0:
+            return self._access_keys.get(key)
         now = _time.monotonic()
         hit = self._auth_cache.get(key)
         if hit is not None and now - hit[0] < self._AUTH_TTL_S:

@@ -336,6 +336,41 @@ class LEvents(abc.ABC):
         )
         return from_events(events, value_spec or ValueSpec())
 
+    def stream_columns_native(
+        self,
+        app_id: int,
+        channel_id: Optional[int] = None,
+        *,
+        value_spec=None,
+        start_time: Optional[_dt.datetime] = None,
+        until_time: Optional[_dt.datetime] = None,
+        entity_type: Optional[str] = None,
+        target_entity_type: OptFilter = UNSET,
+        event_names: Optional[Sequence[str]] = None,
+        batch_rows: int = 1_048_576,
+    ):
+        """Chunked columnar scan (``columnar.ColumnarStream``): fixed-size
+        batches in one shared code space, so the training pipeline can
+        pack batch k while the backend scans batch k+1.
+
+        Returns None when the backend has no chunked path — callers fall
+        back to ``find_columns_native`` (one batch, no overlap). The
+        sqlite backend overrides this with a per-page binary scan.
+        """
+        return None
+
+    def store_fingerprint(
+        self, app_id: int, channel_id: Optional[int] = None
+    ) -> Optional[tuple]:
+        """Cheap state fingerprint of one app/channel's event store —
+        event counts, max ids/times, tombstone state — used to key the
+        pack-artifact cache: a repeat train whose fingerprint matches the
+        cached one skips scan+pack entirely. Must change whenever a scan
+        of the store could return different columns (insert, bulk import,
+        delete). None disables caching for this backend.
+        """
+        return None
+
 
 # --- metadata records ---
 

@@ -120,6 +120,72 @@ class ColumnarEvents:
         )
 
 
+class ColumnarStream:
+    """Chunked columnar scan: an iterator of ``(entity_codes,
+    target_codes, values)`` batches that all share ONE string-code space,
+    plus the id-indexed ``names`` array resolving codes to id strings.
+
+    This is the store→device streaming substrate (the role ALX's
+    pre-bucketed input pipeline plays for TPU matrix factorization,
+    PAPERS.md — arXiv:2112.02194): the training pipeline folds each batch
+    into its pack structures while the backend is still scanning the
+    next one, instead of materializing the whole event history first.
+
+    Contract:
+    - the code space may GROW while iterating (e.g. sqlite's row-store
+      residual tail introduces ids absent from the page dictionary), so
+      consumers size code-indexed accumulators from the codes they see
+      and read ``names`` only after exhausting the iterator;
+    - ``fingerprint`` is the producing store's cheap state fingerprint
+      taken BEFORE the scan started (None when the backend can't provide
+      one). Reading it pre-scan means a cached artifact can only ever be
+      labeled with a fingerprint at least as old as its data — a
+      concurrent write during the scan makes the next lookup miss, never
+      hit stale;
+    - ``cache_key``/``cache_scope`` identify the (app, channel, filters)
+      and the producing DAO for the pack-artifact cache (the scope is
+      compared by IDENTITY, never by a reusable ``id()``).
+    """
+
+    def __init__(
+        self,
+        batches,
+        names_fn,
+        fingerprint=None,
+        cache_key=None,
+        cache_scope=None,
+    ):
+        self._batches = batches
+        self._names_fn = names_fn
+        self.fingerprint = fingerprint
+        self.cache_key = cache_key
+        self.cache_scope = cache_scope
+
+    def __iter__(self):
+        return iter(self._batches)
+
+    @property
+    def names(self) -> np.ndarray:
+        """Id-indexed name array; valid once the iterator is exhausted."""
+        return self._names_fn()
+
+    @staticmethod
+    def from_columnar(cols: ColumnarEvents, **kw) -> "ColumnarStream":
+        """One-shot stream over a materialized scan (the generic
+        fallback): entity codes keep their range, target codes shift past
+        them, so the two sides share one code space."""
+        e_names = np.asarray(cols.entity_names, object)
+        t_names = np.asarray(cols.target_names, object)
+        names = np.concatenate([e_names, t_names])
+        ne = len(e_names)
+        batches = (
+            [(cols.entity_codes, cols.target_codes + np.int32(ne), cols.values)]
+            if cols.n
+            else []
+        )
+        return ColumnarStream(iter(batches), lambda: names, **kw)
+
+
 def encode_strings(ids: Sequence[str]) -> Tuple[np.ndarray, np.ndarray]:
     """Factorize string ids: (names [distinct, sorted], codes int32).
 

@@ -35,6 +35,9 @@ class MemLEvents(base.LEvents):
         # {(app_id, channel_id): {event_id: Event}} with per-namespace
         # insertion-ordered dicts; find() sorts by event time on read.
         self._tables: Dict[Tuple[int, Optional[int]], Dict[str, Event]] = {}
+        # monotone mutation counter: the store-fingerprint component that
+        # distinguishes e.g. delete-then-reinsert from a no-op
+        self._mutations = 0
 
     def _table(self, app_id: int, channel_id: Optional[int]) -> Dict[str, Event]:
         key = (app_id, channel_id)
@@ -62,6 +65,7 @@ class MemLEvents(base.LEvents):
             table = self._table(app_id, channel_id)
             eid = event.event_id or new_event_id()
             table[eid] = event.with_event_id(eid)
+            self._mutations += 1
             return eid
 
     def get(
@@ -74,7 +78,19 @@ class MemLEvents(base.LEvents):
         self, event_id: str, app_id: int, channel_id: Optional[int] = None
     ) -> bool:
         with self._lock:
-            return self._table(app_id, channel_id).pop(event_id, None) is not None
+            found = self._table(app_id, channel_id).pop(event_id, None) is not None
+            if found:
+                self._mutations += 1
+            return found
+
+    def store_fingerprint(
+        self, app_id: int, channel_id: Optional[int] = None
+    ) -> Optional[tuple]:
+        with self._lock:
+            table = self._tables.get((app_id, channel_id))
+            if table is None:
+                return None
+            return ("memory", len(table), self._mutations)
 
     def find(
         self,

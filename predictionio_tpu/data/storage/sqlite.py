@@ -688,20 +688,21 @@ class SQLiteLEvents(base.LEvents):
             self._c.conn.commit()
         return n
 
-    def _page_rows(
-        self, t, start_time, until_time, entity_type, event_names,
+    @staticmethod
+    def _page_filter(
+        start_time, until_time, entity_type, event_names,
         target_entity_type,
     ):
-        """Pages matching the coarse (page-level) filters. Pages only
-        hold target-carrying events, so an explicit target_entity_type
-        IS NULL filter matches none."""
+        """Page-level WHERE ``(clauses, params)`` shared by every page
+        scan (monolithic, streaming, legacy find view), or None when no
+        page can match. Pages only hold target-carrying events, so an
+        explicit target_entity_type IS NULL filter matches none."""
         if target_entity_type is None:  # explicit "no target" filter
-            return []
-        self._ensure_pages_schema(t)
+            return None
         clauses, params = [], []
         if event_names is not None:
             if not event_names:
-                return []
+                return None
             clauses.append(
                 "event IN (" + ",".join("?" * len(event_names)) + ")"
             )
@@ -718,6 +719,21 @@ class SQLiteLEvents(base.LEvents):
         if until_time is not None:
             clauses.append("min_ms < ?")
             params.append(_ms(until_time))
+        return clauses, params
+
+    def _page_rows(
+        self, t, start_time, until_time, entity_type, event_names,
+        target_entity_type,
+    ):
+        """Pages matching the coarse (page-level) filters."""
+        filt = self._page_filter(
+            start_time, until_time, entity_type, event_names,
+            target_entity_type,
+        )
+        if filt is None:
+            return []
+        self._ensure_pages_schema(t)
+        clauses, params = filt
         sql = (
             f"SELECT page, event, entity_type, target_entity_type, prop, "
             f"n, min_ms, max_ms, entities, targets, vals, times, dead "
@@ -988,9 +1004,37 @@ class SQLiteLEvents(base.LEvents):
                     )
                 )
 
-        # residual: row-store events (REST-posted tail) — value evaluated
-        # IN SQL (CASE per event override + json_extract), so even this
-        # path never parses JSON in Python
+        rows, values = self._residual_scan(
+            t, spec, start_time, until_time, entity_type,
+            target_entity_type, event_names,
+        )
+        if rows:
+            from predictionio_tpu.data.storage.columnar import encode_strings
+
+            e_names, e_codes = encode_strings([r[0] for r in rows])
+            g_names, g_codes = encode_strings([r[1] for r in rows])
+            parts.append(
+                ColumnarEvents(
+                    entity_names=e_names,
+                    target_names=g_names,
+                    entity_codes=e_codes,
+                    target_codes=g_codes,
+                    values=values,
+                )
+            )
+        return ColumnarEvents.concat(parts)
+
+    def _residual_scan(
+        self, t, spec, start_time, until_time, entity_type,
+        target_entity_type, event_names,
+    ):
+        """Row-store residual of a columnar scan (REST-posted tail) —
+        value evaluated IN SQL (CASE per event override + json_extract),
+        so even this path never parses JSON in Python. Returns
+        ``(rows, values)``: the raw (entity_id, target_entity_id, ...)
+        rows and their float32 training values."""
+        import numpy as np
+
         clauses, params = self._find_clauses(
             start_time, until_time, entity_type, None, event_names,
             target_entity_type, UNSET,
@@ -1033,39 +1077,215 @@ class SQLiteLEvents(base.LEvents):
             + null_case_params + [prop_path] + params
         )
         rows = self._c.read_execute(sql, all_params).fetchall()
-        if rows:
-            from predictionio_tpu.data.storage.columnar import encode_strings
+        if not rows:
+            return [], None
+        # CAST diverges from the per-event path on non-numeric
+        # property values (unparseable text silently becomes 0.0;
+        # 'nan'/'inf' strings parse in Python but not in CAST) — for
+        # the rare rows whose json_type is not numeric, apply the
+        # same float() rule ValueSpec.value_of uses, so bad events
+        # surface (raise) and parseable text agrees exactly.
+        # json null / missing keep the COALESCE default, as value_of
+        # keeps its default.
+        values = np.fromiter(
+            (
+                r[2]
+                if r[3] in (None, "null", "integer", "real", "true", "false")
+                else float(r[4])
+                for r in rows
+            ),
+            np.float32,
+            count=len(rows),
+        )
+        return rows, values
 
-            e_names, e_codes = encode_strings([r[0] for r in rows])
-            g_names, g_codes = encode_strings([r[1] for r in rows])
-            # CAST diverges from the per-event path on non-numeric
-            # property values (unparseable text silently becomes 0.0;
-            # 'nan'/'inf' strings parse in Python but not in CAST) — for
-            # the rare rows whose json_type is not numeric, apply the
-            # same float() rule ValueSpec.value_of uses, so bad events
-            # surface (raise) and parseable text agrees exactly.
-            # json null / missing keep the COALESCE default, as value_of
-            # keeps its default.
-            values = np.fromiter(
-                (
-                    r[2]
-                    if r[3] in (None, "null", "integer", "real", "true", "false")
-                    else float(r[4])
-                    for r in rows
-                ),
-                np.float32,
-                count=len(rows),
-            )
-            parts.append(
-                ColumnarEvents(
-                    entity_names=e_names,
-                    target_names=g_names,
-                    entity_codes=e_codes,
-                    target_codes=g_codes,
-                    values=values,
+    def stream_columns_native(
+        self,
+        app_id: int,
+        channel_id: Optional[int] = None,
+        *,
+        value_spec=None,
+        start_time: Optional[_dt.datetime] = None,
+        until_time: Optional[_dt.datetime] = None,
+        entity_type: Optional[str] = None,
+        target_entity_type: OptFilter = UNSET,
+        event_names: Optional[Sequence[str]] = None,
+        batch_rows: int = 1_048_576,
+    ):
+        """Chunked binary columnar scan: one batch per page (split past
+        ``batch_rows``), all batches in the TABLE-GLOBAL dictionary code
+        space, plus a final batch for the row-store residual whose new
+        ids extend that space. The page-id list is snapshotted up front
+        (ids only, no blobs), so peak memory is one page and a page
+        inserted mid-scan is simply not part of this scan — exactly the
+        WAL snapshot semantics of the monolithic scan."""
+        import numpy as np
+
+        from predictionio_tpu.data.storage.columnar import (
+            ColumnarStream,
+            ValueSpec,
+        )
+
+        spec = value_spec or ValueSpec()
+        t = self._events_table(app_id, channel_id)
+        with self._c.lock:
+            if not self._exists(t):
+                raise StorageError(f"events table {t} not initialized")
+        # fingerprint BEFORE the scan: a concurrent write during the scan
+        # then makes the next cache lookup miss, never hit stale
+        fingerprint = self.store_fingerprint(app_id, channel_id)
+        self._ensure_pages_schema(t)
+        page_ids: List[int] = []
+        # ids only, no blobs (peak memory stays one page); the filter is
+        # the SAME clause builder the monolithic scan uses, so both paths
+        # select identical pages by construction
+        filt = self._page_filter(
+            start_time, until_time, entity_type, event_names,
+            target_entity_type,
+        )
+        if filt is not None:
+            clauses, params = filt
+            sql = f"SELECT page FROM {t}_pages"
+            if clauses:
+                sql += " WHERE " + " AND ".join(clauses)
+            with self._c.lock:
+                have_pages = self._exists(f"{t}_pages")
+            if have_pages:
+                page_ids = [
+                    r[0]
+                    for r in self._c.read_execute(
+                        sql + " ORDER BY page", params
+                    ).fetchall()
+                ]
+        names_state = {"names": self._dict_names(t), "extra": []}
+
+        def batches():
+            overrides = spec.overrides
+            lo = _ms(start_time) if start_time is not None else None
+            hi = _ms(until_time) if until_time is not None else None
+            for page_id in page_ids:
+                row = self._c.read_execute(
+                    f"SELECT event, prop, n, min_ms, max_ms, entities, "
+                    f"targets, vals, times, dead FROM {t}_pages "
+                    f"WHERE page=?",
+                    (page_id,),
+                ).fetchone()
+                if row is None:
+                    continue  # deleted since listing
+                ev, prop, n, min_ms, max_ms, eb, gb, vb, tb, db = row
+                e = np.frombuffer(eb, np.int32)
+                g = np.frombuffer(gb, np.int32)
+                ov = overrides.get(ev)
+                if ov is not None:
+                    v = np.full(n, ov, np.float32)
+                elif prop == spec.prop:
+                    v = np.frombuffer(vb, np.float32)
+                else:  # stored under a different property: all defaults
+                    v = np.full(n, spec.default, np.float32)
+                needs_time = (lo is not None and min_ms < lo) or (
+                    hi is not None and max_ms >= hi
                 )
+                if needs_time or db is not None:
+                    keep = (
+                        np.frombuffer(db, np.uint8) == 0
+                        if db is not None
+                        else np.ones(n, bool)
+                    )
+                    if needs_time:
+                        ts = np.frombuffer(tb, np.int64)
+                        if lo is not None:
+                            keep = keep & (ts >= lo)
+                        if hi is not None:
+                            keep = keep & (ts < hi)
+                    e, g, v = e[keep], g[keep], v[keep]
+                for s in range(0, len(v), batch_rows):
+                    sl = slice(s, s + batch_rows)
+                    if len(v[sl]):
+                        yield e[sl], g[sl], v[sl]
+            rows, values = self._residual_scan(
+                t, spec, start_time, until_time, entity_type,
+                target_entity_type, event_names,
             )
-        return ColumnarEvents.concat(parts)
+            if rows:
+                # residual ids map into the shared space through a
+                # name->code dict; unseen ids extend it (the residual is
+                # the REST tail — small next to the page bulk)
+                code_of = {
+                    str(nm): j
+                    for j, nm in enumerate(names_state["names"])
+                }
+
+                def enc(strs):
+                    out = np.empty(len(strs), np.int32)
+                    for j, s in enumerate(strs):
+                        c = code_of.get(s)
+                        if c is None:
+                            c = len(code_of)
+                            code_of[s] = c
+                            names_state["extra"].append(s)
+                        out[j] = c
+                    return out
+
+                e_codes = enc([r[0] for r in rows])
+                g_codes = enc([r[1] for r in rows])
+                for s in range(0, len(values), batch_rows):
+                    sl = slice(s, s + batch_rows)
+                    if len(values[sl]):
+                        yield e_codes[sl], g_codes[sl], values[sl]
+
+        def names():
+            base_names = names_state["names"]
+            if not names_state["extra"]:
+                return base_names
+            extra = np.empty(len(names_state["extra"]), object)
+            extra[:] = names_state["extra"]
+            return np.concatenate([base_names, extra])
+
+        return ColumnarStream(batches(), names, fingerprint=fingerprint)
+
+    def store_fingerprint(
+        self, app_id: int, channel_id: Optional[int] = None
+    ) -> Optional[tuple]:
+        """Cheap store-state aggregates: row-store (count, max rowid, max
+        event time) + page store (count, max page id, total rows, max
+        time) + exact tombstone populations. Every mutating path moves at
+        least one component: inserts bump counts/max-rowid (INSERT OR
+        REPLACE reassigns the implicit rowid), bulk imports add pages,
+        deletes shrink counts or flip tombstone bits. Costs a few
+        aggregate scans plus one pass over the (rare) dead blobs."""
+        import numpy as np
+
+        t = self._events_table(app_id, channel_id)
+        with self._c.lock:
+            if not self._exists(t):
+                return None
+        row = tuple(
+            self._c.read_execute(
+                f"SELECT COUNT(*), COALESCE(MAX(rowid), 0), "
+                f"COALESCE(MAX(event_time_ms), 0) FROM {t}"
+            ).fetchone()
+        )
+        pages = (0, 0, 0, 0)
+        dead_sig: tuple = ()
+        self._ensure_pages_schema(t)
+        with self._c.lock:
+            have_pages = self._exists(f"{t}_pages")
+        if have_pages:
+            pages = tuple(
+                self._c.read_execute(
+                    f"SELECT COUNT(*), COALESCE(MAX(page), 0), "
+                    f"COALESCE(TOTAL(n), 0), COALESCE(MAX(max_ms), 0) "
+                    f"FROM {t}_pages"
+                ).fetchone()
+            )
+            dead_sig = tuple(
+                (page, int(np.frombuffer(db, np.uint8).sum()))
+                for page, db in self._c.read_execute(
+                    f"SELECT page, dead FROM {t}_pages "
+                    f"WHERE dead IS NOT NULL ORDER BY page"
+                ).fetchall()
+            )
+        return ("sqlite", row, pages, dead_sig)
 
 
 class _SQLiteMetaBase:

@@ -245,6 +245,65 @@ class PEventStore:
             events=kept,
         )
 
+    def stream_columns(
+        self,
+        app_name: str,
+        value_spec=None,
+        channel_name: Optional[str] = None,
+        batch_rows: int = 1_048_576,
+        **find_kwargs,
+    ):
+        """Chunked columnar scan for the streaming store→device training
+        pipeline (``ops/streaming.py``): a ``columnar.ColumnarStream`` of
+        batches in one shared code space, carrying the store's pre-scan
+        fingerprint and a cache identity for the pack-artifact cache.
+
+        Only the native filter set is streamable (the per-event fallback
+        would defeat the point); backends without a chunked scan wrap the
+        monolithic native scan in a one-batch stream, so callers keep one
+        code path. Returns None when the filters need the per-event path
+        or the backend has no native scan at all — callers fall back to
+        ``find_columns`` + the materialized trainer.
+        """
+        from predictionio_tpu.data.storage.columnar import (
+            ColumnarStream,
+            ValueSpec,
+        )
+
+        native = self._NATIVE_FILTERS - {"channel_name"}
+        if not set(find_kwargs) <= native:
+            return None
+        spec = value_spec or ValueSpec()
+        app_id, channel_id = app_name_to_id(
+            app_name, channel_name, self.storage
+        )
+        le = self.storage.get_p_events()
+        key = (
+            "stream", app_id, channel_id, spec,
+            tuple(
+                (k, tuple(v) if isinstance(v, (list, tuple)) else v)
+                for k, v in sorted(find_kwargs.items())
+            ),
+        )
+        stream = le.stream_columns_native(
+            app_id=app_id, channel_id=channel_id, value_spec=spec,
+            batch_rows=batch_rows, **find_kwargs,
+        )
+        if stream is None:
+            # one-batch fallback: fingerprint read BEFORE the scan so a
+            # cached artifact can never be labeled newer than its data
+            fp = le.store_fingerprint(app_id, channel_id)
+            cols = le.find_columns_native(
+                app_id=app_id, channel_id=channel_id, value_spec=spec,
+                **find_kwargs,
+            )
+            if cols is None:
+                return None
+            stream = ColumnarStream.from_columnar(cols, fingerprint=fp)
+        stream.cache_key = key
+        stream.cache_scope = le
+        return stream
+
     @staticmethod
     def _from_columnar(
         cols,

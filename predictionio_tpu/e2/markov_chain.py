@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import weakref
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -94,17 +95,31 @@ class MarkovChainModel:
         cached — repeat predicts ship only the [n_states] state vector
         (same pattern as SimilarityScorer's device-resident factors).
         shard_batch zero-pads the state rows to divide the mesh axis;
-        padded rows carry zero probability, so they drop from the sum."""
-        key = None if mesh is None else (id(mesh), axis)
-        if self._placed is not None and self._placed[0] == key:
-            return self._placed[1], self._placed[2]
+        padded rows carry zero probability, so they drop from the sum.
+
+        The cache key holds the mesh itself by WEAKREF and compares
+        object identity: an ``id(mesh)`` key could collide when a dead
+        mesh's address is reused by a new one, returning arrays placed
+        for devices/sharding of a mesh that no longer exists."""
+        if self._placed is not None:
+            mesh_ref, cached_axis, t_dev, p_dev = self._placed
+            cached_mesh = mesh_ref() if mesh_ref is not None else None
+            if (
+                cached_axis == axis
+                and cached_mesh is mesh
+                and (mesh is not None or mesh_ref is None)
+            ):
+                return t_dev, p_dev
         if mesh is None:
             t_dev = jnp.asarray(self.targets)
             p_dev = jnp.asarray(self.probs)
         else:
             t_dev, _ = shard_batch(mesh, self.targets, axis)
             p_dev, _ = shard_batch(mesh, self.probs, axis)
-        self._placed = (key, t_dev, p_dev)
+        self._placed = (
+            weakref.ref(mesh) if mesh is not None else None,
+            axis, t_dev, p_dev,
+        )
         return t_dev, p_dev
 
 

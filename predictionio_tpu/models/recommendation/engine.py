@@ -100,6 +100,48 @@ class TrainingData(SanityCheck):
             )
 
 
+class StreamingTrainingData(TrainingData):
+    """Lazy TrainingData backed by a chunked store scan.
+
+    The ALS algorithm feeds ``stream_factory`` straight into the
+    streaming store→device pipeline (``ops/streaming``) without ever
+    materializing the rating columns on host; any other consumer that
+    touches the column attributes transparently materializes through the
+    monolithic scan, so the DASE contract is unchanged."""
+
+    def __init__(self, stream_factory, loader):
+        # no super().__init__: columns materialize on first attribute
+        # access through the class-level properties below
+        self._stream_factory = stream_factory
+        self._loader = loader
+        self._td: Optional[TrainingData] = None
+
+    @property
+    def stream_factory(self):
+        """() -> ColumnarStream for the streaming trainer (a FRESH
+        stream per call: fingerprints are read at stream creation)."""
+        return self._stream_factory
+
+    def materialize(self) -> TrainingData:
+        if self._td is None:
+            self._td = self._loader()
+        return self._td
+
+    user_idx = property(lambda self: self.materialize().user_idx)
+    item_idx = property(lambda self: self.materialize().item_idx)
+    ratings = property(lambda self: self.materialize().ratings)
+    user_index = property(lambda self: self.materialize().user_index)
+    item_index = property(lambda self: self.materialize().item_index)
+
+    def sanity_check(self) -> None:
+        # deferred: materializing here would serialize the very scan the
+        # pipeline overlaps. The streaming trainer returns None on an
+        # empty scan and the algorithm falls back to the materialized
+        # path, whose sanity check raises the user-facing error.
+        if self._td is not None:
+            self._td.sanity_check()
+
+
 @dataclasses.dataclass
 class PreparedData:
     td: TrainingData
@@ -153,7 +195,18 @@ class DataSource(BaseDataSource):
             event_names=list(self.params.event_names),
         )
 
-    def read_training(self, ctx) -> TrainingData:
+    def _stream_columns(self, ctx):
+        store = PEventStore(ctx.storage)
+        return store.stream_columns(
+            self.params.app_name,
+            value_spec=RATING_SPEC,
+            channel_name=self.params.channel_name,
+            entity_type="user",
+            target_entity_type="item",
+            event_names=list(self.params.event_names),
+        )
+
+    def _materialized_training(self, ctx) -> TrainingData:
         cols = self._read_columns(ctx)
         logger.info(
             "DataSource: %d ratings, %d users, %d items",
@@ -166,6 +219,37 @@ class DataSource(BaseDataSource):
             user_index=cols.entity_index,
             item_index=cols.target_index,
         )
+
+    def read_training(self, ctx) -> TrainingData:
+        # streaming handoff: when the store has a native chunked scan,
+        # return a LAZY TrainingData so the ALS algorithm can overlap
+        # scan/pack/transfer/compile (ops/streaming). The reference's
+        # read stage materializes an RDD; here the "RDD" is a stream
+        # factory and materialization is the fallback, not the default.
+        try:
+            stream = self._stream_columns(ctx)
+        except Exception:
+            stream = None
+        if stream is not None:
+            # hand the probe stream to its FIRST consumer: sqlite's
+            # eager setup (fingerprint aggregates, page listing,
+            # dictionary load) should run once per train, not twice.
+            # The pre-scan fingerprint read a moment early stays safe —
+            # it can only cause a spurious cache miss later, never a
+            # stale hit.
+            probe = [stream]
+
+            def stream_factory():
+                first, probe[0] = probe[0], None
+                return first if first is not None else self._stream_columns(
+                    ctx
+                )
+
+            return StreamingTrainingData(
+                stream_factory=stream_factory,
+                loader=lambda: self._materialized_training(ctx),
+            )
+        return self._materialized_training(ctx)
 
     def read_eval(self, ctx):
         if not self.params.eval_k:
@@ -380,6 +464,29 @@ class ALSAlgorithm(BaseAlgorithm):
             seed=p.seed if p.seed is not None else 0,
         )
         mesh = ctx.mesh if ctx is not None else None
+        if mesh is not None and mesh.devices.size == 1:
+            # a 1-device mesh is single-device training: drop to the
+            # device-pack wire path (streaming-capable, smaller wire)
+            mesh = None
+        stream_factory = getattr(td, "stream_factory", None)
+        if stream_factory is not None and mesh is None:
+            from predictionio_tpu.ops.streaming import train_als_streaming
+
+            result = train_als_streaming(
+                stream_factory(), config,
+                timer=getattr(ctx, "timer", None),
+                checkpoint_dir=p.checkpoint_dir,
+                checkpoint_every=p.checkpoint_every,
+            )
+            if result is not None:
+                return ALSModel(
+                    arrays=result.arrays,
+                    user_index=result.user_index,
+                    item_index=result.item_index,
+                )
+            # empty/unstreamable scan: the materialized path below owns
+            # the error reporting (TrainingData.sanity_check semantics)
+            td.materialize().sanity_check()
         arrays = train_als(
             td.user_idx,
             td.item_idx,

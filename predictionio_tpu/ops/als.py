@@ -824,20 +824,24 @@ def _bucket_count(n: int) -> int:
 
 
 def auto_segment_length(
-    idx: np.ndarray, n_rows: int, cap: int,
+    idx: Optional[np.ndarray], n_rows: int, cap: int,
     counts: Optional[np.ndarray] = None,
 ) -> int:
     """Smallest power of two >= the side's mean observation count, within
     [min(8, cap), cap] — shared by train_als and train_als_grid so the
     two paths always pack identically (see ALSConfig.segment_length).
-    Pass precomputed per-row ``counts`` to skip the bincount pass."""
+    Pass precomputed per-row ``counts`` to skip the bincount pass;
+    ``idx`` may then be None (the streaming packer never materializes a
+    row-id plane)."""
     floor = min(8, cap)  # honor caps below 8
     if counts is None:
         counts = np.bincount(idx, minlength=n_rows)
     nonempty = int((counts > 0).sum())
     if nonempty == 0:
         return floor
-    mean = len(idx) / nonempty
+    mean = (
+        len(idx) if idx is not None else int(counts.sum())
+    ) / nonempty
     L = floor
     while L < cap and L < mean:
         L *= 2
@@ -888,6 +892,360 @@ class ALSModelArrays:
     item_factors: np.ndarray  # [n_items, k]
 
 
+# --- host wire: the presorted, narrowed COO + geometry ---
+#
+# Everything the single-device pack path ships to the accelerator, as one
+# value: the streaming ingest pipeline (ops/streaming.py) builds it
+# incrementally while the store scan is still running, the pack-artifact
+# cache stores it so a repeat train skips scan+pack entirely, and
+# train_als builds it monolithically. All three enter training through
+# train_from_wire, so the device program is identical regardless of how
+# the wire was produced.
+
+
+def aux_pad(arr: np.ndarray) -> np.ndarray:
+    """Bucket a CSR-offset array's length (indexed only by row ids
+    <= n_rows, so edge-padding is inert) — keeps the pack executable
+    shared across near-identical cardinalities, matching the row-dim
+    bucketing of the iteration program."""
+    out = np.full(_bucket_count(len(arr)), arr[-1], np.int32)
+    out[: len(arr)] = arr
+    return out
+
+
+@dataclasses.dataclass
+class HostWire:
+    """Presorted (by user), narrowed COO wire plus segment geometry —
+    the minimal host representation of one training input."""
+
+    n_users: int
+    n_items: int
+    L_u: int
+    L_i: int
+    geo_u: _SegGeometry
+    geo_i: _SegGeometry
+    iw: np.ndarray  # item ids, user-sorted, sentinel-padded, narrowed
+    vw: np.ndarray  # values (nibble-packed uint8, int8, or float32)
+    nibble: bool
+    v_scale: float
+    aux: dict  # su/bu/si/bi int32 CSR offsets + segment bases (aux_pad'd)
+    counts_u: np.ndarray  # [n_users] int32 observation counts
+    counts_i: np.ndarray  # [n_items]
+
+    @property
+    def wire_mb(self) -> float:
+        return round(
+            (
+                self.iw.nbytes
+                + self.vw.nbytes
+                + sum(int(a.nbytes) for a in self.aux.values())
+            )
+            / 2**20,
+            1,
+        )
+
+    @property
+    def padded_slots(self) -> int:
+        return self.geo_u.total * self.L_u + self.geo_i.total * self.L_i
+
+    def identity_bytes(self) -> bytes:
+        """Data-identity material for the checkpoint fingerprint."""
+        return self.iw.tobytes() + self.vw.tobytes()
+
+
+def build_host_wire(
+    user_idx: np.ndarray,
+    item_idx: np.ndarray,
+    ratings: np.ndarray,
+    n_users: int,
+    n_items: int,
+    config: ALSConfig,
+    counts_u: Optional[np.ndarray] = None,
+    counts_i: Optional[np.ndarray] = None,
+) -> HostWire:
+    """Monolithic wire build from a COO batch: the host stable-sorts by
+    user (the CSR offsets then encode row ids on device), narrows item
+    ids and ratings to their minimal lossless wire dtypes, and
+    nibble-packs half-step ratings two per byte."""
+    user_idx = np.asarray(user_idx, np.int32)
+    item_idx = np.asarray(item_idx, np.int32)
+    ratings_f = np.asarray(ratings, np.float32)
+    if counts_u is None:
+        counts_u = np.bincount(user_idx, minlength=n_users).astype(np.int32)
+    if counts_i is None:
+        counts_i = np.bincount(item_idx, minlength=n_items).astype(np.int32)
+    L_u = auto_segment_length(
+        user_idx, n_users, config.segment_length, counts=counts_u
+    )
+    L_i = auto_segment_length(
+        item_idx, n_items, config.segment_length, counts=counts_i
+    )
+    geo_u = _segment_geometry(counts_u, n_users, L_u, 1, config.chunk_slots)
+    geo_i = _segment_geometry(counts_i, n_items, L_i, 1, config.chunk_slots)
+    n = len(ratings_f)
+    order = np.argsort(user_idx, kind="stable")
+    # bucket the COO length (4 significant bits) so k-fold/grid runs
+    # with near-identical rating counts share one pack executable;
+    # padding elements carry the sentinel row id on BOTH sides and
+    # either land in masked padding segments or drop out of bounds
+    pad = (_bucket_count(n) - n) if n else 1
+    iw = np.concatenate([item_idx[order], np.full(pad, n_items, np.int32)])
+    vw = np.concatenate([ratings_f[order], np.zeros(pad, np.float32)])
+    return finish_wire(
+        iw, vw, n_users, n_items, L_u, L_i, geo_u, geo_i,
+        counts_u, counts_i,
+    )
+
+
+def finish_wire(
+    iw: np.ndarray,
+    vw: np.ndarray,
+    n_users: int,
+    n_items: int,
+    L_u: int,
+    L_i: int,
+    geo_u: _SegGeometry,
+    geo_i: _SegGeometry,
+    counts_u: np.ndarray,
+    counts_i: np.ndarray,
+) -> HostWire:
+    """Shared tail of the monolithic and streaming packers: narrow a
+    user-sorted, sentinel-padded (to the bucketed COO length) item/value
+    COO to its minimal wire dtypes and assemble the :class:`HostWire` —
+    both producers hand identical inputs here, so the wires (and the
+    device programs consuming them) are byte-identical."""
+    iw = _narrow_ids(iw)
+    vw, v_scale = _narrow_vals(vw)
+    nibble = _nibble_packable(vw)
+    if nibble:
+        vw = _pack_nibbles_host(vw)
+    aux = {
+        "su": aux_pad(geo_u.starts.astype(np.int32)),
+        "bu": aux_pad(geo_u.seg_base.astype(np.int32)),
+        "si": aux_pad(geo_i.starts.astype(np.int32)),
+        "bi": aux_pad(geo_i.seg_base.astype(np.int32)),
+    }
+    return HostWire(
+        n_users=n_users, n_items=n_items, L_u=L_u, L_i=L_i,
+        geo_u=geo_u, geo_i=geo_i, iw=iw, vw=vw, nibble=nibble,
+        v_scale=v_scale, aux=aux, counts_u=counts_u, counts_i=counts_i,
+    )
+
+
+def _padded_rows(n: int, n_shards: int) -> int:
+    # +1 sentinel row for segment padding, bucketed so near-identical
+    # cardinalities share one executable (see _bucket_count), rounded
+    # up so the row dim shards evenly over the mesh
+    return pad_to_multiple(_bucket_count(n + 1), n_shards)
+
+
+def _factor_init_host(
+    n_users: int, n_items: int, config: ALSConfig, n_shards: int
+) -> Tuple[np.ndarray, np.ndarray]:
+    """MLlib-style init: nonnegative scaled normals on the item side;
+    sentinel/padding rows zero."""
+    k = config.rank
+    rng = np.random.default_rng(config.seed)
+    X0 = np.zeros((_padded_rows(n_users, n_shards), k), np.float32)
+    Y0 = np.zeros((_padded_rows(n_items, n_shards), k), np.float32)
+    Y0[:n_items] = np.abs(rng.standard_normal((n_items, k))) / math.sqrt(k)
+    return X0, Y0
+
+
+def _lam_obs_host(
+    counts: np.ndarray, n_real: int, n_sys_rows: int, config: ALSConfig
+) -> Tuple[np.ndarray, np.ndarray]:
+    padded = np.zeros(n_sys_rows, np.float32)
+    padded[:n_real] = counts
+    weighted = config.reg_mode == "weighted"
+    lam = config.reg * padded if weighted else np.full_like(padded, config.reg)
+    # guard zero-count/padding rows against singular systems (their
+    # solutions are discarded by the has_obs select anyway)
+    return np.maximum(lam, 1e-8).astype(np.float32), padded > 0
+
+
+def start_compile_async(
+    n_users: int,
+    n_items: int,
+    geo_u: _SegGeometry,
+    geo_i: _SegGeometry,
+    L_u: int,
+    L_i: int,
+    config: ALSConfig,
+):
+    """Compile the single-device iteration executable for these shapes on
+    a BACKGROUND thread, so XLA compile hides under scan/pack/transfer
+    (the streaming pipeline calls this the moment bucket geometry is
+    known). The warm-up is a zero-iteration run on zero-filled arrays of
+    the exact shapes/dtypes the real call uses, so the jit cache (and the
+    persistent compilation cache) is hot when training dispatches.
+
+    Returns ``wait() -> dict`` with ``busy_s`` (and ``error`` if the
+    warm-up failed — best-effort; training then compiles inline)."""
+    import threading
+    import time as _time
+
+    rec: dict = {}
+
+    def work() -> None:
+        t0 = _time.perf_counter()
+        try:
+            k = config.rank
+            r_u = _padded_rows(n_users, 1)
+            r_i = _padded_rows(n_items, 1)
+
+            def zpack(geo: _SegGeometry, L: int):
+                return (
+                    jnp.zeros((geo.n_chunks, geo.sc), jnp.int32),
+                    jnp.zeros((geo.n_chunks, geo.sc, L), jnp.int32),
+                    jnp.zeros((geo.n_chunks, geo.sc, L), jnp.float32),
+                    jnp.zeros((geo.n_chunks, geo.sc), jnp.int32),
+                )
+
+            out = _run_iterations(
+                jnp.zeros((r_u, k), jnp.float32),
+                jnp.zeros((r_i, k), jnp.float32),
+                zpack(geo_u, L_u), zpack(geo_i, L_i),
+                jnp.zeros((r_u,), jnp.float32),
+                jnp.zeros((r_i,), jnp.float32),
+                jnp.zeros((r_u,), bool), jnp.zeros((r_i,), bool),
+                config.alpha, jnp.int32(0),
+                implicit=config.implicit_prefs,
+                compute_dtype=config.compute_dtype,
+                rep_sharding=None, row_sharding=None,
+            )
+            _fence(out)
+        except Exception as e:  # pragma: no cover - defensive
+            rec["error"] = repr(e)
+        rec["busy_s"] = _time.perf_counter() - t0
+
+    th = threading.Thread(target=work, daemon=True, name="als-warm-compile")
+    th.start()
+
+    def wait() -> dict:
+        th.join()
+        return rec
+
+    return wait
+
+
+def init_factor_state_single(
+    counts_u: np.ndarray,
+    counts_i: np.ndarray,
+    n_users: int,
+    n_items: int,
+    config: ALSConfig,
+) -> tuple:
+    """Place the single-device factor/regularizer state: X as DEVICE
+    zeros (its [r_u, k] buffer never crosses the host→device link — at
+    ML-20M that is ~17 MB of zeros the wire no longer carries), Y0 and
+    the small lam/has_obs vectors shipped from host."""
+    k = config.rank
+    _, Y0 = _factor_init_host(n_users, n_items, config, 1)
+    X = jnp.zeros((_padded_rows(n_users, 1), k), jnp.float32)
+    Y = jnp.asarray(Y0)
+    user_lam_h, user_obs_h = _lam_obs_host(counts_u, n_users, X.shape[0], config)
+    item_lam_h, item_obs_h = _lam_obs_host(counts_i, n_items, Y.shape[0], config)
+    return (
+        X, Y,
+        jnp.asarray(user_lam_h), jnp.asarray(item_lam_h),
+        jnp.asarray(user_obs_h), jnp.asarray(item_obs_h),
+    )
+
+
+def device_pack_from_wire(
+    wire: HostWire,
+    device_wire: Optional[tuple] = None,  # (i_dev, v_dev, aux_dev) pre-shipped
+    timings: Optional[dict] = None,
+) -> Tuple[tuple, tuple]:
+    """Transfer the wire (unless pre-shipped) and build the padded
+    segment layout in HBM. Returns (user_pack, item_pack) ready for
+    :func:`_train_packed`."""
+    import time as _time
+
+    t_phase = _time.perf_counter()
+    if device_wire is None:
+        i_dev = jax.device_put(wire.iw)
+        v_wire_dev = jax.device_put(wire.vw)
+        v_dev = _unpack_nibbles(v_wire_dev) if wire.nibble else v_wire_dev
+        aux = jax.device_put(wire.aux)
+        if timings is not None:
+            # aux was enqueued last; fetching it (small) fences the
+            # serialized transfer queue behind the COO arrays
+            _sync_fetch(aux)
+            timings["device_put_s"] = _time.perf_counter() - t_phase
+    else:
+        i_dev, v_dev, aux = device_wire
+    if timings is not None:
+        timings["wire_mb"] = wire.wire_mb
+    t_phase = _time.perf_counter()
+    u_keys, pcu, pvu = _device_pack_presorted(
+        i_dev, v_dev, aux["su"], aux["bu"],
+        total=wire.geo_u.total, L=wire.L_u, scale=wire.v_scale,
+    )
+    pci, pvi = _device_scatter_pack(
+        i_dev, u_keys, v_dev, aux["si"], aux["bi"],
+        total=wire.geo_i.total, L=wire.L_i, scale=wire.v_scale,
+    )
+    if timings is not None:
+        # dispatch is async; this records the (cached-after-first)
+        # pack-executable compile time, not the scatter itself
+        timings["device_pack_dispatch_s"] = _time.perf_counter() - t_phase
+
+    def geo_pack(geo: _SegGeometry, pc, pv):
+        return (
+            jnp.asarray(geo.seg_rows.reshape(geo.n_chunks, geo.sc)),
+            pc.reshape(geo.n_chunks, geo.sc, geo.L),
+            pv.reshape(geo.n_chunks, geo.sc, geo.L),
+            jnp.asarray(geo.rem.reshape(geo.n_chunks, geo.sc)),
+        )
+
+    return geo_pack(wire.geo_u, pcu, pvu), geo_pack(wire.geo_i, pci, pvi)
+
+
+def train_from_wire(
+    wire: HostWire,
+    config: ALSConfig,
+    *,
+    device_wire: Optional[tuple] = None,  # (i_dev, v_dev, aux_dev) pre-shipped
+    timings: Optional[dict] = None,
+    checkpoint_dir: Optional[str] = None,
+    checkpoint_every: int = 5,
+    profile_dir: Optional[str] = None,
+    compile_wait=None,  # callable from start_compile_async, or None
+    factor_state: Optional[tuple] = None,  # pre-placed (X, Y, lam/obs x4)
+    _fp_material=None,
+) -> ALSModelArrays:
+    """Train from a :class:`HostWire` (single-device device-pack path).
+
+    ``device_wire``/``factor_state``/``compile_wait`` let the streaming
+    pipeline hand in work it already overlapped with the store scan;
+    left as None, this performs the same transfer → device-pack →
+    compile → loop sequence train_als always did."""
+    if factor_state is None:
+        # factor/lam/obs placement first: their (small) transfers enqueue
+        # ahead of the wire, so the device_put fence attributes them too
+        factor_state = init_factor_state_single(
+            wire.counts_u, wire.counts_i, wire.n_users, wire.n_items, config
+        )
+    user_pack, item_pack = device_pack_from_wire(
+        wire, device_wire=device_wire, timings=timings
+    )
+    if timings is not None:
+        timings["padded_slots"] = wire.padded_slots
+    return _train_packed(
+        user_pack, item_pack, *factor_state,
+        config=config, mesh=None, axis="data",
+        n_users=wire.n_users, n_items=wire.n_items,
+        checkpoint_dir=checkpoint_dir, checkpoint_every=checkpoint_every,
+        timings=timings, profile_dir=profile_dir,
+        fp_material=(
+            _fp_material if _fp_material is not None else wire.identity_bytes
+        ),
+        compile_wait=compile_wait,
+    )
+
+
 def train_als(
     user_idx: np.ndarray,
     item_idx: np.ndarray,
@@ -928,13 +1286,48 @@ def train_als(
     """
     import time as _time
 
-    k = config.rank
     n_shards = mesh.shape[axis] if mesh is not None else 1
 
     t_phase = _time.perf_counter()
     user_idx = np.asarray(user_idx, np.int32)
     item_idx = np.asarray(item_idx, np.int32)
     ratings_f = np.asarray(ratings, np.float32)
+
+    def fp_material() -> bytes:
+        return user_idx.tobytes() + item_idx.tobytes() + ratings_f.tobytes()
+
+    if mesh is None:
+        # Device-side packing: the COO crosses the link once WITHOUT its
+        # row-id plane — the host stable-sorts by user (radix, ~1 s at
+        # 20M), so user ids rebuild on device from the CSR offsets
+        # (_device_pack_presorted) and only the narrowed item ids +
+        # ratings (nibble-packed when half-step) travel. At ML-20M that is
+        # ~51 MB on the wire instead
+        # of ~140 MB, and ONE device sort instead of two (the item side
+        # still lax.sorts by item key, consuming the rebuilt user ids).
+        wire = build_host_wire(
+            user_idx, item_idx, ratings_f, n_users, n_items, config
+        )
+        logger.info(
+            "ALS: %d users (%d segments of %d), %d items (%d segments of "
+            "%d), %d ratings, rank %d",
+            n_users, wire.geo_u.total, wire.L_u, n_items, wire.geo_i.total,
+            wire.L_i, len(ratings_f), config.rank,
+        )
+        if timings is not None:
+            timings["pack_s"] = _time.perf_counter() - t_phase
+        return train_from_wire(
+            wire, config,
+            timings=timings,
+            checkpoint_dir=checkpoint_dir,
+            checkpoint_every=checkpoint_every,
+            profile_dir=profile_dir,
+            _fp_material=fp_material,
+        )
+
+    # Mesh path: host-side packing + sharded placement. Multi-device
+    # meshes are local or multi-host (no relayed link), and the packed
+    # arrays must be laid out per the mesh sharding anyway.
     counts_u = np.bincount(user_idx, minlength=n_users).astype(np.int32)
     counts_i = np.bincount(item_idx, minlength=n_items).astype(np.int32)
     L_u = auto_segment_length(
@@ -949,166 +1342,95 @@ def train_als(
         "ALS: %d users (%d segments of %d), %d items (%d segments of %d), "
         "%d ratings, rank %d",
         n_users, geo_u.total, L_u, n_items, geo_i.total, L_i,
-        len(ratings_f), k,
+        len(ratings_f), config.rank,
     )
 
-    rng = np.random.default_rng(config.seed)
-
-    def padded_rows(n: int) -> int:
-        # +1 sentinel row for segment padding, bucketed so near-identical
-        # cardinalities share one executable (see _bucket_count), rounded
-        # up so the row dim shards evenly over the mesh
-        return pad_to_multiple(_bucket_count(n + 1), n_shards)
-
-    # MLlib-style init: nonnegative scaled normals on the item side;
-    # sentinel/padding rows zero
-    Y0 = np.zeros((padded_rows(n_items), k), np.float32)
-    Y0[:n_items] = np.abs(rng.standard_normal((n_items, k))) / math.sqrt(k)
-    rep = P()
-    row_sharded = P(axis) if mesh is not None else P()
+    row_sharded = P(axis)
     # segment arrays are [C, Sc(, L)]; the segment dim (Sc, a multiple of
     # the shard count) shards over the mesh axis, the chunk dim C is the
     # device-loop trip dim and stays unsharded
-    seg_sharded2 = P(None, axis) if mesh is not None else P()
-    seg_sharded3 = P(None, axis, None) if mesh is not None else P()
-    X = _place(mesh, np.zeros((padded_rows(n_users), k), np.float32), row_sharded)
+    seg_sharded2 = P(None, axis)
+    seg_sharded3 = P(None, axis, None)
+    X0, Y0 = _factor_init_host(n_users, n_items, config, n_shards)
+    X = _place(mesh, X0, row_sharded)
     Y = _place(mesh, Y0, row_sharded)
 
-    weighted = config.reg_mode == "weighted"
-
-    def lam_and_obs(counts: np.ndarray, n_real: int, n_sys_rows: int):
-        padded = np.zeros(n_sys_rows, np.float32)
-        padded[:n_real] = counts
-        lam = config.reg * padded if weighted else np.full_like(padded, config.reg)
-        # guard zero-count/padding rows against singular systems (their
-        # solutions are discarded by the has_obs select anyway)
-        lam = np.maximum(lam, 1e-8).astype(np.float32)
-        return (
-            _place(mesh, lam, row_sharded),
-            _place(mesh, padded > 0, row_sharded),
-        )
-
-    if mesh is None:
-        # Device-side packing: the COO crosses the link once WITHOUT its
-        # row-id plane — the host stable-sorts by user (radix, ~1 s at
-        # 20M), so user ids rebuild on device from the CSR offsets
-        # (_device_pack_presorted) and only the narrowed item ids +
-        # ratings (nibble-packed when half-step) travel. At ML-20M that is
-        # ~51 MB on the wire instead
-        # of ~140 MB, and ONE device sort instead of two (the item side
-        # still lax.sorts by item key, consuming the rebuilt user ids).
-        n = len(ratings_f)
-        order = np.argsort(user_idx, kind="stable")
-        # bucket the COO length (4 significant bits) so k-fold/grid runs
-        # with near-identical rating counts share one pack executable;
-        # padding elements carry the sentinel row id on BOTH sides and
-        # either land in masked padding segments or drop out of bounds
-        pad = (_bucket_count(n) - n) if n else 1
-        iw = np.concatenate(
-            [item_idx[order], np.full(pad, n_items, np.int32)]
-        )
-        vw = np.concatenate([ratings_f[order], np.zeros(pad, np.float32)])
-        iw = _narrow_ids(iw)
-        vw, v_scale = _narrow_vals(vw)
-        nibble = _nibble_packable(vw)
-        if nibble:
-            vw = _pack_nibbles_host(vw)
-        if timings is not None:
-            timings["pack_s"] = _time.perf_counter() - t_phase
-        t_phase = _time.perf_counter()
-        i_dev = jax.device_put(iw)
-        v_wire_dev = jax.device_put(vw)
-        v_dev = _unpack_nibbles(v_wire_dev) if nibble else v_wire_dev
-        def aux_pad(arr: np.ndarray) -> np.ndarray:
-            # bucket the CSR-offset length (indexed only by row ids
-            # <= n_rows, so edge-padding is inert) — keeps the pack
-            # executable shared across near-identical cardinalities,
-            # matching the row-dim bucketing of the iteration program
-            out = np.full(_bucket_count(len(arr)), arr[-1], np.int32)
-            out[: len(arr)] = arr
-            return out
-
-        aux = jax.device_put(
-            {
-                "su": aux_pad(geo_u.starts.astype(np.int32)),
-                "bu": aux_pad(geo_u.seg_base.astype(np.int32)),
-                "si": aux_pad(geo_i.starts.astype(np.int32)),
-                "bi": aux_pad(geo_i.seg_base.astype(np.int32)),
-            }
-        )
-        if timings is not None:
-            # aux was enqueued last; fetching it (small) fences the
-            # serialized transfer queue behind the COO arrays
-            _sync_fetch(aux)
-            timings["device_put_s"] = _time.perf_counter() - t_phase
-            timings["wire_mb"] = round(
-                (
-                    iw.nbytes + vw.nbytes
-                    + sum(int(a.nbytes) for a in aux.values())
-                ) / 2**20,
-                1,
-            )
-        t_phase = _time.perf_counter()
-        u_keys, pcu, pvu = _device_pack_presorted(
-            i_dev, v_dev, aux["su"], aux["bu"],
-            total=geo_u.total, L=L_u, scale=v_scale,
-        )
-        pci, pvi = _device_scatter_pack(
-            i_dev, u_keys, v_dev, aux["si"], aux["bi"],
-            total=geo_i.total, L=L_i, scale=v_scale,
-        )
-        if timings is not None:
-            # dispatch is async; this records the (cached-after-first)
-            # pack-executable compile time, not the scatter itself
-            timings["device_pack_dispatch_s"] = _time.perf_counter() - t_phase
-
-        def geo_pack(geo: _SegGeometry, pc, pv):
-            return (
-                jnp.asarray(geo.seg_rows.reshape(geo.n_chunks, geo.sc)),
-                pc.reshape(geo.n_chunks, geo.sc, geo.L),
-                pv.reshape(geo.n_chunks, geo.sc, geo.L),
-                jnp.asarray(geo.rem.reshape(geo.n_chunks, geo.sc)),
-            )
-
-        user_pack = geo_pack(geo_u, pcu, pvu)
-        item_pack = geo_pack(geo_i, pci, pvi)
-    else:
-        # Mesh path: host-side packing + sharded placement. Multi-device
-        # meshes are local or multi-host (no relayed link), and the packed
-        # arrays must be laid out per the mesh sharding anyway.
-        user_side = pack_segments(
-            user_idx, item_idx, ratings_f, n_users, L_u,
-            n_shards, config.chunk_slots,
-        )
-        item_side = pack_segments(
-            item_idx, user_idx, ratings_f, n_items, L_i,
-            n_shards, config.chunk_slots,
-        )
-        if timings is not None:
-            timings["pack_s"] = _time.perf_counter() - t_phase
-        t_phase = _time.perf_counter()
-
-        def put_pack(side: PackedSide):
-            return (
-                _place(mesh, side.seg_rows, seg_sharded2),
-                _place(mesh, side.cols, seg_sharded3),
-                _place(mesh, side.vals, seg_sharded3),
-                _place(mesh, side.rem, seg_sharded2),
-            )
-
-        user_pack = put_pack(user_side)
-        item_pack = put_pack(item_side)
-
-    user_lam, user_has_obs = lam_and_obs(counts_u, n_users, X.shape[0])
-    item_lam, item_has_obs = lam_and_obs(counts_i, n_items, Y.shape[0])
+    user_side = pack_segments(
+        user_idx, item_idx, ratings_f, n_users, L_u,
+        n_shards, config.chunk_slots,
+    )
+    item_side = pack_segments(
+        item_idx, user_idx, ratings_f, n_items, L_i,
+        n_shards, config.chunk_slots,
+    )
     if timings is not None:
-        if mesh is not None:
-            # the has_obs arrays were enqueued last; fetching them (small)
-            # fences the serialized transfer queue behind the pack arrays
-            _sync_fetch((user_has_obs, item_has_obs))
-            timings["device_put_s"] = _time.perf_counter() - t_phase
+        timings["pack_s"] = _time.perf_counter() - t_phase
+    t_phase = _time.perf_counter()
+
+    def put_pack(side: PackedSide):
+        return (
+            _place(mesh, side.seg_rows, seg_sharded2),
+            _place(mesh, side.cols, seg_sharded3),
+            _place(mesh, side.vals, seg_sharded3),
+            _place(mesh, side.rem, seg_sharded2),
+        )
+
+    user_pack = put_pack(user_side)
+    item_pack = put_pack(item_side)
+
+    user_lam_h, user_obs_h = _lam_obs_host(counts_u, n_users, X.shape[0], config)
+    item_lam_h, item_obs_h = _lam_obs_host(counts_i, n_items, Y.shape[0], config)
+    user_lam = _place(mesh, user_lam_h, row_sharded)
+    item_lam = _place(mesh, item_lam_h, row_sharded)
+    user_has_obs = _place(mesh, user_obs_h, row_sharded)
+    item_has_obs = _place(mesh, item_obs_h, row_sharded)
+    if timings is not None:
+        # the has_obs arrays were enqueued last; fetching them (small)
+        # fences the serialized transfer queue behind the pack arrays
+        _sync_fetch((user_has_obs, item_has_obs))
+        timings["device_put_s"] = _time.perf_counter() - t_phase
         timings["padded_slots"] = geo_u.total * L_u + geo_i.total * L_i
-    rep_sharding = NamedSharding(mesh, rep) if mesh is not None else None
+    return _train_packed(
+        user_pack, item_pack, X, Y,
+        user_lam, item_lam, user_has_obs, item_has_obs,
+        config=config, mesh=mesh, axis=axis,
+        n_users=n_users, n_items=n_items,
+        checkpoint_dir=checkpoint_dir, checkpoint_every=checkpoint_every,
+        timings=timings, profile_dir=profile_dir, fp_material=fp_material,
+    )
+
+
+def _train_packed(
+    user_pack,
+    item_pack,
+    X: jax.Array,
+    Y: jax.Array,
+    user_lam: jax.Array,
+    item_lam: jax.Array,
+    user_has_obs: jax.Array,
+    item_has_obs: jax.Array,
+    *,
+    config: ALSConfig,
+    mesh: Optional[Mesh],
+    axis: str,
+    n_users: int,
+    n_items: int,
+    checkpoint_dir: Optional[str],
+    checkpoint_every: int,
+    timings: Optional[dict],
+    profile_dir: Optional[str],
+    fp_material,  # Callable[[], bytes] — data identity for checkpoints
+    compile_wait=None,  # callable from start_compile_async, or None
+) -> ALSModelArrays:
+    """The shared training tail: compile warm-up, checkpoint/resume, the
+    fused iteration loop, and the factor fetch. Every entry path (COO,
+    host wire, streaming pipeline, mesh pack) converges here, so the
+    device program — and its timings contract — is identical for all."""
+    import time as _time
+
+    n_shards = mesh.shape[axis] if mesh is not None else 1
+    rep_sharding = NamedSharding(mesh, P()) if mesh is not None else None
+    row_sharded = P(axis) if mesh is not None else P()
     row_sharding = NamedSharding(mesh, row_sharded) if mesh is not None else None
 
     def run_iters(X, Y, n_iters: int):
@@ -1122,7 +1444,23 @@ def train_als(
             row_sharding=row_sharding,
         )
 
-    if timings is not None:
+    if compile_wait is not None:
+        # the executable was compiled on a background thread while
+        # scan/pack/transfer ran (start_compile_async); only the residual
+        # wait — usually zero — is exposed wall clock
+        t_phase = _time.perf_counter()
+        rec = compile_wait()
+        if timings is not None:
+            timings["compile_exposed_s"] = _time.perf_counter() - t_phase
+            if "busy_s" in rec:
+                timings["compile_s"] = rec["busy_s"]
+        if rec.get("error") and timings is not None:
+            # best-effort warm-up failed; compile inline so the loop
+            # timing stays clean
+            t_phase = _time.perf_counter()
+            _fence(run_iters(X + 0, Y + 0, 0))
+            timings["compile_s"] = _time.perf_counter() - t_phase
+    elif timings is not None:
         # compile outside the timed loop: a ZERO-iteration run builds the
         # same executable the real run reuses (dynamic trip count).
         # Donation consumes its inputs, so feed it copies of the factor
@@ -1147,9 +1485,7 @@ def train_als(
         # restarts cleanly instead of crashing resume on a shape mismatch
         fingerprint = np.frombuffer(
             hashlib.sha256(
-                user_idx.tobytes()
-                + item_idx.tobytes()
-                + np.asarray(ratings, np.float32).tobytes()
+                fp_material()
                 + repr(dataclasses.replace(config, iterations=0)).encode()
                 + f"{n_users},{n_items},{n_shards}".encode()
                 + f";rows={X.shape[0]},{Y.shape[0]}".encode()

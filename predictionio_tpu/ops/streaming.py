@@ -1,0 +1,501 @@
+"""Streaming store→device ALS training pipeline.
+
+Replaces the materialize-everything-then-train path for event-store
+training with a chunked pipeline in which the serial phase chain of the
+monolithic path — store scan, host pack, host→device transfer, XLA
+compile — overlaps:
+
+- the store scan (``data.store.PEventStore.stream_columns``) runs on a
+  background thread, pushing fixed-size columnar batches through a
+  BOUNDED queue;
+- each batch is folded into incremental pack state (dense per-side row
+  ids, per-row observation counts, a per-batch stable presort by user)
+  while the scan of the next batch is still running;
+- the moment the scan ends, bucket geometry is known and the iteration
+  executable starts compiling on its own thread
+  (``als.start_compile_async``), hiding XLA compile under the remaining
+  host work;
+- the presorted batches merge into the final :class:`als.HostWire` with
+  one vectorized counting-sort scatter (no global 20M-element argsort on
+  the critical path — the per-batch sorts already happened under the
+  scan);
+- the wire ships with chunked, double-buffered async ``device_put``:
+  transfer of chunk k+1 overlaps the device-side nibble unpack of chunk
+  k, and factor-state placement overlaps both.
+
+This is the shape of ALX's pre-bucketed TPU input pipeline
+(PAPERS.md — arXiv:2112.02194) and of the GPU MF literature's
+transfer/compute overlap (arXiv:1603.03820), applied to the event-store
+flagship flow. The wire produced here is byte-identical to the
+monolithic ``als.build_host_wire`` output for the same scan, so the
+device program — and the trained factors — match the monolithic path.
+
+A process-global **pack-artifact cache** keyed by the store's cheap
+state fingerprint (``LEvents.store_fingerprint``: event counts, max
+ids/times, tombstone populations) makes a repeat train over an
+unchanged store skip scan+pack entirely: the cached wire goes straight
+to device. The fingerprint is read BEFORE the scan starts, so an entry
+can only ever be labeled with a state at least as old as its data — a
+write racing the scan makes the next lookup miss, never hit stale. The
+producing DAO is held by weakref and compared by identity, so a
+different storage universe (or a GC'd-and-reused object address) can
+never satisfy a lookup.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import queue as _queue
+import threading
+import time
+import weakref
+from collections import OrderedDict
+from typing import Optional, Tuple
+
+import numpy as np
+
+from predictionio_tpu.data.bimap import BiMap
+from predictionio_tpu.ops import als as _als
+
+logger = logging.getLogger(__name__)
+
+
+# --- pack-artifact cache ---
+
+
+@dataclasses.dataclass
+class _PackEntry:
+    scope_ref: "weakref.ref"  # the producing events DAO, by identity
+    fingerprint: tuple  # store state the wire was packed from
+    wire: "_als.HostWire"
+    user_index: BiMap
+    item_index: BiMap
+
+
+_PACK_CACHE: "OrderedDict[tuple, _PackEntry]" = OrderedDict()
+_PACK_CACHE_LOCK = threading.Lock()
+# wires are ~50 MB at ML-20M scale; a small LRU covers the retrain and
+# warm-bench cases without growing with app count
+PACK_CACHE_MAX_ENTRIES = 4
+
+
+def pack_cache_clear() -> None:
+    with _PACK_CACHE_LOCK:
+        _PACK_CACHE.clear()
+
+
+def _cache_key(stream, config) -> Optional[tuple]:
+    # the wire depends on config only through its pack geometry knobs
+    if (
+        stream.cache_key is None
+        or stream.cache_scope is None
+        or stream.fingerprint is None
+    ):
+        return None
+    return (stream.cache_key, config.segment_length, config.chunk_slots)
+
+
+def _cache_get(stream, config) -> Optional[_PackEntry]:
+    key = _cache_key(stream, config)
+    if key is None:
+        return None
+    with _PACK_CACHE_LOCK:
+        entry = _PACK_CACHE.get(key)
+        if entry is None:
+            return None
+        # identity, not id(): the weakref keeps a dead DAO's entry from
+        # ever matching a new object that reused its address
+        if (
+            entry.scope_ref() is not stream.cache_scope
+            or entry.fingerprint != stream.fingerprint
+        ):
+            return None
+        _PACK_CACHE.move_to_end(key)
+        return entry
+
+
+def _cache_put(stream, config, wire, user_index, item_index) -> None:
+    key = _cache_key(stream, config)
+    if key is None:
+        return
+    try:
+        ref = weakref.ref(stream.cache_scope)
+    except TypeError:  # unweakrefable DAO: no caching
+        return
+    with _PACK_CACHE_LOCK:
+        _PACK_CACHE[key] = _PackEntry(
+            ref, stream.fingerprint, wire, user_index, item_index
+        )
+        _PACK_CACHE.move_to_end(key)
+        while len(_PACK_CACHE) > PACK_CACHE_MAX_ENTRIES:
+            _PACK_CACHE.popitem(last=False)
+
+
+# --- incremental pack state ---
+
+
+class _SideCodes:
+    """Dense per-side row ids over the stream's SHARED code space.
+
+    The stream's batches carry codes from one table-global dictionary
+    (users and items mixed); each solve side needs its own dense 0..n-1
+    id space. Dense ids are assigned in first-appearance order as
+    batches fold in, and the shared code of each dense id is kept so the
+    stream's post-scan ``names`` array resolves dense ids to id strings.
+    """
+
+    def __init__(self):
+        self._dense_of = np.full(1024, -1, np.int64)
+        self._code_chunks = []
+        self.n = 0
+
+    def fold(self, codes: np.ndarray) -> np.ndarray:
+        codes = np.asarray(codes)
+        if not len(codes):
+            return np.empty(0, np.int32)
+        hi = int(codes.max()) + 1
+        if hi > len(self._dense_of):
+            grown = np.full(max(hi, 2 * len(self._dense_of)), -1, np.int64)
+            grown[: len(self._dense_of)] = self._dense_of
+            self._dense_of = grown
+        dense = self._dense_of[codes]
+        miss = dense < 0
+        if miss.any():
+            new_codes = codes[miss]
+            uniq, first = np.unique(new_codes, return_index=True)
+            uniq = uniq[np.argsort(first, kind="stable")]  # appearance order
+            self._dense_of[uniq] = np.arange(
+                self.n, self.n + len(uniq), dtype=np.int64
+            )
+            self._code_chunks.append(uniq)
+            self.n += len(uniq)
+            dense = self._dense_of[codes]
+        return dense.astype(np.int32)
+
+    def codes(self) -> np.ndarray:
+        """Shared code of each dense id (dense-id order)."""
+        if not self._code_chunks:
+            return np.empty(0, np.int64)
+        return np.concatenate(self._code_chunks)
+
+
+def _grow_add(acc: np.ndarray, add: np.ndarray) -> np.ndarray:
+    if len(add) > len(acc):
+        grown = np.zeros(len(add), np.int64)
+        grown[: len(acc)] = acc
+        acc = grown
+    acc[: len(add)] += add
+    return acc
+
+
+def _scan_worker(stream, q: "_queue.Queue", box: dict) -> None:
+    """Drive the store scan, pushing batches through the bounded queue.
+    Runs the generator ON THIS THREAD (the sqlite backend reads through
+    per-thread WAL snapshot connections, so the scan never contends with
+    the consumer); resolves ``stream.names`` here too, since it is only
+    valid after exhaustion."""
+    busy = 0.0
+    try:
+        it = iter(stream)
+        while True:
+            t0 = time.perf_counter()
+            try:
+                batch = next(it)
+            except StopIteration:
+                break
+            busy += time.perf_counter() - t0
+            q.put(batch)
+        t0 = time.perf_counter()
+        box["names"] = stream.names
+        busy += time.perf_counter() - t0
+    except BaseException as e:
+        box["error"] = e
+    finally:
+        box["scan_s"] = busy
+        box["done_at"] = time.perf_counter()
+        q.put(None)
+
+
+def _scan_and_pack(stream, config, timings: dict, queue_batches: int):
+    """Consume a ColumnarStream into a HostWire + id indexes, folding
+    each batch while the scan of the next runs on the producer thread.
+
+    Returns ``(wire, user_index, item_index, compile_wait)`` or None for
+    an empty scan (callers fall back to the materialized path, whose
+    sanity check owns the user-facing error)."""
+    q: "_queue.Queue" = _queue.Queue(maxsize=max(1, queue_batches))
+    box: dict = {}
+    th = threading.Thread(
+        target=_scan_worker, args=(stream, q, box),
+        daemon=True, name="als-stream-scan",
+    )
+    th.start()
+
+    uspace, ispace = _SideCodes(), _SideCodes()
+    counts_u = np.zeros(0, np.int64)
+    counts_i = np.zeros(0, np.int64)
+    batches = []
+    n = 0
+    fold_busy = 0.0
+    while True:
+        batch = q.get()
+        if batch is None:
+            break
+        e_codes, t_codes, values = batch
+        t0 = time.perf_counter()
+        u = uspace.fold(e_codes)
+        i = ispace.fold(t_codes)
+        # stable presort by user NOW, under the scan of the next batch;
+        # the merge below then only scatters — no global argsort on the
+        # exposed critical path
+        order = np.argsort(u, kind="stable")
+        u, i = u[order], i[order]
+        v = np.asarray(values, np.float32)[order]
+        counts_u = _grow_add(counts_u, np.bincount(u, minlength=uspace.n))
+        counts_i = _grow_add(counts_i, np.bincount(i, minlength=ispace.n))
+        batches.append((u, i, v))
+        n += len(v)
+        fold_busy += time.perf_counter() - t0
+    th.join()
+    if "error" in box:
+        raise box["error"]
+    timings["scan_s"] = box.get("scan_s", 0.0)
+    timings["fold_s"] = fold_busy
+    if n == 0:
+        return None
+    t_scan_done = box["done_at"]
+
+    # Final dense ids relabel the provisional (first-appearance) ids
+    # into SORTED-NAME order — the order every monolithic scan
+    # (presence-bitmap page remap, np.unique concat, BiMap.string_int)
+    # assigns — so the wire below is byte-identical to the monolithic
+    # packer's and the trained factors match it exactly, not just up to
+    # a row permutation. The relabeling is catalog-sized, not
+    # event-sized.
+    names = box["names"]
+    u_names = names[uspace.codes()]
+    i_names = names[ispace.codes()]
+    n_users, n_items = uspace.n, ispace.n
+    perm_u = np.argsort(u_names)
+    perm_i = np.argsort(i_names)
+    remap_u = np.empty(n_users, np.int32)
+    remap_u[perm_u] = np.arange(n_users, dtype=np.int32)
+    remap_i = np.empty(n_items, np.int32)
+    remap_i[perm_i] = np.arange(n_items, dtype=np.int32)
+    counts_u32 = np.zeros(n_users, np.int64)
+    counts_u32[: len(counts_u)] = counts_u
+    counts_u32 = counts_u32[perm_u].astype(np.int32)
+    counts_i32 = np.zeros(n_items, np.int64)
+    counts_i32[: len(counts_i)] = counts_i
+    counts_i32 = counts_i32[perm_i].astype(np.int32)
+    L_u = _als.auto_segment_length(
+        None, n_users, config.segment_length, counts=counts_u32
+    )
+    L_i = _als.auto_segment_length(
+        None, n_items, config.segment_length, counts=counts_i32
+    )
+    geo_u = _als._segment_geometry(
+        counts_u32, n_users, L_u, 1, config.chunk_slots
+    )
+    geo_i = _als._segment_geometry(
+        counts_i32, n_items, L_i, 1, config.chunk_slots
+    )
+    # geometry known: compile starts NOW, under merge+narrow+transfer
+    compile_wait = _als.start_compile_async(
+        n_users, n_items, geo_u, geo_i, L_u, L_i, config
+    )
+
+    # Counting-sort merge. Each batch is presorted by PROVISIONAL user
+    # id; relabeling is injective, so equal-user runs stay contiguous
+    # and the within-batch occurrence rank computed from the provisional
+    # grouping is also the rank under final ids. Scattering batch b's
+    # run of user u right after the runs batches 0..b-1 wrote
+    # reproduces EXACTLY the stable global argsort of the monolithic
+    # packer: per user, batches in scan order, original order within.
+    pad = (_als._bucket_count(n) - n) if n else 1
+    iw = np.full(n + pad, n_items, np.int32)  # padding -> sentinel id
+    vw = np.zeros(n + pad, np.float32)
+    cursor = geo_u.starts[:-1].copy()  # [n_users] int64 write heads
+    for u, i, v in batches:
+        m = len(u)
+        if not m:
+            continue
+        idx = np.arange(m, dtype=np.int64)
+        newgrp = np.empty(m, bool)
+        newgrp[0] = True
+        np.not_equal(u[1:], u[:-1], out=newgrp[1:])
+        first = np.maximum.accumulate(np.where(newgrp, idx, 0))
+        u_f = remap_u[u]
+        pos = cursor[u_f] + (idx - first)
+        iw[pos] = remap_i[i]
+        vw[pos] = v
+        cursor += np.bincount(u_f, minlength=n_users)
+    batches.clear()
+
+    wire = _als.finish_wire(
+        iw, vw, n_users, n_items, L_u, L_i, geo_u, geo_i,
+        counts_u32, counts_i32,
+    )
+    user_index = BiMap(
+        {str(nm): j for j, nm in enumerate(u_names[perm_u])}
+    )
+    item_index = BiMap(
+        {str(nm): j for j, nm in enumerate(i_names[perm_i])}
+    )
+    now = time.perf_counter()
+    # exposed = the tail the scan could not hide: late folds + geometry
+    # + merge + narrow/nibble + index build
+    timings["pack_exposed_s"] = max(0.0, now - t_scan_done)
+    timings["pack_s"] = fold_busy + timings["pack_exposed_s"]
+    return wire, user_index, item_index, compile_wait
+
+
+# --- transfer ---
+
+
+def _ship_wire(wire: "_als.HostWire", n_chunks: int = 2) -> tuple:
+    """Double-buffered wire transfer: the COO planes split into chunks
+    whose async ``device_put``s pipeline, and each value chunk's
+    device-side nibble unpack dispatches as soon as its bytes are
+    enqueued — so transfer of chunk k+1 overlaps unpack of chunk k.
+    Returns the ``(i_dev, v_dev, aux_dev)`` pre-shipped wire
+    ``als.device_pack_from_wire`` consumes."""
+    import jax
+    import jax.numpy as jnp
+
+    def parts(a: np.ndarray):
+        if n_chunks <= 1 or len(a) < 2 * n_chunks:
+            return [a]
+        step = -(-len(a) // n_chunks)
+        step += step % 2  # even boundary: value pairs stay byte-aligned
+        return [a[s : s + step] for s in range(0, len(a), step)]
+
+    dev_i = [jax.device_put(p) for p in parts(wire.iw)]
+    dev_v = []
+    for p in parts(wire.vw):
+        d = jax.device_put(p)
+        dev_v.append(_als._unpack_nibbles(d) if wire.nibble else d)
+    i_dev = dev_i[0] if len(dev_i) == 1 else jnp.concatenate(dev_i)
+    v_dev = dev_v[0] if len(dev_v) == 1 else jnp.concatenate(dev_v)
+    aux_dev = jax.device_put(wire.aux)  # enqueued last: fences the queue
+    return i_dev, v_dev, aux_dev
+
+
+# --- the pipeline entry ---
+
+
+@dataclasses.dataclass
+class StreamTrainResult:
+    arrays: "_als.ALSModelArrays"
+    user_index: BiMap
+    item_index: BiMap
+    timings: dict
+
+
+def _attribute_phases(timer, timings: dict) -> None:
+    """Record the pipeline's sub-phases on the workflow PhaseTimer,
+    marking the ones that ran UNDER another phase as overlapped so the
+    run summary's wall-clock accounting stays honest."""
+    add = getattr(timer, "add", None)
+    if add is None:
+        return
+    for name, key, overlapped in (
+        ("stream:scan", "scan_s", True),
+        ("stream:fold", "fold_s", True),
+        ("stream:pack-exposed", "pack_exposed_s", False),
+        ("stream:device-put-exposed", "device_put_exposed_s", False),
+        ("stream:compile", "compile_s", True),
+        ("stream:compile-exposed", "compile_exposed_s", False),
+        ("stream:device-loop", "device_loop_s", False),
+    ):
+        if timings.get(key):
+            add(name, timings[key], overlapped=overlapped)
+
+
+def train_als_streaming(
+    stream,
+    config: "_als.ALSConfig",
+    *,
+    timings: Optional[dict] = None,
+    timer=None,
+    checkpoint_dir: Optional[str] = None,
+    checkpoint_every: int = 5,
+    profile_dir: Optional[str] = None,
+    queue_batches: int = 4,
+    ship_chunks: int = 2,
+    cache: bool = True,
+) -> Optional[StreamTrainResult]:
+    """Train ALS from a ``ColumnarStream`` through the overlapped
+    pipeline (module docstring). Returns None when ``stream`` is None or
+    the scan is empty — callers fall back to the materialized
+    ``train_als`` path and its error reporting.
+
+    ``timings`` gains the pipeline's phase split: ``scan_s``/``fold_s``/
+    ``compile_s`` (busy, overlapped), ``pack_exposed_s``/
+    ``device_put_exposed_s``/``compile_exposed_s`` (critical-path wall),
+    ``pack_cache`` ("hit"/"miss"/"off"), plus the usual
+    ``device_loop_s``/``padded_slots``/``wire_mb`` from the shared
+    training tail.
+    """
+    if stream is None:
+        return None
+    timings = {} if timings is None else timings
+    t_start = time.perf_counter()
+
+    entry = _cache_get(stream, config) if cache else None
+    if entry is not None:
+        timings["pack_cache"] = "hit"
+        timings["scan_s"] = timings["fold_s"] = 0.0
+        timings["pack_exposed_s"] = 0.0
+        wire = entry.wire
+        user_index, item_index = entry.user_index, entry.item_index
+        compile_wait = _als.start_compile_async(
+            wire.n_users, wire.n_items, wire.geo_u, wire.geo_i,
+            wire.L_u, wire.L_i, config,
+        )
+        logger.info(
+            "streaming ALS: pack cache HIT (%d users, %d items, %.1f MB "
+            "wire) — skipping scan+pack", wire.n_users, wire.n_items,
+            wire.wire_mb,
+        )
+    else:
+        timings["pack_cache"] = "miss" if cache else "off"
+        packed = _scan_and_pack(stream, config, timings, queue_batches)
+        if packed is None:
+            return None
+        wire, user_index, item_index, compile_wait = packed
+        if cache:
+            _cache_put(stream, config, wire, user_index, item_index)
+
+    # ship (async) first, then factor-state init: the RNG + small
+    # factor/regularizer puts run while the wire chunks are in flight
+    device_wire = _ship_wire(wire, n_chunks=ship_chunks)
+    factor_state = _als.init_factor_state_single(
+        wire.counts_u, wire.counts_i, wire.n_users, wire.n_items, config
+    )
+    t0 = time.perf_counter()
+    # aux was enqueued last: fetching it (small) fences the serialized
+    # transfer queue behind the COO chunks; the 1-element fence then
+    # waits out the concat/unpack tail
+    _als._sync_fetch(device_wire[2])
+    _als._fence((device_wire[0], device_wire[1]))
+    timings["device_put_exposed_s"] = time.perf_counter() - t0
+
+    arrays = _als.train_from_wire(
+        wire, config,
+        device_wire=device_wire,
+        timings=timings,
+        checkpoint_dir=checkpoint_dir,
+        checkpoint_every=checkpoint_every,
+        profile_dir=profile_dir,
+        compile_wait=compile_wait,
+        factor_state=factor_state,
+    )
+    timings["stream_wall_s"] = time.perf_counter() - t_start
+    if timer is not None:
+        _attribute_phases(timer, timings)
+    return StreamTrainResult(
+        arrays=arrays, user_index=user_index, item_index=item_index,
+        timings=timings,
+    )

@@ -297,7 +297,10 @@ def cmd_eventserver(args) -> int:
             cmd.append("--stats")
         procs = [subprocess.Popen(cmd) for _ in range(workers)]
 
+        shutdown = {"requested": False}
+
         def forward(signum, frame):
+            shutdown["requested"] = True
             for p in procs:
                 p.terminate()
 
@@ -332,7 +335,13 @@ def cmd_eventserver(args) -> int:
         )
         rc = 0
         for p in procs:
-            rc = p.wait() or rc
+            code = p.wait()
+            if shutdown["requested"] and code < 0:
+                # worker killed by the signal we forwarded: a clean
+                # operator Ctrl-C / SIGTERM stop is success, not the
+                # worker's -SIGTERM returncode bubbling up as failure
+                code = 0
+            rc = code or rc
         return rc
 
     server = create_event_server(

@@ -119,9 +119,19 @@ class CommandClient:
         keys = self.storage.get_meta_data_access_keys()
         for k in keys.get_by_app_id(app.id):
             keys.delete(k.key)
+            self._revoke_cached_key(k.key)
         if not self.storage.get_meta_data_apps().delete(app.id):
             raise CommandError(f"Error deleting app {name}.")
         logger.info("deleted app %s", name)
+
+    @staticmethod
+    def _revoke_cached_key(key: str) -> None:
+        """Invalidate every in-process event server's auth cache so a
+        just-deleted key stops authenticating immediately instead of at
+        the cache TTL (lazy import: the api layer is optional here)."""
+        from predictionio_tpu.api.event_server import invalidate_access_key
+
+        invalidate_access_key(key)
 
     def app_data_delete(
         self, name: str, channel: Optional[str] = None, all_channels: bool = False
@@ -205,6 +215,7 @@ class CommandClient:
     def access_key_delete(self, key: str) -> None:
         if not self.storage.get_meta_data_access_keys().delete(key):
             raise CommandError(f"Error deleting access key {key}.")
+        self._revoke_cached_key(key)
 
     # --- helpers ---
 

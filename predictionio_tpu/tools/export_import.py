@@ -297,15 +297,11 @@ def _columnar_import_qualify(table):
                 pc.cast(pc.is_null(props_col), pa.int64())
             ).as_py()
         ):
-            # O(1) consistency probe at first/middle/last rows: each
-            # sampled properties bag must be exactly {key: value} — a
-            # file whose bags were edited after export (or an
+            # Consistency probes against the authoritative properties
+            # JSON: a file whose bags were edited after export (or an
             # inconsistent foreign writer) falls through to the
             # fully-validating regex path / generic reader instead of
-            # silently importing sidecar-only data. (A bag altered ONLY
-            # at unsampled rows still slips through — full validation is
-            # exactly the 20M-string reparse this path exists to skip;
-            # the sidecar is documented as the writer's attestation.)
+            # silently importing divergent sidecar values.
             def bag_matches(j: int) -> bool:
                 try:
                     parsed = json.loads(props_col[j].as_py())
@@ -322,11 +318,72 @@ def _columnar_import_qualify(table):
                 v = np.float32(pv[j].as_py())
                 return bool(p == v) or bool(np.isnan(p) and np.isnan(v))
 
-            if all(bag_matches(j) for j in {0, n // 2, n - 1}):
-                prop_key = key
-                values = pv.to_numpy(zero_copy_only=False).astype(
-                    np.float32
+            def sidecar_sample_agrees(pv_np: "np.ndarray") -> bool:
+                # Vectorized sample validation (ADVICE.md): regex-parse
+                # a bounded strided SAMPLE of the properties JSON —
+                # always including the rows holding the sidecar's min
+                # and max, so the cheap aggregates (non-null count was
+                # checked above; extrema here; elementwise equality
+                # implies the sample sums agree) cannot diverge
+                # unnoticed. A bag altered ONLY at unsampled interior
+                # rows still slips through — full validation is exactly
+                # the 20M-string reparse this path exists to skip — but
+                # bulk edits and shifted/scaled value columns now fail
+                # qualification at ~4k parses per row group.
+                idx = np.linspace(
+                    0, n - 1, num=min(n, 4096), dtype=np.int64
                 )
+                finite = np.isfinite(pv_np)
+                if finite.any():
+                    extremes = np.array(
+                        [
+                            int(np.nanargmin(np.where(finite, pv_np, np.nan))),
+                            int(np.nanargmax(np.where(finite, pv_np, np.nan))),
+                        ],
+                        dtype=np.int64,
+                    )
+                    idx = np.concatenate([idx, extremes])
+                idx = np.unique(idx)
+                pattern = (
+                    '^\\{"'
+                    + _re.escape(key)
+                    + '": (?P<v>-?[0-9]+(?:\\.[0-9]+)?'
+                    + "(?:[eE][-+]?[0-9]+)?)\\}$"
+                )
+                sampled = props_col.take(pa.array(idx))
+                extracted = pc.extract_regex(sampled, pattern)
+                nulls = pc.is_null(extracted).to_numpy(
+                    zero_copy_only=False
+                )
+                if nulls.any():
+                    # the numeric regex can't express NaN/±Infinity
+                    # (json.dumps renders the bare tokens); those few
+                    # rows fall back to the exact json parse instead of
+                    # disqualifying a legitimate export
+                    if not all(
+                        bag_matches(int(j)) for j in idx[nulls]
+                    ):
+                        return False
+                parsed = np.asarray(
+                    pc.fill_null(
+                        pc.struct_field(extracted, "v"), "0"
+                    ).to_numpy(zero_copy_only=False),
+                    dtype="U32",
+                ).astype(np.float32)
+                sample = pv_np[idx]
+                ok = (
+                    (parsed == sample)
+                    | (np.isnan(parsed) & np.isnan(sample))
+                    | nulls  # already validated row-exactly above
+                )
+                return bool(ok.all())
+
+            pv_np = pv.to_numpy(zero_copy_only=False).astype(np.float32)
+            if all(
+                bag_matches(j) for j in {0, n // 2, n - 1}
+            ) and sidecar_sample_agrees(pv_np):
+                prop_key = key
+                values = pv_np
 
     if values is None:
         # property bags: all exactly {"<key>": <number>} sharing one key.

@@ -28,6 +28,11 @@ class PhaseRecord:
     seconds: float
     depth: int
     start: float  # perf_counter at phase entry — orders the summary
+    # True for phases that ran CONCURRENTLY under another recorded phase
+    # (the streaming pipeline's scan/fold/compile run under the train
+    # phase's wall clock): excluded from wall-clock totals so the
+    # summary's arithmetic stays honest.
+    overlapped: bool = False
 
 
 class PhaseTimer:
@@ -51,19 +56,46 @@ class PhaseTimer:
             )
             logger.info("phase %s: %.3fs", name, elapsed)
 
+    def add(
+        self, name: str, seconds: float, overlapped: bool = False
+    ) -> None:
+        """Record an externally-measured phase. ``overlapped=True``
+        marks busy time that was hidden under another phase (pipelined
+        work) rather than serial wall clock."""
+        self.records.append(
+            PhaseRecord(
+                name, seconds, self._depth + 1, time.perf_counter(),
+                overlapped=overlapped,
+            )
+        )
+
     def totals(self) -> Dict[str, float]:
         out: Dict[str, float] = {}
         for r in self.records:
             out[r.name] = out.get(r.name, 0.0) + r.seconds
         return out
 
+    def overlapped_total(self) -> float:
+        """Busy seconds that were hidden under other phases — the work
+        the pipeline took OFF the wall clock."""
+        return sum(r.seconds for r in self.records if r.overlapped)
+
     def summary(self) -> str:
         # chronological, parents before their children (same start order,
         # shallower first)
         ordered = sorted(self.records, key=lambda r: (r.start, r.depth))
-        return "\n".join(
-            f"{'  ' * r.depth}{r.name}: {r.seconds:.3f}s" for r in ordered
-        )
+        lines = [
+            f"{'  ' * r.depth}{r.name}: {r.seconds:.3f}s"
+            + (" [overlapped]" if r.overlapped else "")
+            for r in ordered
+        ]
+        hidden = self.overlapped_total()
+        if hidden:
+            lines.append(
+                f"(pipelining hid {hidden:.3f}s of host/compile work "
+                "under the phases above)"
+            )
+        return "\n".join(lines)
 
 
 @contextlib.contextmanager

@@ -148,6 +148,17 @@ class CoreWorkflow:
                 models = engine.train(ctx, engine_params, workflow_params)
             if ctx.timer.records:
                 logger.info("training phases:\n%s", ctx.timer.summary())
+                hidden = ctx.timer.overlapped_total()
+                if hidden:
+                    # overlapped records are pipeline busy time hidden
+                    # UNDER the read/train walls above (streaming
+                    # store→device path) — report what pipelining saved
+                    # rather than double-counting it into the total
+                    logger.info(
+                        "streaming pipeline hid %.3fs of scan/pack/"
+                        "compile work under the train wall clock",
+                        hidden,
+                    )
             if workflow_params.save_model:
                 serializable = (
                     engine.make_serializable_models(

@@ -127,6 +127,44 @@ class TestMarkovChain:
         assert nxt[1] == pytest.approx(0.375)
         assert nxt[2] == pytest.approx(0.125)
 
+    def test_device_cache_keys_mesh_by_identity(self):
+        """The placed-transitions cache holds the mesh by weakref and
+        compares identity: a dead mesh's cache entry must NOT satisfy a
+        lookup (an id(mesh) key could collide after address reuse), and
+        mesh=None must not hit a stale mesh entry."""
+        import gc
+        import weakref
+
+        import jax
+
+        from predictionio_tpu.parallel.mesh import default_mesh
+
+        model = MarkovChain.train(self.ENTRIES, n_states=3, top_n=2)
+        mesh = default_mesh(devices=jax.devices()[:2])
+        expected = model.predict([1.0, 0.0, 0.0])
+        assert model.predict([1.0, 0.0, 0.0], mesh=mesh) == expected
+        placed_for_mesh = model._placed
+        assert isinstance(placed_for_mesh[0], weakref.ref)
+        # mesh=None after a mesh predict: distinct entry, correct result
+        assert model.predict([1.0, 0.0, 0.0]) == expected
+        assert model._placed[0] is None
+        # simulate the GC'd-mesh case (jax's own caches keep a real mesh
+        # alive, so fake the dead ref): the dead entry must satisfy
+        # NEITHER a mesh=None lookup NOR a different live mesh's
+        class _Gone:
+            pass
+
+        dead = weakref.ref(_Gone())
+        gc.collect()
+        assert dead() is None
+        model._placed = (dead,) + placed_for_mesh[1:]
+        assert model.predict([1.0, 0.0, 0.0]) == expected
+        assert model._placed[0] is None  # re-placed, not stale-served
+        mesh2 = default_mesh(devices=jax.devices()[:2])
+        model._placed = (dead,) + placed_for_mesh[1:]
+        assert model.predict([1.0, 0.0, 0.0], mesh=mesh2) == expected
+        assert model._placed[0]() is mesh2
+
 
 class TestPropertiesToBinary:
     MAPS = [

@@ -78,6 +78,48 @@ class TestAuth:
         assert status == 201
 
 
+class TestAuthCache:
+    def test_ttl_zero_disables_caching(self, mem_storage):
+        """auth_ttl_s=0: every request reads the metadata store, so a
+        cross-process revocation is visible immediately (the
+        reference's per-request behavior)."""
+        apps = mem_storage.get_meta_data_apps()
+        app_id = apps.insert(App(id=0, name="t"))
+        keys = mem_storage.get_meta_data_access_keys()
+        keys.insert(AccessKey(key="k0", appid=app_id, events=()))
+        mem_storage.get_l_events().init(app_id)
+        api = EventAPI(
+            storage=mem_storage, config=EventServerConfig(auth_ttl_s=0)
+        )
+        assert post_event(api, EVENT, accessKey="k0")[0] == 201
+        keys.delete("k0")  # store-level delete, NO cache invalidation
+        assert post_event(api, EVENT, accessKey="k0")[0] == 401
+
+    def test_same_process_delete_invalidates_cache(self, mem_storage):
+        """The admin delete path drops the key from every live
+        EventAPI's cache — revocation is immediate, not at TTL expiry."""
+        from predictionio_tpu.tools.commands import CommandClient
+
+        client = CommandClient(mem_storage)
+        d = client.app_new("authapp")
+        key = d.access_keys[0].key
+        api = EventAPI(storage=mem_storage)  # default 5 s TTL
+        assert post_event(api, EVENT, accessKey=key)[0] == 201  # cached
+        client.access_key_delete(key)
+        assert post_event(api, EVENT, accessKey=key)[0] == 401
+
+    def test_app_delete_invalidates_cache(self, mem_storage):
+        from predictionio_tpu.tools.commands import CommandClient
+
+        client = CommandClient(mem_storage)
+        d = client.app_new("authapp2")
+        key = d.access_keys[0].key
+        api = EventAPI(storage=mem_storage)
+        assert post_event(api, EVENT, accessKey=key)[0] == 201
+        client.app_delete("authapp2")
+        assert post_event(api, EVENT, accessKey=key)[0] == 401
+
+
 class TestEventCrud:
     def test_post_returns_201_with_event_id(self, api):
         status, body = post_event(api, EVENT)
