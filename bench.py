@@ -23,6 +23,11 @@ Prints ONE JSON line per config, headline first:
      rest_p50_ms/p99/qps  end-to-end POST /queries.json through the
                           EngineServer micro-batching executor under 32
                           concurrent clients (includes the relay fetch)
+     predict_inproc_p50_ms/p99/qps  the same serving core measured
+                          IN-PROCESS against QueryAPI.handle — no
+                          sockets, no HTTP parse — the direct serving
+                          latency that the RTT-subtraction estimate
+                          above only approximates
 2. nb_classification_train_wall_clock — NaiveBayes over user properties.
 3. similarproduct_train_wall_clock — implicit ALS + cosine top-N.
 4. ecommerce_train_wall_clock — explicit ALS + predict-time rules.
@@ -40,7 +45,13 @@ Prints ONE JSON line per config, headline first:
    critical-path remainders, and rmse_vs_mllib checks BOTH cache paths
    against the float64 oracle on a parity sub-app.
 8. eventserver_ingest_events_per_sec — Event Server write-path
-   throughput under concurrent clients.
+   throughput under concurrent clients. The headline posts batches
+   through the reference-parity /batch/events.json route (each request
+   one group-commit unit, <= 50 events); single-event POST /events.json
+   throughput rides along as single_event_events_per_sec. The
+   concurrent_ingest config runs the same harness against a
+   hash-SHARDED sqlite store (SHARDS=4, per-shard group committers)
+   with a training scan looping in flight.
 
 vs_baseline divides a conservative Spark-1.3-local wall-clock estimate for
 the same config by the measured time (the reference publishes no numbers,
@@ -169,8 +180,12 @@ _SUMMARY_FIELDS = {
     "als_ml100k_train_wall_clock": (
         "value", "rmse_vs_mllib", "predict_p50_ms", "relay_rtt_p50_ms",
         "predict_p50_ms_minus_rtt", "predict_device_compute_ms",
-        "rest_p50_ms", "rest_qps",
+        "predict_inproc_p50_ms", "rest_p50_ms", "rest_qps",
     ),
+    "eventserver_ingest_events_per_sec": (
+        "value", "single_event_events_per_sec",
+    ),
+    "concurrent_ingest_events_per_sec": ("value", "shards"),
     "als_ml20m_train_wall_clock": (
         "value", "device_loop_s", "loop_vs_roofline", "device_put_s",
         "wire_mb",
@@ -180,8 +195,6 @@ _SUMMARY_FIELDS = {
         "train_device_put_exposed_s", "pack_cache_warm", "warm_train_s",
         "rmse_vs_mllib",
     ),
-    "eventserver_ingest_events_per_sec": ("value",),
-    "concurrent_ingest_events_per_sec": ("value",),
 }
 
 
@@ -415,12 +428,33 @@ def bench_rest_serving(u, i, r, pipeline_depth=4, clients=32, n_requests=12):
             for chunk in pool.map(client, range(clients)):
                 lat.extend(chunk)
         wall = time.perf_counter() - t0
+
+        # In-process serving latency: the SAME request core
+        # (QueryAPI.handle — auth-free query route, micro-batching
+        # executor, device dispatch, JSON render) with no socket, no
+        # HTTP parse, no network relay in the measurement. This is the
+        # direct replacement for the fragile predict_p50_ms_minus_rtt
+        # subtraction: what serving costs beyond transport, measured
+        # instead of inferred.
+        def inproc_one(uid):
+            body = json.dumps({"user": f"u{uid}", "num": 10}).encode()
+            t0 = time.perf_counter()
+            status, _, _ = server.api.handle("POST", "/queries.json", {}, body)
+            assert status == 200, status
+            return (time.perf_counter() - t0) * 1000
+
+        for j in range(5):  # warm
+            inproc_one(j)
+        inproc = [inproc_one((j * 31) % N_USERS) for j in range(200)]
         return {
             "rest_p50_ms": round(pctl(lat, 50), 2),
             "rest_p99_ms": round(pctl(lat, 99), 2),
             "rest_qps": round(len(lat) / wall, 1),
             "rest_clients": clients,
             "rest_pipeline_depth": pipeline_depth,
+            "predict_inproc_p50_ms": round(pctl(inproc, 50), 2),
+            "predict_inproc_p99_ms": round(pctl(inproc, 99), 2),
+            "predict_inproc_qps": round(1000.0 / max(pctl(inproc, 50), 1e-6), 1),
         }
     finally:
         server.shutdown()
@@ -976,53 +1010,80 @@ def bench_ml20m_store(device_name):
 # --- config 7: Event Server ingestion throughput ---
 
 
-def _run_ingest_clients(port: int, n_clients: int, n_per_client: int):
+def _run_ingest_clients(
+    port: int, n_clients: int, n_per_client: int, batch_size: int = 1
+):
     """Shared POST-client harness for the ingestion configs: warm one
     client, then fan out ``n_clients`` concurrent clients posting
-    ``n_per_client`` events each. Returns (latencies_ms, wall_s). Kept in
-    one place so the scan-free and scan-in-flight configs can never drift
-    into measuring different protocols."""
+    ``n_per_client`` EVENTS each. ``batch_size`` 1 posts per-event
+    ``/events.json``; > 1 (<= 50) posts ``/batch/events.json`` groups —
+    each request one group-commit unit. Returns
+    (request_latencies_ms, n_events, wall_s). Kept in one place so the
+    scan-free and scan-in-flight configs can never drift into measuring
+    different protocols."""
     import http.client
+
+    assert 1 <= batch_size <= 50
+
+    def event_json(worker, j):
+        return {
+            "event": "rate",
+            "entityType": "user",
+            "entityId": f"u{worker}-{j}",
+            "targetEntityType": "item",
+            "targetEntityId": f"i{j % 97}",
+            "properties": {"rating": float(j % 5 + 1)},
+        }
 
     def client(worker):
         conn = http.client.HTTPConnection("localhost", port)
         lat = []
+        sent = 0
         try:
-            for j in range(n_per_client):
-                body = json.dumps(
-                    {
-                        "event": "rate",
-                        "entityType": "user",
-                        "entityId": f"u{worker}-{j}",
-                        "targetEntityType": "item",
-                        "targetEntityId": f"i{j % 97}",
-                        "properties": {"rating": float(j % 5 + 1)},
-                    }
-                )
+            for s in range(0, n_per_client, batch_size):
+                group = [
+                    event_json(worker, j)
+                    for j in range(s, min(s + batch_size, n_per_client))
+                ]
+                if batch_size == 1:
+                    path, body = (
+                        "/events.json?accessKey=benchkey",
+                        json.dumps(group[0]),
+                    )
+                else:
+                    path, body = (
+                        "/batch/events.json?accessKey=benchkey",
+                        json.dumps(group),
+                    )
                 t0 = time.perf_counter()
                 conn.request(
-                    "POST",
-                    "/events.json?accessKey=benchkey",
-                    body,
-                    {"Content-Type": "application/json"},
+                    "POST", path, body, {"Content-Type": "application/json"}
                 )
                 resp = conn.getresponse()
-                resp.read()
-                assert resp.status == 201, resp.status
+                data = resp.read()
+                if batch_size == 1:
+                    assert resp.status == 201, resp.status
+                else:
+                    assert resp.status == 200, resp.status
+                    statuses = [r["status"] for r in json.loads(data)]
+                    assert statuses == [201] * len(group), statuses
                 lat.append((time.perf_counter() - t0) * 1000)
+                sent += len(group)
         finally:
             conn.close()
-        return lat
+        return lat, sent
 
     client(999)  # warm (threads, code paths)
     lat = []
+    n_events = 0
     t0 = time.perf_counter()
     with concurrent.futures.ThreadPoolExecutor(
         max_workers=n_clients
     ) as pool:
-        for chunk in pool.map(client, range(n_clients)):
+        for chunk, sent in pool.map(client, range(n_clients)):
             lat.extend(chunk)
-    return lat, time.perf_counter() - t0
+            n_events += sent
+    return lat, n_events, time.perf_counter() - t0
 
 
 def bench_ingestion(device_name):
@@ -1047,21 +1108,36 @@ def bench_ingestion(device_name):
         storage=storage, config=EventServerConfig(port=0)
     ).start()
     try:
-        n_clients, n_per_client = 16, 150
-        lat, wall = _run_ingest_clients(server.port, n_clients, n_per_client)
+        # headline: the batch route (each request one <=50-event
+        # group-commit unit) — the protocol a client at "millions of
+        # users" scale is expected to speak
+        n_clients, batch_size = 16, 50
+        n_per_client = 3000
+        blat, n_events, bwall = _run_ingest_clients(
+            server.port, n_clients, n_per_client, batch_size=batch_size
+        )
+        # per-event POSTs ride along so the protocol overhead stays
+        # visible (and regression-watched) next to the batch rate
+        slat, s_events, swall = _run_ingest_clients(
+            server.port, n_clients, 150, batch_size=1
+        )
         emit(
             {
                 "metric": "eventserver_ingest_events_per_sec",
-                "value": round(len(lat) / wall, 1),
+                "value": round(n_events / bwall, 1),
                 "unit": "events/s",
                 # the reference publishes no ingestion numbers; a
                 # single-node spray/HBase event server is commonly cited
                 # around ~1k events/s — conservative stand-in
-                "vs_baseline": round(len(lat) / wall / 1000.0, 2),
+                "vs_baseline": round(n_events / bwall / 1000.0, 2),
                 "baseline_events_per_sec": 1000,
                 "baseline_estimated": True,
-                "ingest_p50_ms": round(pctl(lat, 50), 2),
-                "ingest_p99_ms": round(pctl(lat, 99), 2),
+                "batch_size": batch_size,
+                "ingest_p50_ms": round(pctl(blat, 50), 2),
+                "ingest_p99_ms": round(pctl(blat, 99), 2),
+                "single_event_events_per_sec": round(s_events / swall, 1),
+                "single_ingest_p50_ms": round(pctl(slat, 50), 2),
+                "single_ingest_p99_ms": round(pctl(slat, 99), 2),
                 "clients": n_clients,
                 "device": device_name,
             }
@@ -1095,10 +1171,14 @@ def bench_concurrent_ingest(device_name):
 
     tmp = tempfile.mkdtemp(prefix="bench_conc_")
     try:
+        n_shards = int(os.environ.get("BENCH_INGEST_SHARDS", 4))
         storage = Storage(
             {
                 "PIO_STORAGE_SOURCES_SQLITE_TYPE": "sqlite",
                 "PIO_STORAGE_SOURCES_SQLITE_PATH": os.path.join(tmp, "s.db"),
+                # hash-sharded row stores: K independent WAL write slots,
+                # each with its own group committer (ISSUE 2 tentpole)
+                "PIO_STORAGE_SOURCES_SQLITE_SHARDS": str(n_shards),
                 "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "SQLITE",
                 "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "SQLITE",
                 "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "SQLITE",
@@ -1132,7 +1212,7 @@ def bench_concurrent_ingest(device_name):
             storage=storage, config=EventServerConfig(port=0)
         ).start()
         try:
-            n_clients, n_per_client = 16, 100
+            n_clients, n_per_client, batch_size = 16, 2000, 50
             stop = threading.Event()
             scans = {"count": 0, "events": 0}
             scan_errors = []
@@ -1155,8 +1235,9 @@ def bench_concurrent_ingest(device_name):
 
             scan_t = threading.Thread(target=scanner)
             scan_t.start()
-            lat, wall = _run_ingest_clients(
-                server.port, n_clients, n_per_client
+            lat, n_events, wall = _run_ingest_clients(
+                server.port, n_clients, n_per_client,
+                batch_size=batch_size,
             )
             stop.set()
             scan_t.join(timeout=60)
@@ -1169,13 +1250,15 @@ def bench_concurrent_ingest(device_name):
             emit(
                 {
                     "metric": "concurrent_ingest_events_per_sec",
-                    "value": round(len(lat) / wall, 1),
+                    "value": round(n_events / wall, 1),
                     "unit": "events/s",
                     # same conservative single-node stand-in as the
                     # scan-free ingestion config
-                    "vs_baseline": round(len(lat) / wall / 1000.0, 2),
+                    "vs_baseline": round(n_events / wall / 1000.0, 2),
                     "baseline_events_per_sec": 1000,
                     "baseline_estimated": True,
+                    "shards": n_shards,
+                    "batch_size": batch_size,
                     "ingest_p50_ms": round(pctl(lat, 50), 2),
                     "ingest_p99_ms": round(pctl(lat, 99), 2),
                     "clients": n_clients,

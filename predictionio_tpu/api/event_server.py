@@ -7,6 +7,10 @@ Capability parity with the reference EventServer
   GET    /plugins.json          -> registered plugin descriptions
   GET    /plugins/<type>/<name>/... -> plugin REST handler (auth)
   POST   /events.json           -> insert one event, 201 {"eventId"}
+  POST   /batch/events.json     -> insert up to 50 events as ONE
+                                   group-commit batch, 200 with a
+                                   per-event status array (reference
+                                   EventServer.scala:161-233)
   GET    /events.json           -> batch query (9 filters, default limit 20)
   GET    /events/<id>.json      -> one event or 404
   DELETE /events/<id>.json      -> {"message": "Found"} or 404
@@ -42,7 +46,7 @@ from predictionio_tpu.data.event import (
     parse_iso8601,
 )
 from predictionio_tpu.data.storage import Storage, get_storage
-from predictionio_tpu.data.storage.base import UNSET
+from predictionio_tpu.data.storage.base import UNSET, PartialBatchError
 from predictionio_tpu.data.webhooks import (
     ConnectorException,
     to_event,
@@ -244,6 +248,15 @@ class EventAPI:
                 return self._find_events(app_id, channel_id, query)
             return _message(405, "Method not allowed.")
 
+        if path == "/batch/events.json":
+            auth, err = self._authenticate(query)
+            if err:
+                return err
+            app_id, channel_id = auth
+            if method != "POST":
+                return _message(405, "Method not allowed.")
+            return self._post_batch(app_id, channel_id, body)
+
         if parts[0] == "events" and len(parts) == 2 and parts[1].endswith(".json"):
             auth, err = self._authenticate(query)
             if err:
@@ -299,6 +312,75 @@ class EventAPI:
         if self.config.stats:
             self.stats.bookkeeping(app_id, result[0], event)
         return result
+
+    # reference EventServer.scala:161 ("Batch request must have less
+    # than or equal to 50 events")
+    MAX_BATCH_EVENTS = 50
+
+    def _post_batch(self, app_id, channel_id, body) -> Tuple[int, Any]:
+        """Reference batch route (EventServer.scala:161-233): a JSON
+        array of up to 50 events, answered 200 with one status object
+        per slot — 201 + eventId on success, 400/403 + message on a
+        per-event failure (one bad event never fails its batchmates).
+        All parseable, unblocked events of the request are handed to the
+        store as ONE ``insert_batch`` — the storage tier's group-commit
+        unit, so the whole slice is one transaction per shard instead of
+        50 commits."""
+        try:
+            payload = json.loads((body or b"").decode("utf-8"))
+        except (json.JSONDecodeError, UnicodeDecodeError) as e:
+            return _message(400, str(e))
+        if not isinstance(payload, list):
+            return _message(400, "Request body must be a JSON array.")
+        if len(payload) > self.MAX_BATCH_EVENTS:
+            return _message(
+                400,
+                "Batch request must have less than or equal to "
+                f"{self.MAX_BATCH_EVENTS} events",
+            )
+        results: list = []
+        pending: list = []  # (slot, event) surviving parse + blockers
+        for item in payload:
+            try:
+                if not isinstance(item, dict):
+                    raise EventValidationError(
+                        "each batch entry must be a JSON object"
+                    )
+                event = Event.from_json(item)
+            except EventValidationError as e:
+                results.append({"status": 400, "message": str(e)})
+                continue
+            try:
+                self.plugin_context.run_blockers(app_id, channel_id, event)
+            except Exception as e:  # an input blocker rejected the event
+                results.append({"status": 403, "message": str(e)})
+                continue
+            results.append(None)
+            pending.append((len(results) - 1, event))
+        if pending:
+            try:
+                event_ids = self._events.insert_batch(
+                    [e for _, e in pending], app_id, channel_id
+                )
+                failed: frozenset = frozenset()
+            except PartialBatchError as e:
+                # some shard slices committed, others did not — report
+                # per-event outcomes so the client retries ONLY the
+                # failed slots (a blanket 500 would make it re-post the
+                # committed slice under fresh ids)
+                event_ids, failed = e.event_ids, e.failed_ids
+            for (slot, event), event_id in zip(pending, event_ids):
+                if event_id in failed:
+                    results[slot] = {
+                        "status": 500,
+                        "message": "event failed to commit; retry this event",
+                    }
+                    continue
+                results[slot] = {"status": 201, "eventId": event_id}
+                self.plugin_context.notify_sniffers(app_id, channel_id, event)
+                if self.config.stats:
+                    self.stats.bookkeeping(app_id, 201, event)
+        return 200, results
 
     def _post_event(self, app_id, channel_id, body) -> Tuple[int, Any]:
         try:

@@ -31,7 +31,7 @@ from typing import Any, Dict, Optional
 
 from predictionio_tpu.api.http import JsonHTTPServer
 from predictionio_tpu.data.storage import Storage, get_storage
-from predictionio_tpu.data.storage.base import StorageError
+from predictionio_tpu.data.storage.base import PartialBatchError, StorageError
 from predictionio_tpu.data.storage import wire
 
 logger = logging.getLogger(__name__)
@@ -114,6 +114,16 @@ class StorageGatewayCore:
                 payload.get("args") or {},
             )
             return 200, {"result": result}
+        except PartialBatchError as e:
+            # carry the per-event outcome across the wire — the client
+            # re-raises a PartialBatchError so the event server's
+            # per-slot retry contract holds through the gateway too
+            return 400, {
+                "error": str(e),
+                "type": "PartialBatchError",
+                "event_ids": list(e.event_ids),
+                "failed_ids": sorted(e.failed_ids),
+            }
         except StorageError as e:
             return 400, {"error": str(e), "type": "StorageError"}
         except (KeyError, TypeError, ValueError) as e:
@@ -147,6 +157,9 @@ class StorageGatewayCore:
         if method == "write":
             evs = [wire.event_from_wire(e) for e in a["events"]]
             return le.write(evs, a["app_id"], a.get("channel_id"))
+        if method == "insert_batch":
+            evs = [wire.event_from_wire(e) for e in a["events"]]
+            return le.insert_batch(evs, a["app_id"], a.get("channel_id"))
         if method == "get":
             ev = le.get(a["event_id"], a["app_id"], a.get("channel_id"))
             return None if ev is None else wire.event_to_wire(ev)

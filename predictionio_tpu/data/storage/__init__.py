@@ -13,6 +13,13 @@ the backend module by naming convention ``<Prefix><DAOName>``
 
 Built-in backends: ``memory`` (tests/dev), ``sqlite`` (persistent embedded
 default), ``localfs`` (model blobs).
+
+Backend-specific source keys ride the same scheme — notably the sqlite
+write-path scale-out knobs (see data/storage/sqlite.py)::
+
+    PIO_STORAGE_SOURCES_SQLITE_SHARDS=4            # event-row hash shards
+    PIO_STORAGE_SOURCES_SQLITE_GROUP_COMMIT_EVENTS=512
+    PIO_STORAGE_SOURCES_SQLITE_GROUP_COMMIT_MS=2
 """
 
 from __future__ import annotations

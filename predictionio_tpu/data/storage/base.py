@@ -69,6 +69,19 @@ class StorageError(Exception):
     """Backend failure (reference StorageException, Storage.scala:85-105)."""
 
 
+class PartialBatchError(StorageError):
+    """An ``insert_batch`` where some per-partition slices committed and
+    others failed. ``event_ids`` is the full assigned-id list (input
+    order); ``failed_ids`` the subset whose slice did NOT commit — so a
+    caller (the batch REST route) can report per-event outcomes instead
+    of disavowing the whole batch after part of it is durable."""
+
+    def __init__(self, message: str, event_ids, failed_ids):
+        super().__init__(message)
+        self.event_ids = list(event_ids)
+        self.failed_ids = frozenset(failed_ids)
+
+
 class LEvents(abc.ABC):
     """Event CRUD DAO (reference LEvents.scala:37-328).
 
@@ -181,11 +194,32 @@ class LEvents(abc.ABC):
         )
         return aggregate_properties_single(events)
 
+    def insert_batch(
+        self,
+        events: Sequence[Event],
+        app_id: int,
+        channel_id: Optional[int] = None,
+    ) -> List[str]:
+        """Insert a group of events as ONE batch, returning their ids in
+        input order. This is the group-commit unit of the event tier:
+        the ``/batch/events.json`` route hands its whole request here,
+        so a backend can make it one transaction instead of N.
+
+        Contract for backends that override it: the batch must be
+        atomic per storage partition — a reader may never observe part
+        of a partition's slice (sqlite commits each shard's slice as one
+        transaction; memory applies the whole batch under one lock
+        acquisition). This generic fallback loops ``insert`` and is NOT
+        atomic — acceptable for backends with per-event durability only.
+        """
+        return [self.insert(e, app_id, channel_id) for e in events]
+
     def write(
         self, events: Iterable[Event], app_id: int, channel_id: Optional[int] = None
     ) -> List[str]:
-        """Bulk insert (reference PEvents.write:169-181)."""
-        return [self.insert(e, app_id, channel_id) for e in events]
+        """Bulk insert (reference PEvents.write:169-181) — rides the
+        batch path so backends with a group-commit writer coalesce it."""
+        return self.insert_batch(list(events), app_id, channel_id)
 
     # --- columnar scan path (round 4; reference analog: the partitioned
     # columnar scans HBPEvents.scala:84-90 / JDBCPEvents.scala:51-129) ---

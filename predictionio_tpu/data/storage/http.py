@@ -23,6 +23,7 @@ from __future__ import annotations
 import datetime as _dt
 import http.client
 import json
+import socket
 import threading
 import urllib.parse
 from typing import Any, Dict, Iterator, List, Optional, Sequence
@@ -39,6 +40,7 @@ from predictionio_tpu.data.storage.base import (
     EvaluationInstance,
     Model,
     OptFilter,
+    PartialBatchError,
     StorageError,
 )
 
@@ -89,6 +91,19 @@ class StorageClient(base.DAOCacheMixin):
             conn = http.client.HTTPConnection(
                 self.host, self.port, timeout=self._timeout
             )
+            # TCP_NODELAY: RPC request/response pairs are small JSON
+            # writes on a persistent connection — Nagle + delayed ACK
+            # would stall each by tens of ms (the server side of every
+            # REST frontend already disables it, api/http.py). Connect
+            # errors are NOT raised here: call() owns transport failures
+            # (retry-once + StorageError), and request() re-connects.
+            try:
+                conn.connect()
+                conn.sock.setsockopt(
+                    socket.IPPROTO_TCP, socket.TCP_NODELAY, 1
+                )
+            except OSError:
+                pass
             self._local.conn = conn
             return conn, False
         return conn, True
@@ -139,6 +154,14 @@ class StorageClient(base.DAOCacheMixin):
                 ) from e
             if resp.status == 200:
                 return out.get("result")
+            if out.get("type") == "PartialBatchError":
+                # reconstruct the typed error so the event server's
+                # per-event retry contract survives the gateway hop
+                raise PartialBatchError(
+                    str(out.get("error")),
+                    event_ids=out.get("event_ids") or [],
+                    failed_ids=out.get("failed_ids") or [],
+                )
             raise StorageError(
                 f"gateway {dao}.{method} failed ({resp.status}): "
                 f"{out.get('error')}"
@@ -186,6 +209,19 @@ class HTTPLEvents(_RemoteDAO, base.LEvents):
         # one round trip for the whole batch (import path), not one per event
         return self._call(
             "write",
+            events=[wire.event_to_wire(e) for e in events],
+            app_id=app_id,
+            channel_id=channel_id,
+        )
+
+    def insert_batch(
+        self, events, app_id: int, channel_id: Optional[int] = None
+    ) -> List[str]:
+        # one round trip; the GATEWAY's backend provides the per-shard
+        # atomicity (its own insert_batch), so the group-commit contract
+        # holds end to end
+        return self._call(
+            "insert_batch",
             events=[wire.event_to_wire(e) for e in events],
             app_id=app_id,
             channel_id=channel_id,

@@ -68,6 +68,28 @@ class MemLEvents(base.LEvents):
             self._mutations += 1
             return eid
 
+    def insert_batch(
+        self,
+        events,
+        app_id: int,
+        channel_id: Optional[int] = None,
+    ) -> List[str]:
+        """Atomic batch insert: the whole batch lands under ONE lock
+        acquisition (readers copy under the same lock, so no reader can
+        observe a partial batch) and bumps the mutation counter once —
+        the same group-commit contract the sqlite committer provides
+        (base.LEvents.insert_batch)."""
+        with self._lock:
+            table = self._table(app_id, channel_id)
+            eids = []
+            for event in events:
+                eid = event.event_id or new_event_id()
+                table[eid] = event.with_event_id(eid)
+                eids.append(eid)
+            if eids:
+                self._mutations += 1
+            return eids
+
     def get(
         self, event_id: str, app_id: int, channel_id: Optional[int] = None
     ) -> Optional[Event]:

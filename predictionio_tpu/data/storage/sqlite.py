@@ -7,6 +7,30 @@ holds the metadata tables and per-app/channel event tables named
 JDBCUtils/HBEventsUtil). Event rows carry a millisecond timestamp column for
 ordered range scans (the role of the HBase row-key time component,
 hbase/HBEventsUtil.scala:82-130).
+
+Write-path scale-out (the role of the reference's HBase region servers):
+
+- **Group commit.** Single-event inserts do not commit their own
+  transaction. REST worker threads enqueue rows onto a bounded per-shard
+  queue; a committer thread per shard coalesces queued rows into ONE
+  multi-row transaction (flush at ``GROUP_COMMIT_EVENTS`` rows or
+  ``GROUP_COMMIT_MS`` after the batch opened, whichever first — a solo
+  row with an idle queue flushes immediately). The caller's ``insert``
+  returns only after its batch's COMMIT, so the 201 ack still means
+  durable-to-WAL; what changes is that N concurrent inserts now cost one
+  commit instead of N.
+
+- **Hash sharding.** With ``PIO_STORAGE_SOURCES_<NAME>_SHARDS = K`` (>1),
+  single-event rows split across K independent sqlite files
+  (``<path>.shard<k>``) by a stable hash of the entity id. Each shard has
+  its own connection, lock, WAL write slot, and committer — concurrent
+  writers stop serializing on one lock. The main file keeps the metadata
+  tables, the columnar page store, and the (possibly pre-sharding) row
+  table, which participates in every scan as shard "-1"; turning shards
+  on for an existing database is therefore seamless. Events of one
+  entity always land in one shard, so per-entity order is preserved and
+  the streaming scan's counting-sort merge reproduces the single-file
+  wire byte-for-byte (``ops/streaming.py``).
 """
 
 from __future__ import annotations
@@ -14,7 +38,11 @@ from __future__ import annotations
 import dataclasses
 import datetime as _dt
 import json
+import logging
 import os
+import queue as _queue
+import time as _time
+import zlib
 
 from predictionio_tpu.utils.fs import fs_basedir
 import sqlite3
@@ -39,8 +67,12 @@ from predictionio_tpu.data.storage.base import (
     EvaluationInstance,
     Model,
     OptFilter,
+    PartialBatchError,
     StorageError,
 )
+
+
+logger = logging.getLogger(__name__)
 
 
 def _ms(t: _dt.datetime) -> int:
@@ -78,45 +110,224 @@ class _LockedCursor:
         return self._rows
 
 
-class StorageClient(base.DAOCacheMixin):
-    """Shared sqlite connection per source (reference caches clients per
-    source name, Storage.scala:202-208). ``check_same_thread=False`` plus a
-    lock serializes WRITE access from REST worker threads; bulk reads run
-    on per-thread WAL snapshot connections (``read_execute``), so a
-    training scan never blocks ingest and ingest never stalls a scan —
-    the concurrency role of the reference's HBase client pool +
-    region-parallel reads (hbase/StorageClient.scala:40,
-    HBPEvents.scala:84-90)."""
+def _open_wal_conn(path: str) -> sqlite3.Connection:
+    """Open a writer connection in the mode every concurrent path here
+    assumes: WAL (readers on other connections see a consistent snapshot
+    while one writer proceeds), busy_timeout for multi-process writers
+    (gateway + CLI) briefly contending for the single WAL write slot, and
+    synchronous=NORMAL — WAL's standard production pairing: commits
+    append to the WAL without an fsync each (integrity is preserved on
+    crash; only the tail of very recent commits may be lost on power
+    failure). Per-event REST ingest is commit-bound — FULL measured ~380
+    events/s vs ~thousands with NORMAL on the same rig."""
+    conn = sqlite3.connect(path, check_same_thread=False)
+    conn.execute("PRAGMA journal_mode=WAL")
+    conn.execute("PRAGMA busy_timeout=5000")
+    conn.execute("PRAGMA synchronous=NORMAL")
+    return conn
 
-    def __init__(self, config=None):
-        self.config = config
-        props = getattr(config, "properties", {}) or {}
-        path = props.get("PATH") or os.path.join(
-            fs_basedir(),
-            "storage.db",
-        )
-        if path != ":memory:":
-            os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+
+class _InsertUnit:
+    """One atomic slice of committer work: a statement plus the rows to
+    executemany it with. All rows of a unit commit together or not at
+    all — a unit is one REST insert (1 row) or one ``insert_batch`` slice
+    (the ``/batch/events.json`` group), so a reader can never observe a
+    torn unit."""
+
+    __slots__ = ("sql", "rows", "error", "done")
+
+    def __init__(self, sql: str, rows: list):
+        self.sql = sql
+        self.rows = rows
+        self.error: Optional[BaseException] = None
+        self.done = threading.Event()
+
+    # generous: a unit is at most one committer flush (~512 rows), but
+    # it may queue behind a full backlog on a slow disk — this bound
+    # exists to surface a wedged committer, not to deadline healthy I/O
+    WAIT_S = 600.0
+
+    def wait(self, timeout: Optional[float] = None) -> None:
+        if not self.done.wait(self.WAIT_S if timeout is None else timeout):
+            # the unit is NOT cancelled — it may still commit after this
+            # raises, so the outcome is unknown, not "failed": a caller
+            # that blind-retries could duplicate the event
+            raise StorageError(
+                "group-commit writer did not resolve within "
+                f"{self.WAIT_S if timeout is None else timeout}s; "
+                "outcome UNKNOWN (the batch may still commit) — "
+                "investigate the committer before retrying"
+            )
+        if self.error is not None:
+            raise self.error
+
+
+class _GroupCommitter:
+    """Per-shard group-commit thread: worker threads enqueue
+    :class:`_InsertUnit`s on a bounded queue; this thread coalesces them
+    into one multi-row transaction. Flush policy: at ``max_rows`` rows or
+    ``max_delay_s`` after the batch opened, whichever first; a solo unit
+    with an idle queue flushes immediately, so sequential callers pay no
+    accumulation latency — batching kicks in exactly when concurrency
+    exists. Callers block on ``unit.wait()``, so their ack still means
+    the rows are committed (durable to the WAL)."""
+
+    _STOP = object()
+
+    def __init__(self, shard: "_ShardState", max_rows: int, max_delay_s: float):
+        self._shard = shard
+        self._max_rows = max(1, int(max_rows))
+        self._max_delay_s = max(0.0, float(max_delay_s))
+        self._q: "_queue.Queue[_InsertUnit]" = _queue.Queue(maxsize=4096)
+        self._thread: Optional[threading.Thread] = None
+        self._start_lock = threading.Lock()
+
+    def close(self, timeout: float = 10.0) -> None:
+        """Drain-and-stop: queued units ahead of the sentinel still
+        commit, then the thread exits. Idempotent; a never-started
+        committer has nothing to stop."""
+        t = self._thread
+        if t is None or not t.is_alive():
+            return
+        self._q.put(self._STOP)
+        t.join(timeout)
+
+    def submit(self, sql: str, rows: list) -> _InsertUnit:
+        unit = _InsertUnit(sql, rows)
+        if self._thread is None:
+            with self._start_lock:
+                if self._thread is None:
+                    t = threading.Thread(
+                        target=self._run, daemon=True,
+                        name="sqlite-group-commit",
+                    )
+                    t.start()
+                    self._thread = t
+        self._q.put(unit)
+        return unit
+
+    def _run(self) -> None:
+        while True:
+            try:
+                if not self._drain_one_batch():
+                    return  # close() sentinel
+            except BaseException:  # the loop must survive anything —
+                # but never silently: an exception here (outside
+                # _commit_batch's own handling) means some units may
+                # never resolve and their callers will time out
+                logger.exception(
+                    "group-commit loop error; queued units may be lost"
+                )
+                continue
+
+    def _drain_one_batch(self) -> bool:
+        unit = self._q.get()
+        if unit is self._STOP:
+            return False
+        batch = [unit]
+        n = len(unit.rows)
+        deadline = _time.monotonic() + self._max_delay_s
+        while n < self._max_rows:
+            try:
+                nxt = self._q.get_nowait()
+            except _queue.Empty:
+                if len(batch) == 1:
+                    break  # solo unit, idle queue: zero added latency
+                remaining = deadline - _time.monotonic()
+                if remaining <= 0:
+                    break
+                try:
+                    nxt = self._q.get(timeout=remaining)
+                except _queue.Empty:
+                    break
+            if nxt is self._STOP:
+                self._q.put(nxt)  # commit this batch, stop next round
+                break
+            batch.append(nxt)
+            n += len(nxt.rows)
+        self._commit_batch(batch)
+        return True
+
+    def _commit_batch(self, batch: list) -> None:
+        shard = self._shard
+        with shard.lock:
+            try:
+                for u in batch:
+                    shard.conn.executemany(u.sql, u.rows)
+                fault = shard.commit_fault  # test-only crash injection
+                if fault is not None:
+                    fault()
+                shard.conn.commit()
+            except BaseException as e:
+                try:
+                    shard.conn.rollback()
+                except sqlite3.Error:
+                    pass
+                if len(batch) == 1:
+                    batch[0].error = e
+                else:
+                    # poison isolation: replay each unit as its own
+                    # transaction so one bad unit cannot fail its
+                    # coalesced neighbors; each replay stays unit-atomic
+                    # and consults the fault hook too, so crash tests
+                    # can abort coalesced batches, not just solo units
+                    for u in batch:
+                        try:
+                            shard.conn.executemany(u.sql, u.rows)
+                            fault = shard.commit_fault
+                            if fault is not None:
+                                fault()
+                            shard.conn.commit()
+                        except BaseException as ue:
+                            try:
+                                shard.conn.rollback()
+                            except sqlite3.Error:
+                                pass
+                            u.error = ue
+            finally:
+                for u in batch:
+                    u.done.set()
+
+
+class _ShardState:
+    """One event-row write slot: a sqlite connection, its lock, its
+    thread-local WAL snapshot read connections, and its group committer.
+    The main database file is wrapped in one of these (sharing the
+    client's connection and lock); with ``SHARDS`` > 1, each shard file
+    gets an independent one — an independent WAL write slot."""
+
+    def __init__(
+        self,
+        path: str,
+        conn: sqlite3.Connection,
+        lock,
+        gc_rows: int,
+        gc_delay_s: float,
+    ):
         self.path = path
-        self.conn = sqlite3.connect(path, check_same_thread=False)
-        # WAL: readers on other connections see a consistent snapshot
-        # while one writer proceeds — the mode every concurrent path here
-        # assumes. busy_timeout covers multi-process writers (gateway +
-        # CLI) briefly contending for the single WAL write slot.
-        self.conn.execute("PRAGMA journal_mode=WAL")
-        self.conn.execute("PRAGMA busy_timeout=5000")
-        # WAL's standard production pairing: commits append to the WAL
-        # without an fsync each (integrity is preserved on crash; only
-        # the tail of very recent commits may be lost on power failure).
-        # Per-event REST ingest is commit-bound — FULL measured ~380
-        # events/s vs ~thousands with NORMAL on the same rig.
-        self.conn.execute("PRAGMA synchronous=NORMAL")
-        self.lock = threading.RLock()
+        self.conn = conn
+        self.lock = lock
         self._read_local = threading.local()
-        self._init_dao_cache(self.lock)
+        # memoized POSITIVE table-existence results (see _exists_memo)
+        self.known_tables: set = set()
+        # test-only fault injection: called between the batch's last
+        # execute and its COMMIT (crash-consistency tests)
+        self.commit_fault = None
+        self.committer = _GroupCommitter(self, gc_rows, gc_delay_s)
+
+    @staticmethod
+    def open(path: str, gc_rows: int, gc_delay_s: float) -> "_ShardState":
+        return _ShardState(
+            path, _open_wal_conn(path), threading.RLock(), gc_rows,
+            gc_delay_s,
+        )
 
     def execute(self, sql: str, params=()) -> _LockedCursor:
         return _LockedCursor(self, sql, params)
+
+    def commit(self) -> None:
+        with self.lock:
+            self.conn.commit()
 
     def read_execute(self, sql: str, params=()):
         """Run a read-only statement on a thread-local WAL connection —
@@ -144,6 +355,185 @@ class StorageClient(base.DAOCacheMixin):
                 raise StorageError(str(e)) from e
             raise
 
+    def has_table(self, table: str) -> bool:
+        """Memoized (positive results only) existence probe against THIS
+        shard's file; a table created later must be seen, so negatives
+        re-probe."""
+        if table in self.known_tables:
+            return True
+        row = self.execute(
+            "SELECT name FROM sqlite_master WHERE type='table' AND name=?",
+            (table,),
+        ).fetchone()
+        if row is not None:
+            self.known_tables.add(table)
+            return True
+        return False
+
+    def submit_rows(self, sql: str, rows: list) -> _InsertUnit:
+        """Hand rows to the group committer; returns the unit to wait
+        on. The caller sees the commit (or the unit's error) via
+        ``unit.wait()``."""
+        return self.committer.submit(sql, rows)
+
+
+class StorageClient(base.DAOCacheMixin):
+    """Shared sqlite connection per source (reference caches clients per
+    source name, Storage.scala:202-208). ``check_same_thread=False`` plus a
+    lock serializes WRITE access from REST worker threads; bulk reads run
+    on per-thread WAL snapshot connections (``read_execute``), so a
+    training scan never blocks ingest and ingest never stalls a scan —
+    the concurrency role of the reference's HBase client pool +
+    region-parallel reads (hbase/StorageClient.scala:40,
+    HBPEvents.scala:84-90).
+
+    Source properties (``PIO_STORAGE_SOURCES_<NAME>_<KEY>``):
+
+    - ``PATH``: database file (default ``<fs_basedir>/storage.db``)
+    - ``SHARDS``: event-row shard count K (default 1). K > 1 opens K
+      extra files ``<PATH>.shard<k>``, each an independent WAL write
+      slot with its own group committer; single-event inserts hash to a
+      shard by entity id (module docstring).
+    - ``GROUP_COMMIT_EVENTS`` / ``GROUP_COMMIT_MS``: committer flush
+      thresholds — rows per transaction (default 512) and max
+      accumulation window in ms once a batch has ≥ 2 units (default 2).
+    """
+
+    def __init__(self, config=None):
+        self.config = config
+        props = getattr(config, "properties", {}) or {}
+        path = props.get("PATH") or os.path.join(
+            fs_basedir(),
+            "storage.db",
+        )
+        if path != ":memory:":
+            os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        self.path = path
+        self.conn = _open_wal_conn(path)
+        self.lock = threading.RLock()
+        self._init_dao_cache(self.lock)
+        self.shard_count = self._pin_shard_count(
+            max(1, int(props.get("SHARDS", 1) or 1))
+        )
+        gc_rows = int(props.get("GROUP_COMMIT_EVENTS", 512) or 512)
+        gc_delay_s = float(props.get("GROUP_COMMIT_MS", 2.0) or 0.0) / 1e3
+        # unit-atomicity granularity: batches up to this many rows per
+        # shard commit as ONE unit; larger slices (bulk imports through
+        # write()) split into chunks so no single unit can outgrow a
+        # committer flush (see SQLiteLEvents.insert_batch)
+        self.gc_rows = max(1, gc_rows)
+        # the main file as a write slot (shares this conn + lock): the
+        # K==1 write target, and always scanned as the legacy/residual
+        # row store
+        self.main_store = _ShardState(
+            self.path, self.conn, self.lock, gc_rows, gc_delay_s
+        )
+        if self.shard_count <= 1:
+            self.event_shards = [self.main_store]
+        else:
+            self.event_shards = [
+                _ShardState.open(
+                    ":memory:" if path == ":memory:"
+                    else f"{path}.shard{k}",
+                    gc_rows, gc_delay_s,
+                )
+                for k in range(self.shard_count)
+            ]
+
+    def _pin_shard_count(self, configured: int) -> int:
+        """The shard count is part of the DATA layout (crc32 % K routes
+        every entity), so it is pinned in the main file at first use and
+        validated on every open: reopening a K-sharded database with a
+        different K (or none) would silently hide the shard files' rows
+        from every scan, or re-route entities away from their history.
+        Changing K requires export + re-import. Read-only files (and
+        pre-pin single-file databases) skip the pin and keep K=1
+        semantics."""
+        try:
+            with self.lock:
+                self.conn.execute(
+                    "CREATE TABLE IF NOT EXISTS pio_shard_meta ("
+                    "key TEXT PRIMARY KEY, value TEXT)"
+                )
+                # OR IGNORE: multi-process workers (SO_REUSEPORT) race
+                # this first-open write; losers read the winner's pin
+                self.conn.execute(
+                    "INSERT OR IGNORE INTO pio_shard_meta VALUES "
+                    "('shard_count', ?)",
+                    (str(configured),),
+                )
+                self.conn.commit()
+                row = self.conn.execute(
+                    "SELECT value FROM pio_shard_meta WHERE key='shard_count'"
+                ).fetchone()
+        except sqlite3.OperationalError:
+            # e.g. a read-only database file: honor the configuration
+            # (reads of a sharded db still need the right K to fan out)
+            return configured
+        pinned = int(row[0])
+        if pinned == configured:
+            return pinned
+        if pinned == 1:
+            # 1 -> K is the safe upgrade: every existing row is in the
+            # main file, which is always scanned first, and no entity
+            # has shard-file history to be re-routed away from
+            with self.lock:
+                self.conn.execute(
+                    "UPDATE pio_shard_meta SET value=? "
+                    "WHERE key='shard_count'",
+                    (str(configured),),
+                )
+                self.conn.commit()
+            return configured
+        raise StorageError(
+            f"database {self.path!r} was sharded with SHARDS={pinned} "
+            f"but is being opened with SHARDS={configured}; the shard "
+            "count routes entities to files and cannot change in place "
+            "once rows exist in shard files — reopen with "
+            f"SHARDS={pinned}, or export and re-import to re-shard"
+        )
+
+    def close(self) -> None:
+        """Stop every shard's committer (draining queued units) and
+        close the shard + main connections. For embedders that own a
+        Storage universe's lifecycle; the module-default client lives
+        for the process."""
+        for shard in self.event_shards:
+            shard.committer.close()
+        if self.main_store not in self.event_shards:
+            self.main_store.committer.close()
+        for shard in self.event_shards:
+            if shard is not self.main_store:
+                with shard.lock:
+                    shard.conn.close()
+        with self.lock:
+            self.conn.close()
+
+    def shard_index_for(self, entity_id) -> int:
+        """Stable entity→shard hash (crc32, not ``hash()`` — per-process
+        salting would scatter one entity across files between runs)."""
+        if self.shard_count <= 1:
+            return 0
+        return zlib.crc32(str(entity_id).encode("utf-8")) % self.shard_count
+
+    def shard_for(self, entity_id) -> _ShardState:
+        return self.event_shards[self.shard_index_for(entity_id)]
+
+    def row_stores(self) -> List[_ShardState]:
+        """Every store holding event ROWS, scan order: the main file
+        first (legacy/pre-sharding rows), then the hash shards."""
+        if self.shard_count <= 1:
+            return [self.main_store]
+        return [self.main_store] + self.event_shards
+
+    def execute(self, sql: str, params=()) -> _LockedCursor:
+        return _LockedCursor(self, sql, params)
+
+    def read_execute(self, sql: str, params=()):
+        """Snapshot read against the MAIN file (see
+        :meth:`_ShardState.read_execute`)."""
+        return self.main_store.read_execute(sql, params)
+
     def commit(self) -> None:
         with self.lock:
             self.conn.commit()
@@ -158,8 +548,6 @@ class SQLiteLEvents(base.LEvents):
         self._c = client
         self._ns = namespace or "pio"
         self._pages_schema_ok: set = set()
-        # positive _exists results memoized for hot write paths
-        self._known_tables: set = set()
 
     def _ensure_pages_schema(self, t: str) -> None:
         """Migrate page tables from older layouts (memoized per table):
@@ -203,34 +591,46 @@ class SQLiteLEvents(base.LEvents):
             name += f"_{int(channel_id)}"
         return name
 
+    @staticmethod
+    def _create_row_table(store, t: str) -> None:
+        """Event-row DDL, identical in the main file and every shard
+        file. Caller holds the store's lock."""
+        store.conn.execute(
+            f"""CREATE TABLE IF NOT EXISTS {t} (
+                id TEXT PRIMARY KEY,
+                event TEXT NOT NULL,
+                entity_type TEXT NOT NULL,
+                entity_id TEXT NOT NULL,
+                target_entity_type TEXT,
+                target_entity_id TEXT,
+                properties TEXT,
+                event_time TEXT NOT NULL,
+                event_time_ms INTEGER NOT NULL,
+                tags TEXT,
+                pr_id TEXT,
+                creation_time TEXT NOT NULL
+            )"""
+        )
+        store.conn.execute(
+            f"CREATE INDEX IF NOT EXISTS {t}_time ON {t} (event_time_ms)"
+        )
+        store.conn.execute(
+            f"CREATE INDEX IF NOT EXISTS {t}_entity ON {t} "
+            f"(entity_type, entity_id, event_time_ms)"
+        )
+
     def init(self, app_id: int, channel_id: Optional[int] = None) -> bool:
         t = self._events_table(app_id, channel_id)
         with self._c.lock:
-            self._c.execute(
-                f"""CREATE TABLE IF NOT EXISTS {t} (
-                    id TEXT PRIMARY KEY,
-                    event TEXT NOT NULL,
-                    entity_type TEXT NOT NULL,
-                    entity_id TEXT NOT NULL,
-                    target_entity_type TEXT,
-                    target_entity_id TEXT,
-                    properties TEXT,
-                    event_time TEXT NOT NULL,
-                    event_time_ms INTEGER NOT NULL,
-                    tags TEXT,
-                    pr_id TEXT,
-                    creation_time TEXT NOT NULL
-                )"""
-            )
-            self._c.execute(
-                f"CREATE INDEX IF NOT EXISTS {t}_time ON {t} (event_time_ms)"
-            )
-            self._c.execute(
-                f"CREATE INDEX IF NOT EXISTS {t}_entity ON {t} "
-                f"(entity_type, entity_id, event_time_ms)"
-            )
+            self._create_row_table(self._c.main_store, t)
             self._create_page_tables(t)
             self._c.commit()
+        for shard in self._c.event_shards:
+            if shard is self._c.main_store:
+                continue
+            with shard.lock:
+                self._create_row_table(shard, t)
+                shard.conn.commit()
         return True
 
     def _create_page_tables(self, t: str) -> None:
@@ -270,7 +670,14 @@ class SQLiteLEvents(base.LEvents):
             self._c.execute(f"DROP TABLE IF EXISTS {t}_pages")
             self._c.execute(f"DROP TABLE IF EXISTS {t}_dict")
             self._c.commit()
-            self._known_tables.discard(t)
+            self._c.main_store.known_tables.discard(t)
+        for shard in self._c.event_shards:
+            if shard is self._c.main_store:
+                continue
+            with shard.lock:
+                shard.conn.execute(f"DROP TABLE IF EXISTS {t}")
+                shard.conn.commit()
+                shard.known_tables.discard(t)
         return True
 
     def close(self) -> None:
@@ -289,38 +696,177 @@ class SQLiteLEvents(base.LEvents):
         be seen); remove() invalidates. A table dropped by ANOTHER
         process after memoization surfaces as StorageError from the
         statement itself rather than this probe."""
-        if table in self._known_tables:
-            return True
-        if self._exists(table):
-            self._known_tables.add(table)
-            return True
-        return False
+        return self._c.main_store.has_table(table)
+
+    def _ensure_shard_table(self, shard: _ShardState, t: str) -> None:
+        """Shard files are populated lazily: a database init()ed before
+        sharding was enabled (or before this app existed) gets the row
+        table created in the shard on first write to it. The MAIN file's
+        table is the authority on whether the app is initialized — this
+        is only reached after that check passed."""
+        if shard is self._c.main_store or shard.has_table(t):
+            return
+        with shard.lock:
+            self._create_row_table(shard, t)
+            shard.conn.commit()
+            shard.known_tables.add(t)
+
+    _INSERT_SQL = "INSERT OR REPLACE INTO {t} VALUES (?,?,?,?,?,?,?,?,?,?,?,?)"
+
+    @staticmethod
+    def _event_row(event: Event, eid: str) -> tuple:
+        return (
+            eid,
+            event.event,
+            event.entity_type,
+            event.entity_id,
+            event.target_entity_type,
+            event.target_entity_id,
+            json.dumps(event.properties.to_json()),
+            format_iso8601(event.event_time),
+            _ms(event.event_time),
+            json.dumps(list(event.tags)),
+            event.pr_id,
+            format_iso8601(event.creation_time),
+        )
+
+    def _scrub_duplicate_ids(self, t: str, spares) -> None:
+        """INSERT OR REPLACE only replaces within ONE file — a client
+        re-posting an EXPLICIT event id whose old row lives in another
+        row store (pre-sharding main rows, or the same id re-posted with
+        a different entity) would otherwise leave a stale duplicate that
+        get() keeps returning. ``spares`` is ``[(event_id, keep_store)]``;
+        each id is deleted from every OTHER row store in one batched
+        transaction per store. Called AFTER the replacement row's commit:
+        a failed insert then never loses the old row (the reverse order
+        could drop the event entirely), at the price that a crash in the
+        narrow window between commit and scrub leaves a duplicate of an
+        explicitly re-posted id — duplicates over data loss. Explicit ids
+        are the rare path (imports, updates); server-generated ids never
+        pay this probe."""
+        if not spares:
+            return
+        for store in self._c.row_stores():
+            ids = [eid for eid, keep in spares if keep is not store]
+            if not ids or not store.has_table(t):
+                continue
+            with store.lock:
+                deleted = False
+                for s in range(0, len(ids), 500):  # bound-param headroom
+                    part = ids[s : s + 500]
+                    cur = store.conn.execute(
+                        f"DELETE FROM {t} WHERE id IN "
+                        f"({','.join('?' * len(part))})",
+                        part,
+                    )
+                    deleted = deleted or cur.rowcount > 0
+                if deleted:
+                    store.conn.commit()
+                else:
+                    store.conn.rollback()
 
     def insert(self, event: Event, app_id: int, channel_id: Optional[int] = None) -> str:
+        """Single-event insert through the per-shard GROUP COMMITTER: the
+        row is enqueued, the shard's committer coalesces it with whatever
+        else is in flight into one transaction, and this call returns
+        after that transaction's COMMIT — the returned id is durable (to
+        the WAL) exactly as before, but N concurrent inserts now pay one
+        commit, not N."""
         t = self._events_table(app_id, channel_id)
         eid = event.event_id or new_event_id()
-        with self._c.lock:
-            if not self._exists_memo(t):
-                raise StorageError(f"events table {t} not initialized")
-            self._c.execute(
-                f"INSERT OR REPLACE INTO {t} VALUES (?,?,?,?,?,?,?,?,?,?,?,?)",
-                (
-                    eid,
-                    event.event,
-                    event.entity_type,
-                    event.entity_id,
-                    event.target_entity_type,
-                    event.target_entity_id,
-                    json.dumps(event.properties.to_json()),
-                    format_iso8601(event.event_time),
-                    _ms(event.event_time),
-                    json.dumps(list(event.tags)),
-                    event.pr_id,
-                    format_iso8601(event.creation_time),
-                ),
-            )
-            self._c.commit()
+        if not self._exists_memo(t):
+            raise StorageError(f"events table {t} not initialized")
+        shard = self._c.shard_for(event.entity_id)
+        self._ensure_shard_table(shard, t)
+        shard.submit_rows(
+            self._INSERT_SQL.format(t=t), [self._event_row(event, eid)]
+        ).wait()
+        if event.event_id:
+            self._scrub_duplicate_ids(t, [(eid, shard)])
         return eid
+
+    def insert_batch(
+        self,
+        events: Sequence[Event],
+        app_id: int,
+        channel_id: Optional[int] = None,
+    ) -> List[str]:
+        """Batch insert (the ``/batch/events.json`` path): the batch is
+        split by shard and each shard's slice rides the group committer
+        as an atomic unit — a reader can never observe part of a unit.
+        Slices larger than ``GROUP_COMMIT_EVENTS`` rows (bulk imports
+        through ``write()``) split into chunked units of that size, so
+        no unit can outgrow a committer flush; the <=50-event REST batch
+        is always one unit per shard. With K > 1 a batch spanning shards
+        is atomic PER SHARD, not globally — a failure after some shards
+        committed raises :class:`PartialBatchError` naming exactly which
+        event ids did NOT land, so the REST route reports per-event
+        outcomes. Shard slices commit in parallel; this returns after
+        every slice resolves."""
+        events = list(events)
+        if not events:
+            return []
+        t = self._events_table(app_id, channel_id)
+        if not self._exists_memo(t):
+            raise StorageError(f"events table {t} not initialized")
+        eids = [e.event_id or new_event_id() for e in events]
+        # duplicate EXPLICIT ids within one batch are last-wins, exactly
+        # like single-file INSERT OR REPLACE: earlier occurrences never
+        # reach a shard, so the post-commit scrub can't delete the
+        # survivor from its own store
+        last_slot: Dict[str, int] = {
+            eid: j
+            for j, (event, eid) in enumerate(zip(events, eids))
+            if event.event_id
+        }
+        by_shard: Dict[int, list] = {}  # shard idx -> [(row, eid)]
+        explicit: list = []  # (eid, keep_store) to scrub post-commit
+        for j, (event, eid) in enumerate(zip(events, eids)):
+            if event.event_id and last_slot[eid] != j:
+                continue  # superseded later in this same batch
+            k = self._c.shard_index_for(event.entity_id)
+            if event.event_id:
+                explicit.append((eid, self._c.event_shards[k]))
+            by_shard.setdefault(k, []).append((self._event_row(event, eid), eid))
+        sql = self._INSERT_SQL.format(t=t)
+        chunk = self._c.gc_rows
+        units: list = []  # (unit, [eids])
+        for k, pairs in by_shard.items():
+            shard = self._c.event_shards[k]
+            self._ensure_shard_table(shard, t)
+            for s in range(0, len(pairs), chunk):
+                part = pairs[s : s + chunk]
+                units.append(
+                    (
+                        shard.submit_rows(sql, [row for row, _ in part]),
+                        [eid for _, eid in part],
+                    )
+                )
+        failed: list = []
+        first_error: Optional[BaseException] = None
+        for unit, unit_eids in units:
+            try:
+                unit.wait()
+            except BaseException as e:
+                failed.extend(unit_eids)
+                if first_error is None:
+                    first_error = e
+        # scrub explicit ids only where the REPLACEMENT actually landed
+        # (a failed unit must keep the old copy — see _scrub_duplicate_ids)
+        failed_set = set(failed)
+        self._scrub_duplicate_ids(
+            t, [(eid, keep) for eid, keep in explicit if eid not in failed_set]
+        )
+        if first_error is not None:
+            if len(failed) == len(eids):
+                raise first_error  # nothing landed: plain error
+            raise PartialBatchError(
+                f"{len(failed)}/{len(eids)} batch events failed to "
+                f"commit: {first_error}",
+                event_ids=eids,
+                failed_ids=failed,
+            ) from first_error
+        return eids
 
     @staticmethod
     def _row_to_event(row) -> Event:
@@ -397,9 +943,18 @@ class SQLiteLEvents(base.LEvents):
         with self._c.lock:
             if not self._exists(t):
                 raise StorageError(f"events table {t} not initialized")
-            cur = self._c.execute(f"SELECT * FROM {t} WHERE id=?", (event_id,))
-            row = cur.fetchone()
-        return self._row_to_event(row) if row else None
+        # event ids don't encode their shard (the entity hash needs the
+        # entity id), so probe each row store; K is small and the id
+        # column is the primary key
+        for store in self._c.row_stores():
+            if not store.has_table(t):
+                continue
+            row = store.execute(
+                f"SELECT * FROM {t} WHERE id=?", (event_id,)
+            ).fetchone()
+            if row:
+                return self._row_to_event(row)
+        return None
 
     def _delete_page_event(self, t: str, page: int, idx: int) -> bool:
         """Delete one row of a page by marking its tombstone bit. The
@@ -449,9 +1004,19 @@ class SQLiteLEvents(base.LEvents):
         with self._c.lock:
             if not self._exists(t):
                 raise StorageError(f"events table {t} not initialized")
-            cur = self._c.execute(f"DELETE FROM {t} WHERE id=?", (event_id,))
-            self._c.commit()
-            return cur.rowcount > 0
+        # deletes are rare: a direct per-store transaction, not the
+        # group committer (same shard probe rationale as get())
+        for store in self._c.row_stores():
+            if not store.has_table(t):
+                continue
+            with store.lock:
+                cur = store.conn.execute(
+                    f"DELETE FROM {t} WHERE id=?", (event_id,)
+                )
+                store.conn.commit()
+            if cur.rowcount > 0:
+                return True
+        return False
 
     @staticmethod
     def _find_clauses(
@@ -518,14 +1083,27 @@ class SQLiteLEvents(base.LEvents):
             sql += " WHERE " + " AND ".join(clauses)
         sql += f" ORDER BY event_time_ms {'DESC' if reversed else 'ASC'}"
         if limit is not None and limit >= 0:
-            sql += f" LIMIT {int(limit)}"
+            sql += f" LIMIT {int(limit)}"  # per-store bound; re-cut below
         with self._c.lock:
             if not self._exists(t):
                 raise StorageError(f"events table {t} not initialized")
-        # the potentially-large scan runs on the snapshot connection, so
-        # concurrent ingest proceeds while this fetch streams
-        rows = self._c.read_execute(sql, params).fetchall()
-        row_events = [self._row_to_event(r) for r in rows]
+        # the potentially-large scans run on snapshot connections, so
+        # concurrent ingest proceeds while these fetches stream; sharded
+        # stores fan out per shard and merge (stable sort: ties keep
+        # main-store-then-shard, insertion order). An entity_id filter
+        # pins the events to ONE shard (the insert hash), so the serving
+        # find-by-entity path scans main + that shard, not all K.
+        candidates = self._c.row_stores()
+        if entity_id is not None and self._c.shard_count > 1:
+            candidates = [
+                self._c.main_store, self._c.shard_for(entity_id)
+            ]
+        stores = [s for s in candidates if s.has_table(t)]
+        row_events = [
+            self._row_to_event(r)
+            for store in stores
+            for r in store.read_execute(sql, params).fetchall()
+        ]
         # merge bulk-imported page events (rare on this legacy path — the
         # training scan is find_columns_native; here pages decode into
         # Event objects so find() stays a complete view of the store)
@@ -533,7 +1111,7 @@ class SQLiteLEvents(base.LEvents):
             t, start_time, until_time, entity_type, entity_id, event_names,
             target_entity_type, target_entity_id,
         )
-        if not page_events:
+        if not page_events and len(stores) <= 1:
             return iter(row_events)
         merged = row_events + page_events
         merged.sort(key=lambda e: _ms(e.event_time), reverse=reversed)
@@ -826,15 +1404,25 @@ class SQLiteLEvents(base.LEvents):
         self, app_id: int, channel_id: Optional[int] = None
     ) -> Iterator[Event]:
         """Row-store events ONLY (no page merge) — the export path pairs
-        this with iter_export_pages so neither side is double-counted."""
+        this with iter_export_pages so neither side is double-counted.
+        Sharded stores merge every shard's rows back into one
+        time-ordered view."""
         t = self._events_table(app_id, channel_id)
         with self._c.lock:
             if not self._exists(t):
                 raise StorageError(f"events table {t} not initialized")
-        rows = self._c.read_execute(
-            f"SELECT * FROM {t} ORDER BY event_time_ms ASC"
-        ).fetchall()
-        return (self._row_to_event(r) for r in rows)
+        sql = f"SELECT * FROM {t} ORDER BY event_time_ms ASC"
+        stores = [s for s in self._c.row_stores() if s.has_table(t)]
+        if len(stores) <= 1:
+            rows = stores[0].read_execute(sql).fetchall() if stores else []
+            return (self._row_to_event(r) for r in rows)
+        events = [
+            self._row_to_event(r)
+            for store in stores
+            for r in store.read_execute(sql).fetchall()
+        ]
+        events.sort(key=lambda e: _ms(e.event_time))
+        return iter(events)
 
     def iter_export_pages(
         self, app_id: int, channel_id: Optional[int] = None
@@ -1004,36 +1592,49 @@ class SQLiteLEvents(base.LEvents):
                     )
                 )
 
-        rows, values = self._residual_scan(
-            t, spec, start_time, until_time, entity_type,
-            target_entity_type, event_names,
-        )
-        if rows:
+        # residual row stores in deterministic order (main file, then
+        # hash shards) — the SAME order the streaming scan yields them,
+        # so both paths see one event sequence
+        all_rows: list = []
+        val_parts: list = []
+        for store in self._c.row_stores():
+            rows, values = self._residual_scan(
+                store, t, spec, start_time, until_time, entity_type,
+                target_entity_type, event_names,
+            )
+            if rows:
+                all_rows.extend(rows)
+                val_parts.append(values)
+        if all_rows:
             from predictionio_tpu.data.storage.columnar import encode_strings
 
-            e_names, e_codes = encode_strings([r[0] for r in rows])
-            g_names, g_codes = encode_strings([r[1] for r in rows])
+            e_names, e_codes = encode_strings([r[0] for r in all_rows])
+            g_names, g_codes = encode_strings([r[1] for r in all_rows])
             parts.append(
                 ColumnarEvents(
                     entity_names=e_names,
                     target_names=g_names,
                     entity_codes=e_codes,
                     target_codes=g_codes,
-                    values=values,
+                    values=np.concatenate(val_parts),
                 )
             )
         return ColumnarEvents.concat(parts)
 
     def _residual_scan(
-        self, t, spec, start_time, until_time, entity_type,
+        self, store, t, spec, start_time, until_time, entity_type,
         target_entity_type, event_names,
     ):
-        """Row-store residual of a columnar scan (REST-posted tail) —
-        value evaluated IN SQL (CASE per event override + json_extract),
-        so even this path never parses JSON in Python. Returns
-        ``(rows, values)``: the raw (entity_id, target_entity_id, ...)
-        rows and their float32 training values."""
+        """Row-store residual of a columnar scan (REST-posted tail) for
+        ONE row store (the main file or a hash shard) — value evaluated
+        IN SQL (CASE per event override + json_extract), so even this
+        path never parses JSON in Python. Returns ``(rows, values)``:
+        the raw (entity_id, target_entity_id, ...) rows and their
+        float32 training values."""
         import numpy as np
+
+        if not store.has_table(t):
+            return [], None
 
         clauses, params = self._find_clauses(
             start_time, until_time, entity_type, None, event_names,
@@ -1076,7 +1677,7 @@ class SQLiteLEvents(base.LEvents):
             + null_case_params + [prop_path]
             + null_case_params + [prop_path] + params
         )
-        rows = self._c.read_execute(sql, all_params).fetchall()
+        rows = store.read_execute(sql, all_params).fetchall()
         if not rows:
             return [], None
         # CAST diverges from the per-event path on non-numeric
@@ -1202,30 +1803,40 @@ class SQLiteLEvents(base.LEvents):
                     sl = slice(s, s + batch_rows)
                     if len(v[sl]):
                         yield e[sl], g[sl], v[sl]
-            rows, values = self._residual_scan(
-                t, spec, start_time, until_time, entity_type,
-                target_entity_type, event_names,
-            )
-            if rows:
-                # residual ids map into the shared space through a
-                # name->code dict; unseen ids extend it (the residual is
-                # the REST tail — small next to the page bulk)
-                code_of = {
-                    str(nm): j
-                    for j, nm in enumerate(names_state["names"])
-                }
+            # residual row stores in deterministic order (main file,
+            # then hash shards — the same order find_columns_native
+            # concatenates them). All stores' ids map into ONE shared
+            # code space through a name->code dict; unseen ids extend it
+            # (the residual is the REST tail — small next to the page
+            # bulk). Events of one entity live in one shard, so each
+            # entity's events keep their per-store insertion order and
+            # the consumer's stable counting-sort merge reproduces the
+            # single-file wire byte-for-byte.
+            code_of: Optional[dict] = None
 
-                def enc(strs):
-                    out = np.empty(len(strs), np.int32)
-                    for j, s in enumerate(strs):
-                        c = code_of.get(s)
-                        if c is None:
-                            c = len(code_of)
-                            code_of[s] = c
-                            names_state["extra"].append(s)
-                        out[j] = c
-                    return out
+            def enc(strs):
+                out = np.empty(len(strs), np.int32)
+                for j, s in enumerate(strs):
+                    c = code_of.get(s)
+                    if c is None:
+                        c = len(code_of)
+                        code_of[s] = c
+                        names_state["extra"].append(s)
+                    out[j] = c
+                return out
 
+            for store in self._c.row_stores():
+                rows, values = self._residual_scan(
+                    store, t, spec, start_time, until_time, entity_type,
+                    target_entity_type, event_names,
+                )
+                if not rows:
+                    continue
+                if code_of is None:
+                    code_of = {
+                        str(nm): j
+                        for j, nm in enumerate(names_state["names"])
+                    }
                 e_codes = enc([r[0] for r in rows])
                 g_codes = enc([r[1] for r in rows])
                 for s in range(0, len(values), batch_rows):
@@ -1246,13 +1857,14 @@ class SQLiteLEvents(base.LEvents):
     def store_fingerprint(
         self, app_id: int, channel_id: Optional[int] = None
     ) -> Optional[tuple]:
-        """Cheap store-state aggregates: row-store (count, max rowid, max
-        event time) + page store (count, max page id, total rows, max
-        time) + exact tombstone populations. Every mutating path moves at
-        least one component: inserts bump counts/max-rowid (INSERT OR
-        REPLACE reassigns the implicit rowid), bulk imports add pages,
-        deletes shrink counts or flip tombstone bits. Costs a few
-        aggregate scans plus one pass over the (rare) dead blobs."""
+        """Cheap store-state aggregates: per row store (the main file
+        plus every hash shard) a (count, max rowid, max event time)
+        triple, + page store (count, max page id, total rows, max time)
+        + exact tombstone populations. Every mutating path moves at
+        least one component: inserts bump their shard's counts/max-rowid
+        (INSERT OR REPLACE reassigns the implicit rowid), bulk imports
+        add pages, deletes shrink counts or flip tombstone bits. Costs a
+        few aggregate scans plus one pass over the (rare) dead blobs."""
         import numpy as np
 
         t = self._events_table(app_id, channel_id)
@@ -1260,10 +1872,15 @@ class SQLiteLEvents(base.LEvents):
             if not self._exists(t):
                 return None
         row = tuple(
-            self._c.read_execute(
-                f"SELECT COUNT(*), COALESCE(MAX(rowid), 0), "
-                f"COALESCE(MAX(event_time_ms), 0) FROM {t}"
-            ).fetchone()
+            tuple(
+                store.read_execute(
+                    f"SELECT COUNT(*), COALESCE(MAX(rowid), 0), "
+                    f"COALESCE(MAX(event_time_ms), 0) FROM {t}"
+                ).fetchone()
+            )
+            if store.has_table(t)
+            else (0, 0, 0)
+            for store in self._c.row_stores()
         )
         pages = (0, 0, 0, 0)
         dead_sig: tuple = ()

@@ -78,6 +78,92 @@ class TestAuth:
         assert status == 201
 
 
+class TestBatchEvents:
+    """POST /batch/events.json (reference EventServer.scala:161-233):
+    one request, up to 50 events, per-event status array, routed through
+    the storage tier's group-commit ``insert_batch``."""
+
+    def post_batch(self, api, payload, **query):
+        query.setdefault("accessKey", "secret")
+        return api.handle(
+            "POST", "/batch/events.json", query, json.dumps(payload).encode()
+        )
+
+    def test_batch_inserts_all(self, api):
+        batch = [dict(EVENT, entityId=f"u{k}") for k in range(3)]
+        status, body = self.post_batch(api, batch)
+        assert status == 200
+        assert [r["status"] for r in body] == [201, 201, 201]
+        # every ack'd id is durable and retrievable
+        for r, sent in zip(body, batch):
+            got_status, got = api.handle(
+                "GET", f"/events/{r['eventId']}.json", {"accessKey": "secret"}
+            )
+            assert got_status == 200
+            assert got["entityId"] == sent["entityId"]
+
+    def test_per_event_validation_does_not_fail_batchmates(self, api):
+        bad = {"event": "rate"}  # missing entityType/entityId
+        batch = [dict(EVENT, entityId="ok1"), bad, dict(EVENT, entityId="ok2")]
+        status, body = self.post_batch(api, batch)
+        assert status == 200
+        assert body[0]["status"] == 201 and body[2]["status"] == 201
+        assert body[1]["status"] == 400 and "required" in body[1]["message"]
+
+    def test_non_object_entry_rejected_in_place(self, api):
+        status, body = self.post_batch(api, [dict(EVENT), "not-an-event"])
+        assert status == 200
+        assert body[0]["status"] == 201
+        assert body[1]["status"] == 400
+
+    def test_over_50_rejected(self, api):
+        batch = [dict(EVENT, entityId=f"u{k}") for k in range(51)]
+        status, body = self.post_batch(api, batch)
+        assert status == 400
+        assert "less than or equal to 50" in body["message"]
+
+    def test_non_array_body_rejected(self, api):
+        status, body = self.post_batch(api, {"event": "rate"})
+        assert status == 400
+        assert "JSON array" in body["message"]
+
+    def test_requires_auth(self, api):
+        status, _ = self.post_batch(api, [dict(EVENT)], accessKey="nope")
+        assert status == 401
+
+    def test_get_method_not_allowed(self, api):
+        status, _ = api.handle(
+            "GET", "/batch/events.json", {"accessKey": "secret"}
+        )
+        assert status == 405
+
+    def test_input_blocker_403_in_place(self, mem_storage):
+        from predictionio_tpu.data.storage.base import AccessKey, App
+
+        apps = mem_storage.get_meta_data_apps()
+        app_id = apps.insert(App(id=0, name="blocked"))
+        mem_storage.get_meta_data_access_keys().insert(
+            AccessKey(key="secret", appid=app_id, events=())
+        )
+        mem_storage.get_l_events().init(app_id)
+
+        class Blocker(EventServerPlugin):
+            plugin_name = "b"
+            plugin_type = EventServerPlugin.INPUT_BLOCKER
+
+            def process(self, app_id, channel_id, event, context):
+                if event.entity_id == "banned":
+                    raise ValueError("banned entity")
+
+        ctx = EventServerPluginContext([Blocker()])
+        api = EventAPI(storage=mem_storage, plugin_context=ctx)
+        batch = [dict(EVENT, entityId="ok"), dict(EVENT, entityId="banned")]
+        status, body = self.post_batch(api, batch)
+        assert status == 200
+        assert body[0]["status"] == 201
+        assert body[1]["status"] == 403
+
+
 class TestAuthCache:
     def test_ttl_zero_disables_caching(self, mem_storage):
         """auth_ttl_s=0: every request reads the metadata store, so a

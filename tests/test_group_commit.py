@@ -1,0 +1,569 @@
+"""Group-commit, sharded-writer ingestion tests.
+
+Three contracts of the write-path scale-out (data/storage/sqlite.py):
+
+- **Crash consistency.** A committer that dies between its last execute
+  and its COMMIT leaves NOTHING behind: no partial batch is ever visible
+  to a reader or counted in ``store_fingerprint`` (the batch rode one
+  transaction; WAL rollback discards it whole).
+- **Group-commit correctness under concurrency.** Concurrent writers'
+  coalesced inserts all land exactly once, and each ``insert`` returns
+  only after its row is durable.
+- **Merge-compatible sharded scans.** With writers racing across shards
+  WHILE a streaming training scan runs, the scan stays consistent; and
+  the final merged wire from a sharded store is byte-identical to the
+  wire from a single-file store holding the same events — sharding is
+  invisible to training (the acceptance oracle of ISSUE 2).
+"""
+
+import datetime as dt
+import threading
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.data.event import Event
+from predictionio_tpu.data.storage import Storage
+from predictionio_tpu.data.storage.base import App
+from predictionio_tpu.data.storage.columnar import ValueSpec
+from predictionio_tpu.data.store import PEventStore
+from predictionio_tpu.ops.als import ALSConfig
+from predictionio_tpu.ops.streaming import (
+    _scan_and_pack,
+    pack_cache_clear,
+    train_als_streaming,
+)
+
+WHEN = dt.datetime(2026, 8, 1, tzinfo=dt.timezone.utc)
+
+SCAN_KW = dict(
+    value_spec=ValueSpec(prop="rating", default=1.0),
+    entity_type="user",
+    target_entity_type="item",
+    event_names=["rate"],
+)
+
+
+def sqlite_storage(path, shards: int = 1, app_name: str = "gc"):
+    config = {
+        "PIO_STORAGE_SOURCES_SQLITE_TYPE": "sqlite",
+        "PIO_STORAGE_SOURCES_SQLITE_PATH": str(path),
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "SQLITE",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "SQLITE",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "SQLITE",
+    }
+    if shards > 1:
+        config["PIO_STORAGE_SOURCES_SQLITE_SHARDS"] = str(shards)
+    storage = Storage(config)
+    storage.get_meta_data_apps().insert(App(id=0, name=app_name))
+    storage.get_l_events().init(1)
+    return storage
+
+
+def rating(entity_id: str, target_id: str, value: float, minute: int = 0):
+    return Event(
+        event="rate",
+        entity_type="user",
+        entity_id=entity_id,
+        target_entity_type="item",
+        target_entity_id=target_id,
+        properties={"rating": value},
+        event_time=WHEN + dt.timedelta(minutes=minute),
+    )
+
+
+@pytest.fixture(autouse=True)
+def _fresh_cache():
+    pack_cache_clear()
+    yield
+    pack_cache_clear()
+
+
+class TestCrashConsistency:
+    def test_aborted_batch_is_never_partially_visible(self, tmp_path):
+        """Kill the committer between execute and COMMIT: the whole
+        insert_batch unit rolls back — the reader sees zero of its
+        events and the fingerprint is bit-identical to pre-batch."""
+        storage = sqlite_storage(tmp_path / "s.db")
+        le = storage.get_l_events()
+        seeded = [rating(f"pre{k}", "i0", 3.0, k) for k in range(3)]
+        le.insert_batch(seeded, 1)
+        fp0 = le.store_fingerprint(1)
+
+        shard = le._c.event_shards[0]
+        calls = {"n": 0}
+
+        def crash():
+            calls["n"] += 1
+            raise RuntimeError("simulated committer crash before COMMIT")
+
+        shard.commit_fault = crash
+        doomed = [rating(f"doomed{k}", "i1", 4.0, k) for k in range(10)]
+        try:
+            with pytest.raises(RuntimeError, match="simulated"):
+                le.insert_batch(doomed, 1)
+        finally:
+            shard.commit_fault = None
+        assert calls["n"] == 1
+
+        # nothing of the aborted batch visible anywhere
+        events = list(le.find(1))
+        assert len(events) == 3
+        assert all(e.entity_id.startswith("pre") for e in events)
+        assert le.store_fingerprint(1) == fp0
+        cols = le.find_columns_native(1, **SCAN_KW)
+        assert cols.n == 3
+
+        # the store stays healthy: the same batch commits cleanly now
+        le.insert_batch(doomed, 1)
+        assert len(list(le.find(1))) == 13
+        assert le.store_fingerprint(1) != fp0
+
+    def test_aborted_single_insert_rolls_back(self, tmp_path):
+        storage = sqlite_storage(tmp_path / "s.db")
+        le = storage.get_l_events()
+        shard = le._c.event_shards[0]
+        shard.commit_fault = lambda: (_ for _ in ()).throw(
+            RuntimeError("crash")
+        )
+        try:
+            with pytest.raises(RuntimeError):
+                le.insert(rating("u1", "i1", 2.0), 1)
+        finally:
+            shard.commit_fault = None
+        assert list(le.find(1)) == []
+
+
+class TestGroupCommitConcurrency:
+    def test_concurrent_inserts_all_land_exactly_once(self, tmp_path):
+        """8 writers through the coalescing committer on a 2-shard
+        store: every event lands once, every ack meant durable."""
+        storage = sqlite_storage(tmp_path / "s.db", shards=2)
+        le = storage.get_l_events()
+        n_writers, per_writer = 8, 40
+        errors = []
+
+        def writer(w):
+            try:
+                for k in range(per_writer):
+                    le.insert(rating(f"u{w}-{k}", f"i{k % 5}", 1.0), 1)
+            except Exception as e:  # pragma: no cover - failure evidence
+                errors.append(e)
+
+        threads = [
+            threading.Thread(target=writer, args=(w,))
+            for w in range(n_writers)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        assert not errors, errors
+        events = list(le.find(1))
+        assert len(events) == n_writers * per_writer
+        assert len({e.event_id for e in events}) == n_writers * per_writer
+        # rows genuinely spread across shard FILES (independent WAL
+        # write slots), not funneled through one
+        populated = 0
+        for shard in le._c.event_shards:
+            t = le._events_table(1, None)
+            if shard.has_table(t):
+                n = shard.execute(f"SELECT COUNT(*) FROM {t}").fetchone()[0]
+                populated += int(n > 0)
+        assert populated == 2
+
+
+class TestExplicitIdAcrossShards:
+    def test_reposted_event_id_replaces_across_row_stores(self, tmp_path):
+        """INSERT OR REPLACE semantics survive sharding: re-posting an
+        explicit eventId with a different entity (different shard) must
+        not leave a stale duplicate in the old row store."""
+        storage = sqlite_storage(tmp_path / "s.db", shards=4)
+        le = storage.get_l_events()
+        c = le._c
+        # two entities guaranteed to hash to different shards
+        a = "user-a"
+        b = next(
+            f"user-{k}" for k in range(64)
+            if c.shard_index_for(f"user-{k}") != c.shard_index_for(a)
+        )
+        import dataclasses as _dc
+
+        eid = le.insert(
+            _dc.replace(rating(a, "i1", 2.0), event_id="fixed-id"), 1
+        )
+        assert eid == "fixed-id"
+        le.insert(
+            _dc.replace(rating(b, "i2", 5.0), event_id="fixed-id"), 1
+        )
+        events = list(le.find(1))
+        assert len(events) == 1
+        assert events[0].entity_id == b
+        got = le.get("fixed-id", 1)
+        assert got is not None and got.entity_id == b
+        assert le.delete("fixed-id", 1)
+        assert list(le.find(1)) == []
+
+    def test_find_by_entity_prunes_to_owning_shard(self, tmp_path):
+        storage = sqlite_storage(tmp_path / "s.db", shards=4)
+        le = storage.get_l_events()
+        for k in range(20):
+            le.insert(rating(f"u{k}", "i0", 1.0, minute=k), 1)
+        got = [e.entity_id for e in le.find(1, entity_id="u7")]
+        assert got == ["u7"]
+
+
+class TestShardCountPinned:
+    def test_reopening_with_different_shard_count_refuses(self, tmp_path):
+        """K routes entities to FILES: reopening a K-sharded database
+        with another K (or none) would hide or mis-route shard rows, so
+        the pinned count is validated on open; 1 -> K stays a legal
+        (safe) upgrade."""
+        from predictionio_tpu.data.storage.base import StorageError
+
+        path = tmp_path / "s.db"
+        s4 = sqlite_storage(path, shards=4)
+        s4.get_l_events().insert(rating("u1", "i1", 1.0), 1)
+        with pytest.raises(StorageError, match="SHARDS=4"):
+            Storage(
+                {
+                    "PIO_STORAGE_SOURCES_SQLITE_TYPE": "sqlite",
+                    "PIO_STORAGE_SOURCES_SQLITE_PATH": str(path),
+                    "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "SQLITE",
+                    "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "SQLITE",
+                    "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "SQLITE",
+                }
+            ).get_l_events()
+
+    def test_single_file_database_can_upgrade_to_sharded(self, tmp_path):
+        path = tmp_path / "s.db"
+        s1 = sqlite_storage(path, app_name="up")
+        s1.get_l_events().insert(rating("old", "i1", 1.0), 1)
+        s4 = Storage(
+            {
+                "PIO_STORAGE_SOURCES_SQLITE_TYPE": "sqlite",
+                "PIO_STORAGE_SOURCES_SQLITE_PATH": str(path),
+                "PIO_STORAGE_SOURCES_SQLITE_SHARDS": "4",
+                "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "SQLITE",
+                "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "SQLITE",
+                "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "SQLITE",
+            }
+        )
+        le = s4.get_l_events()
+        le.insert(rating("new", "i1", 2.0, minute=1), 1)
+        assert {e.entity_id for e in le.find(1)} == {"old", "new"}
+
+
+class TestClientClose:
+    def test_close_stops_committers_and_connections(self, tmp_path):
+        storage = sqlite_storage(tmp_path / "s.db", shards=2)
+        le = storage.get_l_events()
+        le.insert(rating("u1", "i1", 1.0), 1)  # spin up a committer
+        c = le._c
+        threads = [
+            s.committer._thread
+            for s in c.event_shards
+            if s.committer._thread is not None
+        ]
+        assert threads
+        c.close()
+        for t in threads:
+            assert not t.is_alive()
+
+
+class TestPartialBatch:
+    def test_duplicate_explicit_id_in_batch_is_last_wins(self, tmp_path):
+        """Two events sharing one explicit eventId in ONE batch, with
+        entities hashing to different shards: exactly one row survives
+        (the later event), matching single-file INSERT OR REPLACE."""
+        import dataclasses as _dc
+
+        storage = sqlite_storage(tmp_path / "s.db", shards=4)
+        le = storage.get_l_events()
+        c = le._c
+        a = "user-a"
+        b = next(
+            f"user-{k}" for k in range(64)
+            if c.shard_index_for(f"user-{k}") != c.shard_index_for(a)
+        )
+        batch = [
+            _dc.replace(rating(a, "i1", 1.0), event_id="dup"),
+            _dc.replace(rating(b, "i2", 5.0, minute=1), event_id="dup"),
+        ]
+        eids = le.insert_batch(batch, 1)
+        assert eids == ["dup", "dup"]
+        events = list(le.find(1))
+        assert len(events) == 1 and events[0].entity_id == b
+        assert le.get("dup", 1).entity_id == b
+    def test_partial_batch_error_names_failed_events(self, tmp_path):
+        from predictionio_tpu.data.storage.base import PartialBatchError
+
+        storage = sqlite_storage(tmp_path / "s.db", shards=2)
+        le = storage.get_l_events()
+        c = le._c
+        batch = [rating(f"u{k}", "i0", 1.0, minute=k) for k in range(12)]
+        # the batch must genuinely span both shards for PARTIAL failure
+        assert len({c.shard_index_for(e.entity_id) for e in batch}) == 2
+        # fault exactly one shard's committer: its slice must fail, the
+        # other shard's slice must commit, and the error must name
+        # exactly the failed slice's event ids
+        bad = c.shard_index_for(batch[0].entity_id)
+        c.event_shards[bad].commit_fault = lambda: (_ for _ in ()).throw(
+            RuntimeError("one shard down")
+        )
+        try:
+            with pytest.raises(PartialBatchError) as exc:
+                le.insert_batch(batch, 1)
+        finally:
+            c.event_shards[bad].commit_fault = None
+        err = exc.value
+        landed = {e.entity_id for e in le.find(1)}
+        expect_failed = {
+            e.entity_id
+            for e in batch
+            if c.shard_index_for(e.entity_id) == bad
+        }
+        assert landed == {e.entity_id for e in batch} - expect_failed
+        assert len(err.failed_ids) == len(expect_failed)
+        assert set(err.event_ids) >= err.failed_ids
+
+    def test_batch_route_reports_per_event_outcomes(self, tmp_path):
+        """A partial storage failure surfaces as per-slot 201/500 in the
+        /batch/events.json response, never a blanket 500 that would make
+        the client re-post already-committed events."""
+        import json as _json
+
+        from predictionio_tpu.api.event_server import EventAPI
+        from predictionio_tpu.data.storage.base import AccessKey
+
+        storage = sqlite_storage(tmp_path / "s.db", shards=2)
+        storage.get_meta_data_access_keys().insert(
+            AccessKey(key="k", appid=1, events=())
+        )
+        api = EventAPI(storage=storage)
+        le = storage.get_l_events()
+        payload = [
+            {
+                "event": "rate", "entityType": "user",
+                "entityId": f"u{k}", "targetEntityType": "item",
+                "targetEntityId": "i0", "properties": {"rating": 1.0},
+            }
+            for k in range(10)
+        ]
+        assert len(
+            {le._c.shard_index_for(p["entityId"]) for p in payload}
+        ) == 2
+        bad = le._c.shard_index_for("u0")
+        le._c.event_shards[bad].commit_fault = lambda: (
+            _ for _ in ()
+        ).throw(RuntimeError("shard down"))
+        try:
+            status, body = api.handle(
+                "POST", "/batch/events.json", {"accessKey": "k"},
+                _json.dumps(payload).encode(),
+            )
+        finally:
+            le._c.event_shards[bad].commit_fault = None
+        assert status == 200
+        statuses = [r["status"] for r in body]
+        assert 201 in statuses and 500 in statuses
+        landed = {e.entity_id for e in le.find(1)}
+        for item, r in zip(payload, body):
+            assert (r["status"] == 201) == (item["entityId"] in landed)
+
+    def test_partial_batch_error_survives_gateway(self, tmp_path, request):
+        """The typed PartialBatchError crosses the storage-gateway wire
+        intact (event_ids + failed_ids), so a gateway-backed event
+        server keeps its per-slot retry contract."""
+        from predictionio_tpu.api.storage_gateway import StorageGatewayServer
+        from predictionio_tpu.data.storage.base import PartialBatchError
+
+        backend = sqlite_storage(tmp_path / "s.db", shards=2)
+        server = StorageGatewayServer(
+            backend, ip="127.0.0.1", port=0
+        ).start()
+        request.addfinalizer(server.shutdown)
+        remote = Storage(
+            {
+                "PIO_STORAGE_SOURCES_GW_TYPE": "http",
+                "PIO_STORAGE_SOURCES_GW_URL": f"http://127.0.0.1:{server.port}",
+                "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "GW",
+                "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "GW",
+                "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "GW",
+            }
+        )
+        batch = [rating(f"u{k}", "i0", 1.0, minute=k) for k in range(12)]
+        backend_le = backend.get_l_events()
+        assert len(
+            {backend_le._c.shard_index_for(e.entity_id) for e in batch}
+        ) == 2
+        bad = backend_le._c.shard_index_for(batch[0].entity_id)
+        backend_le._c.event_shards[bad].commit_fault = lambda: (
+            _ for _ in ()
+        ).throw(RuntimeError("shard down"))
+        try:
+            with pytest.raises(PartialBatchError) as exc:
+                remote.get_l_events().insert_batch(batch, 1)
+        finally:
+            backend_le._c.event_shards[bad].commit_fault = None
+        assert exc.value.failed_ids
+        assert len(exc.value.event_ids) == 12
+        assert exc.value.failed_ids < set(exc.value.event_ids)
+
+    def test_oversize_slices_chunk_and_land(self, tmp_path):
+        """Bulk writes bigger than GROUP_COMMIT_EVENTS split into
+        chunked units (bounded unit size) and still all land."""
+        from predictionio_tpu.data.storage import Storage
+
+        config = {
+            "PIO_STORAGE_SOURCES_SQLITE_TYPE": "sqlite",
+            "PIO_STORAGE_SOURCES_SQLITE_PATH": str(tmp_path / "s.db"),
+            "PIO_STORAGE_SOURCES_SQLITE_SHARDS": "2",
+            "PIO_STORAGE_SOURCES_SQLITE_GROUP_COMMIT_EVENTS": "8",
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "SQLITE",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "SQLITE",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "SQLITE",
+        }
+        storage = Storage(config)
+        storage.get_meta_data_apps().insert(App(id=0, name="chunk"))
+        le = storage.get_l_events()
+        le.init(1)
+        eids = le.insert_batch(
+            [rating(f"u{k}", "i0", 1.0, minute=k) for k in range(50)], 1
+        )
+        assert len(eids) == 50
+        assert len(list(le.find(1))) == 50
+
+
+class TestShardedScanParity:
+    def _fill_both(self, single_le, sharded_le, n_writers=4, per_writer=40):
+        """Concurrent writers, each owning its user ids and posting its
+        events to BOTH stores in its own sequential order — so per-user
+        event order (the only order the user-sorted wire preserves) is
+        identical in both stores regardless of cross-writer
+        interleaving."""
+        errors = []
+
+        def writer(w):
+            try:
+                for k in range(per_writer):
+                    ev = rating(
+                        f"u{w}-{k % 6}", f"i{k % 9}",
+                        float(k % 9 + 1) / 2.0, minute=k,
+                    )
+                    single_le.insert(ev, 1)
+                    sharded_le.insert(ev, 1)
+            except Exception as e:  # pragma: no cover
+                errors.append(e)
+
+        threads = [
+            threading.Thread(target=writer, args=(w,))
+            for w in range(n_writers)
+        ]
+        for t in threads:
+            t.start()
+        return threads, errors, n_writers * per_writer
+
+    def test_wire_byte_identical_and_scan_merge_compatible(self, tmp_path):
+        single = sqlite_storage(tmp_path / "one.db", app_name="gc")
+        sharded = sqlite_storage(
+            tmp_path / "many.db", shards=4, app_name="gc"
+        )
+        single_le = single.get_l_events()
+        sharded_le = sharded.get_l_events()
+
+        stop = threading.Event()
+        scan_errors = []
+        scans = {"count": 0}
+
+        def scanner():
+            """Streaming scans racing the sharded writers: every batch
+            must decode through the shared code space (merge
+            compatibility), whatever snapshot it caught."""
+            try:
+                while not stop.is_set():
+                    stream = sharded_le.stream_columns_native(1, **SCAN_KW)
+                    total = 0
+                    for e, g, v in stream:
+                        assert len(e) == len(g) == len(v)
+                        total += len(v)
+                    names = stream.names
+                    if total:
+                        assert len(names) > 0
+                    scans["count"] += 1
+            except Exception as e:  # pragma: no cover
+                scan_errors.append(e)
+
+        scan_t = threading.Thread(target=scanner)
+        scan_t.start()
+        threads, errors, n_total = self._fill_both(single_le, sharded_le)
+        for t in threads:
+            t.join(timeout=120)
+        stop.set()
+        scan_t.join(timeout=60)
+        assert not errors, errors
+        assert not scan_errors, scan_errors
+        assert scans["count"] > 0, "no scan completed during ingest"
+
+        # the acceptance oracle: the sharded store's merged wire is
+        # BYTE-identical to the single-file store's
+        config = ALSConfig(rank=4, iterations=1, reg=0.05)
+        w1 = _scan_and_pack(
+            PEventStore(single).stream_columns("gc", **SCAN_KW),
+            config, {}, 4,
+        )
+        w2 = _scan_and_pack(
+            PEventStore(sharded).stream_columns("gc", **SCAN_KW),
+            config, {}, 4,
+        )
+        assert w1 is not None and w2 is not None
+        wire1, uidx1, iidx1, _ = w1
+        wire2, uidx2, iidx2, _ = w2
+        assert list(uidx1) == list(uidx2)
+        assert list(iidx1) == list(iidx2)
+        assert wire1.iw.tobytes() == wire2.iw.tobytes()
+        assert wire1.vw.tobytes() == wire2.vw.tobytes()
+        assert wire1.nibble == wire2.nibble
+        assert wire1.v_scale == wire2.v_scale
+        for key in wire1.aux:
+            np.testing.assert_array_equal(wire1.aux[key], wire2.aux[key])
+        np.testing.assert_array_equal(wire1.counts_u, wire2.counts_u)
+        np.testing.assert_array_equal(wire1.counts_i, wire2.counts_i)
+        assert wire1.n_users == wire2.n_users
+        assert wire1.n_items == wire2.n_items
+        assert int(wire1.counts_u.sum()) == n_total
+        assert wire2.iw.dtype == wire1.iw.dtype
+
+    def test_pack_cache_hits_on_unchanged_sharded_store(self, tmp_path):
+        """The combined per-shard fingerprint is stable across repeat
+        scans of an unchanged sharded store (cache hit) and moves when
+        any ONE shard takes a write (miss, never stale)."""
+        sharded = sqlite_storage(
+            tmp_path / "many.db", shards=4, app_name="gc"
+        )
+        le = sharded.get_l_events()
+        le.insert_batch(
+            [rating(f"u{k}", f"i{k % 3}", 2.5, k) for k in range(40)], 1
+        )
+        store = PEventStore(sharded)
+        config = ALSConfig(rank=4, iterations=2, reg=0.05)
+        t1 = {}
+        r1 = train_als_streaming(
+            store.stream_columns("gc", **SCAN_KW), config, timings=t1
+        )
+        assert r1 is not None and t1["pack_cache"] == "miss"
+        t2 = {}
+        r2 = train_als_streaming(
+            store.stream_columns("gc", **SCAN_KW), config, timings=t2
+        )
+        assert t2["pack_cache"] == "hit"
+        np.testing.assert_array_equal(
+            r1.arrays.user_factors, r2.arrays.user_factors
+        )
+        le.insert(rating("fresh", "i0", 1.0), 1)  # moves ONE shard
+        t3 = {}
+        r3 = train_als_streaming(
+            store.stream_columns("gc", **SCAN_KW), config, timings=t3
+        )
+        assert t3["pack_cache"] == "miss"
+        assert "fresh" in r3.user_index

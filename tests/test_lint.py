@@ -1,0 +1,85 @@
+"""Tier-1 source lint: ban new ``id(...)``-keyed caches.
+
+The bug class (PR 1's markov_chain stale-mesh fix): keying a cache or
+registry by ``id(obj)`` silently aliases entries when the object dies
+and CPython reuses its address — a later, unrelated object then HITS the
+dead object's entry. The sanctioned idiom is a ``weakref.ref`` held in
+the entry and compared by identity at lookup (see
+``ops/streaming.py::_cache_get`` and ``e2/markov_chain.py``).
+
+This test greps the package for ``id(`` and fails on any occurrence not
+in the reviewed allowlist below. If you are adding one: either switch to
+the weakref-identity idiom, or — if the keyed objects provably outlive
+every lookup (e.g. grouping items of ONE in-flight batch) — add the
+line to the allowlist with a justification in your PR.
+"""
+
+import re
+from pathlib import Path
+
+PACKAGE = Path(__file__).resolve().parent.parent / "predictionio_tpu"
+
+# \bid\( — won't match foo_id( / event_id( (the preceding word char
+# kills the boundary), but catches id(x) used as a key anywhere,
+# including docstrings that *recommend* it
+_ID_CALL = re.compile(r"\bid\(")
+
+# (relative path, stripped line) pairs reviewed as safe or as prose
+# ABOUT the bug class. Keep this list short and justified:
+ALLOWED = {
+    # prose documenting why id() keys are forbidden
+    (
+        "ops/streaming.py",
+        "# identity, not id(): the weakref keeps a dead DAO's entry from",
+    ),
+    (
+        "e2/markov_chain.py",
+        "object identity: an ``id(mesh)`` key could collide when a dead",
+    ),
+    (
+        "data/storage/columnar.py",
+        "compared by IDENTITY, never by a reusable ``id()``).",
+    ),
+    # groups items of ONE in-flight micro-batch; every keyed object is a
+    # live strong reference in the same local list, so no id can alias
+    (
+        "api/engine_server.py",
+        "groups.setdefault(id(item[0]), []).append(item)",
+    ),
+    # lock table keyed by (id(cache), key): worst case an address reuse
+    # SHARES a lock between two caches — coarser locking, never stale
+    # data; entries are few (one per live eval cache)
+    (
+        "controller/fast_eval.py",
+        "lock = self._build_locks.setdefault((id(cache), key), threading.Lock())",
+    ),
+}
+
+
+def _occurrences():
+    found = set()
+    for path in sorted(PACKAGE.rglob("*.py")):
+        rel = path.relative_to(PACKAGE).as_posix()
+        for line in path.read_text(encoding="utf-8").splitlines():
+            if _ID_CALL.search(line):
+                found.add((rel, line.strip()))
+    return found
+
+
+def test_no_new_id_keyed_caches():
+    found = _occurrences()
+    new = found - ALLOWED
+    assert not new, (
+        "new id(...) usage found — id()-keyed caches alias entries when "
+        "an address is reused (the markov_chain stale-mesh bug class); "
+        "hold a weakref and compare identity at lookup instead, or "
+        f"justify an allowlist entry: {sorted(new)}"
+    )
+
+
+def test_allowlist_is_not_stale():
+    """Every allowlisted line must still exist — delete entries when the
+    code they excuse goes away, so the list can only shrink."""
+    found = _occurrences()
+    stale = ALLOWED - found
+    assert not stale, f"allowlist entries no longer in the tree: {sorted(stale)}"
