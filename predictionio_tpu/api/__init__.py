@@ -3,8 +3,11 @@
 The reference implements these as spray/akka actor systems
 (data/src/main/scala/io/prediction/data/api/); here each service is a pure
 request-handling core (`EventAPI`) — directly unit-testable, mirroring the
-reference's spray-testkit route tests — wrapped by a stdlib threading HTTP
-server for deployment. Ingestion is host-side work and never touches the
+reference's spray-testkit route tests — wrapped by an HTTP transport for
+deployment: a single-threaded asyncio event loop by default
+(api/aio_http.py; in-flight requests are awaited futures, not parked
+threads) with the stdlib threading server as the ``transport='threaded'``
+fallback (api/http.py). Ingestion is host-side work and never touches the
 TPU; the store layer hands accumulated events to device-bound columnar
 batches at training time.
 """

@@ -37,19 +37,20 @@ import html
 import json
 import logging
 import queue
+import random
 import secrets
 import string
 import threading
 import time
 import urllib.parse
 import urllib.request
-from typing import Any, Dict, List, Optional, Sequence, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 
 from predictionio_tpu.api.engine_plugins import (
     EngineServerPlugin,
     EngineServerPluginContext,
 )
-from predictionio_tpu.api.http import JsonHTTPServer
+from predictionio_tpu.api.aio_http import TRANSPORTS, make_http_server
 from predictionio_tpu.controller.engine import Engine, EngineParams
 from predictionio_tpu.data.storage import Storage, get_storage
 from predictionio_tpu.utils.serialize import loads_model
@@ -60,10 +61,19 @@ logger = logging.getLogger(__name__)
 
 _ALPHANUMERIC = string.ascii_letters + string.digits
 
+# byte -> alphanumeric translation table: one 64-byte CSPRNG read per
+# prId instead of 64 secrets.choice draws (each a fresh urandom-backed
+# randbelow) on the feedback hot path. The %62 fold weights the first
+# 256%62=8 characters 5/256 vs 4/256 — ~0.04 bit of entropy per char
+# below uniform, irrelevant for a 64-char correlation id.
+_PR_ID_TABLE = bytes(
+    ord(_ALPHANUMERIC[b % len(_ALPHANUMERIC)]) for b in range(256)
+)
+
 
 def _gen_pr_id() -> str:
     """64-char alphanumeric prId (reference CreateServer.scala:525)."""
-    return "".join(secrets.choice(_ALPHANUMERIC) for _ in range(64))
+    return secrets.token_bytes(64).translate(_PR_ID_TABLE).decode("ascii")
 
 
 @dataclasses.dataclass
@@ -99,12 +109,27 @@ class ServerConfig:
     # at depth 2. The packaged templates are pure: deploy them with
     # `--pipeline-depth 2` to overlap device dispatch with result fetch.
     pipeline_depth: int = 1
+    # REST transport: "async" = the event-loop frontend (api/aio_http.py,
+    # in-flight queries are queue entries awaited as futures — the
+    # collector can fill max_batch-sized device batches under load);
+    # "threaded" = the stdlib thread-per-connection fallback.
+    transport: str = "async"
+    # feedback posts queue here when the event server lags; beyond this
+    # the OLDEST pending post is dropped (and counted in status.json's
+    # feedbackQueueDropped) — a down event server must not grow the
+    # queue without bound
+    feedback_queue_max: int = 4096
 
     def __post_init__(self):
         if self.feedback and not self.access_key:
             raise ValueError(
                 "feedback loop requires access_key "
                 "(reference CreateServer.scala:139-143)"
+            )
+        if self.transport not in TRANSPORTS:
+            raise ValueError(
+                f"unknown transport {self.transport!r} "
+                f"(expected one of {TRANSPORTS})"
             )
 
 
@@ -226,16 +251,23 @@ class DeployedEngine:
 class _BatchingExecutor:
     """Coalesces concurrent requests into device-sized batches.
 
-    Request threads enqueue (query, slot) and block; one collector thread
-    drains the queue — waiting up to window_ms after the first arrival —
-    and hands each batch to a serve pool holding up to ``pipeline_depth``
-    batches in flight. The default depth is 1: strictly serial serving,
-    the reference's contract (CreateServer.scala:473-624), safe for
-    engines with mutable predict-time state. Depth 2 (opt-in, see
-    ServerConfig.pipeline_depth) double-buffers: while batch k's result
-    fetch is crossing host<->device (or, on a relay rig, the network),
-    batch k+1 already dispatched and batch k+2 accumulates behind the
-    semaphore — the device never idles waiting on a fetch.
+    Requests enqueue (query, future); one collector thread drains the
+    queue — waiting up to window_ms after the first arrival — and hands
+    each batch to a serve pool holding up to ``pipeline_depth`` batches
+    in flight. ``submit_nowait`` returns the
+    ``concurrent.futures.Future`` directly: the event-loop frontend
+    awaits it, so an in-flight query is a queue entry, not a parked OS
+    thread, and the collector can actually accumulate ``max_batch``-
+    sized device batches under load. ``submit`` is the blocking wrapper
+    the threaded transport (and in-process callers) use.
+
+    The default depth is 1: strictly serial serving, the reference's
+    contract (CreateServer.scala:473-624), safe for engines with mutable
+    predict-time state. Depth 2 (opt-in, see ServerConfig.pipeline_depth)
+    double-buffers: while batch k's result fetch is crossing
+    host<->device (or, on a relay rig, the network), batch k+1 already
+    dispatched and batch k+2 accumulates behind the semaphore — the
+    device never idles waiting on a fetch.
     """
 
     _STOP = object()  # collector-thread shutdown sentinel
@@ -252,23 +284,47 @@ class _BatchingExecutor:
         self._serve_pool = concurrent.futures.ThreadPoolExecutor(
             max_workers=self.pipeline_depth, thread_name_prefix="serve"
         )
+        # collector batch-size accounting (served-group granularity, the
+        # actual device batch): proves micro-batches coalesce under load
+        self._stats_lock = threading.Lock()
+        self._batch_count = 0
+        self._query_count = 0
+        self._batch_hist: Dict[int, int] = {}
 
-    def submit(self, deployed: DeployedEngine, query: Any) -> Any:
-        slot: Dict[str, Any] = {"done": threading.Event()}
+    def submit_nowait(
+        self, deployed: DeployedEngine, query: Any
+    ) -> "concurrent.futures.Future":
+        """Enqueue one query; the returned future resolves to its
+        prediction (or raises its per-query error) once the micro-batch
+        it rides is served."""
+        fut: "concurrent.futures.Future" = concurrent.futures.Future()
         # the closed-check and the enqueue share the lock with close()'s
         # sentinel post, so a request can never land behind _STOP in the
-        # queue (it would block its handler thread forever)
+        # queue (its future would never resolve)
         with self._lock:
             if self._closed:
                 raise RuntimeError("server is shutting down")
             if self._worker is None or not self._worker.is_alive():
                 self._worker = threading.Thread(target=self._run, daemon=True)
                 self._worker.start()
-            self._queue.put((deployed, query, slot))
-        slot["done"].wait()
-        if "error" in slot:
-            raise slot["error"]
-        return slot["result"]
+            self._queue.put((deployed, query, fut))
+        return fut
+
+    def submit(self, deployed: DeployedEngine, query: Any) -> Any:
+        return self.submit_nowait(deployed, query).result()
+
+    def stats(self) -> Dict[str, Any]:
+        """Served-batch accounting: count, mean fill, size histogram."""
+        with self._stats_lock:
+            batches = self._batch_count
+            queries = self._query_count
+            hist = dict(sorted(self._batch_hist.items()))
+        return {
+            "batches": batches,
+            "queries": queries,
+            "batch_fill_mean": (queries / batches) if batches else 0.0,
+            "batch_size_histogram": hist,
+        }
 
     def close(self) -> None:
         """Stop the collector thread and release the serve-pool workers
@@ -312,10 +368,25 @@ class _BatchingExecutor:
                     break
                 batch.append(item)
             # group by deployed engine (a reload may be in flight)
-            groups: Dict[int, List[Tuple[DeployedEngine, Any, dict]]] = {}
+            groups: Dict[int, List[Tuple[DeployedEngine, Any, Any]]] = {}
             for item in batch:
                 groups.setdefault(id(item[0]), []).append(item)
             for items in groups.values():
+                # a future the transport cancelled (client gone before
+                # its batch formed) is dropped here; marking the rest
+                # RUNNING pins them against late cancellation
+                items = [
+                    it for it in items
+                    if it[2].set_running_or_notify_cancel()
+                ]
+                if not items:
+                    continue
+                with self._stats_lock:
+                    self._batch_count += 1
+                    self._query_count += len(items)
+                    self._batch_hist[len(items)] = (
+                        self._batch_hist.get(len(items), 0) + 1
+                    )
                 # blocks while pipeline_depth batches are in flight — the
                 # next batch keeps accumulating in self._queue meanwhile
                 self._inflight.acquire()
@@ -325,14 +396,13 @@ class _BatchingExecutor:
                     )
                 except RuntimeError as e:
                     # pool shut down mid-close (a >join-timeout batch was
-                    # in flight): fail these slots instead of leaving
-                    # their request threads blocked forever
+                    # in flight): fail these futures instead of leaving
+                    # their waiters pending forever
                     self._inflight.release()
-                    for _, _, s in items:
-                        s["error"] = RuntimeError(
-                            f"server is shutting down: {e}"
+                    for _, _, f in items:
+                        f.set_exception(
+                            RuntimeError(f"server is shutting down: {e}")
                         )
-                        s["done"].set()
 
     def _serve_and_release(self, dep: DeployedEngine, items) -> None:
         try:
@@ -347,14 +417,11 @@ class _BatchingExecutor:
         innocent's latency by the batch size)."""
         try:
             results = dep.serve_batch([q for _, q, _ in items])
-            for (_, _, s), r in zip(items, results):
-                s["result"] = r
-                s["done"].set()
+            for (_, _, f), r in zip(items, results):
+                f.set_result(r)
         except Exception as e:
             if len(items) == 1:
-                _, _, s = items[0]
-                s["error"] = e
-                s["done"].set()
+                items[0][2].set_exception(e)
                 return
             mid = len(items) // 2
             self._serve_isolating(dep, items[:mid])
@@ -382,14 +449,33 @@ class QueryAPI:
             self.config.max_batch,
             self.config.pipeline_depth,
         )
+        # non-query routes under the async transport run here, not on
+        # the event loop: /plugins/... executes third-party handle_rest
+        # code of unknown cost, and one blocking call inline on the
+        # single-threaded loop would stall every connection
+        self._route_pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=2, thread_name_prefix="qroutes"
+        )
         self.server_start_time = _dt.datetime.now(_dt.timezone.utc)
         self.request_count = 0
         self.avg_serving_sec = 0.0
         self.last_serving_sec = 0.0
         self._stats_lock = threading.Lock()
+        # serving-latency reservoir (algorithm R, fixed K): p50/p99
+        # estimates for status.json without unbounded sample growth. The
+        # RNG is a plain PRNG — it picks which sample to evict, nothing
+        # security-relevant — and is guarded by _stats_lock.
+        self._lat_reservoir: List[float] = []
+        self._lat_rng = random.Random(0x5EED)
         # feedback posts drain on ONE daemon worker (not a thread per
-        # request — that would throttle the micro-batched hot path)
-        self._feedback_queue: "queue.Queue" = queue.Queue()
+        # request — that would throttle the micro-batched hot path). The
+        # queue is BOUNDED (config.feedback_queue_max): a down event
+        # server drops the oldest pending post instead of growing the
+        # queue without limit; drops are counted for status.json.
+        self._feedback_queue: "queue.Queue" = queue.Queue(
+            maxsize=max(1, self.config.feedback_queue_max)
+        )
+        self._feedback_dropped = 0
         self._feedback_worker: Optional[threading.Thread] = None
         self._feedback_lock = threading.Lock()
         self._feedback_closed = False
@@ -419,18 +505,58 @@ class QueryAPI:
 
     _FEEDBACK_STOP = object()
 
+    # fixed reservoir size: ~0.2 KB of floats, yet p99 of a 512-sample
+    # reservoir is stable to a few percent at serving request rates
+    LAT_RESERVOIR_K = 512
+
     def close(self) -> None:
         """Release serving resources (the batching executor's collector,
         serve-pool, feedback, and upgrade-check threads) when the server
         stops or undeploys."""
         self._upgrade_stop.set()
         self._executor.close()
+        # wait=False: an in-flight route (e.g. /stop itself, whose timer
+        # invoked this close) must not deadlock the teardown
+        self._route_pool.shutdown(wait=False)
         with self._feedback_lock:
             self._feedback_closed = True
             worker = self._feedback_worker
-            self._feedback_queue.put(self._FEEDBACK_STOP)
+            # the queue is bounded now: drain pending posts (they are
+            # best-effort and the server is stopping) so the sentinel
+            # put cannot hit a full queue. Producers hold
+            # _feedback_lock too and check _feedback_closed first, so
+            # nothing can refill the queue between the drain and the
+            # sentinel put.
+            try:
+                while True:
+                    self._feedback_queue.get_nowait()
+            except queue.Empty:
+                pass
+            self._feedback_queue.put_nowait(self._FEEDBACK_STOP)
         if worker is not None and worker.is_alive():
             worker.join(timeout=10.0)
+
+    def _enqueue_feedback(self, item) -> None:
+        """Bounded, drop-oldest enqueue: when the event server lags or
+        is down, the newest prediction wins a slot and the oldest
+        pending post is counted dropped — memory stays bounded. Holds
+        _feedback_lock so it serializes with close()'s drain+sentinel
+        (an enqueue can neither land after the stop sentinel nor drop
+        it)."""
+        with self._feedback_lock:
+            if self._feedback_closed:
+                return  # feedback is best-effort; server is stopping
+            while True:
+                try:
+                    self._feedback_queue.put_nowait(item)
+                    return
+                except queue.Full:
+                    try:
+                        self._feedback_queue.get_nowait()
+                    except queue.Empty:
+                        continue  # the worker drained it; retry the put
+                    with self._stats_lock:
+                        self._feedback_dropped += 1
 
     def _ensure_feedback_worker(self) -> None:
         with self._feedback_lock:
@@ -480,6 +606,40 @@ class QueryAPI:
             logger.exception("internal error handling %s %s", method, path)
             return 500, {"message": str(e)}, "application/json"
 
+    def handle_nowait(
+        self,
+        method: str,
+        path: str,
+        query: Optional[Dict[str, str]] = None,
+        body: Optional[bytes] = None,
+        form: Optional[Dict[str, str]] = None,
+    ) -> Union[Tuple[int, Any, str], "concurrent.futures.Future"]:
+        """Transport-facing dispatch for the event-loop frontend
+        (api/aio_http.py): the /queries.json hot path returns a
+        ``concurrent.futures.Future`` resolving to a
+        (status, payload, content_type) tuple, so an in-flight query is
+        a micro-batch queue entry — not a parked OS thread; every other
+        route is offloaded to a small pool (plugin handle_rest code has
+        unknown cost and must not run inline on the loop) whose future
+        the loop awaits the same way. Parse errors answer inline."""
+        if path == "/queries.json" and method == "POST":
+            try:
+                return self._handle_query_nowait(body)
+            except Exception as e:
+                logger.exception(
+                    "internal error handling POST /queries.json"
+                )
+                return 500, {"message": str(e)}, "application/json"
+        try:
+            return self._route_pool.submit(
+                self.handle, method, path, query, body
+            )
+        except RuntimeError:  # pool shut down: server is stopping
+            return (
+                503, {"message": "server is shutting down"},
+                "application/json",
+            )
+
     def _route(self, method, path, query, body) -> Tuple[int, Any, str]:
         parts = [p for p in path.strip("/").split("/") if p]
         if not parts and method == "GET":
@@ -515,6 +675,18 @@ class QueryAPI:
     # --- the hot path (reference CreateServer.scala:473-624) ---
 
     def _handle_query(self, body: Optional[bytes]) -> Tuple[int, Any, str]:
+        result = self._handle_query_nowait(body)
+        if isinstance(result, concurrent.futures.Future):
+            return result.result()
+        return result
+
+    def _handle_query_nowait(
+        self, body: Optional[bytes]
+    ) -> Union[Tuple[int, Any, str], "concurrent.futures.Future"]:
+        """Parse + enqueue; the returned future completes (via the
+        serve-pool thread that resolves the prediction, so feedback,
+        plugins, and bookkeeping stay off the event loop) when the
+        query's micro-batch is served. Parse errors answer inline."""
         serving_start = time.perf_counter()
         deployed = self.deployed  # snapshot against concurrent reload
         algorithms = deployed.algorithms
@@ -526,8 +698,43 @@ class QueryAPI:
             logger.error("query %r is invalid: %s", body, e)
             return 400, {"message": str(e)}, "application/json"
 
-        prediction = self._executor.submit(deployed, query)
-        prediction_json = algorithms[0].result_to_json(prediction)
+        prediction_fut = self._executor.submit_nowait(deployed, query)
+        out: "concurrent.futures.Future" = concurrent.futures.Future()
+
+        def _finish(f: "concurrent.futures.Future") -> None:
+            try:
+                result = self._finish_query(
+                    deployed, query, query_json, f.result(), query_time,
+                    serving_start,
+                )
+            except concurrent.futures.CancelledError:
+                return  # request was cancelled before its batch formed
+            except Exception as e:
+                logger.exception(
+                    "internal error handling POST /queries.json"
+                )
+                result = (500, {"message": str(e)}, "application/json")
+            try:
+                out.set_result(result)
+            except concurrent.futures.InvalidStateError:
+                pass  # the transport cancelled the request (client gone)
+
+        prediction_fut.add_done_callback(_finish)
+
+        def _propagate_cancel(f: "concurrent.futures.Future") -> None:
+            if f.cancelled():
+                # client went away: if the query has not been picked up
+                # into a batch yet, drop it from the collector entirely
+                prediction_fut.cancel()
+
+        out.add_done_callback(_propagate_cancel)
+        return out
+
+    def _finish_query(
+        self, deployed, query, query_json, prediction, query_time,
+        serving_start,
+    ) -> Tuple[int, Any, str]:
+        prediction_json = deployed.algorithms[0].result_to_json(prediction)
 
         if self.config.feedback:
             prediction_json = self._feedback(
@@ -549,6 +756,13 @@ class QueryAPI:
                 self.avg_serving_sec * self.request_count + elapsed
             ) / (self.request_count + 1)
             self.request_count += 1
+            # reservoir sample (algorithm R) for the p50/p99 estimates
+            if len(self._lat_reservoir) < self.LAT_RESERVOIR_K:
+                self._lat_reservoir.append(elapsed)
+            else:
+                j = self._lat_rng.randrange(self.request_count)
+                if j < self.LAT_RESERVOIR_K:
+                    self._lat_reservoir[j] = elapsed
         return 200, prediction_json, "application/json"
 
     # --- feedback loop (reference CreateServer.scala:509-579) ---
@@ -579,7 +793,7 @@ class QueryAPI:
             f"{self.config.event_server_port}/events.json?"
             + urllib.parse.urlencode({"accessKey": self.config.access_key})
         )
-        self._feedback_queue.put((url, data))
+        self._enqueue_feedback((url, data))
         self._ensure_feedback_worker()
 
         # inject the fresh prId into the response if the result carries one
@@ -589,9 +803,20 @@ class QueryAPI:
 
     # --- status page (reference CreateServer.scala:444-471 html.index) ---
 
+    @staticmethod
+    def _pctl(sorted_values: List[float], q: float) -> float:
+        if not sorted_values:
+            return 0.0
+        idx = min(
+            len(sorted_values) - 1, int(round(q * (len(sorted_values) - 1)))
+        )
+        return sorted_values[idx]
+
     def _status_json(self) -> dict:
         inst = self.deployed.engine_instance
+        batch_stats = self._executor.stats()
         with self._stats_lock:
+            lat = sorted(self._lat_reservoir)
             return {
                 "status": "alive",
                 "engineInstanceId": inst.id,
@@ -608,6 +833,16 @@ class QueryAPI:
                 "requestCount": self.request_count,
                 "avgServingSec": self.avg_serving_sec,
                 "lastServingSec": self.last_serving_sec,
+                # reservoir-estimated latency percentiles (LAT_RESERVOIR_K
+                # samples under _stats_lock) alongside the running average
+                "p50ServingSec": self._pctl(lat, 0.50),
+                "p99ServingSec": self._pctl(lat, 0.99),
+                # collector batch accounting: does micro-batching engage?
+                "batchFillMean": round(batch_stats["batch_fill_mean"], 3),
+                "batchSizeHistogram": batch_stats["batch_size_histogram"],
+                # bounded feedback queue (drop-oldest when the event
+                # server lags; see ServerConfig.feedback_queue_max)
+                "feedbackQueueDropped": self._feedback_dropped,
                 # daily self-check (reference CreateServer.scala:253-260)
                 "upgradeStatus": self._upgrade_status,
                 "upgradeLastChecked": self._upgrade_checked_at,
@@ -628,10 +863,11 @@ class QueryAPI:
         )
 
 
-class EngineServer(JsonHTTPServer):
+class EngineServer:
     """The MasterActor equivalent (reference CreateServer.scala:262-384):
-    binds the HTTP server, hot-swaps serving state on /reload, undeploys on
-    /stop."""
+    binds the HTTP frontend (event-loop by default, thread-per-connection
+    via ``ServerConfig.transport='threaded'``), hot-swaps serving state
+    on /reload, undeploys on /stop."""
 
     def __init__(
         self,
@@ -659,12 +895,32 @@ class EngineServer(JsonHTTPServer):
         def handle(method, path, query, body, form=None):
             return self.api.handle(method, path, query, body)
 
-        super().__init__(
-            handle, self.config.ip, self.config.port, "Engine Server"
+        def handle_nowait(method, path, query, body, form=None):
+            return self.api.handle_nowait(method, path, query, body)
+
+        # the event loop awaits the query route's future; the threaded
+        # frontend cannot await, so it gets the blocking dispatch
+        fn = (
+            handle_nowait if self.config.transport == "async" else handle
+        )
+        self._http = make_http_server(
+            fn, self.config.ip, self.config.port, "Engine Server",
+            transport=self.config.transport,
         )
 
+    @property
+    def port(self) -> int:
+        return self._http.port
+
+    def start(self) -> "EngineServer":
+        self._http.start()
+        return self
+
+    def serve_forever(self) -> None:
+        self._http.serve_forever()
+
     def shutdown(self) -> None:
-        super().shutdown()
+        self._http.shutdown()
         self.api.close()
 
     def reload(self) -> None:

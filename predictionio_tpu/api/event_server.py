@@ -31,6 +31,7 @@ event data later, as columnar batches from the store layer.
 
 from __future__ import annotations
 
+import concurrent.futures
 import dataclasses
 import json
 import logging
@@ -38,7 +39,7 @@ import urllib.parse
 import weakref
 from typing import Any, Dict, Optional, Tuple
 
-from predictionio_tpu.api.http import JsonHTTPServer
+from predictionio_tpu.api.aio_http import TRANSPORTS, make_http_server
 
 from predictionio_tpu.data.event import (
     Event,
@@ -95,6 +96,24 @@ class EventServerConfig:
     # disables caching — every request reads the metadata store, the
     # reference's per-request behavior.
     auth_ttl_s: float = 5.0
+    # REST transport: "async" = the event-loop frontend (api/aio_http.py)
+    # — connections cost no OS threads; request handlers run on a
+    # BOUNDED pool (handler_threads) because the insert path blocks
+    # until its group-commit COMMIT acks. "threaded" = the stdlib
+    # thread-per-connection fallback.
+    transport: str = "async"
+    # async-transport handler pool size: the ceiling on in-flight
+    # (parked-on-COMMIT) requests. The group committer coalesces
+    # everything queued within GROUP_COMMIT_MS, so a modest pool
+    # saturates the write path; connections beyond it just queue.
+    handler_threads: int = 16
+
+    def __post_init__(self):
+        if self.transport not in TRANSPORTS:
+            raise ValueError(
+                f"unknown transport {self.transport!r} "
+                f"(expected one of {TRANSPORTS})"
+            )
 
 
 def _message(status: int, message: str) -> Tuple[int, dict]:
@@ -467,8 +486,15 @@ class EventAPI:
         return self._insert(app_id, channel_id, event)
 
 
-class EventServer(JsonHTTPServer):
-    """HTTP wrapper (reference EventServerActor + Run, EventServer.scala:471-531)."""
+class EventServer:
+    """HTTP wrapper (reference EventServerActor + Run, EventServer.scala:471-531).
+
+    With the default async transport, every route is offloaded to a
+    bounded handler pool and the event loop awaits the returned future:
+    an idle keep-alive connection costs no thread, and the threads that
+    do exist are parked exactly where the work is (the group-commit
+    COMMIT wait), which is what the committer wants — many requests
+    queued inside one flush window."""
 
     def __init__(
         self,
@@ -478,10 +504,43 @@ class EventServer(JsonHTTPServer):
     ):
         self.config = config or EventServerConfig()
         self.api = EventAPI(storage, self.config, plugin_context)
-        super().__init__(
-            self.api.handle, self.config.ip, self.config.port,
-            "Event Server", reuse_port=self.config.reuse_port,
+        self._pool: Optional[concurrent.futures.ThreadPoolExecutor] = None
+        if self.config.transport == "async":
+            self._pool = concurrent.futures.ThreadPoolExecutor(
+                max_workers=max(1, self.config.handler_threads),
+                thread_name_prefix="evhandler",
+            )
+            pool = self._pool
+
+            def fn(method, path, query, body, form=None):
+                return pool.submit(
+                    self.api.handle, method, path, query, body, form
+                )
+        else:
+            fn = self.api.handle
+        self._http = make_http_server(
+            fn, self.config.ip, self.config.port, "Event Server",
+            reuse_port=self.config.reuse_port,
+            transport=self.config.transport,
         )
+
+    @property
+    def port(self) -> int:
+        return self._http.port
+
+    def start(self) -> "EventServer":
+        self._http.start()
+        return self
+
+    def serve_forever(self) -> None:
+        self._http.serve_forever()
+
+    def shutdown(self) -> None:
+        self._http.shutdown()
+        if self._pool is not None:
+            # wait=False: a handler parked on a wedged COMMIT must not
+            # hang undeploy (same contract as the batching executor)
+            self._pool.shutdown(wait=False)
 
 
 def create_event_server(

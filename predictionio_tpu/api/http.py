@@ -24,6 +24,12 @@ logger = logging.getLogger(__name__)
 # (method, path, query, body, form) -> (status, payload[, content_type])
 HandleFn = Callable[..., Tuple]
 
+# request-body ceiling shared by both transports (threaded here, the
+# event loop in api/aio_http.py): a hostile Content-Length must not make
+# a frontend buffer gigabytes. Largest legitimate body is a 50-event
+# batch post — a few hundred KB.
+MAX_BODY_BYTES = 16 * 1024 * 1024
+
 
 class _Server(ThreadingHTTPServer):
     # the stdlib default backlog (5) drops connections under concurrent
@@ -78,8 +84,14 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             length = int(self.headers.get("Content-Length") or 0)
         except ValueError:
-            length = 0
             self.close_connection = True
+            self.send_error(400, "invalid Content-Length")
+            return
+        if length > MAX_BODY_BYTES:
+            # refuse BEFORE reading (the async frontend does the same)
+            self.close_connection = True
+            self.send_error(413, "request body too large")
+            return
         body = self.rfile.read(length) if length > 0 else b""
         # form-encoded bodies are parsed as a convenience, but the raw body
         # is kept too: clients (curl -d) often post JSON without setting
@@ -118,12 +130,39 @@ class _Handler(BaseHTTPRequestHandler):
         logger.debug("%s - %s", self.address_string(), fmt % args)
 
 
+def bind_with_retries(attempt_fn: Callable, name: str, ip: str, port: int):
+    """Shared bind policy for BOTH transports (this threaded server and
+    the event-loop frontend in api/aio_http.py): run ``attempt_fn``
+    (which binds and returns a server or socket) up to
+    ``JsonHTTPServer.BIND_RETRIES`` times, ``BIND_RETRY_DELAY_S`` apart
+    (reference CreateServer.scala:347-357 retries the spray bind 3x,
+    1s apart — covers the undeploy-then-redeploy race where the old
+    server's port lingers in TIME_WAIT). ``ReusePortUnavailable`` is
+    permanent and never retried; a plain OSError is treated as a
+    transient port conflict. The tunables stay class attributes on
+    JsonHTTPServer (read at call time) so operational overrides cover
+    both transports."""
+    last_error: Optional[OSError] = None
+    for attempt in range(JsonHTTPServer.BIND_RETRIES):
+        try:
+            return attempt_fn()
+        except ReusePortUnavailable:
+            raise  # permanent: retrying cannot make the option appear
+        except OSError as e:
+            last_error = e
+            logger.warning(
+                "%s bind to %s:%d failed (%s); retry %d/%d",
+                name, ip, port, e, attempt + 1,
+                JsonHTTPServer.BIND_RETRIES,
+            )
+            time.sleep(JsonHTTPServer.BIND_RETRY_DELAY_S)
+    raise last_error
+
+
 class JsonHTTPServer:
     """Threaded HTTP server around a request-core callable.
 
-    Binding retries (reference CreateServer.scala:347-357 retries the
-    spray bind 3x, 1s apart — covers the undeploy-then-redeploy race
-    where the old server's port lingers in TIME_WAIT).
+    Binding retries via ``bind_with_retries`` above.
     """
 
     BIND_RETRIES = 3
@@ -151,22 +190,9 @@ class JsonHTTPServer:
         # a worker that silently bound without it would steal the port
         # from its siblings.
         server_cls = _ReusePortServer if reuse_port else _Server
-        last_error: Optional[OSError] = None
-        for attempt in range(self.BIND_RETRIES):
-            try:
-                self.httpd = server_cls((ip, port), handler)
-                break
-            except ReusePortUnavailable:
-                raise  # permanent: retrying cannot make the option appear
-            except OSError as e:
-                last_error = e
-                logger.warning(
-                    "%s bind to %s:%d failed (%s); retry %d/%d",
-                    name, ip, port, e, attempt + 1, self.BIND_RETRIES,
-                )
-                time.sleep(self.BIND_RETRY_DELAY_S)
-        else:
-            raise last_error
+        self.httpd = bind_with_retries(
+            lambda: server_cls((ip, port), handler), name, ip, port
+        )
         self._thread: Optional[threading.Thread] = None
 
     @property

@@ -225,6 +225,7 @@ def cmd_deploy(args) -> int:
         batch_window_ms=args.batch_window_ms,
         max_batch=args.max_batch,
         pipeline_depth=args.pipeline_depth,
+        transport=args.transport,
     )
     server = create_server(engine, config)
     print(f"Engine server serving on {args.ip}:{server.port}")
@@ -292,6 +293,7 @@ def cmd_eventserver(args) -> int:
             sys.executable, "-m", "predictionio_tpu.tools.cli",
             "eventserver", "--ip", args.ip, "--port", str(args.port),
             "--workers", "1", "--reuse-port",
+            "--transport", args.transport,
         ]
         if args.stats:
             cmd.append("--stats")
@@ -348,6 +350,7 @@ def cmd_eventserver(args) -> int:
         EventServerConfig(
             ip=args.ip, port=args.port, stats=args.stats,
             reuse_port=bool(getattr(args, "reuse_port", False)),
+            transport=args.transport,
         )
     )
     print(f"Event server serving on {args.ip}:{server.port}")
@@ -707,6 +710,13 @@ def build_parser() -> argparse.ArgumentParser:
         "with no mutable predict-time state, like the packaged "
         "templates; see ServerConfig.pipeline_depth)",
     )
+    deploy.add_argument(
+        "--transport", choices=("async", "threaded"), default="async",
+        help="REST frontend: 'async' = single-threaded event loop with "
+        "future-based micro-batch handoff (in-flight queries are queue "
+        "entries, thousands of connections cost no OS threads); "
+        "'threaded' = stdlib thread-per-connection fallback",
+    )
     deploy.set_defaults(func=cmd_deploy)
 
     undeploy = sub.add_parser("undeploy", help="stop a deployed server")
@@ -727,6 +737,11 @@ def build_parser() -> argparse.ArgumentParser:
     es.add_argument(
         "--reuse-port", action="store_true",
         help="bind with SO_REUSEPORT (set automatically for workers)",
+    )
+    es.add_argument(
+        "--transport", choices=("async", "threaded"), default="async",
+        help="REST frontend: 'async' = event loop + bounded handler "
+        "pool; 'threaded' = stdlib thread-per-connection fallback",
     )
     es.set_defaults(func=cmd_eventserver)
 

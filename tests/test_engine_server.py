@@ -467,6 +467,89 @@ class TestFeedbackLoop:
             ServerConfig(feedback=True)
 
 
+class TestServingSatellites:
+    def test_gen_pr_id_64_alnum_and_distinct(self):
+        import string as _string
+
+        from predictionio_tpu.api.engine_server import _gen_pr_id
+
+        alnum = set(_string.ascii_letters + _string.digits)
+        ids = {_gen_pr_id() for _ in range(32)}
+        assert len(ids) == 32  # no collisions across draws
+        for pr_id in ids:
+            assert len(pr_id) == 64
+            assert set(pr_id) <= alnum
+
+    def test_feedback_queue_drops_oldest_and_counts(self, query_api):
+        """A down event server must not grow the feedback queue without
+        bound: beyond feedback_queue_max the OLDEST post is dropped and
+        the drop is surfaced in status.json."""
+        query_api.config.feedback_queue_max = 4
+        # rebuild the queue at the smaller bound (config was read at init)
+        import queue as _queue
+
+        query_api._feedback_queue = _queue.Queue(maxsize=4)
+        for n in range(7):
+            query_api._enqueue_feedback(("url", {"n": n}))
+        assert query_api._feedback_queue.qsize() == 4
+        kept = [
+            query_api._feedback_queue.get_nowait()[1]["n"] for _ in range(4)
+        ]
+        assert kept == [3, 4, 5, 6]  # newest survive
+        _, status, _ = query_api.handle("GET", "/status.json")
+        assert status["feedbackQueueDropped"] == 3
+
+    def test_close_with_full_feedback_queue_does_not_deadlock(
+        self, query_api
+    ):
+        import queue as _queue
+
+        query_api._feedback_queue = _queue.Queue(maxsize=2)
+        query_api._enqueue_feedback(("url", {"n": 0}))
+        query_api._enqueue_feedback(("url", {"n": 1}))
+        t0 = time.time()
+        query_api.close()
+        assert time.time() - t0 < 5.0
+
+    def test_status_reports_latency_percentiles_and_batch_histogram(
+        self, query_api
+    ):
+        for qx in range(20):
+            status, _, _ = query_api.handle(
+                "POST", "/queries.json", body=json.dumps({"qx": qx}).encode()
+            )
+            assert status == 200
+        _, s, _ = query_api.handle("GET", "/status.json")
+        assert s["requestCount"] == 20
+        assert 0 < s["p50ServingSec"] <= s["p99ServingSec"]
+        # percentile estimates come from a bounded reservoir
+        assert len(query_api._lat_reservoir) <= query_api.LAT_RESERVOIR_K
+        hist = s["batchSizeHistogram"]
+        assert sum(size * count for size, count in hist.items()) == 20
+        assert s["batchFillMean"] >= 1.0
+
+    def test_handle_nowait_returns_future_for_queries(self, query_api):
+        import concurrent.futures as cf
+
+        result = query_api.handle_nowait(
+            "POST", "/queries.json", body=json.dumps({"qx": 3}).encode()
+        )
+        assert isinstance(result, cf.Future)
+        status, body, ctype = result.result(timeout=5)
+        assert status == 200 and body["qx"] == 3
+
+    def test_handle_nowait_parse_error_answers_inline(self, query_api):
+        result = query_api.handle_nowait(
+            "POST", "/queries.json", body=b"not json"
+        )
+        assert isinstance(result, tuple)
+        assert result[0] == 400
+
+    def test_transport_config_validated(self):
+        with pytest.raises(ValueError, match="transport"):
+            ServerConfig(transport="carrier-pigeon")
+
+
 class TestReloadAndHTTP:
     def test_http_roundtrip_and_reload(self, mem_storage):
         fe.reset_counters()

@@ -730,9 +730,11 @@ class TestWebhookRoutes:
 
 
 class TestHTTPServer:
-    """One end-to-end socket test over the stdlib server wrapper."""
+    """End-to-end socket tests over both transport frontends (the
+    event-loop default and the stdlib threaded fallback)."""
 
-    def test_post_and_get_over_http(self, mem_storage):
+    @pytest.mark.parametrize("transport", ["async", "threaded"])
+    def test_post_and_get_over_http(self, mem_storage, transport):
         apps = mem_storage.get_meta_data_apps()
         app_id = apps.insert(App(id=0, name="httpapp"))
         mem_storage.get_meta_data_access_keys().insert(
@@ -740,7 +742,8 @@ class TestHTTPServer:
         )
         mem_storage.get_l_events().init(app_id)
         server = EventServer(
-            storage=mem_storage, config=EventServerConfig(port=0)
+            storage=mem_storage,
+            config=EventServerConfig(port=0, transport=transport),
         ).start()
         try:
             base = f"http://localhost:{server.port}"

@@ -1,4 +1,5 @@
-"""Tier-1 source lint: ban new ``id(...)``-keyed caches.
+"""Tier-1 source lints: ban new ``id(...)``-keyed caches, and ban
+blocking calls inside ``async def`` coroutines in ``api/``.
 
 The bug class (PR 1's markov_chain stale-mesh fix): keying a cache or
 registry by ``id(obj)`` silently aliases entries when the object dies
@@ -83,3 +84,90 @@ def test_allowlist_is_not_stale():
     found = _occurrences()
     stale = ALLOWED - found
     assert not stale, f"allowlist entries no longer in the tree: {sorted(stale)}"
+
+
+# --- blocking calls inside event-loop coroutines (api/ only) ---
+#
+# The bug class (this round's serving-frontend rework): a coroutine on
+# the single-threaded asyncio frontend that calls ``time.sleep``, parks
+# on an Event/Future ``.wait()``, or blocks in ``Future.result()``
+# freezes EVERY connection the loop is serving — exactly the
+# thread-parked handoff (``slot["done"].wait()``) the event loop
+# replaced, except now it stalls the whole server instead of one
+# thread. The sanctioned idioms are ``await asyncio.sleep``,
+# ``await asyncio.wrap_future(fut)``, and handing blocking work to an
+# executor pool that returns a future the loop awaits.
+
+_BLOCKING_METHOD_NAMES = {"sleep", "wait", "result"}
+
+# (relative path, lineno-independent stripped source line) pairs
+# reviewed as safe. Empty today — the async frontend awaits everything;
+# add entries only with a justification in your PR.
+ASYNC_BLOCKING_ALLOWED: set = set()
+
+
+def _async_blocking_occurrences():
+    import ast
+
+    found = set()
+    api_dir = PACKAGE / "api"
+    for path in sorted(api_dir.rglob("*.py")):
+        rel = ("api/" + path.relative_to(api_dir).as_posix())
+        source = path.read_text(encoding="utf-8")
+        lines = source.splitlines()
+        tree = ast.parse(source, filename=str(path))
+        # mark every call that is directly awaited — those are fine
+        awaited_calls = {
+            id(node.value)
+            for node in ast.walk(tree)
+            if isinstance(node, ast.Await)
+        }
+
+        def scan_async_body(node):
+            """Walk an async function's own statements, NOT nested sync
+            defs (their bodies run on whatever thread later calls them,
+            e.g. executor callbacks — legal places to block)."""
+            import ast as _ast
+
+            for child in _ast.iter_child_nodes(node):
+                if isinstance(
+                    child, (_ast.FunctionDef, _ast.Lambda)
+                ):
+                    continue
+                if isinstance(child, _ast.Call) and id(child) not in awaited_calls:
+                    fn = child.func
+                    name = None
+                    if isinstance(fn, _ast.Attribute):
+                        name = fn.attr
+                    elif isinstance(fn, _ast.Name):
+                        name = fn.id
+                    if name in _BLOCKING_METHOD_NAMES:
+                        found.add((rel, lines[child.lineno - 1].strip()))
+                scan_async_body(child)
+
+        for node in ast.walk(tree):
+            if isinstance(node, ast.AsyncFunctionDef):
+                scan_async_body(node)
+    return found
+
+
+def test_no_blocking_calls_in_api_coroutines():
+    found = _async_blocking_occurrences()
+    new = found - ASYNC_BLOCKING_ALLOWED
+    assert not new, (
+        "blocking call inside an async def in api/ — time.sleep / "
+        ".wait() / .result() on the event loop stalls every connection "
+        "the loop serves (the thread-parked handoff bug class the async "
+        "frontend replaced); await the async equivalent "
+        "(asyncio.sleep / wrap_future) or justify an "
+        f"ASYNC_BLOCKING_ALLOWED entry: {sorted(new)}"
+    )
+
+
+def test_async_blocking_allowlist_is_not_stale():
+    found = _async_blocking_occurrences()
+    stale = ASYNC_BLOCKING_ALLOWED - found
+    assert not stale, (
+        f"async-blocking allowlist entries no longer in the tree: "
+        f"{sorted(stale)}"
+    )
