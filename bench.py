@@ -187,6 +187,9 @@ _SUMMARY_FIELDS = {
         "value", "single_event_events_per_sec",
     ),
     "concurrent_ingest_events_per_sec": ("value", "shards"),
+    "segment_scan_events_per_sec": (
+        "value", "row_scan_events_per_sec", "speedup_vs_row_store",
+    ),
     "als_ml20m_train_wall_clock": (
         "value", "device_loop_s", "loop_vs_roofline", "device_put_s",
         "wire_mb",
@@ -1546,6 +1549,126 @@ def bench_kfold_cv(device_name):
     )
 
 
+# --- config 7c: compacted segment tier scan rate (sqlite) ---
+
+
+def bench_segment_scan(device_name):
+    """Training-scan throughput of the 1M-event sqlite ROW store before
+    and after LSM-style compaction into immutable columnar segments
+    (data/storage/segments.py). The row store decodes sqlite pages and
+    evaluates the value rule in SQL per row; a compacted store streams
+    np.frombuffer batches off mmap'd segment files through the SAME
+    ``stream_columns_native`` fan-out, wire byte-identical. Headline
+    ``segment_scan_events_per_sec`` (warm, page-cache-resident — the
+    retrain steady state); acceptance gate is >= 2x the row-store rate.
+    """
+    import datetime as dt
+    import shutil
+    import tempfile
+
+    from predictionio_tpu.data.event import Event
+    from predictionio_tpu.data.storage import Storage
+    from predictionio_tpu.data.storage.base import App
+    from predictionio_tpu.data.storage.segments import CompactionPolicy
+    from predictionio_tpu.models.recommendation.engine import RATING_SPEC
+
+    n_events = int(os.environ.get("BENCH_SEGMENT_EVENTS", 1_000_000))
+    n_users, n_items = 50_000, 5_000
+    tmp = tempfile.mkdtemp(prefix="bench_seg_")
+    try:
+        storage = Storage(
+            {
+                "PIO_STORAGE_SOURCES_SQLITE_TYPE": "sqlite",
+                "PIO_STORAGE_SOURCES_SQLITE_PATH": os.path.join(tmp, "s.db"),
+                # seeding 1M rows is setup, not the measurement: big
+                # committer units keep it to a handful of transactions
+                "PIO_STORAGE_SOURCES_SQLITE_GROUP_COMMIT_EVENTS": "65536",
+                "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "SQLITE",
+                "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "SQLITE",
+                "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "SQLITE",
+            }
+        )
+        storage.get_meta_data_apps().insert(App(id=0, name="seg"))
+        le = storage.get_l_events()
+        le.init(1)
+        rng = np.random.default_rng(17)
+        u = rng.integers(0, n_users, n_events)
+        i = rng.integers(0, n_items, n_events)
+        # half-star ratings: float32-exact, so every row qualifies for
+        # the columnar seal
+        r = (rng.integers(1, 11, n_events) / 2.0).astype(np.float32)
+        when = dt.datetime(2026, 1, 1, tzinfo=dt.timezone.utc)
+        t0 = time.perf_counter()
+        chunk = 100_000
+        for s in range(0, n_events, chunk):
+            le.insert_batch(
+                [
+                    Event(
+                        event="rate",
+                        entity_type="user",
+                        entity_id=f"u{u[j]}",
+                        target_entity_type="item",
+                        target_entity_id=f"i{i[j]}",
+                        properties={"rating": float(r[j])},
+                        event_time=when + dt.timedelta(seconds=int(j)),
+                    )
+                    for j in range(s, min(s + chunk, n_events))
+                ],
+                1,
+            )
+        seed_s = time.perf_counter() - t0
+
+        scan_kwargs = dict(
+            value_spec=RATING_SPEC,
+            entity_type="user",
+            target_entity_type="item",
+            event_names=["rate", "buy"],
+        )
+
+        def scan_rate():
+            t0 = time.perf_counter()
+            stream = le.stream_columns_native(1, **scan_kwargs)
+            total = 0
+            for e, g, v in stream:
+                total += len(v)
+            _ = stream.names
+            return total, n_events / (time.perf_counter() - t0)
+
+        n_row, _ = scan_rate()  # warm the page cache
+        assert n_row == n_events, (n_row, n_events)
+        _, row_rate = scan_rate()
+
+        t0 = time.perf_counter()
+        result = le.compact_app(
+            1,
+            policy=CompactionPolicy(
+                cold_s=0.0, min_events=1, grace_s=0.0
+            ),
+        )
+        compact_s = time.perf_counter() - t0
+        n_seg, seg_cold_rate = scan_rate()
+        assert n_seg == n_events, (n_seg, n_events)
+        _, seg_rate = scan_rate()
+        emit(
+            {
+                "metric": "segment_scan_events_per_sec",
+                "unit": "events/s",
+                "value": round(seg_rate),
+                "segment_scan_cold_events_per_sec": round(seg_cold_rate),
+                "row_scan_events_per_sec": round(row_rate),
+                "speedup_vs_row_store": round(seg_rate / row_rate, 2),
+                "events": n_events,
+                "sealed_events": result["sealed_events"],
+                "segments": result["segments"],
+                "compact_s": round(compact_s, 3),
+                "seed_s": round(seed_s, 3),
+                "device": device_name,
+            }
+        )
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 BENCHES = {
     "recommendation": bench_recommendation,
     "classification": bench_classification,
@@ -1556,6 +1679,7 @@ BENCHES = {
     "ml20m_store": bench_ml20m_store,
     "ingestion": bench_ingestion,
     "concurrent_ingest": bench_concurrent_ingest,
+    "segment_scan": bench_segment_scan,
 }
 
 

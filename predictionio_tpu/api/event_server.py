@@ -107,6 +107,14 @@ class EventServerConfig:
     # everything queued within GROUP_COMMIT_MS, so a modest pool
     # saturates the write path; connections beyond it just queue.
     handler_threads: int = 16
+    # background segment compaction (data/storage/segments.py): the
+    # event server owns the write path, so it owns sealing cold row
+    # ranges into mmap-scannable columnar segments too. A no-op on
+    # backends without the tier (memory/http). False disables the
+    # daemon (`pio eventserver --no-compact`); standalone compaction
+    # stays available via `pio compact`.
+    compact: bool = True
+    compact_interval_s: float = 60.0
 
     def __post_init__(self):
         if self.transport not in TRANSPORTS:
@@ -160,6 +168,14 @@ class EventAPI:
         # deletes invalidate immediately (invalidate_access_key below).
         self._auth_cache: Dict[str, Tuple[float, Any]] = {}
         self._AUTH_TTL_S = float(self.config.auth_ttl_s)
+        import time as _time
+
+        self._started_monotonic = _time.monotonic()
+        from predictionio_tpu.data.storage.segments import (
+            CachedCompactionStatus,
+        )
+
+        self._compaction_status = CachedCompactionStatus(self.storage)
         _LIVE_APIS.add(self)
 
     # --- auth (reference withAccessKey, EventServer.scala:81-107) ---
@@ -241,6 +257,9 @@ class EventAPI:
         if path == "/plugins.json" and method == "GET":
             return 200, self.plugin_context.describe()
 
+        if path == "/status.json" and method == "GET":
+            return 200, self._status_json(query)
+
         if parts[0] == "plugins" and len(parts) >= 3 and method == "GET":
             auth, err = self._authenticate(query)
             if err:
@@ -321,6 +340,56 @@ class EventAPI:
             return self._webhook_form(app_id, channel_id, name, method, form)
 
         return _message(404, "Not Found")
+
+    def _status_json(self, query: Optional[Dict[str, str]] = None) -> dict:
+        """Operational status (the engine server's status.json
+        counterpart): uptime, transport, and segment-tier observability
+        — segment count, compacted-event fraction, last-compaction
+        timestamp (stats TTL-cached, ``CachedCompactionStatus``).
+
+        The route itself stays unauthenticated (a health probe), but
+        without a valid ``accessKey`` the compaction block is the
+        cross-app AGGREGATE only — per-app names and counts are the
+        same class of information the rest of the API gates behind
+        keys. A valid key adds its own app's detail."""
+        import time as _time
+
+        per_app = self._compaction_status.get()
+        out = {
+            "status": "alive",
+            "transport": self.config.transport,
+            "uptimeSec": round(
+                _time.monotonic() - self._started_monotonic, 3
+            ),
+            "compaction": {
+                "apps": len(per_app),
+                "segments": sum(s["segments"] for s in per_app.values()),
+                "compactedEvents": sum(
+                    s["segmentEvents"] for s in per_app.values()
+                ),
+                "lastCompactionMs": max(
+                    (s["lastCompactionMs"] for s in per_app.values()),
+                    default=0,
+                ),
+            },
+        }
+        key = (query or {}).get("accessKey")
+        if key:
+            access_key = self._lookup_access_key(key)
+            if access_key is not None:
+                app = self.storage.get_meta_data_apps().get(access_key.appid)
+                s = per_app.get(app.name) if app else None
+                if s is not None:
+                    out["appCompaction"] = {
+                        "app": app.name,
+                        "segments": s["segments"],
+                        "compactedEvents": s["segmentEvents"],
+                        "compactedFraction": round(
+                            s["compactedFraction"], 6
+                        ),
+                        "lastCompactionMs": s["lastCompactionMs"],
+                    }
+        return out
 
     # --- event handlers ---
 
@@ -504,6 +573,20 @@ class EventServer:
     ):
         self.config = config or EventServerConfig()
         self.api = EventAPI(storage, self.config, plugin_context)
+        # background compactor: seals cold row ranges into columnar
+        # segments while the server ingests (no-op for backends without
+        # the tier). Owned here so shutdown stops it with the server.
+        self.compactor = None
+        if self.config.compact:
+            from predictionio_tpu.data.storage.segments import (
+                SegmentCompactor,
+            )
+
+            if SegmentCompactor.supported(self.api.storage):
+                self.compactor = SegmentCompactor(
+                    self.api.storage,
+                    interval_s=self.config.compact_interval_s,
+                )
         self._pool: Optional[concurrent.futures.ThreadPoolExecutor] = None
         if self.config.transport == "async":
             self._pool = concurrent.futures.ThreadPoolExecutor(
@@ -530,12 +613,18 @@ class EventServer:
 
     def start(self) -> "EventServer":
         self._http.start()
+        if self.compactor is not None:
+            self.compactor.start()
         return self
 
     def serve_forever(self) -> None:
+        if self.compactor is not None:
+            self.compactor.start()
         self._http.serve_forever()
 
     def shutdown(self) -> None:
+        if self.compactor is not None:
+            self.compactor.close()
         self._http.shutdown()
         if self._pool is not None:
             # wait=False: a handler parked on a wedged COMMIT must not

@@ -1,0 +1,706 @@
+"""Compacted columnar segment tier: the event store's read-optimized
+half.
+
+The sharded-WAL sqlite row stores (``data/storage/sqlite.py``) are
+write-optimized: group-commit transactions, per-shard WAL write slots.
+Training scans over them still pay sqlite page decode per row — ~3.3M
+events/s — while the reference's production path never decodes one
+object per event (HBase region scans and day-partitioned JDBC scans
+feed columnar partitions directly, HBPEvents.scala:84-90 /
+JDBCPEvents.scala:51-129). This module adds the LSM-style answer: a
+background compactor seals COLD prefixes of each row store into
+immutable columnar **segment files** that scan at ``np.frombuffer``/
+mmap rate, atomically registers them in a manifest inside the main
+database, and advances a per-store rowid **watermark** that excludes
+the sealed rows from every residual scan. The physical DELETE of the
+sealed rows is deferred by a grace period, so a scan that snapshotted
+the manifest just before a compaction commit still finds every row it
+expects (scans never coordinate with the compactor).
+
+Correctness contract (the acceptance oracle): a compacted store's
+streaming scan feeds the counting-sort merge in ``ops/streaming.py`` a
+wire BYTE-identical to a never-compacted store's. The design choices
+that guarantee it:
+
+- a compaction round seals a contiguous rowid PREFIX ``(watermark,
+  hi]`` of one row store, and a segment keeps its rows in rowid order
+  with per-row event/type/prop codes — scans replay exactly the
+  per-entity event order the residual SQL scan would have produced
+  (mixed event names included; rows are never regrouped);
+- rows that cannot round-trip through the columnar form (tags, prId,
+  ``$``-events, targetless events, multi-key or non-numeric property
+  bags, non-canonical timestamp text) become bounded **holdouts**:
+  they stay in the row store, named by rowid in the compaction state,
+  and every residual predicate re-admits them;
+- entity/target ids are dict-encoded into the SAME table-global code
+  space the columnar page store uses, so segment batches merge with
+  page batches and the row-store residual without re-encoding.
+
+Crash safety: a segment file is written and fsync-renamed BEFORE the
+manifest transaction that makes it (and the new watermark) visible —
+a crash in between leaves an orphan file and an untouched row store
+(no loss, no duplication; orphans are swept by later rounds). The
+physical delete runs last and is idempotent, so a crash between
+manifest commit and delete just re-runs the delete next round.
+
+Everything here is **instance-scoped** — no module-level mutable
+state (``tests/test_lint.py`` enforces this): the compactor daemon, its
+per-app threads, and all caches hang off objects owned by a server or
+CLI invocation, never the module.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import os
+import threading
+import zlib
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+SEGMENT_MAGIC = b"PIOSEG1\n"
+
+# every per-row column a segment stores, in file order. Codes index the
+# footer's small dictionaries (event names, types, props); entities and
+# targets are TABLE-GLOBAL dict codes (the page store's code space).
+_COLUMNS = (
+    ("rids", np.int64),  # source rowids (recovery + debugging)
+    ("entities", np.int32),
+    ("targets", np.int32),
+    ("values", np.float32),
+    ("times_ms", np.int64),
+    ("ctimes_ms", np.int64),
+    ("evcodes", np.uint16),
+    ("propcodes", np.uint16),
+    ("etcodes", np.uint16),
+    ("tetcodes", np.uint16),
+    # "ids" is appended with a per-file fixed width (S<w> bytes)
+)
+
+# a row whose id exceeds this many utf-8 bytes stays in the row store —
+# one giant id must not inflate the whole fixed-width id column
+MAX_ID_BYTES = 64
+
+
+@dataclasses.dataclass
+class SegmentColumns:
+    """The columnar image of one sealed rowid range, in rowid order."""
+
+    rids: np.ndarray
+    ids: np.ndarray  # S<w> fixed-width utf-8 bytes
+    entities: np.ndarray  # int32, table-global dict codes
+    targets: np.ndarray  # int32, table-global dict codes
+    values: np.ndarray  # float32
+    times_ms: np.ndarray  # int64
+    ctimes_ms: np.ndarray  # int64
+    evcodes: np.ndarray  # uint16 -> event_names
+    propcodes: np.ndarray  # uint16 -> props
+    etcodes: np.ndarray  # uint16 -> entity_types
+    tetcodes: np.ndarray  # uint16 -> target_entity_types
+    event_names: List[str]
+    props: List[str]
+    entity_types: List[str]
+    target_entity_types: List[str]
+
+    @property
+    def n(self) -> int:
+        return len(self.values)
+
+    def slice(self, lo: int, hi: int) -> "SegmentColumns":
+        return dataclasses.replace(
+            self,
+            rids=self.rids[lo:hi],
+            ids=self.ids[lo:hi],
+            entities=self.entities[lo:hi],
+            targets=self.targets[lo:hi],
+            values=self.values[lo:hi],
+            times_ms=self.times_ms[lo:hi],
+            ctimes_ms=self.ctimes_ms[lo:hi],
+            evcodes=self.evcodes[lo:hi],
+            propcodes=self.propcodes[lo:hi],
+            etcodes=self.etcodes[lo:hi],
+            tetcodes=self.tetcodes[lo:hi],
+        )
+
+
+# --- file format ---
+#
+# [MAGIC][column payloads, back to back][footer JSON][uint64 footer len]
+# [MAGIC]. The footer carries the column offset/dtype table, per-segment
+# counts, min/max rowid + event time, the small dictionaries, and a
+# crc32 checksum of the payload region — readers verify it once per
+# open, then every scan is np.frombuffer over one mmap.
+
+
+def write_segment_file(path: str, cols: SegmentColumns) -> dict:
+    """Write one immutable segment: temp file + fsync + atomic rename.
+    Returns the footer dict (the manifest row's source of truth)."""
+    payloads: List[Tuple[str, bytes, str]] = []
+    for name, dtype in _COLUMNS:
+        arr = np.ascontiguousarray(getattr(cols, name), dtype)
+        payloads.append((name, arr.tobytes(), np.dtype(dtype).str))
+    ids = np.ascontiguousarray(cols.ids)
+    payloads.append(("ids", ids.tobytes(), ids.dtype.str))
+
+    columns = {}
+    offset = len(SEGMENT_MAGIC)
+    crc = 0
+    for name, blob, dstr in payloads:
+        columns[name] = {"offset": offset, "nbytes": len(blob), "dtype": dstr}
+        offset += len(blob)
+        crc = zlib.crc32(blob, crc)
+    footer = {
+        "version": 1,
+        "n": int(cols.n),
+        "min_rowid": int(cols.rids.min()) if cols.n else 0,
+        "max_rowid": int(cols.rids.max()) if cols.n else 0,
+        "min_ms": int(cols.times_ms.min()) if cols.n else 0,
+        "max_ms": int(cols.times_ms.max()) if cols.n else 0,
+        "checksum": int(crc),
+        "columns": columns,
+        "event_names": list(cols.event_names),
+        "props": list(cols.props),
+        "entity_types": list(cols.entity_types),
+        "target_entity_types": list(cols.target_entity_types),
+    }
+    footer_blob = json.dumps(footer).encode("utf-8")
+    tmp = f"{path}.tmp-{os.getpid()}-{threading.get_ident()}"
+    with open(tmp, "wb") as f:
+        f.write(SEGMENT_MAGIC)
+        for _, blob, _ in payloads:
+            f.write(blob)
+        f.write(footer_blob)
+        f.write(np.uint64(len(footer_blob)).tobytes())
+        f.write(SEGMENT_MAGIC)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    return footer
+
+
+class SegmentReadError(Exception):
+    pass
+
+
+class SegmentData:
+    """An open (mmap'd) segment. Arrays are zero-copy views over the
+    mapped file — resident pages belong to the OS page cache, so a
+    long-lived process holding many open segments costs evictable
+    cache, not anonymous heap. The object is immutable and safe to
+    share across scans."""
+
+    def __init__(self, path: str, verify: bool = True):
+        import mmap as _mmap
+
+        self.path = path
+        with open(path, "rb") as f:
+            # zero-copy scans over the mapping; the checksum pass below
+            # touches every page once (sequential fault-in)
+            buf = _mmap.mmap(f.fileno(), 0, access=_mmap.ACCESS_READ)
+        if (
+            len(buf) < 2 * len(SEGMENT_MAGIC) + 8
+            or buf[: len(SEGMENT_MAGIC)] != SEGMENT_MAGIC
+            or buf[-len(SEGMENT_MAGIC) :] != SEGMENT_MAGIC
+        ):
+            raise SegmentReadError(f"{path}: not a segment file")
+        tail = len(buf) - len(SEGMENT_MAGIC) - 8
+        flen = int(np.frombuffer(buf[tail : tail + 8], np.uint64)[0])
+        footer = json.loads(buf[tail - flen : tail].decode("utf-8"))
+        self.footer = footer
+        self.n = int(footer["n"])
+        cols = footer["columns"]
+        if verify:
+            lo = min(c["offset"] for c in cols.values())
+            hi = max(c["offset"] + c["nbytes"] for c in cols.values())
+            # memoryview slice: no heap copy of the payload region
+            if zlib.crc32(memoryview(buf)[lo:hi]) != footer["checksum"]:
+                raise SegmentReadError(f"{path}: checksum mismatch")
+        self._arrays: Dict[str, np.ndarray] = {}
+        for name, meta in cols.items():
+            self._arrays[name] = np.frombuffer(
+                buf, np.dtype(meta["dtype"]),
+                count=meta["nbytes"] // np.dtype(meta["dtype"]).itemsize,
+                offset=meta["offset"],
+            )
+        self.event_names = footer["event_names"]
+        self.props = footer["props"]
+        self.entity_types = footer["entity_types"]
+        self.target_entity_types = footer["target_entity_types"]
+        # lazy sorted-id index (id_rows): built on the first by-id probe
+        self._ids_order: Optional[np.ndarray] = None
+        self._ids_sorted: Optional[np.ndarray] = None
+
+    def column(self, name: str) -> np.ndarray:
+        return self._arrays[name]
+
+    def id_rows(self, needles) -> np.ndarray:
+        """Row indices whose event id matches any of ``needles`` (bytes,
+        each already length-checked against the column width — a longer
+        needle would silently truncate into a false match). One lazy
+        sort per open segment, then O(k log n) probes, so explicit-id
+        scrubs and deletes never rescan the whole id column per call."""
+        if self._ids_order is None:
+            col = self.column("ids")
+            self._ids_order = np.argsort(col, kind="stable")
+            self._ids_sorted = col[self._ids_order]
+        srt = self._ids_sorted
+        if not len(srt):
+            return np.empty(0, np.int64)
+        arr = np.asarray(needles, dtype=srt.dtype)
+        pos = np.clip(np.searchsorted(srt, arr), 0, len(srt) - 1)
+        hits = srt[pos] == arr
+        return self._ids_order[pos[hits]]
+
+    # --- scan-time evaluation (mirrors the residual SQL semantics) ---
+
+    def keep_mask(
+        self,
+        *,
+        lo_ms: Optional[int] = None,
+        hi_ms: Optional[int] = None,
+        entity_type: Optional[str] = None,
+        target_entity_type=None,
+        target_entity_type_set: bool = False,
+        event_names: Optional[Sequence[str]] = None,
+        dead: Optional[np.ndarray] = None,
+    ) -> Optional[np.ndarray]:
+        """Row filter identical to the residual scan's WHERE clauses.
+        Returns None when every row survives (the common cold-scan
+        case), or a bool mask. ``target_entity_type_set`` True with
+        value None matches NOTHING (segments only hold targetful
+        events)."""
+        if target_entity_type_set and target_entity_type is None:
+            return np.zeros(self.n, bool)
+        keep: Optional[np.ndarray] = None
+
+        def conj(m):
+            nonlocal keep
+            keep = m if keep is None else (keep & m)
+
+        if dead is not None:
+            conj(dead == 0)
+        if event_names is not None:
+            allowed = np.fromiter(
+                (nm in event_names for nm in self.event_names),
+                bool, count=len(self.event_names),
+            )
+            if not allowed.any():
+                return np.zeros(self.n, bool)
+            if not allowed.all():
+                conj(allowed[self.column("evcodes")])
+        if entity_type is not None:
+            ok = np.fromiter(
+                (nm == entity_type for nm in self.entity_types),
+                bool, count=len(self.entity_types),
+            )
+            if not ok.any():
+                return np.zeros(self.n, bool)
+            if not ok.all():
+                conj(ok[self.column("etcodes")])
+        if target_entity_type_set:
+            ok = np.fromiter(
+                (nm == target_entity_type for nm in self.target_entity_types),
+                bool, count=len(self.target_entity_types),
+            )
+            if not ok.any():
+                return np.zeros(self.n, bool)
+            if not ok.all():
+                conj(ok[self.column("tetcodes")])
+        if lo_ms is not None and self.footer["min_ms"] < lo_ms:
+            conj(self.column("times_ms") >= lo_ms)
+        if hi_ms is not None and self.footer["max_ms"] >= hi_ms:
+            conj(self.column("times_ms") < hi_ms)
+        return keep
+
+    def spec_values(self, spec) -> np.ndarray:
+        """Per-row training values under a ``columnar.ValueSpec`` —
+        exactly the residual SQL's CASE/COALESCE rule, vectorized:
+        an event-name override wins, else the stored value when the
+        row's property key is the spec's, else the default."""
+        overrides = spec.overrides
+        ov_vals = np.fromiter(
+            (overrides.get(nm, 0.0) for nm in self.event_names),
+            np.float32, count=len(self.event_names),
+        )
+        ov_has = np.fromiter(
+            (nm in overrides for nm in self.event_names),
+            bool, count=len(self.event_names),
+        )
+        prop_is = np.fromiter(
+            (p == spec.prop for p in self.props),
+            bool, count=len(self.props),
+        )
+        v = np.where(
+            prop_is[self.column("propcodes")],
+            self.column("values"),
+            np.float32(spec.default),
+        )
+        if ov_has.any():
+            v = np.where(
+                ov_has[self.column("evcodes")],
+                ov_vals[self.column("evcodes")],
+                v,
+            )
+        return v.astype(np.float32, copy=False)
+
+    def ids_str(self) -> np.ndarray:
+        """Decoded event ids (object array of str)."""
+        raw = self.column("ids")
+        out = np.empty(self.n, object)
+        for j, b in enumerate(raw):
+            out[j] = b.decode("utf-8")
+        return out
+
+
+# --- row qualification ---
+
+
+def _canonical_iso(text: Optional[str], ms: int, format_iso8601, from_ms) -> bool:
+    """True when ``text`` is exactly the canonical UTC millisecond
+    rendering of ``ms`` — the only case the int64 column round-trips
+    losslessly (offset renderings and sub-ms text stay in rows)."""
+    if not text:
+        return False
+    return format_iso8601(from_ms(ms)) == text
+
+
+class RowQualifier:
+    """Decides whether a row round-trips through the columnar form and
+    accumulates the qualified columns (in input = rowid order).
+
+    Rows are the named tuples of the sqlite row layout:
+    ``(rowid, id, event, entity_type, entity_id, target_entity_type,
+    target_entity_id, properties, event_time, event_time_ms, tags,
+    pr_id, creation_time)``. A row qualifies when every field the
+    segment cannot store is absent/trivial and every stored field
+    round-trips exactly — see ``docs/PERF.md`` (storage tier) for the
+    one documented exception: property values are kept as float32 (the
+    precision the training wire uses either way).
+    """
+
+    def __init__(self):
+        from predictionio_tpu.data.event import format_iso8601
+
+        self._format_iso = format_iso8601
+        self.rids: List[int] = []
+        self.ids: List[bytes] = []
+        self.entity_ids: List[str] = []
+        self.target_ids: List[str] = []
+        self.values: List[float] = []
+        self.times_ms: List[int] = []
+        self.ctimes_ms: List[int] = []
+        self.evcodes: List[int] = []
+        self.propcodes: List[int] = []
+        self.etcodes: List[int] = []
+        self.tetcodes: List[int] = []
+        self._events: Dict[str, int] = {}
+        self._props: Dict[str, int] = {}
+        self._etypes: Dict[str, int] = {}
+        self._tetypes: Dict[str, int] = {}
+
+    @staticmethod
+    def _code(table: Dict[str, int], name: str) -> Optional[int]:
+        """Dict code, or None when the table is full — the codes column
+        is uint16, and event names are arbitrary client input, so a
+        high-cardinality prefix must overflow into holdouts, not crash
+        (and permanently stall) every future compaction round."""
+        c = table.get(name)
+        if c is None:
+            if len(table) > 0xFFFF:
+                return None
+            c = len(table)
+            table[name] = c
+        return c
+
+    def _ms_dt(self, ms: int):
+        import datetime as _dt
+
+        return _dt.datetime.fromtimestamp(ms / 1000.0, _dt.timezone.utc)
+
+    def offer(self, row) -> bool:
+        """Fold one row in; False means it must stay in the row store
+        (the caller records its rowid as a holdout)."""
+        (
+            rid, eid, event, etype, entity_id, tetype, target_id,
+            props_json, etime_text, etime_ms, tags_json, pr_id, ctime_text,
+        ) = row
+        if (
+            target_id is None
+            or tetype is None
+            or pr_id is not None
+            or event.startswith("$")
+            or (tags_json not in (None, "[]"))
+        ):
+            return False
+        eid_b = (eid or "").encode("utf-8")
+        if not eid_b or len(eid_b) > MAX_ID_BYTES:
+            return False
+        # timestamps must be exactly their canonical UTC ms rendering —
+        # anything else (client-zone offsets) can't rebuild the TEXT
+        if not _canonical_iso(
+            etime_text, etime_ms, self._format_iso, self._ms_dt
+        ):
+            return False
+        try:
+            import datetime as _dt
+
+            from predictionio_tpu.data.event import parse_iso8601
+
+            ctime = parse_iso8601(ctime_text)
+            if ctime.utcoffset() not in (None, _dt.timedelta(0)):
+                return False
+            ctime_ms = int(ctime.timestamp() * 1000)
+            if self._format_iso(self._ms_dt(ctime_ms)) != ctime_text:
+                return False
+        except (ValueError, TypeError):
+            return False
+        prop, value = "", 0.0
+        if props_json and props_json != "{}":
+            try:
+                bag = json.loads(props_json)
+            except ValueError:
+                return False
+            if not isinstance(bag, dict) or len(bag) != 1:
+                return False
+            prop, value = next(iter(bag.items()))
+            if isinstance(value, bool) or not isinstance(value, (int, float)):
+                return False
+            value = float(value)
+        codes = (
+            self._code(self._events, event),
+            self._code(self._props, prop),
+            self._code(self._etypes, etype),
+            self._code(self._tetypes, tetype),
+        )
+        if any(c is None for c in codes):
+            return False  # a uint16 dictionary is full: holdout
+        self.rids.append(rid)
+        self.ids.append(eid_b)
+        self.entity_ids.append(str(entity_id))
+        self.target_ids.append(str(target_id))
+        self.values.append(value)
+        self.times_ms.append(int(etime_ms))
+        self.ctimes_ms.append(ctime_ms)
+        self.evcodes.append(codes[0])
+        self.propcodes.append(codes[1])
+        self.etcodes.append(codes[2])
+        self.tetcodes.append(codes[3])
+        return True
+
+    @property
+    def n(self) -> int:
+        return len(self.rids)
+
+    def finish(self, entity_codes: np.ndarray, target_codes: np.ndarray) -> SegmentColumns:
+        """Assemble the columns; the caller supplies the table-global
+        dict codes for ``entity_ids``/``target_ids`` (the dict lives in
+        the sqlite main database)."""
+        width = max((len(b) for b in self.ids), default=1)
+        ids = np.array(self.ids, dtype=f"S{width}")
+        return SegmentColumns(
+            rids=np.asarray(self.rids, np.int64),
+            ids=ids,
+            entities=np.asarray(entity_codes, np.int32),
+            targets=np.asarray(target_codes, np.int32),
+            values=np.asarray(self.values, np.float32),
+            times_ms=np.asarray(self.times_ms, np.int64),
+            ctimes_ms=np.asarray(self.ctimes_ms, np.int64),
+            evcodes=np.asarray(self.evcodes, np.uint16),
+            propcodes=np.asarray(self.propcodes, np.uint16),
+            etcodes=np.asarray(self.etcodes, np.uint16),
+            tetcodes=np.asarray(self.tetcodes, np.uint16),
+            event_names=list(self._events),
+            props=list(self._props),
+            entity_types=list(self._etypes),
+            target_entity_types=list(self._tetypes),
+        )
+
+
+# --- the background compactor daemon ---
+
+
+@dataclasses.dataclass
+class CompactionPolicy:
+    """Compaction triggers and safety knobs (docs/PERF.md)."""
+
+    # an event is COLD once its event time is this far in the past
+    cold_s: float = 300.0
+    # don't bother sealing ranges smaller than this many qualified rows
+    min_events: int = 4096
+    # per-round row ceiling (bounds compactor memory to one range)
+    max_rows: int = 4_194_304
+    # rows per segment file (a range splits into sequential files)
+    rows_per_segment: int = 4_194_304
+    # sealed rows stay physically present (but watermark-excluded) this
+    # long, so scans that snapshotted the manifest just before the
+    # commit still find every row they expect
+    grace_s: float = 600.0
+    # non-columnar rows in a sealed range stay behind as holdouts; past
+    # this many per store, the watermark stops advancing
+    max_holdouts: int = 4096
+
+
+class SegmentCompactor:
+    """Background compaction daemon: one worker thread per app (the
+    reference's per-region HBase compactions, without the HBase). Owned
+    by the event server (``EventServerConfig.compact``) or a standalone
+    ``pio compact`` run; everything is instance state."""
+
+    def __init__(
+        self,
+        storage,
+        policy: Optional[CompactionPolicy] = None,
+        interval_s: float = 60.0,
+        apps: Optional[Sequence[int]] = None,
+    ):
+        self.storage = storage
+        self.policy = policy or CompactionPolicy()
+        self.interval_s = max(1.0, float(interval_s))
+        self._apps = list(apps) if apps is not None else None
+        self._threads: Dict[int, threading.Thread] = {}
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+        self._started = False
+
+    @staticmethod
+    def supported(storage) -> bool:
+        """Duck-typed backend gate: only stores exposing ``compact_app``
+        (the sqlite tier) can compact; memory/http backends no-op."""
+        try:
+            return hasattr(storage.get_l_events(), "compact_app")
+        except Exception:
+            return False
+
+    def _app_ids(self) -> List[int]:
+        if self._apps is not None:
+            return list(self._apps)
+        try:
+            return [a.id for a in self.storage.get_meta_data_apps().get_all()]
+        except Exception:
+            logger.exception("compactor: app listing failed")
+            return []
+
+    def run_once(self, app_id: int, channel_id: Optional[int] = None) -> dict:
+        """One synchronous compaction round for one app/channel."""
+        le = self.storage.get_l_events()
+        return le.compact_app(app_id, channel_id, policy=self.policy)
+
+    def compact_all_once(self) -> Dict[int, dict]:
+        """One round over every app (and its channels) — the ``pio
+        compact --once`` path."""
+        out: Dict[int, dict] = {}
+        channels = self.storage.get_meta_data_channels()
+        for app_id in self._app_ids():
+            result = self.run_once(app_id)
+            for ch in channels.get_by_app_id(app_id):
+                ch_res = self.run_once(app_id, ch.id)
+                for k, v in ch_res.items():
+                    if isinstance(v, (int, float)):
+                        result[k] = result.get(k, 0) + v
+            out[app_id] = result
+        return out
+
+    def _app_loop(self, app_id: int) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.run_once(app_id)
+                for ch in (
+                    self.storage.get_meta_data_channels().get_by_app_id(app_id)
+                ):
+                    self.run_once(app_id, ch.id)
+            except Exception:
+                # the daemon must outlive any one round's failure
+                logger.exception("compaction round failed for app %d", app_id)
+
+    def start(self) -> "SegmentCompactor":
+        """Spawn per-app worker threads (and a refresher that picks up
+        apps created later). Idempotent; no-op for backends without
+        compaction support."""
+        with self._lock:
+            if self._started or not self.supported(self.storage):
+                return self
+            self._started = True
+            self._refresh_threads()
+            t = threading.Thread(
+                target=self._refresher, daemon=True, name="segment-compactor"
+            )
+            t.start()
+            self._refresher_thread = t
+        return self
+
+    def _refresh_threads(self) -> None:
+        for app_id in self._app_ids():
+            if app_id in self._threads:
+                continue
+            t = threading.Thread(
+                target=self._app_loop, args=(app_id,), daemon=True,
+                name=f"segment-compactor-app{app_id}",
+            )
+            t.start()
+            self._threads[app_id] = t
+
+    def _refresher(self) -> None:
+        while not self._stop.wait(self.interval_s * 5):
+            with self._lock:
+                if self._stop.is_set():
+                    return
+                self._refresh_threads()
+
+    def close(self) -> None:
+        self._stop.set()
+
+
+class CachedCompactionStatus:
+    """Instance-scoped TTL cache over :func:`compaction_status`: the
+    underlying stats cost COUNT(*) scans per app, and both surfaces
+    that expose them (event-server status route, admin app listing)
+    face pollers — neither may hand anonymous clients a repeated
+    full-table-scan lever. One helper so TTL and recompute behavior
+    can't drift between the two."""
+
+    def __init__(self, storage, ttl_s: float = 5.0):
+        self.storage = storage
+        self.ttl_s = float(ttl_s)
+        self._cached: Optional[Tuple[float, Dict[str, dict]]] = None
+
+    def get(self) -> Dict[str, dict]:
+        import time as _time
+
+        now = _time.monotonic()
+        cached = self._cached
+        if cached is None or now - cached[0] >= self.ttl_s:
+            self._cached = cached = (now, compaction_status(self.storage))
+        return cached[1]
+
+
+def compaction_status(storage) -> Dict[str, dict]:
+    """Per-app compaction observability (event-server ``status.json``
+    and the admin app listing): segment count, compacted-event count and
+    fraction, last-compaction timestamp. Empty for backends without a
+    segment tier."""
+    out: Dict[str, dict] = {}
+    try:
+        le = storage.get_l_events()
+    except Exception:
+        return out
+    stats = getattr(le, "compaction_stats", None)
+    if stats is None:
+        return out
+    try:
+        apps = storage.get_meta_data_apps().get_all()
+    except Exception:
+        return out
+    for app in apps:
+        try:
+            s = stats(app.id)
+        except Exception:
+            logger.exception("compaction stats failed for app %s", app.name)
+            continue
+        if s is not None:
+            out[app.name] = s
+    return out

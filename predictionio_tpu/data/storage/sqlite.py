@@ -355,6 +355,37 @@ class _ShardState:
                 raise StorageError(str(e)) from e
             raise
 
+    def read_snapshot(self, stmts):
+        """Run several read statements inside ONE read transaction, so
+        they observe a single WAL snapshot — the segment-tier scans need
+        the compaction watermark and the segment manifest to be a
+        consistent pair (a compaction commits both in one transaction;
+        two autocommit reads could straddle it and double- or
+        zero-count the sealed rows). Returns a list of fetchall lists.
+        :memory: databases fall back to the shared locked connection
+        (writes serialize on the same lock, so the pair is consistent
+        there too)."""
+        if self.path == ":memory:":
+            with self.lock:
+                return [
+                    self.conn.execute(sql, params).fetchall()
+                    for sql, params in stmts
+                ]
+        conn = self.read_execute("SELECT 1").connection
+        out = []
+        conn.execute("BEGIN")
+        try:
+            for sql, params in stmts:
+                try:
+                    out.append(conn.execute(sql, params).fetchall())
+                except sqlite3.OperationalError as e:
+                    if "no such table" in str(e):
+                        raise StorageError(str(e)) from e
+                    raise
+        finally:
+            conn.execute("COMMIT")
+        return out
+
     def has_table(self, table: str) -> bool:
         """Memoized (positive results only) existence probe against THIS
         shard's file; a table created later must be seen, so negatives
@@ -543,11 +574,26 @@ def _table_name(namespace: str, suffix: str) -> str:
     return f"{ns}_{suffix}"
 
 
+class _StaleWatermark(Exception):
+    """Another compactor advanced this store's watermark first; the
+    round's files are abandoned (optimistic concurrency)."""
+
+
 class SQLiteLEvents(base.LEvents):
     def __init__(self, client: StorageClient, config=None, namespace: str = ""):
         self._c = client
         self._ns = namespace or "pio"
         self._pages_schema_ok: set = set()
+        self._seg_schema_ok: set = set()
+        # path -> SegmentData, LRU (see _open_segment); segment files
+        # are immutable, so entries never go stale (remove()/app delete
+        # clears them)
+        from collections import OrderedDict
+
+        self._seg_cache: "OrderedDict[str, object]" = OrderedDict()
+        # test-only crash injection: called between segment-file write
+        # and the manifest commit (compaction crash-consistency tests)
+        self.compact_fault = None
 
     def _ensure_pages_schema(self, t: str) -> None:
         """Migrate page tables from older layouts (memoized per table):
@@ -594,10 +640,21 @@ class SQLiteLEvents(base.LEvents):
     @staticmethod
     def _create_row_table(store, t: str) -> None:
         """Event-row DDL, identical in the main file and every shard
-        file. Caller holds the store's lock."""
+        file. Caller holds the store's lock.
+
+        ``rid INTEGER PRIMARY KEY AUTOINCREMENT`` makes rowids strictly
+        monotonic for the table's whole lifetime (sqlite_sequence keeps
+        the high-water mark across deletes): the compaction tier's
+        per-store watermark — "rowids <= W are sealed into segments" —
+        stays sound even after every row below it is physically
+        deleted, because no future insert can ever be assigned a rowid
+        under W. Tables created before this schema (plain implicit
+        rowid) are migrated on their first compaction
+        (:meth:`_ensure_monotonic_rowids`)."""
         store.conn.execute(
             f"""CREATE TABLE IF NOT EXISTS {t} (
-                id TEXT PRIMARY KEY,
+                rid INTEGER PRIMARY KEY AUTOINCREMENT,
+                id TEXT UNIQUE NOT NULL,
                 event TEXT NOT NULL,
                 entity_type TEXT NOT NULL,
                 entity_id TEXT NOT NULL,
@@ -665,12 +722,34 @@ class SQLiteLEvents(base.LEvents):
 
     def remove(self, app_id: int, channel_id: Optional[int] = None) -> bool:
         t = self._events_table(app_id, channel_id)
+        # collect segment file paths before the manifest drops
+        seg_paths: List[str] = []
+        if self._c.main_store.has_table(f"{t}_segments"):
+            try:
+                seg_paths = [
+                    r[0]
+                    for r in self._c.execute(
+                        f"SELECT path FROM {t}_segments"
+                    ).fetchall()
+                ]
+            except StorageError:
+                pass
         with self._c.lock:
             self._c.execute(f"DROP TABLE IF EXISTS {t}")
             self._c.execute(f"DROP TABLE IF EXISTS {t}_pages")
             self._c.execute(f"DROP TABLE IF EXISTS {t}_dict")
+            self._c.execute(f"DROP TABLE IF EXISTS {t}_segments")
+            self._c.execute(f"DROP TABLE IF EXISTS {t}_compaction")
             self._c.commit()
             self._c.main_store.known_tables.discard(t)
+            self._c.main_store.known_tables.discard(f"{t}_segments")
+            self._seg_schema_ok.discard(t)
+        for path in seg_paths:
+            self._seg_cache.pop(path, None)
+            try:
+                os.remove(path)
+            except OSError:
+                pass
         for shard in self._c.event_shards:
             if shard is self._c.main_store:
                 continue
@@ -711,7 +790,20 @@ class SQLiteLEvents(base.LEvents):
             shard.conn.commit()
             shard.known_tables.add(t)
 
-    _INSERT_SQL = "INSERT OR REPLACE INTO {t} VALUES (?,?,?,?,?,?,?,?,?,?,?,?)"
+    # event-row column list (no rid): names both the insert slots and
+    # every row SELECT, so the schema can carry the rid column without
+    # positional drift between old and migrated tables
+    _ROW_COLS = (
+        "id, event, entity_type, entity_id, target_entity_type, "
+        "target_entity_id, properties, event_time, event_time_ms, tags, "
+        "pr_id, creation_time"
+    )
+    _INSERT_SQL = (
+        "INSERT OR REPLACE INTO {t} ("
+        "id, event, entity_type, entity_id, target_entity_type, "
+        "target_entity_id, properties, event_time, event_time_ms, tags, "
+        "pr_id, creation_time) VALUES (?,?,?,?,?,?,?,?,?,?,?,?)"
+    )
 
     @staticmethod
     def _event_row(event: Event, eid: str) -> tuple:
@@ -764,6 +856,11 @@ class SQLiteLEvents(base.LEvents):
                     store.conn.commit()
                 else:
                     store.conn.rollback()
+        # a compacted copy of a re-posted id lives in an immutable
+        # segment, out of DELETE's reach — tombstone it in the manifest
+        # (explicit ids are the rare path; server-generated ids never
+        # reach here)
+        self._tombstone_segment_ids(t, [eid for eid, _ in spares])
 
     def insert(self, event: Event, app_id: int, channel_id: Optional[int] = None) -> str:
         """Single-event insert through the per-shard GROUP COMMITTER: the
@@ -950,11 +1047,12 @@ class SQLiteLEvents(base.LEvents):
             if not store.has_table(t):
                 continue
             row = store.execute(
-                f"SELECT * FROM {t} WHERE id=?", (event_id,)
+                f"SELECT {self._ROW_COLS} FROM {t} WHERE id=?", (event_id,)
             ).fetchone()
             if row:
                 return self._row_to_event(row)
-        return None
+        # compacted events keep their original ids inside segment files
+        return self._get_segment_event(t, event_id)
 
     def _delete_page_event(self, t: str, page: int, idx: int) -> bool:
         """Delete one row of a page by marking its tombstone bit. The
@@ -1005,7 +1103,11 @@ class SQLiteLEvents(base.LEvents):
             if not self._exists(t):
                 raise StorageError(f"events table {t} not initialized")
         # deletes are rare: a direct per-store transaction, not the
-        # group committer (same shard probe rationale as get())
+        # group committer (same shard probe rationale as get()). A
+        # sealed copy may ALSO exist in the segment tier (always, after
+        # compaction; plus a grace-window row copy) — tombstone it too,
+        # or the event would resurface on the next scan.
+        deleted = False
         for store in self._c.row_stores():
             if not store.has_table(t):
                 continue
@@ -1015,8 +1117,9 @@ class SQLiteLEvents(base.LEvents):
                 )
                 store.conn.commit()
             if cur.rowcount > 0:
-                return True
-        return False
+                deleted = True
+                break
+        return self._tombstone_segment_ids(t, [event_id]) or deleted
 
     @staticmethod
     def _find_clauses(
@@ -1078,42 +1181,61 @@ class SQLiteLEvents(base.LEvents):
             start_time, until_time, entity_type, entity_id, event_names,
             target_entity_type, target_entity_id,
         )
-        sql = f"SELECT * FROM {t}"
-        if clauses:
-            sql += " WHERE " + " AND ".join(clauses)
-        sql += f" ORDER BY event_time_ms {'DESC' if reversed else 'ASC'}"
-        if limit is not None and limit >= 0:
-            sql += f" LIMIT {int(limit)}"  # per-store bound; re-cut below
         with self._c.lock:
             if not self._exists(t):
                 raise StorageError(f"events table {t} not initialized")
+        marks, segs = self._segment_state(t)
         # the potentially-large scans run on snapshot connections, so
         # concurrent ingest proceeds while these fetches stream; sharded
         # stores fan out per shard and merge (stable sort: ties keep
         # main-store-then-shard, insertion order). An entity_id filter
         # pins the events to ONE shard (the insert hash), so the serving
         # find-by-entity path scans main + that shard, not all K.
-        candidates = self._c.row_stores()
+        all_stores = self._c.row_stores()
+        keys = list(range(len(all_stores)))
         if entity_id is not None and self._c.shard_count > 1:
-            candidates = [
-                self._c.main_store, self._c.shard_for(entity_id)
-            ]
-        stores = [s for s in candidates if s.has_table(t)]
-        row_events = [
-            self._row_to_event(r)
-            for store in stores
-            for r in store.read_execute(sql, params).fetchall()
-        ]
-        # merge bulk-imported page events (rare on this legacy path — the
-        # training scan is find_columns_native; here pages decode into
-        # Event objects so find() stays a complete view of the store)
+            keys = [0, all_stores.index(self._c.shard_for(entity_id))]
+        row_events: List[Event] = []
+        n_stores = 0
+        for key in keys:
+            store = all_stores[key]
+            if not store.has_table(t):
+                continue
+            n_stores += 1
+            sql = f"SELECT {self._ROW_COLS} FROM {t}"
+            store_clauses = list(clauses)
+            store_params = list(params)
+            pred = self._residual_clause(marks, key)
+            if pred is not None:  # sealed prefix lives in segments now
+                store_clauses.append(pred[0])
+                store_params.extend(pred[1])
+            if store_clauses:
+                sql += " WHERE " + " AND ".join(store_clauses)
+            sql += f" ORDER BY event_time_ms {'DESC' if reversed else 'ASC'}"
+            if limit is not None and limit >= 0:
+                sql += f" LIMIT {int(limit)}"  # per-store bound; re-cut below
+            row_events.extend(
+                self._row_to_event(r)
+                for r in store.read_execute(sql, store_params).fetchall()
+            )
+        # merge compacted segment events and bulk-imported page events
+        # (rare on this legacy path — the training scan is
+        # find_columns_native; here both decode into Event objects so
+        # find() stays a complete view of the store)
+        seg_events = self._segment_events(
+            t, segs, start_time, until_time, entity_type, entity_id,
+            event_names, target_entity_type, target_entity_id,
+            store_keys=set(keys), limit=limit, reversed=reversed,
+        )
         page_events = self._page_events(
             t, start_time, until_time, entity_type, entity_id, event_names,
             target_entity_type, target_entity_id,
         )
-        if not page_events and len(stores) <= 1:
+        if not page_events and not seg_events and n_stores <= 1:
             return iter(row_events)
-        merged = row_events + page_events
+        # stable sort: segment events (the sealed, older prefix) sort
+        # before the residual rows they precede on equal timestamps
+        merged = seg_events + row_events + page_events
         merged.sort(key=lambda e: _ms(e.event_time), reverse=reversed)
         if limit is not None and limit >= 0:
             merged = merged[: int(limit)]
@@ -1411,15 +1533,35 @@ class SQLiteLEvents(base.LEvents):
         with self._c.lock:
             if not self._exists(t):
                 raise StorageError(f"events table {t} not initialized")
-        sql = f"SELECT * FROM {t} ORDER BY event_time_ms ASC"
-        stores = [s for s in self._c.row_stores() if s.has_table(t)]
-        if len(stores) <= 1:
-            rows = stores[0].read_execute(sql).fetchall() if stores else []
+        marks, _ = self._segment_state(t)
+        queries: list = []  # (store, sql, params)
+        for key, store in enumerate(self._c.row_stores()):
+            if not store.has_table(t):
+                continue
+            sql = f"SELECT {self._ROW_COLS} FROM {t}"
+            pred = self._residual_clause(marks, key)
+            params: list = []
+            if pred is not None:  # sealed rows export via segments
+                sql += f" WHERE {pred[0]}"
+                params = pred[1]
+            sql += " ORDER BY event_time_ms ASC"
+            queries.append((store, sql, params))
+        if len(queries) <= 1:
+            # single store: Event objects materialize one at a time as
+            # the consumer (e.g. the parquet export writer) iterates —
+            # a 20M-row export must not hold 20M Events at once
+            rows = (
+                queries[0][0].read_execute(
+                    queries[0][1], queries[0][2]
+                ).fetchall()
+                if queries
+                else []
+            )
             return (self._row_to_event(r) for r in rows)
         events = [
             self._row_to_event(r)
-            for store in stores
-            for r in store.read_execute(sql).fetchall()
+            for store, sql, params in queries
+            for r in store.read_execute(sql, params).fetchall()
         ]
         events.sort(key=lambda e: _ms(e.event_time))
         return iter(events)
@@ -1488,6 +1630,993 @@ class SQLiteLEvents(base.LEvents):
                 "times_ms": np.frombuffer(tb, np.int64)[alive],
             }
 
+    # --- compacted columnar segment tier (data/storage/segments.py) ---
+    #
+    # Immutable segment files hold sealed cold prefixes of each row
+    # store; a manifest + per-store watermark in the MAIN database makes
+    # them atomically visible and excludes the sealed rowid ranges from
+    # every residual row scan. Scans fan out over
+    # pages -> per store: [segments, residual rows] — exactly the event
+    # order of an uncompacted store, so the counting-sort merge's wire
+    # stays byte-identical (segments module docstring).
+
+    def _seg_dir(self) -> str:
+        return f"{self._c.path}.segments"
+
+    def _ensure_segment_schema(self, t: str) -> None:
+        """Create the manifest + compaction-state tables (main db)."""
+        if t in self._seg_schema_ok:
+            return
+        with self._c.lock:
+            self._c.execute(
+                f"""CREATE TABLE IF NOT EXISTS {t}_segments (
+                    segment INTEGER PRIMARY KEY AUTOINCREMENT,
+                    store INTEGER NOT NULL,
+                    n INTEGER NOT NULL,
+                    min_rowid INTEGER NOT NULL,
+                    max_rowid INTEGER NOT NULL,
+                    min_ms INTEGER NOT NULL,
+                    max_ms INTEGER NOT NULL,
+                    events TEXT NOT NULL,
+                    entity_types TEXT NOT NULL,
+                    target_entity_types TEXT NOT NULL,
+                    path TEXT NOT NULL,
+                    checksum INTEGER NOT NULL,
+                    created_ms INTEGER NOT NULL,
+                    dead BLOB
+                )"""
+            )
+            self._c.execute(
+                f"""CREATE TABLE IF NOT EXISTS {t}_compaction (
+                    store INTEGER PRIMARY KEY,
+                    watermark INTEGER NOT NULL,
+                    cleaned INTEGER NOT NULL,
+                    holdouts BLOB,
+                    last_ms INTEGER NOT NULL
+                )"""
+            )
+            self._c.commit()
+            self._seg_schema_ok.add(t)
+
+    def _segment_state(self, t: str):
+        """One consistent snapshot of (compaction marks, live segment
+        manifest): ``marks`` is ``{store_key: (watermark, holdout rowid
+        tuple, cleaned, last_ms)}``, ``segs`` a list of manifest dicts
+        ordered by (store, segment id) — which IS rowid order, because
+        each store's watermark only advances. Store keys index
+        ``row_stores()`` (0 = main file, then hash shards); the pair is
+        read in ONE read transaction so a racing compaction commit can
+        never double- or zero-count sealed rows."""
+        if not self._c.main_store.has_table(f"{t}_segments"):
+            return {}, []
+        import numpy as np
+
+        rows_marks, rows_segs = self._c.main_store.read_snapshot(
+            [
+                (
+                    f"SELECT store, watermark, cleaned, holdouts, last_ms "
+                    f"FROM {t}_compaction",
+                    (),
+                ),
+                (
+                    f"SELECT segment, store, n, min_rowid, max_rowid, "
+                    f"min_ms, max_ms, events, entity_types, "
+                    f"target_entity_types, path, checksum, created_ms, "
+                    f"dead FROM {t}_segments ORDER BY store, segment",
+                    (),
+                ),
+            ]
+        )
+        marks = {
+            int(r[0]): (
+                int(r[1]),
+                tuple(
+                    int(x) for x in np.frombuffer(r[3], np.int64)
+                )
+                if r[3]
+                else (),
+                int(r[2]),
+                int(r[4]),
+            )
+            for r in rows_marks
+        }
+        segs = [
+            {
+                "segment": r[0], "store": r[1], "n": r[2],
+                "min_rowid": r[3], "max_rowid": r[4], "min_ms": r[5],
+                "max_ms": r[6], "events": json.loads(r[7]),
+                "entity_types": json.loads(r[8]),
+                "target_entity_types": json.loads(r[9]), "path": r[10],
+                "checksum": r[11], "created_ms": r[12], "dead": r[13],
+            }
+            for r in rows_segs
+        ]
+        return marks, segs
+
+    # open-segment LRU bound: entries are mmap-backed (resident pages
+    # are OS page cache, evictable), so the cap limits mappings/handles,
+    # not data bytes
+    _SEG_CACHE_MAX = 128
+
+    def _open_segment(self, path: str):
+        """Open (and cache) one immutable segment file. The cache is
+        instance-scoped, LRU-bounded, and keyed by path; files never
+        change in place (writes go through temp + rename under a fresh
+        name), so entries can't go stale — only cold."""
+        from predictionio_tpu.data.storage import segments as _seg
+
+        data = self._seg_cache.get(path)
+        if data is None:
+            try:
+                data = _seg.SegmentData(path)
+            except (OSError, _seg.SegmentReadError) as e:
+                raise StorageError(f"segment unreadable: {e}") from e
+            self._seg_cache[path] = data
+            while len(self._seg_cache) > self._SEG_CACHE_MAX:
+                self._seg_cache.pop(next(iter(self._seg_cache)))
+        else:
+            self._seg_cache.move_to_end(path)
+        return data
+
+    @staticmethod
+    def _residual_clause(marks, store_key: int):
+        """SQL predicate excluding the compacted prefix of one row
+        store (``None`` when nothing is compacted): rows above the
+        watermark, plus the bounded holdout set the compactor could not
+        columnarize."""
+        mark = marks.get(store_key) if marks else None
+        if not mark or mark[0] <= 0:
+            return None
+        wm, holdouts = mark[0], mark[1]
+        if holdouts:
+            # holdout rowids inline as integer literals, not bound
+            # parameters: max_holdouts (4096) exceeds older sqlite's
+            # 999-variable limit, and these are int64s from our own
+            # manifest — nothing to escape
+            inlist = ",".join(str(int(h)) for h in holdouts)
+            return f"(rowid > ? OR rowid IN ({inlist}))", [wm]
+        return "rowid > ?", [wm]
+
+    @staticmethod
+    def _segs_match(
+        seg: dict, event_names, entity_type, target_entity_type, lo, hi
+    ) -> bool:
+        """Coarse manifest-level pruning, mirroring ``_page_filter``."""
+        if target_entity_type is None:  # explicit "no target" filter
+            return False
+        if event_names is not None and not (
+            set(event_names) & set(seg["events"])
+        ):
+            return False
+        if entity_type is not None and entity_type not in seg["entity_types"]:
+            return False
+        if (
+            target_entity_type is not UNSET
+            and target_entity_type not in seg["target_entity_types"]
+        ):
+            return False
+        if lo is not None and seg["max_ms"] < lo:
+            return False
+        if hi is not None and seg["min_ms"] >= hi:
+            return False
+        return True
+
+    def _seg_dead(self, seg: dict):
+        import numpy as np
+
+        if seg["dead"] is None:
+            return None
+        return np.frombuffer(seg["dead"], np.uint8)
+
+    def _segment_events(
+        self, t, segs, start_time, until_time, entity_type, entity_id,
+        event_names, target_entity_type, target_entity_id,
+        store_keys=None, limit=None, reversed=False,
+    ) -> List[Event]:
+        """Decode matching segment rows into Event objects (the legacy
+        ``find()`` view), original ids and creation times preserved.
+        With ``limit``, only the per-segment top-``limit`` rows by event
+        time decode (the global top-limit is a subset of the union of
+        per-segment top-limits), so a bounded serving query never pays a
+        full-dataset decode."""
+        import numpy as np
+
+        if not segs or target_entity_id is None:
+            return []
+        lo = _ms(start_time) if start_time is not None else None
+        hi = _ms(until_time) if until_time is not None else None
+        wanted = [
+            s
+            for s in segs
+            if (store_keys is None or s["store"] in store_keys)
+            and self._segs_match(
+                s, event_names, entity_type, target_entity_type, lo, hi
+            )
+        ]
+        if not wanted:
+            return []
+        e_code = g_code = None
+        if entity_id is not None or target_entity_id is not UNSET:
+            def code_of(name: str):
+                row = self._c.execute(
+                    f"SELECT id FROM {t}_dict WHERE name=?", (name,)
+                ).fetchone()
+                return row[0] if row else None
+
+            if entity_id is not None:
+                e_code = code_of(entity_id)
+                if e_code is None:
+                    return []
+            if target_entity_id is not UNSET:
+                g_code = code_of(target_entity_id)
+                if g_code is None:
+                    return []
+        names = self._dict_names(t)
+        out: List[Event] = []
+        for seg in wanted:
+            data = self._open_segment(seg["path"])
+            keep = data.keep_mask(
+                lo_ms=lo, hi_ms=hi, entity_type=entity_type,
+                target_entity_type=(
+                    None if target_entity_type is None else target_entity_type
+                ),
+                target_entity_type_set=target_entity_type is not UNSET,
+                event_names=event_names, dead=self._seg_dead(seg),
+            )
+            e = data.column("entities")
+            if e_code is not None:
+                m = e == e_code
+                keep = m if keep is None else (keep & m)
+            if g_code is not None:
+                m = data.column("targets") == g_code
+                keep = m if keep is None else (keep & m)
+            idx = np.nonzero(keep)[0] if keep is not None else np.arange(data.n)
+            if not len(idx):
+                continue
+            if limit is not None and 0 <= limit < len(idx):
+                t_of = data.column("times_ms")[idx]
+                order = np.argsort(
+                    -t_of if reversed else t_of, kind="stable"
+                )[:limit]
+                idx = idx[np.sort(order)]  # keep row order among chosen
+            g = data.column("targets")
+            v = data.column("values")
+            ts = data.column("times_ms")
+            cts = data.column("ctimes_ms")
+            ev = data.column("evcodes")
+            pr = data.column("propcodes")
+            et = data.column("etcodes")
+            tet = data.column("tetcodes")
+            ids = data.column("ids")
+            for j in idx:
+                prop = data.props[pr[j]]
+                when = _dt.datetime.fromtimestamp(
+                    ts[j] / 1000.0, _dt.timezone.utc
+                )
+                out.append(
+                    Event(
+                        event_id=ids[j].decode("utf-8"),
+                        event=data.event_names[ev[j]],
+                        entity_type=data.entity_types[et[j]],
+                        entity_id=names[e[j]],
+                        target_entity_type=data.target_entity_types[tet[j]],
+                        target_entity_id=names[g[j]],
+                        properties=DataMap(
+                            {prop: float(v[j])} if prop else {}
+                        ),
+                        event_time=when,
+                        creation_time=_dt.datetime.fromtimestamp(
+                            cts[j] / 1000.0, _dt.timezone.utc
+                        ),
+                    )
+                )
+        return out
+
+    def _get_segment_event(self, t: str, event_id: str) -> Optional[Event]:
+        """Probe the segment tier for one event by its ORIGINAL id."""
+        import numpy as np
+
+        _, segs = self._segment_state(t)
+        if not segs:
+            return None
+        needle = event_id.encode("utf-8")
+        names = None
+        for seg in segs:
+            data = self._open_segment(seg["path"])
+            ids = data.column("ids")
+            if len(needle) > ids.dtype.itemsize:
+                continue
+            hit = data.id_rows([needle])
+            if not len(hit):
+                continue
+            j = int(hit[0])
+            dead = self._seg_dead(seg)
+            if dead is not None and dead[j]:
+                continue
+            if names is None:
+                names = self._dict_names(t)
+            prop = data.props[data.column("propcodes")[j]]
+            when = _dt.datetime.fromtimestamp(
+                data.column("times_ms")[j] / 1000.0, _dt.timezone.utc
+            )
+            return Event(
+                event_id=event_id,
+                event=data.event_names[data.column("evcodes")[j]],
+                entity_type=data.entity_types[data.column("etcodes")[j]],
+                entity_id=names[data.column("entities")[j]],
+                target_entity_type=data.target_entity_types[
+                    data.column("tetcodes")[j]
+                ],
+                target_entity_id=names[data.column("targets")[j]],
+                properties=DataMap(
+                    {prop: float(data.column("values")[j])} if prop else {}
+                ),
+                event_time=when,
+                creation_time=_dt.datetime.fromtimestamp(
+                    data.column("ctimes_ms")[j] / 1000.0, _dt.timezone.utc
+                ),
+            )
+        return None
+
+    def _tombstone_segment_ids(self, t: str, ids: Sequence[str]) -> bool:
+        """Set the manifest dead bit for any segment rows carrying these
+        ids (delete of a compacted event; explicit-id re-post scrub).
+        Segments stay immutable — liveness lives in the manifest."""
+        import numpy as np
+
+        if not ids:
+            return False
+        _, segs = self._segment_state(t)
+        if not segs:
+            return False
+        needles = [i.encode("utf-8") for i in ids]
+        changed = False
+        for seg in segs:
+            data = self._open_segment(seg["path"])
+            col = data.column("ids")
+            fit = [b for b in needles if len(b) <= col.dtype.itemsize]
+            if not fit:
+                continue
+            hits = data.id_rows(fit)
+            if not len(hits):
+                continue
+            with self._c.lock:
+                row = self._c.execute(
+                    f"SELECT dead FROM {t}_segments WHERE segment=?",
+                    (seg["segment"],),
+                ).fetchone()
+                if row is None:
+                    continue
+                dead = (
+                    np.frombuffer(row[0], np.uint8).copy()
+                    if row[0] is not None
+                    else np.zeros(data.n, np.uint8)
+                )
+                if dead[hits].all():
+                    continue
+                dead[hits] = 1
+                self._c.execute(
+                    f"UPDATE {t}_segments SET dead=? WHERE segment=?",
+                    (dead.tobytes(), seg["segment"]),
+                )
+                self._c.commit()
+                changed = True
+        return changed
+
+    def _ensure_monotonic_rowids(self, store, t: str) -> None:
+        """Migrate a pre-segment-tier row table (implicit rowid) to the
+        AUTOINCREMENT schema, preserving every rowid. Without this, a
+        compaction that empties the table would let sqlite re-issue
+        rowids UNDER the watermark — silently invisible events. One
+        full-table rewrite, once per store file."""
+        ok = getattr(store, "rid_ok", None)
+        if ok is None:
+            ok = store.rid_ok = set()
+        if t in ok:
+            return
+        with store.lock:
+            row = store.conn.execute(
+                "SELECT sql FROM sqlite_master WHERE type='table' AND name=?",
+                (t,),
+            ).fetchone()
+            if row is None:
+                return
+            if "AUTOINCREMENT" in (row[0] or ""):
+                ok.add(t)
+                return
+            mig = f"{t}__rid_mig"
+            store.conn.execute(f"DROP TABLE IF EXISTS {mig}")
+            self._create_row_table(store, mig)
+            # _create_row_table names indexes after its table argument;
+            # drop the migration-name indexes and let the final CREATE
+            # below rebuild them under the real name
+            store.conn.execute(f"DROP INDEX IF EXISTS {mig}_time")
+            store.conn.execute(f"DROP INDEX IF EXISTS {mig}_entity")
+            store.conn.execute(
+                f"INSERT INTO {mig} (rid, {self._ROW_COLS}) "
+                f"SELECT rowid, {self._ROW_COLS} FROM {t} ORDER BY rowid"
+            )
+            store.conn.execute(f"DROP TABLE {t}")
+            store.conn.execute(f"ALTER TABLE {mig} RENAME TO {t}")
+            store.conn.execute(
+                f"CREATE INDEX IF NOT EXISTS {t}_time ON {t} (event_time_ms)"
+            )
+            store.conn.execute(
+                f"CREATE INDEX IF NOT EXISTS {t}_entity ON {t} "
+                f"(entity_type, entity_id, event_time_ms)"
+            )
+            store.conn.commit()
+            ok.add(t)
+
+    def _sweep_orphan_segments(self, t: str, live_paths, now_ms: int) -> None:
+        """Delete segment files this table owns that no manifest row
+        references (a crash between file write and manifest commit, or
+        a lost optimistic-concurrency race). Age-gated so a concurrent
+        compactor's just-written, not-yet-committed files survive."""
+        seg_dir = self._seg_dir()
+        if not os.path.isdir(seg_dir):
+            return
+        prefix = f"{t}."
+        cutoff_s = (now_ms / 1000.0) - 3600.0
+        for name in os.listdir(seg_dir):
+            if not name.startswith(prefix):
+                continue
+            path = os.path.join(seg_dir, name)
+            if path in live_paths:
+                continue
+            try:
+                if os.path.getmtime(path) < cutoff_s:
+                    os.remove(path)
+                    logger.info("swept orphan segment %s", path)
+            except OSError:
+                pass
+
+    def compact_app(
+        self, app_id: int, channel_id: Optional[int] = None, *, policy=None,
+        now_ms: Optional[int] = None,
+    ) -> dict:
+        """One compaction round for one app/channel: per row store, seal
+        the cold qualified prefix above the watermark into immutable
+        segment file(s), register them + the advanced watermark in ONE
+        main-db transaction, then (grace period permitting) physically
+        delete sealed rows. Returns counters for observability. Safe to
+        run concurrently with writers, scans, and other compactors (the
+        manifest commit re-validates the watermark it started from and
+        aborts if another compactor advanced it first)."""
+        import time as _t
+
+        from predictionio_tpu.data.storage import segments as _seg
+
+        if self._c.path == ":memory:":
+            return {"skipped": "memory database has no segment tier"}
+        policy = policy or _seg.CompactionPolicy()
+        t = self._events_table(app_id, channel_id)
+        with self._c.lock:
+            if not self._exists(t):
+                return {"skipped": "not initialized"}
+        now = int(now_ms if now_ms is not None else _t.time() * 1000)
+        self._ensure_segment_schema(t)
+        os.makedirs(self._seg_dir(), exist_ok=True)
+        cutoff = now - int(policy.cold_s * 1000)
+        result = {
+            "sealed_events": 0, "segments": 0, "holdouts_added": 0,
+            "rows_deleted": 0,
+        }
+        marks, segs = self._segment_state(t)
+        for key, store in enumerate(self._c.row_stores()):
+            if not store.has_table(t):
+                continue
+            sealed = self._compact_store(
+                t, key, store, marks, policy, cutoff, now
+            )
+            for k, v in sealed.items():
+                result[k] = result.get(k, 0) + v
+        # physical cleanup + orphan sweep run AFTER sealing so a fresh
+        # manifest state is observed; both are idempotent
+        marks, segs = self._segment_state(t)
+        deleted = self._cleanup_sealed_rows(t, marks, segs, policy, now)
+        result["rows_deleted"] += deleted
+        self._sweep_orphan_segments(
+            t, {s["path"] for s in segs}, now
+        )
+        if result["segments"]:
+            logger.info(
+                "compacted app %s%s: %d events into %d segment(s)",
+                app_id, f"/{channel_id}" if channel_id else "",
+                result["sealed_events"], result["segments"],
+            )
+        return result
+
+    def _compact_store(
+        self, t, key, store, marks, policy, cutoff, now
+    ) -> dict:
+        import numpy as np
+
+        from predictionio_tpu.data.storage import segments as _seg
+
+        mark = marks.get(key, (0, (), 0, 0))
+        wm, holdouts = mark[0], list(mark[1])
+        if len(holdouts) >= policy.max_holdouts:
+            return {}
+        self._ensure_monotonic_rowids(store, t)
+        rows = store.read_execute(
+            f"SELECT rowid, {self._ROW_COLS} FROM {t} WHERE rowid > ? "
+            f"ORDER BY rowid LIMIT ?",
+            (wm, int(policy.max_rows)),
+        ).fetchall()
+        if not rows:
+            return {}
+        qual = _seg.RowQualifier()
+        new_holdouts: list = []
+        hi = wm
+        day_ms = 86_400_000
+        for row in rows:
+            if row[9] > cutoff:  # event_time_ms
+                if row[9] <= now + day_ms:
+                    # genuinely recent (will cool): the cold prefix
+                    # ends here
+                    break
+                # far-future-dated junk never cools — a break here
+                # would stall the watermark for the whole store
+                # forever; bounded holdout instead
+                if len(holdouts) + len(new_holdouts) >= policy.max_holdouts:
+                    break
+                new_holdouts.append(row[0])
+                hi = row[0]
+                continue
+            if qual.offer(row):
+                hi = row[0]
+            else:
+                if len(holdouts) + len(new_holdouts) >= policy.max_holdouts:
+                    break
+                new_holdouts.append(row[0])
+                hi = row[0]
+        if qual.n < max(1, int(policy.min_events)):
+            return {}
+        # table-global dict codes for the id columns (the page store's
+        # code space, so segment batches merge without re-encoding)
+        e_uniq, e_inv = np.unique(
+            np.asarray(qual.entity_ids, object), return_inverse=True
+        )
+        g_uniq, g_inv = np.unique(
+            np.asarray(qual.target_ids, object), return_inverse=True
+        )
+        e_codes = self._dict_encode(t, e_uniq)[e_inv]
+        g_codes = self._dict_encode(t, g_uniq)[g_inv]
+        cols = qual.finish(e_codes, g_codes)
+        files: list = []  # (path, footer)
+        try:
+            for s in range(0, cols.n, int(policy.rows_per_segment)):
+                part = cols.slice(s, min(s + int(policy.rows_per_segment), cols.n))
+                path = os.path.join(
+                    self._seg_dir(),
+                    f"{t}.k{key}.{int(part.rids[0])}-{int(part.rids[-1])}"
+                    f".{now}-{s}.seg",
+                )
+                footer = _seg.write_segment_file(path, part)
+                files.append((path, footer))
+            fault = self.compact_fault
+            if fault is not None:
+                fault()
+            with self._c.lock:
+                # BEGIN IMMEDIATE takes the write lock BEFORE the
+                # watermark re-read, so the check and the commit are one
+                # atomic unit ACROSS PROCESSES too (a deferred
+                # transaction would upgrade at the first INSERT — after
+                # the check — letting two compactor processes both pass
+                # it and register overlapping segment sets)
+                self._c.conn.commit()  # close any implicit txn first
+                self._c.conn.execute("BEGIN IMMEDIATE")
+                try:
+                    cur = self._c.conn.execute(
+                        f"SELECT watermark FROM {t}_compaction "
+                        f"WHERE store=?",
+                        (key,),
+                    ).fetchone()
+                    if cur is not None and int(cur[0]) != wm:
+                        # another compactor advanced this store first:
+                        # our range overlaps its segments — abandon ours
+                        raise _StaleWatermark()
+                    for path, footer in files:
+                        self._c.conn.execute(
+                            f"INSERT INTO {t}_segments (store, n, "
+                            f"min_rowid, max_rowid, min_ms, max_ms, "
+                            f"events, entity_types, target_entity_types, "
+                            f"path, checksum, created_ms, dead) "
+                            f"VALUES (?,?,?,?,?,?,?,?,?,?,?,?,NULL)",
+                            (
+                                key, footer["n"], footer["min_rowid"],
+                                footer["max_rowid"], footer["min_ms"],
+                                footer["max_ms"],
+                                json.dumps(footer["event_names"]),
+                                json.dumps(footer["entity_types"]),
+                                json.dumps(footer["target_entity_types"]),
+                                path, footer["checksum"], now,
+                            ),
+                        )
+                    all_holdouts = np.asarray(
+                        holdouts + new_holdouts, np.int64
+                    )
+                    self._c.conn.execute(
+                        f"INSERT OR REPLACE INTO {t}_compaction "
+                        f"(store, watermark, cleaned, holdouts, last_ms) "
+                        f"VALUES (?,?,?,?,?)",
+                        (
+                            key, int(hi), int(mark[2]),
+                            all_holdouts.tobytes()
+                            if len(all_holdouts)
+                            else None,
+                            now,
+                        ),
+                    )
+                    self._c.commit()
+                except BaseException:
+                    # NEVER leave the IMMEDIATE transaction open with
+                    # partial manifest rows: an unrelated later commit
+                    # on this shared connection would persist segments
+                    # WITHOUT the watermark advance — every sealed row
+                    # then scans twice, forever
+                    try:
+                        self._c.conn.rollback()
+                    except sqlite3.Error:
+                        pass
+                    raise
+            # TOCTOU reconciliation: a delete() (or an explicit-id
+            # re-post's REPLACE) that removed a sealed row AFTER our
+            # snapshot but BEFORE the manifest commit found no segment
+            # to tombstone — re-check the sealed range and tombstone
+            # whatever vanished from the row store (deletes after the
+            # commit see the manifest and tombstone themselves)
+            self._reconcile_sealed_rows(t, store, files, wm, hi)
+        except _StaleWatermark:
+            for path, _ in files:
+                try:
+                    os.remove(path)
+                except OSError:
+                    pass
+            return {}
+        except BaseException:
+            # crash path (incl. injected faults): files may remain as
+            # orphans but the manifest never saw them — rows stay
+            # authoritative, the sweep reclaims the files later
+            raise
+        return {
+            "sealed_events": int(cols.n),
+            "segments": len(files),
+            "holdouts_added": len(new_holdouts),
+        }
+
+    def _reconcile_sealed_rows(self, t, store, files, wm, hi) -> None:
+        """Post-commit sweep of the sealed range: any rowid the segment
+        carries that is no longer in the row store was deleted (or
+        REPLACE-moved by an explicit-id re-post) during the compaction
+        window — tombstone it in the manifest so it cannot resurrect.
+        Idempotent; races with concurrent deletes only double-set the
+        same dead bits."""
+        import numpy as np
+
+        present = np.fromiter(
+            (
+                r[0]
+                for r in store.read_execute(
+                    f"SELECT rowid FROM {t} WHERE rowid > ? AND rowid <= ?",
+                    (wm, hi),
+                ).fetchall()
+            ),
+            np.int64,
+        )
+        present.sort()
+        for path, footer in files:
+            data = self._open_segment(path)
+            rids = data.column("rids")
+            if len(present):
+                pos = np.clip(
+                    np.searchsorted(present, rids), 0, len(present) - 1
+                )
+                found = present[pos] == rids
+            else:
+                found = np.zeros(len(rids), bool)
+            missing = np.nonzero(~found)[0]
+            if not len(missing):
+                continue
+            with self._c.lock:
+                row = self._c.execute(
+                    f"SELECT segment, dead FROM {t}_segments WHERE path=?",
+                    (path,),
+                ).fetchone()
+                if row is None:
+                    continue
+                dead = (
+                    np.frombuffer(row[1], np.uint8).copy()
+                    if row[1] is not None
+                    else np.zeros(data.n, np.uint8)
+                )
+                dead[missing] = 1
+                self._c.execute(
+                    f"UPDATE {t}_segments SET dead=? WHERE segment=?",
+                    (dead.tobytes(), row[0]),
+                )
+                self._c.commit()
+            logger.info(
+                "compaction reconciliation: %d row(s) deleted during the "
+                "seal window tombstoned in %s", len(missing), path,
+            )
+
+    def _cleanup_sealed_rows(self, t, marks, segs, policy, now) -> int:
+        """Physically delete sealed rows once their segments are older
+        than the grace period (scans snapshot the manifest at start, so
+        rows must outlive any scan that began before the seal).
+        Idempotent: a crash between the delete and the ``cleaned`` mark
+        just re-deletes nothing next round."""
+        deleted = 0
+        grace_ms = int(policy.grace_s * 1000)
+        for key, store in enumerate(self._c.row_stores()):
+            mark = marks.get(key)
+            if mark is None:
+                continue
+            wm, holdouts, cleaned = mark[0], mark[1], mark[2]
+            eligible = [
+                s["max_rowid"]
+                for s in segs
+                if s["store"] == key
+                and s["max_rowid"] > cleaned
+                and s["created_ms"] + grace_ms <= now
+            ]
+            if not eligible:
+                continue
+            upto = max(eligible)
+            if not store.has_table(t):
+                continue
+            # delete (cleaned, upto] minus holdouts as open intervals
+            # between consecutive holdout rowids — bounded statements
+            bounds = sorted(
+                h for h in holdouts if cleaned < h <= upto
+            )
+            spans = []
+            lo = cleaned
+            for h in bounds:
+                if h - 1 > lo:
+                    spans.append((lo, h - 1))
+                lo = h
+            if upto > lo:
+                spans.append((lo, upto))
+            with store.lock:
+                for lo_ex, hi_in in spans:
+                    cur = store.conn.execute(
+                        f"DELETE FROM {t} WHERE rowid > ? AND rowid <= ?",
+                        (lo_ex, hi_in),
+                    )
+                    deleted += max(0, cur.rowcount)
+                store.conn.commit()
+            with self._c.lock:
+                self._c.execute(
+                    f"UPDATE {t}_compaction SET cleaned=? WHERE store=?",
+                    (int(upto), key),
+                )
+                self._c.commit()
+        return deleted
+
+    def compaction_stats(
+        self, app_id: int, channel_id: Optional[int] = None
+    ) -> Optional[dict]:
+        """Observability summary for status.json / the admin listing:
+        segment count, live compacted events, residual row events, the
+        compacted fraction of the scannable store, and the last
+        compaction timestamp."""
+        import numpy as np
+
+        t = self._events_table(app_id, channel_id)
+        with self._c.lock:
+            if not self._exists(t):
+                return None
+        marks, segs = self._segment_state(t)
+        seg_events = 0
+        for s in segs:
+            dead = self._seg_dead(s)
+            seg_events += int(s["n"]) - (
+                int(dead.sum()) if dead is not None else 0
+            )
+        row_events = 0
+        for key, store in enumerate(self._c.row_stores()):
+            if not store.has_table(t):
+                continue
+            pred = self._residual_clause(marks, key)
+            sql = f"SELECT COUNT(*) FROM {t}"
+            params: list = []
+            if pred is not None:
+                sql += f" WHERE {pred[0]}"
+                params = pred[1]
+            row_events += int(store.read_execute(sql, params).fetchone()[0])
+        page_events = 0
+        self._ensure_pages_schema(t)
+        with self._c.lock:
+            have_pages = self._exists(f"{t}_pages")
+        if have_pages:
+            page_events = int(
+                self._c.read_execute(
+                    f"SELECT COALESCE(TOTAL(n), 0) FROM {t}_pages"
+                ).fetchone()[0]
+            )
+            for (db,) in self._c.read_execute(
+                f"SELECT dead FROM {t}_pages WHERE dead IS NOT NULL"
+            ).fetchall():
+                page_events -= int(np.frombuffer(db, np.uint8).sum())
+        total = seg_events + row_events + page_events
+        return {
+            "segments": len(segs),
+            "segmentEvents": seg_events,
+            "rowEvents": row_events,
+            "pageEvents": page_events,
+            "compactedFraction": (seg_events / total) if total else 0.0,
+            "lastCompactionMs": max(
+                (m[3] for m in marks.values()), default=0
+            ),
+        }
+
+    def iter_export_segments(
+        self, app_id: int, channel_id: Optional[int] = None
+    ) -> Iterator[dict]:
+        """Bulk-export view of the segment tier: decoded numpy column
+        groups, one per homogeneous (event, types, prop) run of each
+        segment, live rows only — the near-zero-copy half of segment
+        exchange (``tools/export_import.py``). Keys match
+        ``iter_export_pages`` plus ``creation_times_ms``; ``event_ids``
+        are the ORIGINAL ids, preserved end to end."""
+        import numpy as np
+
+        t = self._events_table(app_id, channel_id)
+        with self._c.lock:
+            if not self._exists(t):
+                raise StorageError(f"events table {t} not initialized")
+        _, segs = self._segment_state(t)
+        if not segs:
+            return
+        names = self._dict_names(t)
+        for seg in segs:
+            data = self._open_segment(seg["path"])
+            dead = self._seg_dead(seg)
+            alive = (
+                np.nonzero(dead == 0)[0]
+                if dead is not None
+                else np.arange(data.n)
+            )
+            if not len(alive):
+                continue
+            # group key per row: (event, prop, etype, tetype) — emit
+            # maximal CONSECUTIVE runs so row order survives the
+            # round trip
+            gk = (
+                data.column("evcodes").astype(np.int64) * (1 << 48)
+                + data.column("propcodes").astype(np.int64) * (1 << 32)
+                + data.column("etcodes").astype(np.int64) * (1 << 16)
+                + data.column("tetcodes").astype(np.int64)
+            )[alive]
+            ids = data.ids_str()
+            starts = np.concatenate(
+                [[0], np.nonzero(gk[1:] != gk[:-1])[0] + 1, [len(alive)]]
+            )
+            for a, b in zip(starts[:-1], starts[1:]):
+                rows = alive[a:b]
+                j0 = rows[0]
+                yield {
+                    "event": data.event_names[data.column("evcodes")[j0]],
+                    "entity_type": data.entity_types[
+                        data.column("etcodes")[j0]
+                    ],
+                    "target_entity_type": data.target_entity_types[
+                        data.column("tetcodes")[j0]
+                    ],
+                    "prop": data.props[data.column("propcodes")[j0]],
+                    "event_ids": ids[rows],
+                    "entity_ids": names[data.column("entities")[rows]],
+                    "target_ids": names[data.column("targets")[rows]],
+                    "values": data.column("values")[rows],
+                    "times_ms": data.column("times_ms")[rows],
+                    "creation_times_ms": data.column("ctimes_ms")[rows],
+                }
+
+    def insert_segment_encoded(
+        self,
+        app_id: int,
+        channel_id: Optional[int] = None,
+        *,
+        event: str,
+        entity_type: str,
+        target_entity_type: str,
+        entity_names,
+        entity_codes,
+        target_names,
+        target_codes,
+        values,
+        event_ids,
+        value_property: str = "rating",
+        event_times_ms=None,
+        creation_times_ms=None,
+    ) -> int:
+        """Import a homogeneous column group DIRECTLY as a sealed
+        segment, preserving the original event ids — the receiving half
+        of near-zero-copy segment exchange. Append-only: the caller
+        (``tools/export_import.py``) falls back to the keyed generic
+        path when any sampled id already exists in this store."""
+        import time as _t
+
+        import numpy as np
+
+        from predictionio_tpu.data.storage import segments as _seg
+
+        if self._c.path == ":memory:":
+            raise StorageError("memory database has no segment tier")
+        if event.startswith("$"):
+            raise StorageError(
+                f"insert_segment cannot write special event {event!r}"
+            )
+        t = self._events_table(app_id, channel_id)
+        with self._c.lock:
+            if not self._exists(t):
+                raise StorageError(f"events table {t} not initialized")
+        vals = np.asarray(values, np.float32)
+        n = len(vals)
+        if n == 0:
+            return 0
+        times = np.asarray(event_times_ms, np.int64)
+        ctimes = (
+            np.asarray(creation_times_ms, np.int64)
+            if creation_times_ms is not None
+            else times
+        )
+        ids_b = [str(i).encode("utf-8") for i in event_ids]
+        width = max(len(b) for b in ids_b)
+        if width > _seg.MAX_ID_BYTES:
+            raise StorageError("event id exceeds segment id width")
+        e_glob = self._dict_encode(t, np.asarray(entity_names, object))[
+            np.asarray(entity_codes, np.int64)
+        ]
+        g_glob = self._dict_encode(t, np.asarray(target_names, object))[
+            np.asarray(target_codes, np.int64)
+        ]
+        cols = _seg.SegmentColumns(
+            rids=np.zeros(n, np.int64),  # no source rows: outside every
+            ids=np.array(ids_b, dtype=f"S{width}"),  # cleanup range
+            entities=e_glob.astype(np.int32),
+            targets=g_glob.astype(np.int32),
+            values=vals,
+            times_ms=times,
+            ctimes_ms=ctimes,
+            evcodes=np.zeros(n, np.uint16),
+            propcodes=np.zeros(n, np.uint16),
+            etcodes=np.zeros(n, np.uint16),
+            tetcodes=np.zeros(n, np.uint16),
+            event_names=[event],
+            props=[value_property],
+            entity_types=[entity_type],
+            target_entity_types=[target_entity_type],
+        )
+        now = int(_t.time() * 1000)
+        self._ensure_segment_schema(t)
+        os.makedirs(self._seg_dir(), exist_ok=True)
+        path = os.path.join(
+            self._seg_dir(),
+            f"{t}.import.{now}-{os.getpid()}-"
+            f"{int.from_bytes(os.urandom(4), 'big')}.seg",
+        )
+        footer = _seg.write_segment_file(path, cols)
+        with self._c.lock:
+            self._c.conn.execute(
+                f"INSERT INTO {t}_segments (store, n, min_rowid, max_rowid, "
+                f"min_ms, max_ms, events, entity_types, target_entity_types, "
+                f"path, checksum, created_ms, dead) "
+                f"VALUES (?,?,?,?,?,?,?,?,?,?,?,?,NULL)",
+                (
+                    0, footer["n"], 0, 0, footer["min_ms"], footer["max_ms"],
+                    json.dumps(footer["event_names"]),
+                    json.dumps(footer["entity_types"]),
+                    json.dumps(footer["target_entity_types"]),
+                    path, footer["checksum"], now,
+                ),
+            )
+            self._c.commit()
+        return n
+
     def find_columns_native(
         self,
         app_id: int,
@@ -1517,6 +2646,27 @@ class SQLiteLEvents(base.LEvents):
             if not self._exists(t):
                 raise StorageError(f"events table {t} not initialized")
         parts: List[ColumnarEvents] = []
+        # segment state BEFORE the dict snapshot: a compaction commits
+        # its dict inserts first, so any segment this state references
+        # resolves through the names we read after it
+        marks, segs = self._segment_state(t)
+        names = None  # dict snapshot, fetched once on first need
+
+        def dense(codes):
+            # compress global dict codes to dense name-sorted
+            # indices via a presence bitmap + LUT — three linear
+            # passes instead of np.unique's 20M-element argsort
+            # (the whole scan's former hot spot)
+            seen = np.zeros(len(names), bool)
+            seen[codes] = True
+            present = np.nonzero(seen)[0]
+            pnames = names[present]
+            order = np.argsort(pnames)  # distinct-sized
+            lut = np.zeros(len(names), np.int32)
+            lut[present[order]] = np.arange(
+                len(present), dtype=np.int32
+            )
+            return pnames[order], lut[codes]
 
         pages = self._page_rows(
             t, start_time, until_time, entity_type, event_names,
@@ -1562,24 +2712,8 @@ class SQLiteLEvents(base.LEvents):
             g_all = np.concatenate(g_parts)
             v_all = np.concatenate(v_parts)
             if len(e_all):
-                names = self._dict_names(t)
-
-                def dense(codes):
-                    # compress global dict codes to dense name-sorted
-                    # indices via a presence bitmap + LUT — three linear
-                    # passes instead of np.unique's 20M-element argsort
-                    # (the whole scan's former hot spot)
-                    seen = np.zeros(len(names), bool)
-                    seen[codes] = True
-                    present = np.nonzero(seen)[0]
-                    pnames = names[present]
-                    order = np.argsort(pnames)  # distinct-sized
-                    lut = np.zeros(len(names), np.int32)
-                    lut[present[order]] = np.arange(
-                        len(present), dtype=np.int32
-                    )
-                    return pnames[order], lut[codes]
-
+                if names is None:
+                    names = self._dict_names(t)
                 ue_names, e_codes = dense(e_all)
                 ug_names, g_codes = dense(g_all)
                 parts.append(
@@ -1592,45 +2726,88 @@ class SQLiteLEvents(base.LEvents):
                     )
                 )
 
-        # residual row stores in deterministic order (main file, then
-        # hash shards) — the SAME order the streaming scan yields them,
-        # so both paths see one event sequence
-        all_rows: list = []
-        val_parts: list = []
-        for store in self._c.row_stores():
+        # per row store, in deterministic order (main file, then hash
+        # shards): first the store's sealed SEGMENTS (its compacted
+        # rowid prefix, already in the table-global dict space), then
+        # its residual rows — exactly the per-entity event order an
+        # uncompacted store's residual scan yields, which is what keeps
+        # the merged wire byte-identical. The streaming scan interleaves
+        # identically.
+        from predictionio_tpu.data.storage.columnar import encode_strings
+
+        lo = _ms(start_time) if start_time is not None else None
+        hi = _ms(until_time) if until_time is not None else None
+        for key, store in enumerate(self._c.row_stores()):
+            seg_e, seg_g, seg_v = [], [], []
+            for seg in segs:
+                if seg["store"] != key or not self._segs_match(
+                    seg, event_names, entity_type, target_entity_type, lo, hi
+                ):
+                    continue
+                data = self._open_segment(seg["path"])
+                keep = data.keep_mask(
+                    lo_ms=lo, hi_ms=hi, entity_type=entity_type,
+                    target_entity_type=(
+                        None if target_entity_type is None
+                        else target_entity_type
+                    ),
+                    target_entity_type_set=target_entity_type is not UNSET,
+                    event_names=event_names, dead=self._seg_dead(seg),
+                )
+                e = data.column("entities")
+                g = data.column("targets")
+                v = data.spec_values(spec)
+                if keep is not None:
+                    e, g, v = e[keep], g[keep], v[keep]
+                if len(v):
+                    seg_e.append(e)
+                    seg_g.append(g)
+                    seg_v.append(v)
+            if seg_v:
+                if names is None:
+                    names = self._dict_names(t)
+                ue_names, e_codes = dense(np.concatenate(seg_e))
+                ug_names, g_codes = dense(np.concatenate(seg_g))
+                parts.append(
+                    ColumnarEvents(
+                        entity_names=ue_names,
+                        target_names=ug_names,
+                        entity_codes=e_codes,
+                        target_codes=g_codes,
+                        values=np.concatenate(seg_v),
+                    )
+                )
             rows, values = self._residual_scan(
                 store, t, spec, start_time, until_time, entity_type,
                 target_entity_type, event_names,
+                extra=self._residual_clause(marks, key),
             )
             if rows:
-                all_rows.extend(rows)
-                val_parts.append(values)
-        if all_rows:
-            from predictionio_tpu.data.storage.columnar import encode_strings
-
-            e_names, e_codes = encode_strings([r[0] for r in all_rows])
-            g_names, g_codes = encode_strings([r[1] for r in all_rows])
-            parts.append(
-                ColumnarEvents(
-                    entity_names=e_names,
-                    target_names=g_names,
-                    entity_codes=e_codes,
-                    target_codes=g_codes,
-                    values=np.concatenate(val_parts),
+                e_names, e_codes = encode_strings([r[0] for r in rows])
+                g_names, g_codes = encode_strings([r[1] for r in rows])
+                parts.append(
+                    ColumnarEvents(
+                        entity_names=e_names,
+                        target_names=g_names,
+                        entity_codes=e_codes,
+                        target_codes=g_codes,
+                        values=values,
+                    )
                 )
-            )
         return ColumnarEvents.concat(parts)
 
     def _residual_scan(
         self, store, t, spec, start_time, until_time, entity_type,
-        target_entity_type, event_names,
+        target_entity_type, event_names, extra=None,
     ):
         """Row-store residual of a columnar scan (REST-posted tail) for
         ONE row store (the main file or a hash shard) — value evaluated
         IN SQL (CASE per event override + json_extract), so even this
-        path never parses JSON in Python. Returns ``(rows, values)``:
-        the raw (entity_id, target_entity_id, ...) rows and their
-        float32 training values."""
+        path never parses JSON in Python. ``extra`` is an optional
+        pre-bound ``(clause, params)`` predicate — the segment tier's
+        watermark exclusion. Returns ``(rows, values)``: the raw
+        (entity_id, target_entity_id, ...) rows and their float32
+        training values."""
         import numpy as np
 
         if not store.has_table(t):
@@ -1641,6 +2818,9 @@ class SQLiteLEvents(base.LEvents):
             target_entity_type, UNSET,
         )
         clauses.append("target_entity_id IS NOT NULL")
+        if extra is not None:
+            clauses.append(extra[0])
+            params = list(params) + list(extra[1])
         case_sql = ""
         case_params: list = []
         null_case_sql = ""
@@ -1666,10 +2846,16 @@ class SQLiteLEvents(base.LEvents):
             # fail the scan (the value CASE short-circuits past it too)
             type_sql = f"CASE event {null_case_sql}ELSE {type_sql} END"
             raw_sql = f"CASE event {null_case_sql}ELSE {raw_sql} END"
+        # ORDER BY rowid pins the scan to insertion order. Without it
+        # the order is the query planner's choice (the entity index
+        # groups by entity id when entity_type filters) — and the
+        # segment tier replays sealed rows in ROWID order, so the
+        # residual must too or a compacted store's wire would diverge
+        # from an uncompacted one's.
         sql = (
             f"SELECT entity_id, target_entity_id, {value_sql}, "
             f"{type_sql}, {raw_sql} FROM {t} "
-            "WHERE " + " AND ".join(clauses)
+            "WHERE " + " AND ".join(clauses) + " ORDER BY rowid"
         )
         prop_path = '$."' + spec.prop.replace('"', '""') + '"'
         all_params = (
@@ -1713,13 +2899,16 @@ class SQLiteLEvents(base.LEvents):
         event_names: Optional[Sequence[str]] = None,
         batch_rows: int = 1_048_576,
     ):
-        """Chunked binary columnar scan: one batch per page (split past
-        ``batch_rows``), all batches in the TABLE-GLOBAL dictionary code
-        space, plus a final batch for the row-store residual whose new
-        ids extend that space. The page-id list is snapshotted up front
-        (ids only, no blobs), so peak memory is one page and a page
-        inserted mid-scan is simply not part of this scan — exactly the
-        WAL snapshot semantics of the monolithic scan."""
+        """Chunked binary columnar scan: one batch per page/segment
+        (split past ``batch_rows``), all batches in the TABLE-GLOBAL
+        dictionary code space, plus per-store residual batches whose new
+        ids extend that space. Order per row store: the store's sealed
+        SEGMENTS (its compacted rowid prefix), then its residual rows —
+        the per-entity event order of an uncompacted store, which keeps
+        the merged wire byte-identical. The page-id list and the segment
+        manifest are snapshotted up front (ids/manifest only, no blobs),
+        so peak memory is one page/segment and anything committed
+        mid-scan is simply not part of this scan."""
         import numpy as np
 
         from predictionio_tpu.data.storage.columnar import (
@@ -1736,6 +2925,9 @@ class SQLiteLEvents(base.LEvents):
         # then makes the next cache lookup miss, never hit stale
         fingerprint = self.store_fingerprint(app_id, channel_id)
         self._ensure_pages_schema(t)
+        # segment state BEFORE the dict snapshot (compaction commits its
+        # dict inserts first, so every referenced code resolves)
+        marks, segs = self._segment_state(t)
         page_ids: List[int] = []
         # ids only, no blobs (peak memory stays one page); the filter is
         # the SAME clause builder the monolithic scan uses, so both paths
@@ -1803,15 +2995,17 @@ class SQLiteLEvents(base.LEvents):
                     sl = slice(s, s + batch_rows)
                     if len(v[sl]):
                         yield e[sl], g[sl], v[sl]
-            # residual row stores in deterministic order (main file,
-            # then hash shards — the same order find_columns_native
-            # concatenates them). All stores' ids map into ONE shared
-            # code space through a name->code dict; unseen ids extend it
-            # (the residual is the REST tail — small next to the page
-            # bulk). Events of one entity live in one shard, so each
-            # entity's events keep their per-store insertion order and
-            # the consumer's stable counting-sort merge reproduces the
-            # single-file wire byte-for-byte.
+            # per row store, in deterministic order (main file, then
+            # hash shards — the same order find_columns_native
+            # concatenates them): the store's segments (already in the
+            # global dict code space, like pages), then its residual
+            # rows. All stores' residual ids map into ONE shared code
+            # space through a name->code dict; unseen ids extend it
+            # (the residual is the REST tail — small next to the
+            # page/segment bulk). Events of one entity live in one
+            # shard, so each entity's events keep their per-store order
+            # and the consumer's stable counting-sort merge reproduces
+            # the single-file, uncompacted wire byte-for-byte.
             code_of: Optional[dict] = None
 
             def enc(strs):
@@ -1825,10 +3019,37 @@ class SQLiteLEvents(base.LEvents):
                     out[j] = c
                 return out
 
-            for store in self._c.row_stores():
+            tet_set = target_entity_type is not UNSET
+            for key, store in enumerate(self._c.row_stores()):
+                for seg in segs:
+                    if seg["store"] != key or not self._segs_match(
+                        seg, event_names, entity_type, target_entity_type,
+                        lo, hi,
+                    ):
+                        continue
+                    data = self._open_segment(seg["path"])
+                    keep = data.keep_mask(
+                        lo_ms=lo, hi_ms=hi, entity_type=entity_type,
+                        target_entity_type=(
+                            None if target_entity_type is None
+                            else target_entity_type
+                        ),
+                        target_entity_type_set=tet_set,
+                        event_names=event_names, dead=self._seg_dead(seg),
+                    )
+                    e = data.column("entities")
+                    g = data.column("targets")
+                    v = data.spec_values(spec)
+                    if keep is not None:
+                        e, g, v = e[keep], g[keep], v[keep]
+                    for s in range(0, len(v), batch_rows):
+                        sl = slice(s, s + batch_rows)
+                        if len(v[sl]):
+                            yield e[sl], g[sl], v[sl]
                 rows, values = self._residual_scan(
                     store, t, spec, start_time, until_time, entity_type,
                     target_entity_type, event_names,
+                    extra=self._residual_clause(marks, key),
                 )
                 if not rows:
                     continue
@@ -1860,27 +3081,49 @@ class SQLiteLEvents(base.LEvents):
         """Cheap store-state aggregates: per row store (the main file
         plus every hash shard) a (count, max rowid, max event time)
         triple, + page store (count, max page id, total rows, max time)
-        + exact tombstone populations. Every mutating path moves at
+        + exact tombstone populations + the segment manifest (id, n,
+        dead population per segment). Every mutating path moves at
         least one component: inserts bump their shard's counts/max-rowid
-        (INSERT OR REPLACE reassigns the implicit rowid), bulk imports
-        add pages, deletes shrink counts or flip tombstone bits. Costs a
-        few aggregate scans plus one pass over the (rare) dead blobs."""
+        (INSERT OR REPLACE reassigns the rowid), bulk imports add pages,
+        compactions register segments, deletes shrink counts or flip
+        tombstone bits. Row triples apply the segment tier's residual
+        predicate, so the DEFERRED physical delete of sealed rows (pure
+        space reclaim, no logical change) never moves the fingerprint —
+        the pack cache keeps hitting across cleanups. Costs a few
+        aggregate scans plus one pass over the (rare) dead blobs."""
         import numpy as np
 
         t = self._events_table(app_id, channel_id)
         with self._c.lock:
             if not self._exists(t):
                 return None
-        row = tuple(
-            tuple(
-                store.read_execute(
-                    f"SELECT COUNT(*), COALESCE(MAX(rowid), 0), "
-                    f"COALESCE(MAX(event_time_ms), 0) FROM {t}"
-                ).fetchone()
+        marks, segs = self._segment_state(t)
+        row_parts = []
+        for key, store in enumerate(self._c.row_stores()):
+            if not store.has_table(t):
+                row_parts.append((0, 0, 0))
+                continue
+            sql = (
+                f"SELECT COUNT(*), COALESCE(MAX(rowid), 0), "
+                f"COALESCE(MAX(event_time_ms), 0) FROM {t}"
             )
-            if store.has_table(t)
-            else (0, 0, 0)
-            for store in self._c.row_stores()
+            pred = self._residual_clause(marks, key)
+            params: list = []
+            if pred is not None:
+                sql += f" WHERE {pred[0]}"
+                params = pred[1]
+            row_parts.append(
+                tuple(store.read_execute(sql, params).fetchone())
+            )
+        row = tuple(row_parts)
+        seg_sig = tuple(
+            (
+                s["segment"], s["n"],
+                int(np.frombuffer(s["dead"], np.uint8).sum())
+                if s["dead"] is not None
+                else 0,
+            )
+            for s in segs
         )
         pages = (0, 0, 0, 0)
         dead_sig: tuple = ()
@@ -1902,7 +3145,7 @@ class SQLiteLEvents(base.LEvents):
                     f"WHERE dead IS NOT NULL ORDER BY page"
                 ).fetchall()
             )
-        return ("sqlite", row, pages, dead_sig)
+        return ("sqlite", row, pages, dead_sig, seg_sig)
 
 
 class _SQLiteMetaBase:

@@ -42,6 +42,7 @@ import functools
 import hashlib
 import logging
 import math
+import threading
 from typing import List, Optional, Sequence, Tuple
 
 import jax
@@ -52,6 +53,23 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from predictionio_tpu.parallel.mesh import pad_to_multiple
 
 logger = logging.getLogger(__name__)
+
+# Concurrent fused-loop executions from several host threads (a grid
+# evaluation's thread-parallel variants) deterministically deadlock the
+# XLA CPU client on small-core boxes: threads park forever inside
+# run_iters/device_get (tier-1's test_grid_evaluation_picks_best hang).
+# On the CPU backend the device work serializes on the cores anyway, so
+# a process-wide lock around the device loop + factor fetch costs
+# nothing and removes the deadlock; accelerator backends never take it.
+_CPU_DEVICE_LOOP_LOCK = threading.Lock()
+
+
+def _device_loop_guard():
+    import contextlib
+
+    if jax.default_backend() == "cpu":
+        return _CPU_DEVICE_LOOP_LOCK
+    return contextlib.nullcontext()
 
 
 @dataclasses.dataclass(frozen=True)
@@ -1102,19 +1120,24 @@ def start_compile_async(
                     jnp.zeros((geo.n_chunks, geo.sc), jnp.int32),
                 )
 
-            out = _run_iterations(
-                jnp.zeros((r_u, k), jnp.float32),
-                jnp.zeros((r_i, k), jnp.float32),
-                zpack(geo_u, L_u), zpack(geo_i, L_i),
-                jnp.zeros((r_u,), jnp.float32),
-                jnp.zeros((r_i,), jnp.float32),
-                jnp.zeros((r_u,), bool), jnp.zeros((r_i,), bool),
-                config.alpha, jnp.int32(0),
-                implicit=config.implicit_prefs,
-                compute_dtype=config.compute_dtype,
-                rep_sharding=None, row_sharding=None,
-            )
-            _fence(out)
+            # the warm-up EXECUTES (zero iterations) — on the CPU
+            # backend it must serialize with any in-flight device loop,
+            # or the concurrent-execution deadlock the guard exists for
+            # can recur through this background thread
+            with _device_loop_guard():
+                out = _run_iterations(
+                    jnp.zeros((r_u, k), jnp.float32),
+                    jnp.zeros((r_i, k), jnp.float32),
+                    zpack(geo_u, L_u), zpack(geo_i, L_i),
+                    jnp.zeros((r_u,), jnp.float32),
+                    jnp.zeros((r_i,), jnp.float32),
+                    jnp.zeros((r_u,), bool), jnp.zeros((r_i,), bool),
+                    config.alpha, jnp.int32(0),
+                    implicit=config.implicit_prefs,
+                    compute_dtype=config.compute_dtype,
+                    rep_sharding=None, row_sharding=None,
+                )
+                _fence(out)
         except Exception as e:  # pragma: no cover - defensive
             rec["error"] = repr(e)
         rec["busy_s"] = _time.perf_counter() - t0
@@ -1458,7 +1481,8 @@ def _train_packed(
             # best-effort warm-up failed; compile inline so the loop
             # timing stays clean
             t_phase = _time.perf_counter()
-            _fence(run_iters(X + 0, Y + 0, 0))
+            with _device_loop_guard():
+                _fence(run_iters(X + 0, Y + 0, 0))
             timings["compile_s"] = _time.perf_counter() - t_phase
     elif timings is not None:
         # compile outside the timed loop: a ZERO-iteration run builds the
@@ -1466,7 +1490,8 @@ def _train_packed(
         # Donation consumes its inputs, so feed it copies of the factor
         # arrays (cheap HBM-side copies).
         t_phase = _time.perf_counter()
-        _fence(run_iters(X + 0, Y + 0, 0))
+        with _device_loop_guard():
+            _fence(run_iters(X + 0, Y + 0, 0))
         timings["compile_s"] = _time.perf_counter() - t_phase
 
     from predictionio_tpu.workflow.checkpoint import StepCheckpointer
@@ -1524,7 +1549,7 @@ def _train_packed(
     # (bench.py --trace-loop reduces the trace to docs/ALS_LOOP_TRACE.json).
     # Covers both the single-program path and the checkpoint-chunked loop.
     try:
-        with _profiler_trace(profile_dir):
+        with _device_loop_guard(), _profiler_trace(profile_dir):
             if not ckpt.enabled:
                 # the entire loop is one device program
                 if config.iterations > start_it:
@@ -1577,16 +1602,17 @@ def _train_packed(
     finally:
         ckpt.close()
 
-    if getattr(X, "is_fully_addressable", True) and getattr(
-        Y, "is_fully_addressable", True
-    ):
-        # one device_get for both factor matrices: each separate fetch
-        # costs a full round trip on relayed rigs (~65 ms), which at
-        # ML-100K scale is a third of the train wall clock
-        X_host, Y_host = jax.device_get((X, Y))
-        X_host, Y_host = np.asarray(X_host), np.asarray(Y_host)
-    else:
-        X_host, Y_host = _fetch_global(X), _fetch_global(Y)
+    with _device_loop_guard():
+        if getattr(X, "is_fully_addressable", True) and getattr(
+            Y, "is_fully_addressable", True
+        ):
+            # one device_get for both factor matrices: each separate fetch
+            # costs a full round trip on relayed rigs (~65 ms), which at
+            # ML-100K scale is a third of the train wall clock
+            X_host, Y_host = jax.device_get((X, Y))
+            X_host, Y_host = np.asarray(X_host), np.asarray(Y_host)
+        else:
+            X_host, Y_host = _fetch_global(X), _fetch_global(Y)
     return ALSModelArrays(X_host[:n_users], Y_host[:n_items])
 
 

@@ -30,8 +30,8 @@ from predictionio_tpu.tools.commands import (
 logger = logging.getLogger(__name__)
 
 
-def _describe(d: AppDescription) -> dict:
-    return {
+def _describe(d: AppDescription, compaction: Optional[dict] = None) -> dict:
+    out = {
         "name": d.app.name,
         "id": d.app.id,
         "description": d.app.description or "",
@@ -40,11 +40,29 @@ def _describe(d: AppDescription) -> dict:
         ],
         "channels": [{"name": c.name, "id": c.id} for c in d.channels],
     }
+    if compaction is not None:
+        # segment-tier observability (data/storage/segments.py): how
+        # much of the app's event store scans at mmap rate
+        out["compaction"] = {
+            "segments": compaction["segments"],
+            "compactedEvents": compaction["segmentEvents"],
+            "compactedFraction": round(compaction["compactedFraction"], 6),
+            "lastCompactionMs": compaction["lastCompactionMs"],
+        }
+    return out
 
 
 class AdminAPI:
     def __init__(self, storage: Optional[Storage] = None):
-        self.client = CommandClient(storage or get_storage())
+        from predictionio_tpu.data.storage.segments import (
+            CachedCompactionStatus,
+        )
+
+        self.storage = storage or get_storage()
+        self.client = CommandClient(self.storage)
+        # stats cost COUNT(*) scans per app; shared TTL cache so
+        # listing-happy dashboards can't hammer the store
+        self._compaction_status = CachedCompactionStatus(self.storage)
 
     def handle(self, method, path, query=None, body=None, form=None) -> Tuple[int, dict]:
         try:
@@ -66,9 +84,13 @@ class AdminAPI:
 
         if len(parts) == 2:
             if method == "GET":
+                compaction = self._compaction_status.get()
                 return 200, {
                     "status": 0,
-                    "apps": [_describe(d) for d in self.client.app_list()],
+                    "apps": [
+                        _describe(d, compaction.get(d.app.name))
+                        for d in self.client.app_list()
+                    ],
                 }
             if method == "POST":
                 try:

@@ -297,7 +297,20 @@ def cmd_eventserver(args) -> int:
         ]
         if args.stats:
             cmd.append("--stats")
-        procs = [subprocess.Popen(cmd) for _ in range(workers)]
+        # exactly ONE worker runs the segment compactor (concurrent
+        # compactors are safe — the manifest commit re-validates the
+        # watermark — but N of them would duplicate the sealing work)
+        procs = [
+            subprocess.Popen(
+                cmd
+                + (
+                    ["--no-compact"]
+                    if (w > 0 or getattr(args, "no_compact", False))
+                    else []
+                )
+            )
+            for w in range(workers)
+        ]
 
         shutdown = {"requested": False}
 
@@ -351,10 +364,67 @@ def cmd_eventserver(args) -> int:
             ip=args.ip, port=args.port, stats=args.stats,
             reuse_port=bool(getattr(args, "reuse_port", False)),
             transport=args.transport,
+            compact=not getattr(args, "no_compact", False),
         )
     )
     print(f"Event server serving on {args.ip}:{server.port}")
     server.serve_forever()
+    return 0
+
+
+def cmd_compact(args) -> int:
+    """Standalone segment compaction (the event server runs the same
+    daemon in-process by default): one round per app, or a daemon loop
+    with --interval."""
+    import time as _time
+
+    from predictionio_tpu.data.storage import get_storage
+    from predictionio_tpu.data.store import app_name_to_id
+    from predictionio_tpu.data.storage.segments import (
+        CompactionPolicy,
+        SegmentCompactor,
+    )
+
+    storage = get_storage()
+    if not SegmentCompactor.supported(storage):
+        print(
+            "compact: the configured EVENTDATA backend has no segment "
+            "tier (sqlite only); nothing to do",
+            file=sys.stderr,
+        )
+        return 2
+    policy = CompactionPolicy(
+        cold_s=args.cold_s, min_events=args.min_events, grace_s=args.grace_s
+    )
+    apps = None
+    if args.app:
+        app_id, _ = app_name_to_id(args.app, None, storage)
+        apps = [app_id]
+    compactor = SegmentCompactor(
+        storage, policy=policy,
+        interval_s=args.interval or 60.0, apps=apps,
+    )
+
+    def run_round() -> None:
+        if args.app and args.channel:
+            app_id, channel_id = app_name_to_id(
+                args.app, args.channel, storage
+            )
+            results = {app_id: compactor.run_once(app_id, channel_id)}
+        else:
+            results = compactor.compact_all_once()
+        for app_id, r in results.items():
+            print(f"app {app_id}: {r}")
+
+    run_round()
+    if args.interval > 0:
+        print(f"compact: daemon mode, every {args.interval:g}s (Ctrl-C stops)")
+        try:
+            while True:
+                _time.sleep(args.interval)
+                run_round()
+        except KeyboardInterrupt:
+            return 0
     return 0
 
 
@@ -743,7 +813,38 @@ def build_parser() -> argparse.ArgumentParser:
         help="REST frontend: 'async' = event loop + bounded handler "
         "pool; 'threaded' = stdlib thread-per-connection fallback",
     )
+    es.add_argument(
+        "--no-compact", action="store_true",
+        help="disable the background segment compactor (cold event "
+        "ranges stay in the row store; see 'pio compact')",
+    )
     es.set_defaults(func=cmd_eventserver)
+
+    cp = sub.add_parser(
+        "compact",
+        help="seal cold event ranges into immutable columnar segments",
+    )
+    cp.add_argument("--app", help="app name (default: every app)")
+    cp.add_argument("--channel", help="channel name (with --app)")
+    cp.add_argument(
+        "--interval", type=float, default=0.0,
+        help="run as a daemon at this period in seconds "
+        "(default: one round, then exit)",
+    )
+    cp.add_argument(
+        "--cold-s", type=float, default=300.0,
+        help="events older than this are sealable (default 300)",
+    )
+    cp.add_argument(
+        "--min-events", type=int, default=4096,
+        help="skip rounds that would seal fewer events (default 4096)",
+    )
+    cp.add_argument(
+        "--grace-s", type=float, default=600.0,
+        help="sealed rows stay physically present this long so "
+        "in-flight scans never lose them (default 600)",
+    )
+    cp.set_defaults(func=cmd_compact)
 
     gw = sub.add_parser(
         "storagegateway",

@@ -57,17 +57,26 @@ def events_to_file(
     le = storage.get_p_events()
     if format == "parquet" and hasattr(le, "iter_export_pages"):
         # split export: row-store events through the generic batch
-        # writer, bulk pages as vectorized column batches (exporting 20M
-        # events must not build 20M Event objects any more than
-        # importing them does)
+        # writer, bulk pages AND compacted segments as vectorized column
+        # batches (exporting 20M events must not build 20M Event objects
+        # any more than importing them does). Segment groups carry the
+        # ORIGINAL event ids + creation times, so the import side can
+        # re-seal them as segments — the near-zero-copy exchange.
+        import itertools
+
+        column_groups = le.iter_export_pages(app_id, channel_id)
+        if hasattr(le, "iter_export_segments"):
+            column_groups = itertools.chain(
+                column_groups, le.iter_export_segments(app_id, channel_id)
+            )
         n = _write_parquet(
             path,
             le.iter_row_events(app_id, channel_id),
-            page_columns=le.iter_export_pages(app_id, channel_id),
+            page_columns=column_groups,
         )
         logger.info(
-            "exported %d events of app %s to %s (parquet, columnar pages)",
-            n, app_name, path,
+            "exported %d events of app %s to %s (parquet, columnar "
+            "pages + segments)", n, app_name, path,
         )
         return n
     events_iter = le.find(app_id=app_id, channel_id=channel_id)
@@ -147,7 +156,22 @@ def file_to_events(
                     break
                 if kind == "error":
                     raise table
-                if prepared is not None:
+                if prepared is not None and "event_ids" in prepared:
+                    # segment-export group (real ids preserved): re-seal
+                    # it directly as a segment when the backend has the
+                    # tier AND none of the sampled ids already exist —
+                    # re-importing into the source app must stay
+                    # idempotent, which only the keyed generic path is
+                    n = _import_segment_group(
+                        le, app_id, channel_id, prepared
+                    )
+                    if n is None:
+                        group_events = _events_from_table(table)
+                        le.write(group_events, app_id, channel_id)
+                        n = len(group_events)
+                    else:
+                        bulk += n
+                elif prepared is not None:
                     # the WRITE stays outside the producer's qualify
                     # net: a failed/ambiguous bulk write must surface,
                     # not silently fall through to the generic reader
@@ -193,6 +217,30 @@ def file_to_events(
     storage.get_p_events().write(events, app_id, channel_id)
     logger.info("imported %d events into app %s", len(events), app_name)
     return len(events)
+
+
+def _import_segment_group(le, app_id, channel_id, prepared):
+    """Land a real-id column group as a sealed segment (near-zero-copy
+    import), or return None to route it through the generic reader:
+    when the backend has no segment tier, or when any sampled id
+    already exists here (idempotent re-import needs the keyed path —
+    the segment tier is append-only)."""
+    insert_segment = getattr(le, "insert_segment_encoded", None)
+    if insert_segment is None:
+        return None
+    ids = prepared["event_ids"]
+    probe = {str(ids[0]), str(ids[len(ids) // 2]), str(ids[-1])}
+    try:
+        for eid in probe:
+            if le.get(eid, app_id, channel_id) is not None:
+                return None
+        return insert_segment(app_id, channel_id, **prepared)
+    except Exception:
+        logger.warning(
+            "segment import path failed; falling back to the generic "
+            "reader", exc_info=True,
+        )
+        return None
 
 
 def _columnar_import_qualify(table):
@@ -247,19 +295,34 @@ def _columnar_import_qualify(table):
     for name in ("entityId", "targetEntityId", "eventTime"):
         if pc.sum(pc.cast(pc.is_null(cols[name]), pa.int64())).as_py():
             return None
-    # event ids must be absent or page-synthetic ("pg-<page>-<idx>" —
-    # source-local positional handles with no meaning in another store).
-    # Files carrying REAL event ids take the generic path, which
-    # preserves them and stays idempotent across re-imports (INSERT OR
-    # REPLACE keyed on id); the bulk path is append-only.
+    # event ids: absent or page-synthetic ("pg-<page>-<idx>" —
+    # source-local positional handles with no meaning in another store)
+    # keep the plain bulk path. A group where EVERY row carries a real,
+    # unique, bounded-width id is a SEGMENT export: qualify it with the
+    # ids (and creation times below) preserved, so the import side can
+    # re-seal it as a segment. Mixed/partial ids take the generic path,
+    # which preserves them row by row and stays idempotent across
+    # re-imports (INSERT OR REPLACE keyed on id).
+    event_ids = None
     if "eventId" in cols:
         ids = cols["eventId"].combine_chunks()
         n_real = pc.sum(pc.cast(pc.is_valid(ids), pa.int64())).as_py() or 0
         if n_real:
             synthetic = pc.match_substring_regex(ids, "^pg-[0-9]+-[0-9]+$")
             ok = pc.sum(pc.cast(synthetic, pa.int64())).as_py() or 0
-            if ok != n_real:
+            if ok != n_real and not (ok == 0 and n_real == n):
                 return None
+            if ok == 0 and n_real == n:
+                from predictionio_tpu.data.storage.segments import (
+                    MAX_ID_BYTES,
+                )
+
+                ids_np = ids.to_numpy(zero_copy_only=False)
+                if len(np.unique(ids_np)) != n or max(
+                    len(str(i).encode("utf-8")) for i in ids_np
+                ) > MAX_ID_BYTES:
+                    return None
+                event_ids = ids_np
     if "prId" in cols and pc.sum(
         pc.cast(pc.is_valid(cols["prId"]), pa.int64())
     ).as_py():
@@ -445,7 +508,7 @@ def _columnar_import_qualify(table):
 
     e_names, e_codes = encode("entityId")
     g_names, g_codes = encode("targetEntityId")
-    return dict(
+    prepared = dict(
         event=event,
         entity_type=entity_type,
         target_entity_type=target_entity_type,
@@ -457,6 +520,24 @@ def _columnar_import_qualify(table):
         value_property=prop_key,
         event_times_ms=times_ms,
     )
+    if event_ids is not None:
+        # a real-id (segment) group must also round-trip its creation
+        # times to re-seal losslessly; sub-ms creation times fall back
+        # to the generic reader via the safe-cast raise
+        ctimes = cols.get("creationTime")
+        if ctimes is None:
+            return None
+        ctimes = ctimes.combine_chunks()
+        if not pa.types.is_timestamp(ctimes.type):
+            return None
+        prepared["event_ids"] = event_ids
+        prepared["creation_times_ms"] = (
+            pc.cast(ctimes, pa.timestamp("ms", tz="UTC"))
+            .cast(pa.int64())
+            .to_numpy(zero_copy_only=False)
+            .astype(np.int64)
+        )
+    return prepared
 
 
 # --- parquet columnar layout ---
@@ -500,11 +581,24 @@ def _page_columns_to_table(pa, schema, ts, page: dict):
                 "NaN" if v != v else ("Infinity" if v > 0 else "-Infinity")
             )
     # the key goes through json.dumps so quotes/backslashes/control
-    # chars escape correctly
-    props = np.char.add(
-        np.char.add("{%s: " % json.dumps(page["prop"]), vals_str), "}"
-    )
+    # chars escape correctly. Empty-prop rows (segment groups of
+    # propertyless events) render an empty bag.
+    if page["prop"]:
+        props = np.char.add(
+            np.char.add("{%s: " % json.dumps(page["prop"]), vals_str), "}"
+        )
+        props = props.tolist()
+    else:
+        props = [None] * n
     times = pa.array(page["times_ms"] * 1000, type=pa.int64()).cast(ts)
+    ctimes = (
+        pa.array(
+            np.asarray(page["creation_times_ms"], np.int64) * 1000,
+            type=pa.int64(),
+        ).cast(ts)
+        if page.get("creation_times_ms") is not None
+        else times
+    )
     cols = {
         "eventId": pa.array(page["event_ids"], type=pa.string()),
         "event": const(page["event"]),
@@ -519,11 +613,13 @@ def _page_columns_to_table(pa, schema, ts, page: dict):
             np.asarray(page["target_ids"], object), type=pa.string()
         ),
         "prId": pa.array([None] * n, type=pa.string()),
-        "properties": pa.array(props.tolist(), type=pa.string()),
+        "properties": pa.array(props, type=pa.string()),
         "tags": pa.array([[]] * n, type=pa.list_(pa.string())),
         "eventTime": times,
-        "creationTime": times,
-        "propKey": const(page["prop"]),
+        "creationTime": ctimes,
+        "propKey": const(page["prop"]) if page["prop"] else pa.array(
+            [None] * n, type=pa.string()
+        ),
         "propValue": pa.array(
             np.asarray(values, np.float64), type=pa.float64()
         ),

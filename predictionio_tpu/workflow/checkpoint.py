@@ -67,7 +67,13 @@ class StepCheckpointer:
         logger.info(
             "restoring checkpoint step %d from %s", step, self.directory
         )
-        return self._mgr.restore(step)
+        # orbax >= 0.5 requires the CheckpointArgs subclass on restore
+        # (a bare restore() raises KeyError for the "default" item);
+        # StandardRestore with no target tree reproduces the old
+        # restore-everything behavior for our numpy/step pytrees
+        return self._mgr.restore(
+            step, args=self._ocp.args.StandardRestore()
+        )
 
     def maybe_save(self, step: int, pytree: Any, force: bool = False) -> bool:
         """Save when the step hits the cadence (or force=True)."""

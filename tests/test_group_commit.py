@@ -534,6 +534,123 @@ class TestShardedScanParity:
         assert int(wire1.counts_u.sum()) == n_total
         assert wire2.iw.dtype == wire1.iw.dtype
 
+    def test_wire_byte_identical_with_compactor_racing(self, tmp_path):
+        """ISSUE 6 acceptance oracle: a background compactor sealing
+        cold ranges into columnar segments WHILE writers ingest and a
+        streaming scan loops must leave the final merged wire
+        BYTE-identical to a never-compacted single-file store's —
+        compaction, like sharding, is invisible to training."""
+        import time as _time
+
+        from predictionio_tpu.data.storage.segments import (
+            CompactionPolicy,
+        )
+
+        single = sqlite_storage(tmp_path / "one.db", app_name="gc")
+        sharded = sqlite_storage(
+            tmp_path / "many.db", shards=4, app_name="gc"
+        )
+        single_le = single.get_l_events()
+        sharded_le = sharded.get_l_events()
+
+        stop = threading.Event()
+        scan_errors = []
+        compact_errors = []
+        scans = {"count": 0}
+        compactions = {"sealed": 0, "rounds": 0}
+        # everything is instantly cold; the grace window outlives the
+        # test so racing scans can never lose rows to physical deletes
+        policy = CompactionPolicy(
+            cold_s=0.0, min_events=1, grace_s=3600.0
+        )
+
+        def compactor():
+            while not stop.is_set():
+                try:
+                    r = sharded_le.compact_app(1, policy=policy)
+                    compactions["sealed"] += r.get("sealed_events", 0)
+                    compactions["rounds"] += 1
+                except Exception as e:  # pragma: no cover
+                    compact_errors.append(e)
+                    return
+                _time.sleep(0.01)
+
+        def scanner():
+            try:
+                while not stop.is_set():
+                    stream = sharded_le.stream_columns_native(1, **SCAN_KW)
+                    total = 0
+                    for e, g, v in stream:
+                        assert len(e) == len(g) == len(v)
+                        total += len(v)
+                    _ = stream.names
+                    scans["count"] += 1
+            except Exception as e:  # pragma: no cover
+                scan_errors.append(e)
+
+        scan_t = threading.Thread(target=scanner)
+        comp_t = threading.Thread(target=compactor)
+        scan_t.start()
+        comp_t.start()
+        threads, errors, n_total = self._fill_both(single_le, sharded_le)
+        for t in threads:
+            t.join(timeout=120)
+        # let the compactor catch the tail before quiescing
+        deadline = _time.monotonic() + 30.0
+        while (
+            compactions["sealed"] < n_total
+            and _time.monotonic() < deadline
+        ):
+            _time.sleep(0.05)
+        stop.set()
+        scan_t.join(timeout=60)
+        comp_t.join(timeout=60)
+        assert not errors, errors
+        assert not scan_errors, scan_errors
+        assert not compact_errors, compact_errors
+        assert scans["count"] > 0, "no scan completed during the race"
+        assert compactions["sealed"] >= n_total, (
+            "compaction never caught up with ingest",
+            compactions,
+        )
+        stats = sharded_le.compaction_stats(1)
+        assert stats["segments"] > 0 and stats["segmentEvents"] == n_total
+
+        config = ALSConfig(rank=4, iterations=1, reg=0.05)
+        w1 = _scan_and_pack(
+            PEventStore(single).stream_columns("gc", **SCAN_KW),
+            config, {}, 4,
+        )
+        w2 = _scan_and_pack(
+            PEventStore(sharded).stream_columns("gc", **SCAN_KW),
+            config, {}, 4,
+        )
+        assert w1 is not None and w2 is not None
+        wire1, uidx1, iidx1, _ = w1
+        wire2, uidx2, iidx2, _ = w2
+        assert list(uidx1) == list(uidx2)
+        assert list(iidx1) == list(iidx2)
+        assert wire1.iw.tobytes() == wire2.iw.tobytes()
+        assert wire1.vw.tobytes() == wire2.vw.tobytes()
+        np.testing.assert_array_equal(wire1.counts_u, wire2.counts_u)
+        np.testing.assert_array_equal(wire1.counts_i, wire2.counts_i)
+        assert int(wire2.counts_u.sum()) == n_total
+
+        # and once more after the deferred physical delete: cleanup is
+        # pure space reclaim, the wire cannot move
+        sharded_le.compact_app(
+            1,
+            policy=CompactionPolicy(cold_s=0.0, min_events=1, grace_s=0.0),
+        )
+        assert sharded_le.compaction_stats(1)["rowEvents"] == 0
+        w3 = _scan_and_pack(
+            PEventStore(sharded).stream_columns("gc", **SCAN_KW),
+            config, {}, 4,
+        )
+        wire3 = w3[0]
+        assert wire3.iw.tobytes() == wire1.iw.tobytes()
+        assert wire3.vw.tobytes() == wire1.vw.tobytes()
+
     def test_pack_cache_hits_on_unchanged_sharded_store(self, tmp_path):
         """The combined per-shard fingerprint is stable across repeat
         scans of an unchanged sharded store (cache hit) and moves when

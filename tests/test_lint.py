@@ -171,3 +171,94 @@ def test_async_blocking_allowlist_is_not_stale():
         f"async-blocking allowlist entries no longer in the tree: "
         f"{sorted(stale)}"
     )
+
+
+# --- mutable module-level state in the segment tier ---
+#
+# The bug class: a compactor (or its caches/locks/thread registries)
+# held in module globals is shared by every storage universe in the
+# process — one test's daemon outlives its store, a second event server
+# inherits the first's threads, and cross-universe state aliases exactly
+# like the id()-keyed caches above. data/storage/segments.py is the
+# subsystem's home, so it is held to instance-scoped state ONLY: module
+# level may bind constants (numbers, strings, tuples of constants),
+# classes, and functions — never lists/dicts/sets/locks/threads/queues.
+
+_MUTABLE_STATE_FILES = ("data/storage/segments.py",)
+
+_MUTABLE_CALLS = {
+    "dict", "list", "set", "bytearray", "defaultdict", "OrderedDict",
+    "Counter", "deque", "Queue", "LifoQueue", "PriorityQueue",
+    "SimpleQueue", "Lock", "RLock", "Condition", "Event", "Semaphore",
+    "BoundedSemaphore", "Barrier", "Thread", "ThreadPoolExecutor",
+    "WeakSet", "WeakKeyDictionary", "WeakValueDictionary",
+}
+
+# (relative path, stripped source line) pairs reviewed as safe.
+# Shrink-only: delete entries when the code they excuse goes away.
+MUTABLE_MODULE_STATE_ALLOWED: set = set()
+
+
+def _mutable_module_state_occurrences():
+    import ast
+
+    found = set()
+    for rel in _MUTABLE_STATE_FILES:
+        path = PACKAGE / rel
+        source = path.read_text(encoding="utf-8")
+        lines = source.splitlines()
+        tree = ast.parse(source, filename=str(path))
+
+        def is_mutable(node) -> bool:
+            if isinstance(
+                node,
+                (
+                    ast.List, ast.Dict, ast.Set, ast.ListComp,
+                    ast.DictComp, ast.SetComp, ast.GeneratorExp,
+                ),
+            ):
+                return True
+            if isinstance(node, ast.Call):
+                fn = node.func
+                name = fn.attr if isinstance(fn, ast.Attribute) else (
+                    fn.id if isinstance(fn, ast.Name) else None
+                )
+                return name in _MUTABLE_CALLS
+            return False
+
+        for node in ast.iter_child_nodes(tree):
+            if isinstance(node, ast.AugAssign):
+                # any module-level augmented assignment is mutation of
+                # module state — flag unconditionally
+                found.add((rel, lines[node.lineno - 1].strip()))
+                continue
+            if isinstance(node, (ast.Assign, ast.AnnAssign)):
+                if node.value is not None and is_mutable(node.value):
+                    found.add((rel, lines[node.lineno - 1].strip()))
+            # a module-level `global` escape hatch inside a function is
+            # the same bug wearing a trench coat
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Global):
+                found.add((rel, lines[node.lineno - 1].strip()))
+    return found
+
+
+def test_no_mutable_module_state_in_segment_tier():
+    found = _mutable_module_state_occurrences()
+    new = found - MUTABLE_MODULE_STATE_ALLOWED
+    assert not new, (
+        "mutable module-level state in the segment tier — compactor "
+        "daemons, caches, and locks must hang off an instance owned by "
+        "a server or CLI run, never the module (cross-universe aliasing "
+        "and leaked daemon threads); move it into a class or justify an "
+        f"allowlist entry: {sorted(new)}"
+    )
+
+
+def test_mutable_module_state_allowlist_is_not_stale():
+    found = _mutable_module_state_occurrences()
+    stale = MUTABLE_MODULE_STATE_ALLOWED - found
+    assert not stale, (
+        f"mutable-module-state allowlist entries no longer in the "
+        f"tree: {sorted(stale)}"
+    )
