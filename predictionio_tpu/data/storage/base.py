@@ -393,6 +393,40 @@ class LEvents(abc.ABC):
         """
         return None
 
+    def stream_columns_delta(
+        self,
+        app_id: int,
+        channel_id: Optional[int] = None,
+        *,
+        cursor: tuple,
+        value_spec=None,
+        start_time: Optional[_dt.datetime] = None,
+        until_time: Optional[_dt.datetime] = None,
+        entity_type: Optional[str] = None,
+        target_entity_type: OptFilter = UNSET,
+        event_names: Optional[Sequence[str]] = None,
+        batch_rows: int = 1_048_576,
+    ):
+        """Incremental columnar scan: ONLY the target-carrying events
+        committed after ``cursor`` (an opaque value a previous
+        ``stream_columns_native``/``stream_columns_delta`` of the SAME
+        app/channel/filters exposed via ``ColumnarStream.cursor``), in
+        the order a full rescan would emit them after the rows the
+        cursor already covered. The returned stream's own ``cursor``
+        (valid after exhaustion) chains the next round.
+
+        Contract — a backend may only return a stream when appending the
+        delta to the prior scan reproduces a full rescan of the CURRENT
+        store exactly; anything that rewrote or reordered already-scanned
+        rows (deletes, tombstones, explicit-id re-posts, bulk-import page
+        changes, a changed shard layout) must return ``None`` instead, so
+        the caller falls back to a full repack. This default has no delta
+        path at all; sqlite scans above per-shard rowid high-water marks
+        (compaction watermarks guarantee sealed prefixes never re-issue
+        rowids), memory replays its append-only tail.
+        """
+        return None
+
     def store_fingerprint(
         self, app_id: int, channel_id: Optional[int] = None
     ) -> Optional[tuple]:

@@ -144,7 +144,14 @@ class ColumnarStream:
       hit stale;
     - ``cache_key``/``cache_scope`` identify the (app, channel, filters)
       and the producing DAO for the pack-artifact cache (the scope is
-      compared by IDENTITY, never by a reusable ``id()``).
+      compared by IDENTITY, never by a reusable ``id()``);
+    - ``cursor`` (valid once the iterator is exhausted, like ``names``)
+      is the backend's opaque delta cursor: the high-water state this
+      scan actually covered. Feeding it back through ``delta_factory``
+      (set by ``PEventStore.stream_columns``) yields a stream of ONLY
+      the rows committed after it — the substrate of delta training
+      (``ops/streaming``). ``None`` means the backend has no delta path
+      and retrains rescan in full.
     """
 
     def __init__(
@@ -154,12 +161,17 @@ class ColumnarStream:
         fingerprint=None,
         cache_key=None,
         cache_scope=None,
+        cursor_fn=None,
     ):
         self._batches = batches
         self._names_fn = names_fn
+        self._cursor_fn = cursor_fn
         self.fingerprint = fingerprint
         self.cache_key = cache_key
         self.cache_scope = cache_scope
+        # (cursor) -> Optional[ColumnarStream]: a delta scan of the same
+        # app/filters from a prior scan's cursor (None: no delta path)
+        self.delta_factory = None
 
     def __iter__(self):
         return iter(self._batches)
@@ -168,6 +180,12 @@ class ColumnarStream:
     def names(self) -> np.ndarray:
         """Id-indexed name array; valid once the iterator is exhausted."""
         return self._names_fn()
+
+    @property
+    def cursor(self):
+        """Delta cursor covering exactly the rows this scan emitted;
+        valid once the iterator is exhausted. None: no delta support."""
+        return self._cursor_fn() if self._cursor_fn is not None else None
 
     @staticmethod
     def from_columnar(cols: ColumnarEvents, **kw) -> "ColumnarStream":

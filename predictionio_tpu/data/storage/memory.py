@@ -38,6 +38,11 @@ class MemLEvents(base.LEvents):
         # monotone mutation counter: the store-fingerprint component that
         # distinguishes e.g. delete-then-reinsert from a no-op
         self._mutations = 0
+        # monotone DESTRUCTIVE counter: bumps only when an already-stored
+        # event is removed or overwritten (delete, explicit-id re-post).
+        # Unchanged counter + grown table == strictly append-only since,
+        # which is what lets the delta scan replay just the tail.
+        self._destructive = 0
 
     def _table(self, app_id: int, channel_id: Optional[int]) -> Dict[str, Event]:
         key = (app_id, channel_id)
@@ -55,7 +60,14 @@ class MemLEvents(base.LEvents):
 
     def remove(self, app_id: int, channel_id: Optional[int] = None) -> bool:
         with self._lock:
-            return self._tables.pop((app_id, channel_id), None) is not None
+            found = self._tables.pop((app_id, channel_id), None) is not None
+            if found:
+                # dropping a table destroys every covered row: a delta
+                # cursor taken before must never validate afterwards,
+                # even if the table is re-init'ed and refilled
+                self._mutations += 1
+                self._destructive += 1
+            return found
 
     def close(self) -> None:
         pass
@@ -64,6 +76,8 @@ class MemLEvents(base.LEvents):
         with self._lock:
             table = self._table(app_id, channel_id)
             eid = event.event_id or new_event_id()
+            if eid in table:
+                self._destructive += 1  # explicit-id re-post: REPLACE
             table[eid] = event.with_event_id(eid)
             self._mutations += 1
             return eid
@@ -84,6 +98,8 @@ class MemLEvents(base.LEvents):
             eids = []
             for event in events:
                 eid = event.event_id or new_event_id()
+                if eid in table:
+                    self._destructive += 1  # explicit-id re-post
                 table[eid] = event.with_event_id(eid)
                 eids.append(eid)
             if eids:
@@ -103,6 +119,7 @@ class MemLEvents(base.LEvents):
             found = self._table(app_id, channel_id).pop(event_id, None) is not None
             if found:
                 self._mutations += 1
+                self._destructive += 1
             return found
 
     def store_fingerprint(
@@ -133,29 +150,173 @@ class MemLEvents(base.LEvents):
         names = set(event_names) if event_names is not None else None
         start_time = _aware(start_time)
         until_time = _aware(until_time)
-
-        def keep(e: Event) -> bool:
-            if start_time is not None and e.event_time < start_time:
-                return False
-            if until_time is not None and e.event_time >= until_time:
-                return False
-            if entity_type is not None and e.entity_type != entity_type:
-                return False
-            if entity_id is not None and e.entity_id != entity_id:
-                return False
-            if names is not None and e.event not in names:
-                return False
-            if target_entity_type is not UNSET and e.target_entity_type != target_entity_type:
-                return False
-            if target_entity_id is not UNSET and e.target_entity_id != target_entity_id:
-                return False
-            return True
-
-        out = [e for e in events if keep(e)]
+        out = [
+            e
+            for e in events
+            if _matches(
+                e, start_time, until_time, entity_type, entity_id,
+                names, target_entity_type, target_entity_id,
+            )
+        ]
         out.sort(key=lambda e: e.event_time, reverse=reversed)
         if limit is not None and limit >= 0:
             out = out[:limit]
         return iter(out)
+
+    def stream_columns_native(
+        self,
+        app_id: int,
+        channel_id: Optional[int] = None,
+        *,
+        value_spec=None,
+        start_time: Optional[_dt.datetime] = None,
+        until_time: Optional[_dt.datetime] = None,
+        entity_type: Optional[str] = None,
+        target_entity_type: OptFilter = UNSET,
+        event_names: Optional[Sequence[str]] = None,
+        batch_rows: int = 1_048_576,
+    ):
+        """One-batch stream (in-memory scale needs no chunking) with the
+        same wire the generic ``find_columns_native`` fallback produces,
+        PLUS a delta cursor: the scan-time table length, the destructive
+        counter, and the max matched event time — everything the
+        append-only tail replay (``stream_columns_delta``) re-validates.
+        Snapshot and counters are read under ONE lock acquisition, so
+        the cursor can never be newer than the data it describes."""
+        from predictionio_tpu.data.storage.columnar import (
+            ColumnarStream,
+            ValueSpec,
+            from_events,
+        )
+
+        spec = value_spec or ValueSpec()
+        with self._lock:
+            n_table = len(self._table(app_id, channel_id))
+            destructive = self._destructive
+            fingerprint = ("memory", n_table, self._mutations)
+            events = list(self._table(app_id, channel_id).values())
+        kept = self._matching_targetful(
+            events, start_time, until_time, entity_type,
+            target_entity_type, event_names,
+        )
+        max_t = max((e.event_time for e in kept), default=None)
+        cursor = (
+            "memory-delta", app_id, channel_id, n_table, destructive,
+            max_t,
+        )
+        return ColumnarStream.from_columnar(
+            from_events(kept, spec),
+            fingerprint=fingerprint,
+            cursor_fn=lambda: cursor,
+        )
+
+    @staticmethod
+    def _matching_targetful(
+        events, start_time, until_time, entity_type, target_entity_type,
+        event_names,
+    ) -> List[Event]:
+        """The columnar-scan selection: filter like ``find``, keep only
+        target-carrying events, sort by event time (stable — insertion
+        order breaks ties, which is what makes an appended tail agree
+        with a full re-sort)."""
+        names = set(event_names) if event_names is not None else None
+        start_time = _aware(start_time)
+        until_time = _aware(until_time)
+        kept = [
+            e
+            for e in events
+            if e.target_entity_id is not None
+            and _matches(
+                e, start_time, until_time, entity_type, None, names,
+                target_entity_type, UNSET,
+            )
+        ]
+        kept.sort(key=lambda e: e.event_time)
+        return kept
+
+    def stream_columns_delta(
+        self,
+        app_id: int,
+        channel_id: Optional[int] = None,
+        *,
+        cursor: tuple,
+        value_spec=None,
+        start_time: Optional[_dt.datetime] = None,
+        until_time: Optional[_dt.datetime] = None,
+        entity_type: Optional[str] = None,
+        target_entity_type: OptFilter = UNSET,
+        event_names: Optional[Sequence[str]] = None,
+        batch_rows: int = 1_048_576,
+    ):
+        """Append-only tail replay: valid only while no event the prior
+        scan covered was deleted or overwritten (destructive counter
+        unchanged) AND every new matching event's time is >= the prior
+        scan's max — the memory wire is EVENT-TIME ordered, so an
+        out-of-order arrival would sort into the already-folded prefix
+        and needs the full repack."""
+        from predictionio_tpu.data.storage.columnar import (
+            ColumnarStream,
+            ValueSpec,
+            from_events,
+        )
+
+        if (
+            not isinstance(cursor, tuple)
+            or len(cursor) != 6
+            or cursor[0] != "memory-delta"
+            or (cursor[1], cursor[2]) != (app_id, channel_id)
+        ):
+            return None
+        _, _, _, n_then, destructive_then, max_t = cursor
+        spec = value_spec or ValueSpec()
+        with self._lock:
+            table = self._tables.get((app_id, channel_id))
+            if table is None or self._destructive != destructive_then:
+                return None
+            events = list(table.values())
+            fingerprint = ("memory", len(events), self._mutations)
+        if len(events) < n_then:
+            return None
+        kept = self._matching_targetful(
+            events[n_then:], start_time, until_time, entity_type,
+            target_entity_type, event_names,
+        )
+        if max_t is not None and any(e.event_time < max_t for e in kept):
+            return None  # out-of-order arrival: sorts into the prefix
+        new_max = max((e.event_time for e in kept), default=max_t)
+        new_cursor = (
+            "memory-delta", app_id, channel_id, len(events),
+            destructive_then, new_max,
+        )
+        return ColumnarStream.from_columnar(
+            from_events(kept, spec),
+            fingerprint=fingerprint,
+            cursor_fn=lambda: new_cursor,
+        )
+
+
+def _matches(
+    e: Event, start_time, until_time, entity_type, entity_id, names,
+    target_entity_type, target_entity_id,
+) -> bool:
+    """The ``find()`` filter predicate (time bounds already tz-aware,
+    ``names`` already a set or None) — shared with the columnar scans so
+    a delta tail is selected by EXACTLY the full scan's rules."""
+    if start_time is not None and e.event_time < start_time:
+        return False
+    if until_time is not None and e.event_time >= until_time:
+        return False
+    if entity_type is not None and e.entity_type != entity_type:
+        return False
+    if entity_id is not None and e.entity_id != entity_id:
+        return False
+    if names is not None and e.event not in names:
+        return False
+    if target_entity_type is not UNSET and e.target_entity_type != target_entity_type:
+        return False
+    if target_entity_id is not UNSET and e.target_entity_id != target_entity_id:
+        return False
+    return True
 
 
 def _utcnow():

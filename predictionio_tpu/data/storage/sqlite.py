@@ -569,6 +569,12 @@ class StorageClient(base.DAOCacheMixin):
         with self.lock:
             self.conn.commit()
 
+_GEN_SCHEMA = (
+    "CREATE TABLE IF NOT EXISTS pio_table_gen "
+    "(tbl TEXT PRIMARY KEY, gen INTEGER NOT NULL)"
+)
+
+
 def _table_name(namespace: str, suffix: str) -> str:
     ns = "".join(c if c.isalnum() else "_" for c in (namespace or "pio"))
     return f"{ns}_{suffix}"
@@ -740,6 +746,16 @@ class SQLiteLEvents(base.LEvents):
             self._c.execute(f"DROP TABLE IF EXISTS {t}_dict")
             self._c.execute(f"DROP TABLE IF EXISTS {t}_segments")
             self._c.execute(f"DROP TABLE IF EXISTS {t}_compaction")
+            # bump the table GENERATION: DROP resets the AUTOINCREMENT
+            # sequence, so without this a delta cursor taken before a
+            # wipe-and-reimport of a same-sized dataset could validate
+            # against the recreated table and serve the stale wire
+            self._c.execute(_GEN_SCHEMA)
+            self._c.execute(
+                "INSERT INTO pio_table_gen (tbl, gen) VALUES (?, 2) "
+                "ON CONFLICT(tbl) DO UPDATE SET gen = gen + 1",
+                (t,),
+            )
             self._c.commit()
             self._c.main_store.known_tables.discard(t)
             self._c.main_store.known_tables.discard(f"{t}_segments")
@@ -1757,6 +1773,18 @@ class SQLiteLEvents(base.LEvents):
         else:
             self._seg_cache.move_to_end(path)
         return data
+
+    @staticmethod
+    def _and_extras(*extras):
+        """AND-combine optional pre-bound ``(clause, params)`` predicates
+        (None entries skipped; None when nothing remains)."""
+        parts = [e for e in extras if e is not None]
+        if not parts:
+            return None
+        return (
+            " AND ".join(f"({c})" for c, _ in parts),
+            [p for _, ps in parts for p in ps],
+        )
 
     @staticmethod
     def _residual_clause(marks, store_key: int):
@@ -2777,7 +2805,7 @@ class SQLiteLEvents(base.LEvents):
                         values=np.concatenate(seg_v),
                     )
                 )
-            rows, values = self._residual_scan(
+            rows, values, _ = self._residual_scan(
                 store, t, spec, start_time, until_time, entity_type,
                 target_entity_type, event_names,
                 extra=self._residual_clause(marks, key),
@@ -2798,20 +2826,28 @@ class SQLiteLEvents(base.LEvents):
 
     def _residual_scan(
         self, store, t, spec, start_time, until_time, entity_type,
-        target_entity_type, event_names, extra=None,
+        target_entity_type, event_names, extra=None, stats=None,
     ):
         """Row-store residual of a columnar scan (REST-posted tail) for
         ONE row store (the main file or a hash shard) — value evaluated
         IN SQL (CASE per event override + json_extract), so even this
         path never parses JSON in Python. ``extra`` is an optional
         pre-bound ``(clause, params)`` predicate — the segment tier's
-        watermark exclusion. Returns ``(rows, values)``: the raw
-        (entity_id, target_entity_id, ...) rows and their float32
-        training values."""
+        watermark exclusion. Returns ``(rows, values, stat_rows)``: the
+        raw (entity_id, target_entity_id, ...) rows, their float32
+        training values, and one ``(count, max_rowid)`` pair per entry
+        of ``stats`` (a list of pre-bound ``(clause, params)``
+        predicates, None clause = whole table), evaluated in the SAME
+        read snapshot as the row scan — the delta cursor's coverage
+        accounting must be atomic with the rows it vouches for. The
+        stat predicates are rowid ranges and watermark bounds only, so
+        sqlite answers them from the rowid b-tree without touching the
+        filter/json machinery."""
         import numpy as np
 
+        empty_stats = [(0, 0)] * len(stats or [])
         if not store.has_table(t):
-            return [], None
+            return [], None, empty_stats
 
         clauses, params = self._find_clauses(
             start_time, until_time, entity_type, None, event_names,
@@ -2863,9 +2899,23 @@ class SQLiteLEvents(base.LEvents):
             + null_case_params + [prop_path]
             + null_case_params + [prop_path] + params
         )
-        rows = store.read_execute(sql, all_params).fetchall()
+        stmts = [(sql, all_params)]
+        for stat in stats or []:
+            stat_sql = (
+                f"SELECT COUNT(*), COALESCE(MAX(rowid), 0) FROM {t}"
+            )
+            stat_params: list = []
+            if stat is not None:
+                stat_sql += f" WHERE {stat[0]}"
+                stat_params = list(stat[1])
+            stmts.append((stat_sql, stat_params))
+        results = store.read_snapshot(stmts)
+        rows = results[0]
+        stat_rows = [
+            (int(r[0][0]), int(r[0][1])) for r in results[1:]
+        ]
         if not rows:
-            return [], None
+            return [], None, stat_rows
         # CAST diverges from the per-event path on non-numeric
         # property values (unparseable text silently becomes 0.0;
         # 'nan'/'inf' strings parse in Python but not in CAST) — for
@@ -2884,7 +2934,7 @@ class SQLiteLEvents(base.LEvents):
             np.float32,
             count=len(rows),
         )
-        return rows, values
+        return rows, values, stat_rows
 
     def stream_columns_native(
         self,
@@ -2921,41 +2971,69 @@ class SQLiteLEvents(base.LEvents):
         with self._c.lock:
             if not self._exists(t):
                 raise StorageError(f"events table {t} not initialized")
-        # fingerprint BEFORE the scan: a concurrent write during the scan
-        # then makes the next cache lookup miss, never hit stale
+        # fingerprint (and the table generation) BEFORE the scan: a
+        # concurrent write during the scan then makes the next cache
+        # lookup miss, never hit stale
         fingerprint = self.store_fingerprint(app_id, channel_id)
+        generation = self._table_generation(t)
         self._ensure_pages_schema(t)
         # segment state BEFORE the dict snapshot (compaction commits its
         # dict inserts first, so every referenced code resolves)
         marks, segs = self._segment_state(t)
-        page_ids: List[int] = []
-        # ids only, no blobs (peak memory stays one page); the filter is
-        # the SAME clause builder the monolithic scan uses, so both paths
-        # select identical pages by construction
-        filt = self._page_filter(
-            start_time, until_time, entity_type, event_names,
-            target_entity_type,
-        )
-        if filt is not None:
+        # The dict-name snapshot and the page-id listing are DEFERRED to
+        # first iteration: a continuous-training fold round constructs
+        # this stream only for its fingerprint/cursor identity and never
+        # consumes it — eager setup would charge every delta round an
+        # O(vocab) dict read it doesn't use.
+        dict_snapshot, enc, names = self._residual_code_space(t)
+
+        def _page_id_listing() -> List[int]:
+            # ids only, no blobs (peak memory stays one page); the
+            # filter is the SAME clause builder the monolithic scan
+            # uses, so both paths select identical pages by construction
+            filt = self._page_filter(
+                start_time, until_time, entity_type, event_names,
+                target_entity_type,
+            )
+            if filt is None:
+                return []
             clauses, params = filt
             sql = f"SELECT page FROM {t}_pages"
             if clauses:
                 sql += " WHERE " + " AND ".join(clauses)
             with self._c.lock:
                 have_pages = self._exists(f"{t}_pages")
-            if have_pages:
-                page_ids = [
-                    r[0]
-                    for r in self._c.read_execute(
-                        sql + " ORDER BY page", params
-                    ).fetchall()
-                ]
-        names_state = {"names": self._dict_names(t), "extra": []}
+            if not have_pages:
+                return []
+            return [
+                r[0]
+                for r in self._c.read_execute(
+                    sql + " ORDER BY page", params
+                ).fetchall()
+            ]
+        # per row store (residual-live count, max residual rowid), read
+        # in the SAME snapshot as that store's residual row scan —
+        # finalized into the delta cursor on exhaustion. Snapshot
+        # atomicity is what keeps the cursor exactly consistent with the
+        # folded data: a row committed after the snapshot has a higher
+        # rowid and is the next delta's business, never skipped, never
+        # double-folded.
+        cursor_state = {
+            "stores": [(0, 0) for _ in self._c.row_stores()],
+        }
 
         def batches():
             overrides = spec.overrides
             lo = _ms(start_time) if start_time is not None else None
             hi = _ms(until_time) if until_time is not None else None
+            # snapshot order: segment state was read above; pages are
+            # listed BEFORE the dict snapshot (writers commit dict
+            # entries first, pages second — listing first guarantees
+            # every listed page's global codes resolve in the names
+            # snapshot, and the residual enc() extras can never collide
+            # with codes a racing import minted)
+            page_ids = _page_id_listing()
+            dict_snapshot()
             for page_id in page_ids:
                 row = self._c.read_execute(
                     f"SELECT event, prop, n, min_ms, max_ms, entities, "
@@ -3006,19 +3084,6 @@ class SQLiteLEvents(base.LEvents):
             # shard, so each entity's events keep their per-store order
             # and the consumer's stable counting-sort merge reproduces
             # the single-file, uncompacted wire byte-for-byte.
-            code_of: Optional[dict] = None
-
-            def enc(strs):
-                out = np.empty(len(strs), np.int32)
-                for j, s in enumerate(strs):
-                    c = code_of.get(s)
-                    if c is None:
-                        c = len(code_of)
-                        code_of[s] = c
-                        names_state["extra"].append(s)
-                    out[j] = c
-                return out
-
             tet_set = target_entity_type is not UNSET
             for key, store in enumerate(self._c.row_stores()):
                 for seg in segs:
@@ -3046,18 +3111,17 @@ class SQLiteLEvents(base.LEvents):
                         sl = slice(s, s + batch_rows)
                         if len(v[sl]):
                             yield e[sl], g[sl], v[sl]
-                rows, values = self._residual_scan(
+                residual_pred = self._residual_clause(marks, key)
+                rows, values, stats = self._residual_scan(
                     store, t, spec, start_time, until_time, entity_type,
                     target_entity_type, event_names,
-                    extra=self._residual_clause(marks, key),
+                    extra=residual_pred,
+                    # UNFILTERED residual-live coverage, same snapshot
+                    stats=[residual_pred],
                 )
+                cursor_state["stores"][key] = stats[0]
                 if not rows:
                     continue
-                if code_of is None:
-                    code_of = {
-                        str(nm): j
-                        for j, nm in enumerate(names_state["names"])
-                    }
                 e_codes = enc([r[0] for r in rows])
                 g_codes = enc([r[1] for r in rows])
                 for s in range(0, len(values), batch_rows):
@@ -3065,15 +3129,344 @@ class SQLiteLEvents(base.LEvents):
                     if len(values[sl]):
                         yield e_codes[sl], g_codes[sl], values[sl]
 
+        def cursor():
+            return self._delta_cursor(
+                cursor_state["stores"], marks, segs, fingerprint,
+                generation,
+            )
+
+        return ColumnarStream(
+            batches(), names, fingerprint=fingerprint, cursor_fn=cursor
+        )
+
+    # --- delta scan (incremental training, round 9) ---
+    #
+    # A scan's cursor records, per row store, the high-water rowid it
+    # covered (the store's max rowid at the scan's snapshot, residual
+    # and sealed alike), how many LIVE rows sat at or below it —
+    # unfiltered: residual-live count + sealed-live manifest sums — and
+    # the compaction state (watermark + holdouts) it replayed under;
+    # the page-store signature rides along whole. The delta scan
+    # re-validates all of it: rowids are AUTOINCREMENT (PR 4 migrated
+    # every row table) so the covered prefix can never grow back, the
+    # live count at or below the mark is monotone non-increasing under
+    # the only mutations sqlite allows (delete, tombstone, explicit-id
+    # re-post — which reassigns the rowid), and compaction only moves
+    # rows across the segment/residual split without changing the sum.
+    # Count equality therefore PROVES the folded prefix is still
+    # exactly what a full rescan would emit first — and the delta is
+    # every matching row above the mark, sealed segments first (their
+    # manifest order IS rowid order), then residual rows, the same
+    # order the full scan interleaves. Everything the validation reads
+    # is rowid-b-tree range counts and manifest/dead-bitmap sums — no
+    # per-row filter or json evaluation, so polling a quiet 20M store
+    # costs milliseconds, not a scan.
+
+    @staticmethod
+    def _seg_live_count(seg, dead_arr) -> int:
+        n = int(seg["n"])
+        return n - int(dead_arr.sum()) if dead_arr is not None else n
+
+    def _residual_code_space(self, t: str):
+        """The streaming scans' shared code space: a DEFERRED
+        table-global dict snapshot, the residual-tail string encoder
+        over it, and the post-iteration ``names`` resolver. One
+        implementation for the native scan AND the delta scan — the
+        fold's wire byte-identity depends on both paths encoding
+        residual ids identically (code seeding, extra-name append
+        order, names() concatenation), so they must never diverge.
+
+        Deferral matters twice over: a continuous-training fold round
+        constructs the native stream only for its fingerprint/cursor
+        identity, and an empty delta round has no residual rows — in
+        both cases the O(vocab) dict read never happens. Call
+        ``snapshot()``/``enc()`` only AFTER the data they cover was
+        listed: the dict is append-only, so a later snapshot is always
+        a superset of the codes that data references, and extras minted
+        past it can never collide."""
+        import numpy as np
+
+        state: dict = {"names": None, "extra": [], "code_of": None}
+
+        def snapshot():
+            if state["names"] is None:
+                state["names"] = self._dict_names(t)
+            return state["names"]
+
+        def enc(strs):
+            if state["code_of"] is None:
+                state["code_of"] = {
+                    str(nm): j for j, nm in enumerate(snapshot())
+                }
+            code_of = state["code_of"]
+            out = np.empty(len(strs), np.int32)
+            for j, s in enumerate(strs):
+                c = code_of.get(s)
+                if c is None:
+                    c = len(code_of)
+                    code_of[s] = c
+                    state["extra"].append(s)
+                out[j] = c
+            return out
+
         def names():
-            base_names = names_state["names"]
-            if not names_state["extra"]:
+            base_names = snapshot()
+            if not state["extra"]:
                 return base_names
-            extra = np.empty(len(names_state["extra"]), object)
-            extra[:] = names_state["extra"]
+            extra = np.empty(len(state["extra"]), object)
+            extra[:] = state["extra"]
             return np.concatenate([base_names, extra])
 
-        return ColumnarStream(batches(), names, fingerprint=fingerprint)
+        return snapshot, enc, names
+
+    def _table_generation(self, t: str) -> int:
+        """Monotone per-events-table generation (main db, survives the
+        table itself): ``remove()`` bumps it, so a delta cursor taken
+        before a DROP — which resets the AUTOINCREMENT sequence — can
+        never validate against the recreated table."""
+        with self._c.lock:
+            self._c.execute(_GEN_SCHEMA)
+            row = self._c.execute(
+                "SELECT gen FROM pio_table_gen WHERE tbl=?", (t,)
+            ).fetchone()
+            if row is not None:
+                return int(row[0])
+            self._c.execute(
+                "INSERT INTO pio_table_gen (tbl, gen) VALUES (?, 1)",
+                (t,),
+            )
+            self._c.commit()
+            return 1
+
+    def _delta_cursor(
+        self, stores, marks, segs, fingerprint, generation
+    ) -> tuple:
+        """Assemble the opaque cursor from the per-store residual
+        coverage (``(residual-live count, max residual rowid)`` read in
+        the residual scan's snapshot), the segment manifest, the
+        compaction snapshot, the pre-scan fingerprint's page-store
+        component, and the table generation."""
+        parts = []
+        for key, (rcount, rmax) in enumerate(stores):
+            sealed_live = 0
+            seg_max = 0
+            for seg in segs:
+                if seg["store"] != key:
+                    continue
+                sealed_live += self._seg_live_count(
+                    seg, self._seg_dead(seg)
+                )
+                seg_max = max(seg_max, int(seg["max_rowid"]))
+            hwm = max(int(rmax), seg_max)
+            mark = marks.get(key) if marks else None
+            wm = mark[0] if mark else 0
+            holds = mark[1] if mark else ()
+            parts.append(
+                (
+                    hwm,
+                    int(rcount) + sealed_live,
+                    int(wm),
+                    tuple(h for h in holds if h <= hwm),
+                )
+            )
+        pages_sig = (
+            (fingerprint[2], fingerprint[3]) if fingerprint else None
+        )
+        return (
+            "sqlite-delta", int(generation), len(parts), tuple(parts),
+            pages_sig,
+        )
+
+    def stream_columns_delta(
+        self,
+        app_id: int,
+        channel_id: Optional[int] = None,
+        *,
+        cursor: tuple,
+        value_spec=None,
+        start_time: Optional[_dt.datetime] = None,
+        until_time: Optional[_dt.datetime] = None,
+        entity_type: Optional[str] = None,
+        target_entity_type: OptFilter = UNSET,
+        event_names: Optional[Sequence[str]] = None,
+        batch_rows: int = 1_048_576,
+    ):
+        """Incremental columnar scan above a prior scan's cursor
+        (``base.LEvents.stream_columns_delta``). Returns None — full
+        repack — whenever appending the delta could NOT reproduce a full
+        rescan: page-store changes (bulk imports order before all row
+        stores), any shrink of the matching live rows at or below a
+        store's high-water mark (delete / tombstone / explicit-id
+        re-post), new holdouts at or below the mark or a watermark that
+        moved past interleaved holdouts (both reorder the already-folded
+        prefix), or a changed shard layout."""
+        import numpy as np
+
+        from predictionio_tpu.data.storage.columnar import (
+            ColumnarStream,
+            ValueSpec,
+        )
+
+        if (
+            not isinstance(cursor, tuple)
+            or len(cursor) != 5
+            or cursor[0] != "sqlite-delta"
+        ):
+            return None
+        spec = value_spec or ValueSpec()
+        t = self._events_table(app_id, channel_id)
+        with self._c.lock:
+            if not self._exists(t):
+                return None
+        stores = self._c.row_stores()
+        if cursor[2] != len(stores):
+            return None  # shard layout changed under the cursor
+        generation = self._table_generation(t)
+        if cursor[1] != generation:
+            # the table was dropped and recreated since the cursor:
+            # its AUTOINCREMENT sequence restarted, so rowid/count
+            # arithmetic against the old prefix proves nothing
+            return None
+        # fingerprint BEFORE the scan (labels the folded artifact; a
+        # racing write makes the next cache lookup miss, never hit stale)
+        fingerprint = self.store_fingerprint(app_id, channel_id)
+        self._ensure_pages_schema(t)
+        marks, segs = self._segment_state(t)
+        pages_sig = (
+            (fingerprint[2], fingerprint[3]) if fingerprint else None
+        )
+        if pages_sig != cursor[4]:
+            return None  # page store changed: pages order before rows
+        lo = _ms(start_time) if start_time is not None else None
+        hi = _ms(until_time) if until_time is not None else None
+
+        per_store = []  # (seg_parts, rows, values) to emit, store order
+        new_parts = []  # the chained cursor's per-store records
+        for key, store in enumerate(stores):
+            hwm, live_then, wm_then, holds_then = cursor[3][key]
+            mark = marks.get(key) if marks else None
+            wm_now = mark[0] if mark else 0
+            holds_now = mark[1] if mark else ()
+            if tuple(h for h in holds_now if h <= hwm) != holds_then:
+                # compaction held out rows inside the folded prefix: a
+                # full rescan now replays them AFTER sealed rows the
+                # fold placed them before
+                return None
+            if holds_then and wm_now != wm_then:
+                # sealed rows moved past interleaved holdouts (see
+                # docs/PERF.md, delta training): replay order of the
+                # folded prefix changed
+                return None
+            sealed_le = 0  # live sealed rows at or below the mark
+            sealed_above = 0  # live sealed rows above it (delta region)
+            seg_max = 0
+            seg_parts = []  # (SegmentData, mask): matching rows > hwm
+            for seg in segs:
+                if seg["store"] != key:
+                    continue
+                seg_max = max(seg_max, int(seg["max_rowid"]))
+                dead_arr = self._seg_dead(seg)
+                if seg["max_rowid"] <= hwm:
+                    sealed_le += self._seg_live_count(seg, dead_arr)
+                elif seg["min_rowid"] > hwm:
+                    sealed_above += self._seg_live_count(seg, dead_arr)
+                else:  # straddles the mark: split by source rowid
+                    data = self._open_segment(seg["path"])
+                    rid = data.column("rids")
+                    alive = (
+                        dead_arr == 0
+                        if dead_arr is not None
+                        else np.ones(data.n, bool)
+                    )
+                    sealed_le += int(
+                        np.count_nonzero(alive & (rid <= hwm))
+                    )
+                    sealed_above += int(
+                        np.count_nonzero(alive & (rid > hwm))
+                    )
+                if seg["max_rowid"] > hwm and self._segs_match(
+                    seg, event_names, entity_type, target_entity_type,
+                    lo, hi,
+                ):
+                    data = self._open_segment(seg["path"])
+                    keep = data.keep_mask(
+                        lo_ms=lo, hi_ms=hi, entity_type=entity_type,
+                        target_entity_type=(
+                            None if target_entity_type is None
+                            else target_entity_type
+                        ),
+                        target_entity_type_set=(
+                            target_entity_type is not UNSET
+                        ),
+                        event_names=event_names, dead=self._seg_dead(seg),
+                    )
+                    if keep is None:
+                        keep = np.ones(data.n, bool)
+                    dm = keep & (data.column("rids") > hwm)
+                    if dm.any():
+                        seg_parts.append((data, dm))
+            residual_pred = self._residual_clause(marks, key)
+            rows, values, stats = self._residual_scan(
+                store, t, spec, start_time, until_time, entity_type,
+                target_entity_type, event_names,
+                extra=self._and_extras(
+                    residual_pred, ("rowid > ?", [hwm])
+                ),
+                # same-snapshot coverage accounting, rowid ranges only:
+                # live residual rows at/below the mark, and the count +
+                # max rowid of the delta region
+                stats=[
+                    self._and_extras(
+                        residual_pred, ("rowid <= ?", [hwm])
+                    ),
+                    self._and_extras(
+                        residual_pred, ("rowid > ?", [hwm])
+                    ),
+                ],
+            )
+            (resid_le, _), (resid_above, resid_max_above) = stats
+            if resid_le + sealed_le != live_then:
+                return None  # the folded prefix shrank: full repack
+            new_hwm = max(hwm, seg_max, resid_max_above)
+            new_live = live_then + resid_above + sealed_above
+            new_holds = tuple(h for h in holds_now if h <= new_hwm)
+            new_parts.append((new_hwm, new_live, int(wm_now), new_holds))
+            per_store.append((seg_parts, rows, values))
+
+        # shared deferred code space (see _residual_code_space): an
+        # empty delta round — common while polling — never pays the
+        # O(vocab) dict read, and the residual encoding is the SAME
+        # implementation the native scan uses, byte for byte
+        _, enc, names = self._residual_code_space(t)
+
+        new_cursor = (
+            "sqlite-delta", generation, len(new_parts),
+            tuple(new_parts), pages_sig,
+        )
+
+        def batches():
+            for seg_parts, rows, values in per_store:
+                for data, dm in seg_parts:
+                    e = data.column("entities")[dm]
+                    g = data.column("targets")[dm]
+                    v = data.spec_values(spec)[dm]
+                    for s in range(0, len(v), batch_rows):
+                        sl = slice(s, s + batch_rows)
+                        if len(v[sl]):
+                            yield e[sl], g[sl], v[sl]
+                if not rows:
+                    continue
+                e_codes = enc([r[0] for r in rows])
+                g_codes = enc([r[1] for r in rows])
+                for s in range(0, len(values), batch_rows):
+                    sl = slice(s, s + batch_rows)
+                    if len(values[sl]):
+                        yield e_codes[sl], g_codes[sl], values[sl]
+
+        return ColumnarStream(
+            batches(), names, fingerprint=fingerprint,
+            cursor_fn=lambda: new_cursor,
+        )
 
     def store_fingerprint(
         self, app_id: int, channel_id: Optional[int] = None

@@ -300,8 +300,25 @@ class PEventStore:
             if cols is None:
                 return None
             stream = ColumnarStream.from_columnar(cols, fingerprint=fp)
+
+        def delta_factory(cursor):
+            """Delta scan of the same app/filters from a prior scan's
+            cursor (None when the backend has no delta path or the
+            cursor no longer covers a clean prefix). The returned
+            stream keeps this factory, so delta rounds chain."""
+            dstream = le.stream_columns_delta(
+                app_id=app_id, channel_id=channel_id, cursor=cursor,
+                value_spec=spec, batch_rows=batch_rows, **find_kwargs,
+            )
+            if dstream is not None:
+                dstream.cache_key = key
+                dstream.cache_scope = le
+                dstream.delta_factory = delta_factory
+            return dstream
+
         stream.cache_key = key
         stream.cache_scope = le
+        stream.delta_factory = delta_factory
         return stream
 
     @staticmethod

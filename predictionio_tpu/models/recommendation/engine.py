@@ -316,6 +316,10 @@ class ALSAlgorithmParams(Params):
     # to ServerConfig.max_batch if you raise that)
     warm_num: int = 16
     warm_max_batch: int = 128
+    # delta retrains (pio train --continuous): iteration budget when the
+    # pack cache folds a delta and warm-starts from the previous model
+    # (ops/streaming). 0 keeps the full num_iterations on delta rounds.
+    delta_sweeps: int = 2
 
 
 @dataclasses.dataclass
@@ -477,6 +481,7 @@ class ALSAlgorithm(BaseAlgorithm):
                 timer=getattr(ctx, "timer", None),
                 checkpoint_dir=p.checkpoint_dir,
                 checkpoint_every=p.checkpoint_every,
+                warm_sweeps=p.delta_sweeps,
             )
             if result is not None:
                 return ALSModel(

@@ -303,6 +303,37 @@ def _pack_nibbles_host(vw: np.ndarray) -> np.ndarray:
     )
 
 
+def _unpack_nibbles_host(packed: np.ndarray) -> np.ndarray:
+    """Host inverse of _pack_nibbles_host (the delta-fold path recovers
+    the cached wire's exact COO instead of rescanning the store)."""
+    out = np.empty(packed.size * 2, np.int8)
+    out[0::2] = (packed & np.uint8(0xF)).astype(np.int8)
+    out[1::2] = (packed >> np.uint8(4)).astype(np.int8)
+    return out
+
+
+def wire_coo(wire: "HostWire") -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Recover the exact user-major (user, item, value) COO a HostWire
+    was finished from — every narrowing tier is lossless, so feeding
+    this back through :func:`finish_wire` (after a dense-id relabel)
+    reproduces the wire byte-for-byte. This is what lets the delta-fold
+    path re-finish a grown store from the CACHED wire without touching
+    the old rows in storage."""
+    n = int(wire.counts_u.sum())
+    u = np.repeat(
+        np.arange(wire.n_users, dtype=np.int32), wire.counts_u
+    )
+    i = np.asarray(wire.iw[:n], dtype=np.int32)
+    if wire.nibble:
+        v = _unpack_nibbles_host(wire.vw)[:n].astype(np.float32)
+        v *= np.float32(wire.v_scale)
+    elif wire.vw.dtype == np.int8:
+        v = wire.vw[:n].astype(np.float32) * np.float32(wire.v_scale)
+    else:
+        v = np.asarray(wire.vw[:n], dtype=np.float32)
+    return u, i, v
+
+
 @jax.jit
 def _unpack_nibbles(packed):
     """uint8 [n/2] -> int8 [n], inverse of _pack_nibbles_host (one cheap
@@ -1082,6 +1113,17 @@ def _lam_obs_host(
     return np.maximum(lam, 1e-8).astype(np.float32), padded > 0
 
 
+# geometries whose iteration executable this process already warmed up
+# (under _CPU_DEVICE_LOOP_LOCK's module; guarded by its own lock). The
+# continuous-training loop re-enters start_compile_async every round
+# with bucket-stable shapes — re-running the zero-filled warm-up
+# execution would serialize behind the device-loop guard and burn a
+# core for nothing. If the jit cache was dropped anyway, training just
+# compiles inline (timing-accounted, never wrong).
+_WARMED_GEOMETRIES: set = set()
+_WARMED_LOCK = threading.Lock()
+
+
 def start_compile_async(
     n_users: int,
     n_items: int,
@@ -1096,12 +1138,22 @@ def start_compile_async(
     (the streaming pipeline calls this the moment bucket geometry is
     known). The warm-up is a zero-iteration run on zero-filled arrays of
     the exact shapes/dtypes the real call uses, so the jit cache (and the
-    persistent compilation cache) is hot when training dispatches.
+    persistent compilation cache) is hot when training dispatches; a
+    geometry this process already warmed skips the whole thing.
 
     Returns ``wait() -> dict`` with ``busy_s`` (and ``error`` if the
     warm-up failed — best-effort; training then compiles inline)."""
     import threading
     import time as _time
+
+    geo_key = (
+        _padded_rows(n_users, 1), _padded_rows(n_items, 1),
+        geo_u.n_chunks, geo_u.sc, L_u, geo_i.n_chunks, geo_i.sc, L_i,
+        config.rank, config.implicit_prefs, config.compute_dtype,
+    )
+    with _WARMED_LOCK:
+        if geo_key in _WARMED_GEOMETRIES:
+            return lambda: {"busy_s": 0.0}
 
     rec: dict = {}
 
@@ -1138,6 +1190,8 @@ def start_compile_async(
                     rep_sharding=None, row_sharding=None,
                 )
                 _fence(out)
+            with _WARMED_LOCK:
+                _WARMED_GEOMETRIES.add(geo_key)
         except Exception as e:  # pragma: no cover - defensive
             rec["error"] = repr(e)
         rec["busy_s"] = _time.perf_counter() - t0
@@ -1158,15 +1212,52 @@ def init_factor_state_single(
     n_users: int,
     n_items: int,
     config: ALSConfig,
+    warm: Optional[Tuple[np.ndarray, np.ndarray]] = None,
 ) -> tuple:
     """Place the single-device factor/regularizer state: X as DEVICE
     zeros (its [r_u, k] buffer never crosses the host→device link — at
     ML-20M that is ~17 MB of zeros the wire no longer carries), Y0 and
-    the small lam/has_obs vectors shipped from host."""
+    the small lam/has_obs vectors shipped from host.
+
+    ``warm`` — ``([n_users, k], [n_items, k])`` host factor seeds (the
+    delta-training warm start: previous model rows carried over, new
+    rows already given a fresh init by the caller). A few ALS sweeps
+    from a warm seed recover full quality after small data changes (the
+    ALX / GPU-MF warm-start observation, PAPERS.md), which is what makes
+    a reduced sweep budget safe."""
     k = config.rank
+    if warm is not None:
+        Xw, Yw = warm
+        if Xw.shape != (n_users, k) or Yw.shape != (n_items, k):
+            raise ValueError(
+                f"warm factor shapes {Xw.shape}/{Yw.shape} do not match "
+                f"({n_users}, {k})/({n_items}, {k})"
+            )
+        X0 = np.zeros((_padded_rows(n_users, 1), k), np.float32)
+        X0[:n_users] = Xw
+        Y0 = np.zeros((_padded_rows(n_items, 1), k), np.float32)
+        Y0[:n_items] = Yw
+        # these device arrays enter the DONATED X/Y slots of the fused
+        # loop; place them as device-owned copies (jnp.array copies,
+        # jnp.asarray may zero-copy alias page-aligned host memory on
+        # the CPU backend — donating an alias hands XLA a buffer the
+        # caller's numpy still points into)
+        X = jnp.array(X0)
+        Y = jnp.array(Y0)
+        user_lam_h, user_obs_h = _lam_obs_host(
+            counts_u, n_users, X.shape[0], config
+        )
+        item_lam_h, item_obs_h = _lam_obs_host(
+            counts_i, n_items, Y.shape[0], config
+        )
+        return (
+            X, Y,
+            jnp.asarray(user_lam_h), jnp.asarray(item_lam_h),
+            jnp.asarray(user_obs_h), jnp.asarray(item_obs_h),
+        )
     _, Y0 = _factor_init_host(n_users, n_items, config, 1)
     X = jnp.zeros((_padded_rows(n_users, 1), k), jnp.float32)
-    Y = jnp.asarray(Y0)
+    Y = jnp.array(Y0)  # device-owned copy: Y is DONATED (see warm note)
     user_lam_h, user_obs_h = _lam_obs_host(counts_u, n_users, X.shape[0], config)
     item_lam_h, item_obs_h = _lam_obs_host(counts_i, n_items, Y.shape[0], config)
     return (
@@ -1237,6 +1328,7 @@ def train_from_wire(
     profile_dir: Optional[str] = None,
     compile_wait=None,  # callable from start_compile_async, or None
     factor_state: Optional[tuple] = None,  # pre-placed (X, Y, lam/obs x4)
+    warm_start: Optional[ALSModelArrays] = None,
     _fp_material=None,
 ) -> ALSModelArrays:
     """Train from a :class:`HostWire` (single-device device-pack path).
@@ -1244,12 +1336,28 @@ def train_from_wire(
     ``device_wire``/``factor_state``/``compile_wait`` let the streaming
     pipeline hand in work it already overlapped with the store scan;
     left as None, this performs the same transfer → device-pack →
-    compile → loop sequence train_als always did."""
+    compile → loop sequence train_als always did.
+
+    ``warm_start`` seeds the factor state from a previous model whose
+    rows are ALREADY aligned to this wire's dense id spaces (shapes must
+    be exactly [n_users, k]/[n_items, k] — callers relabel old rows and
+    fresh-init new ones; see ops/streaming's delta fold). Combined with
+    a reduced ``config.iterations`` this is the delta-retrain budget:
+    cost proportional to the data change, not the store size."""
     if factor_state is None:
         # factor/lam/obs placement first: their (small) transfers enqueue
         # ahead of the wire, so the device_put fence attributes them too
         factor_state = init_factor_state_single(
-            wire.counts_u, wire.counts_i, wire.n_users, wire.n_items, config
+            wire.counts_u, wire.counts_i, wire.n_users, wire.n_items,
+            config,
+            warm=(
+                None
+                if warm_start is None
+                else (
+                    np.asarray(warm_start.user_factors, np.float32),
+                    np.asarray(warm_start.item_factors, np.float32),
+                )
+            ),
         )
     user_pack, item_pack = device_pack_from_wire(
         wire, device_wire=device_wire, timings=timings
@@ -1613,6 +1721,16 @@ def _train_packed(
             X_host, Y_host = np.asarray(X_host), np.asarray(Y_host)
         else:
             X_host, Y_host = _fetch_global(X), _fetch_global(Y)
+    # OWN the returned factors: on the CPU backend device_get is
+    # zero-copy (owndata=False views over XLA-owned buffers). A model —
+    # or the delta fold's warm-start seed — outlives the jax.Arrays it
+    # was fetched from, and re-reading the view after later donated
+    # executions recycled that memory produced flaky NaNs and exit
+    # segfaults. One catalog-sized memcpy buys unconditional safety.
+    if not X_host.flags.owndata:
+        X_host = X_host.copy()
+    if not Y_host.flags.owndata:
+        Y_host = Y_host.copy()
     return ALSModelArrays(X_host[:n_users], Y_host[:n_items])
 
 
@@ -1669,6 +1787,19 @@ def _topn_packed_impl(factors_q, Y, n):
 
 
 _topn_packed = jax.jit(_topn_packed_impl, static_argnames=("n",))
+
+
+@functools.partial(jax.jit, static_argnames=("n", "out_s"))
+def _topn_packed_sharded(factors_q, Y, n, out_s):
+    """Mesh-path top-N with the output PINNED row-sharded. XLA's sharding
+    propagation is free to replicate the result of the per-shard
+    matmul+top_k (and does on some backends/core counts), which would put
+    a B×catalog-independent collective on the serving hot path;
+    ``out_s`` (a hashable NamedSharding, so it rides the jit cache as a
+    static) keeps each device holding only its query rows' results."""
+    return jax.lax.with_sharding_constraint(
+        _topn_packed_impl(factors_q, Y, n), out_s
+    )
 
 
 @functools.partial(jax.jit, static_argnames=("n",))
@@ -1752,13 +1883,15 @@ class ServingFactors:
         q = pad_rows_pow2(user_rows, 8)
         if self.mesh is None:
             q_dev = jax.device_put(q)
-        else:
-            # shard_batch further pads so the batch divides the mesh axis
-            # (a no-op for power-of-two axes), then places row-sharded
-            from predictionio_tpu.parallel.mesh import shard_batch
+            return _topn_packed(q_dev, self._if_dev, n)
+        # shard_batch further pads so the batch divides the mesh axis
+        # (a no-op for power-of-two axes), then places row-sharded
+        from predictionio_tpu.parallel.mesh import shard_batch
 
-            q_dev, _ = shard_batch(self.mesh, q, self._axis)
-        return _topn_packed(q_dev, self._if_dev, n)
+        q_dev, _ = shard_batch(self.mesh, q, self._axis)
+        return _topn_packed_sharded(
+            q_dev, self._if_dev, n, NamedSharding(self.mesh, P(self._axis))
+        )
 
     def warm(self, n: int = 16, max_batch: int = 128) -> None:
         """Compile every padded-batch-size executable the serving path can

@@ -71,6 +71,13 @@ class _PackEntry:
     wire: "_als.HostWire"
     user_index: BiMap
     item_index: BiMap
+    # --- delta-fold state (round 9): a pack entry IS the foldable
+    # checkpoint — the cached wire losslessly inverts to the old COO
+    # (als.wire_coo), the cursor says which store prefix it covers, and
+    # the trained factors seed the next round's warm start. No extra
+    # event-sized buffers beyond the wire the cache already held.
+    cursor: Optional[tuple] = None  # storage delta cursor, None: no delta
+    arrays: Optional["_als.ALSModelArrays"] = None  # factors of this wire
 
 
 _PACK_CACHE: "OrderedDict[tuple, _PackEntry]" = OrderedDict()
@@ -79,10 +86,31 @@ _PACK_CACHE_LOCK = threading.Lock()
 # warm-bench cases without growing with app count
 PACK_CACHE_MAX_ENTRIES = 4
 
+# lifetime hit/miss/fold counters (under _PACK_CACHE_LOCK), surfaced in
+# the training PhaseTimer summary — the cache is no longer silent
+_CACHE_STATS = {"hit": 0, "miss": 0, "fold": 0}
+
 
 def pack_cache_clear() -> None:
+    """Drop every cached wire AND its cursor-keyed fold state (the
+    delta-training checkpoint rides in the same entry), and reset the
+    hit/miss/fold counters."""
     with _PACK_CACHE_LOCK:
         _PACK_CACHE.clear()
+        for k in _CACHE_STATS:
+            _CACHE_STATS[k] = 0
+
+
+def pack_cache_stats() -> dict:
+    """Lifetime {'hit', 'miss', 'fold'} counters (reset by
+    pack_cache_clear)."""
+    with _PACK_CACHE_LOCK:
+        return dict(_CACHE_STATS)
+
+
+def _stat_bump(kind: str) -> None:
+    with _PACK_CACHE_LOCK:
+        _CACHE_STATS[kind] = _CACHE_STATS.get(kind, 0) + 1
 
 
 def _cache_key(stream, config) -> Optional[tuple]:
@@ -96,7 +124,7 @@ def _cache_key(stream, config) -> Optional[tuple]:
     return (stream.cache_key, config.segment_length, config.chunk_slots)
 
 
-def _cache_get(stream, config) -> Optional[_PackEntry]:
+def _cache_lookup(stream, config, any_fingerprint: bool):
     key = _cache_key(stream, config)
     if key is None:
         return None
@@ -106,30 +134,51 @@ def _cache_get(stream, config) -> Optional[_PackEntry]:
             return None
         # identity, not id(): the weakref keeps a dead DAO's entry from
         # ever matching a new object that reused its address
-        if (
-            entry.scope_ref() is not stream.cache_scope
-            or entry.fingerprint != stream.fingerprint
-        ):
+        if entry.scope_ref() is not stream.cache_scope:
+            return None
+        if not any_fingerprint and entry.fingerprint != stream.fingerprint:
             return None
         _PACK_CACHE.move_to_end(key)
         return entry
 
 
-def _cache_put(stream, config, wire, user_index, item_index) -> None:
+def _cache_get(stream, config) -> Optional[_PackEntry]:
+    """Exact-state lookup: same DAO identity AND same fingerprint."""
+    return _cache_lookup(stream, config, any_fingerprint=False)
+
+
+def _cache_get_foldable(stream, config) -> Optional[_PackEntry]:
+    """Stale-state lookup for the delta fold: same key and DAO identity,
+    fingerprint MOVED (the exact-match path already missed), and the
+    entry carries a cursor to scan the delta from."""
+    entry = _cache_lookup(stream, config, any_fingerprint=True)
+    if entry is None or entry.cursor is None:
+        return None
+    return entry
+
+
+def _cache_put(
+    stream, config, wire, user_index, item_index,
+    fingerprint=None, cursor=None,
+) -> Optional[_PackEntry]:
     key = _cache_key(stream, config)
     if key is None:
-        return
+        return None
     try:
         ref = weakref.ref(stream.cache_scope)
     except TypeError:  # unweakrefable DAO: no caching
-        return
+        return None
+    entry = _PackEntry(
+        ref,
+        stream.fingerprint if fingerprint is None else fingerprint,
+        wire, user_index, item_index, cursor=cursor,
+    )
     with _PACK_CACHE_LOCK:
-        _PACK_CACHE[key] = _PackEntry(
-            ref, stream.fingerprint, wire, user_index, item_index
-        )
+        _PACK_CACHE[key] = entry
         _PACK_CACHE.move_to_end(key)
         while len(_PACK_CACHE) > PACK_CACHE_MAX_ENTRIES:
             _PACK_CACHE.popitem(last=False)
+    return entry
 
 
 # --- incremental pack state ---
@@ -189,6 +238,41 @@ def _grow_add(acc: np.ndarray, add: np.ndarray) -> np.ndarray:
     return acc
 
 
+def _scatter_merge(
+    batches, n, n_users, n_items, geo_u,
+    remap_u=None, remap_i=None,
+):
+    """Counting-sort merge of user-presorted COO batches into the final
+    sentinel-padded item/value planes. Each batch must be sorted by its
+    user ids; ``remap_u``/``remap_i`` optionally relabel per-batch ids
+    into the final dense spaces (the relabeling must be injective and,
+    for the sort to survive it, monotone — both the provisional→sorted
+    relabel of the full scan and the old→merged relabel of the delta
+    fold are). Scattering batch b's run of user u right after the runs
+    batches 0..b-1 wrote reproduces EXACTLY the stable global argsort of
+    the monolithic packer: per user, batches in scan order, original
+    order within."""
+    pad = (_als._bucket_count(n) - n) if n else 1
+    iw = np.full(n + pad, n_items, np.int32)  # padding -> sentinel id
+    vw = np.zeros(n + pad, np.float32)
+    heads = geo_u.starts[:-1].copy()  # [n_users] int64 write heads
+    for u, i, v in batches:
+        m = len(u)
+        if not m:
+            continue
+        idx = np.arange(m, dtype=np.int64)
+        newgrp = np.empty(m, bool)
+        newgrp[0] = True
+        np.not_equal(u[1:], u[:-1], out=newgrp[1:])
+        first = np.maximum.accumulate(np.where(newgrp, idx, 0))
+        u_f = remap_u[u] if remap_u is not None else u
+        pos = heads[u_f] + (idx - first)
+        iw[pos] = remap_i[i] if remap_i is not None else i
+        vw[pos] = v
+        heads += np.bincount(u_f, minlength=n_users)
+    return iw, vw
+
+
 def _scan_worker(stream, q: "_queue.Queue", box: dict) -> None:
     """Drive the store scan, pushing batches through the bounded queue.
     Runs the generator ON THIS THREAD (the sqlite backend reads through
@@ -208,6 +292,7 @@ def _scan_worker(stream, q: "_queue.Queue", box: dict) -> None:
             q.put(batch)
         t0 = time.perf_counter()
         box["names"] = stream.names
+        box["cursor"] = getattr(stream, "cursor", None)
         busy += time.perf_counter() - t0
     except BaseException as e:
         box["error"] = e
@@ -221,9 +306,9 @@ def _scan_and_pack(stream, config, timings: dict, queue_batches: int):
     """Consume a ColumnarStream into a HostWire + id indexes, folding
     each batch while the scan of the next runs on the producer thread.
 
-    Returns ``(wire, user_index, item_index, compile_wait)`` or None for
-    an empty scan (callers fall back to the materialized path, whose
-    sanity check owns the user-facing error)."""
+    Returns ``(wire, user_index, item_index, compile_wait, cursor)`` or
+    None for an empty scan (callers fall back to the materialized path,
+    whose sanity check owns the user-facing error)."""
     q: "_queue.Queue" = _queue.Queue(maxsize=max(1, queue_batches))
     box: dict = {}
     th = threading.Thread(
@@ -306,31 +391,15 @@ def _scan_and_pack(stream, config, timings: dict, queue_batches: int):
         n_users, n_items, geo_u, geo_i, L_u, L_i, config
     )
 
-    # Counting-sort merge. Each batch is presorted by PROVISIONAL user
-    # id; relabeling is injective, so equal-user runs stay contiguous
-    # and the within-batch occurrence rank computed from the provisional
-    # grouping is also the rank under final ids. Scattering batch b's
-    # run of user u right after the runs batches 0..b-1 wrote
-    # reproduces EXACTLY the stable global argsort of the monolithic
-    # packer: per user, batches in scan order, original order within.
-    pad = (_als._bucket_count(n) - n) if n else 1
-    iw = np.full(n + pad, n_items, np.int32)  # padding -> sentinel id
-    vw = np.zeros(n + pad, np.float32)
-    cursor = geo_u.starts[:-1].copy()  # [n_users] int64 write heads
-    for u, i, v in batches:
-        m = len(u)
-        if not m:
-            continue
-        idx = np.arange(m, dtype=np.int64)
-        newgrp = np.empty(m, bool)
-        newgrp[0] = True
-        np.not_equal(u[1:], u[:-1], out=newgrp[1:])
-        first = np.maximum.accumulate(np.where(newgrp, idx, 0))
-        u_f = remap_u[u]
-        pos = cursor[u_f] + (idx - first)
-        iw[pos] = remap_i[i]
-        vw[pos] = v
-        cursor += np.bincount(u_f, minlength=n_users)
+    # Counting-sort merge (shared helper). Each batch is presorted by
+    # PROVISIONAL user id; the provisional→sorted relabel is injective,
+    # so equal-user runs stay contiguous and the within-batch occurrence
+    # rank computed from the provisional grouping is also the rank under
+    # final ids.
+    iw, vw = _scatter_merge(
+        batches, n, n_users, n_items, geo_u,
+        remap_u=remap_u, remap_i=remap_i,
+    )
     batches.clear()
 
     wire = _als.finish_wire(
@@ -348,7 +417,203 @@ def _scan_and_pack(stream, config, timings: dict, queue_batches: int):
     # + merge + narrow/nibble + index build
     timings["pack_exposed_s"] = max(0.0, now - t_scan_done)
     timings["pack_s"] = fold_busy + timings["pack_exposed_s"]
-    return wire, user_index, item_index, compile_wait
+    return wire, user_index, item_index, compile_wait, box.get("cursor")
+
+
+# --- delta fold (round 9) ---
+#
+# Retrain cost proportional to the delta: the storage layer scans ONLY
+# the rows committed after the cached entry's cursor
+# (LEvents.stream_columns_delta); here the cached wire losslessly
+# inverts back to the old user-major COO (als.wire_coo), the delta's ids
+# merge into the old sorted-name spaces (a monotone relabel, so the old
+# batch stays user-sorted), and ONE counting-sort scatter re-finishes
+# the wire — O(total events) of vectorized host work, no store rescan,
+# no per-batch argsorts. The result is byte-identical to a cold full
+# scan of the grown store, because per user the folded sequence (old
+# wire order, then delta in scan order) IS the cold scan's sequence —
+# the storage layer's cursor validation guarantees nothing already
+# folded was deleted, reordered, or resealed out from under us, and
+# falls back to the full repack otherwise.
+
+
+def _names_of(index: BiMap) -> np.ndarray:
+    """A BiMap's keys as a sorted object-str array (BiMaps here are
+    always built from sorted name arrays, so iteration order is sorted
+    order)."""
+    out = np.empty(len(index), object)
+    out[:] = [str(k) for k in index]
+    return out
+
+
+def _merge_sorted_names(old_names: np.ndarray, add_names: np.ndarray):
+    """Merge ``add_names`` (sorted, disjoint from ``old_names``) into
+    the sorted ``old_names``. Returns ``(merged, old_to_new)`` where
+    ``old_to_new`` is the (monotone) relabel of old dense ids."""
+    if not len(add_names):
+        return old_names, np.arange(len(old_names), dtype=np.int64)
+    old_pos = (
+        np.arange(len(old_names), dtype=np.int64)
+        + np.searchsorted(add_names, old_names)
+    )
+    new_pos = (
+        np.arange(len(add_names), dtype=np.int64)
+        + np.searchsorted(old_names, add_names)
+    )
+    merged = np.empty(len(old_names) + len(add_names), object)
+    merged[old_pos] = old_names
+    merged[new_pos] = add_names
+    return merged, old_pos
+
+
+def _side_fold_codes(codes: np.ndarray, names_arr, old_names: np.ndarray):
+    """Fold one side's delta codes (in the DELTA stream's shared code
+    space) into the cached side's sorted-name space, extending it with
+    unseen names. Delta-sized work only. Returns
+    ``(merged_names, old_to_new, dense_codes)``."""
+    if not len(codes):
+        return (
+            old_names,
+            np.arange(len(old_names), dtype=np.int64),
+            codes.astype(np.int64),
+        )
+    uniq = np.unique(codes)  # distinct delta codes, ascending
+    uniq_names = np.empty(len(uniq), object)
+    uniq_names[:] = [str(x) for x in np.asarray(names_arr)[uniq]]
+    if len(old_names):
+        pos = np.minimum(
+            np.searchsorted(old_names, uniq_names), len(old_names) - 1
+        )
+        is_old = old_names[pos] == uniq_names
+    else:
+        is_old = np.zeros(len(uniq_names), bool)
+    add = np.sort(uniq_names[~is_old])  # distinct by construction
+    merged, old_to_new = _merge_sorted_names(old_names, add)
+    lut = np.zeros(int(uniq[-1]) + 1, np.int64)
+    lut[uniq] = np.searchsorted(merged, uniq_names)
+    return merged, old_to_new, lut[np.asarray(codes, np.int64)]
+
+
+def _fold_delta(entry: _PackEntry, dstream, config, timings: dict):
+    """Fold a delta stream into a cached pack entry: re-finished wire,
+    merged id indexes, warm-start factor seeds, and the chained cursor.
+    Returns None when the delta stream cannot vouch for its own chain
+    (no cursor) — the caller falls back to the full repack."""
+    t0 = time.perf_counter()
+    parts = []
+    n_delta = 0
+    for e, g, v in dstream:
+        parts.append(
+            (
+                np.asarray(e, np.int64),
+                np.asarray(g, np.int64),
+                np.asarray(v, np.float32),
+            )
+        )
+        n_delta += len(v)
+    new_cursor = dstream.cursor
+    if new_cursor is None:
+        return None
+    timings["delta_scan_s"] = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    old_u_names = _names_of(entry.user_index)
+    old_i_names = _names_of(entry.item_index)
+    if parts:
+        e_codes = np.concatenate([p[0] for p in parts])
+        g_codes = np.concatenate([p[1] for p in parts])
+        dv = np.concatenate([p[2] for p in parts])
+        names_arr = dstream.names
+    else:
+        e_codes = g_codes = np.empty(0, np.int64)
+        dv = np.empty(0, np.float32)
+        names_arr = None
+    u_names, u_old2new, du = _side_fold_codes(
+        e_codes, names_arr, old_u_names
+    )
+    i_names, i_old2new, di = _side_fold_codes(
+        g_codes, names_arr, old_i_names
+    )
+    n_users, n_items = len(u_names), len(i_names)
+
+    old_wire = entry.wire
+    counts_u = np.zeros(n_users, np.int64)
+    counts_u[u_old2new] = old_wire.counts_u
+    counts_u += np.bincount(du, minlength=n_users)
+    counts_i = np.zeros(n_items, np.int64)
+    counts_i[i_old2new] = old_wire.counts_i
+    counts_i += np.bincount(di, minlength=n_items)
+    counts_u32 = counts_u.astype(np.int32)
+    counts_i32 = counts_i.astype(np.int32)
+
+    L_u = _als.auto_segment_length(
+        None, n_users, config.segment_length, counts=counts_u32
+    )
+    L_i = _als.auto_segment_length(
+        None, n_items, config.segment_length, counts=counts_i32
+    )
+    geo_u = _als._segment_geometry(
+        counts_u32, n_users, L_u, 1, config.chunk_slots
+    )
+    geo_i = _als._segment_geometry(
+        counts_i32, n_items, L_i, 1, config.chunk_slots
+    )
+    # geometry known: compile starts NOW, under the merge + transfer
+    compile_wait = _als.start_compile_async(
+        n_users, n_items, geo_u, geo_i, L_u, L_i, config
+    )
+
+    # old COO straight off the cached wire (user-major, original
+    # per-user order — exactly the cold scan's prefix), relabeled by the
+    # MONOTONE old→merged LUT so it stays user-sorted; the delta gets
+    # its own stable presort, preserving scan order within each user
+    ou, oi, ov = _als.wire_coo(old_wire)
+    ou = u_old2new[ou].astype(np.int64)
+    oi = i_old2new[oi]
+    order = np.argsort(du, kind="stable")
+    n = len(ov) + n_delta
+    iw, vw = _scatter_merge(
+        [(ou, oi, ov), (du[order], di[order], dv[order])],
+        n, n_users, n_items, geo_u,
+    )
+    wire = _als.finish_wire(
+        iw, vw, n_users, n_items, L_u, L_i, geo_u, geo_i,
+        counts_u32, counts_i32,
+    )
+    user_index = BiMap({str(nm): j for j, nm in enumerate(u_names)})
+    item_index = BiMap({str(nm): j for j, nm in enumerate(i_names)})
+
+    warm = None
+    k = config.rank
+    if (
+        entry.arrays is not None
+        and entry.arrays.user_factors.shape == (old_wire.n_users, k)
+        and entry.arrays.item_factors.shape == (old_wire.n_items, k)
+    ):
+        # previous factors carry over row-by-row; new users solve from
+        # the item side on the first half-sweep, new items get the same
+        # fresh nonnegative init a cold train would give them
+        X0 = np.zeros((n_users, k), np.float32)
+        X0[u_old2new] = entry.arrays.user_factors
+        Y0 = np.ascontiguousarray(
+            _als._factor_init_host(n_users, n_items, config, 1)[1][
+                :n_items
+            ]
+        )
+        Y0[i_old2new] = entry.arrays.item_factors
+        warm = _als.ALSModelArrays(user_factors=X0, item_factors=Y0)
+
+    timings["fold_exposed_s"] = time.perf_counter() - t0
+    return {
+        "wire": wire,
+        "user_index": user_index,
+        "item_index": item_index,
+        "compile_wait": compile_wait,
+        "cursor": new_cursor,
+        "fingerprint": dstream.fingerprint,
+        "warm": warm,
+        "delta_events": n_delta,
+    }
 
 
 # --- transfer ---
@@ -403,6 +668,8 @@ def _attribute_phases(timer, timings: dict) -> None:
     for name, key, overlapped in (
         ("stream:scan", "scan_s", True),
         ("stream:fold", "fold_s", True),
+        ("stream:delta-scan", "delta_scan_s", False),
+        ("stream:delta-fold", "fold_exposed_s", False),
         ("stream:pack-exposed", "pack_exposed_s", False),
         ("stream:device-put-exposed", "device_put_exposed_s", False),
         ("stream:compile", "compile_s", True),
@@ -411,6 +678,20 @@ def _attribute_phases(timer, timings: dict) -> None:
     ):
         if timings.get(key):
             add(name, timings[key], overlapped=overlapped)
+    note = getattr(timer, "note", None)
+    if note is None:
+        return
+    # the pack cache is not silent: this round's outcome, the lifetime
+    # hit/miss/fold counters, and the delta size land in the summary
+    if timings.get("pack_cache"):
+        note("pack_cache", timings["pack_cache"])
+    stats = pack_cache_stats()
+    note(
+        "pack_cache_stats",
+        f"hit={stats['hit']} miss={stats['miss']} fold={stats['fold']}",
+    )
+    if "delta_events" in timings:
+        note("delta_events", timings["delta_events"])
 
 
 def train_als_streaming(
@@ -425,16 +706,28 @@ def train_als_streaming(
     queue_batches: int = 4,
     ship_chunks: int = 2,
     cache: bool = True,
+    delta: bool = True,
+    warm_sweeps: int = 2,
 ) -> Optional[StreamTrainResult]:
     """Train ALS from a ``ColumnarStream`` through the overlapped
     pipeline (module docstring). Returns None when ``stream`` is None or
     the scan is empty — callers fall back to the materialized
     ``train_als`` path and its error reporting.
 
+    With ``delta`` (and ``cache``) on, a store that GREW since the
+    cached round skips the full rescan: the delta fold (module comment
+    above) re-finishes the cached wire from only the new rows, and
+    training warm-starts from the previous round's factors with a
+    ``warm_sweeps`` iteration budget (0 disables the reduced budget) —
+    retrain cost proportional to the delta, not the store. Any change
+    the storage cursor cannot vouch for (deletes, tombstones, bulk
+    imports, resealing) falls back to the full repack automatically.
+
     ``timings`` gains the pipeline's phase split: ``scan_s``/``fold_s``/
     ``compile_s`` (busy, overlapped), ``pack_exposed_s``/
     ``device_put_exposed_s``/``compile_exposed_s`` (critical-path wall),
-    ``pack_cache`` ("hit"/"miss"/"off"), plus the usual
+    ``pack_cache`` ("hit"/"miss"/"fold"/"off") with ``delta_events``/
+    ``delta_scan_s``/``fold_exposed_s`` on fold rounds, plus the usual
     ``device_loop_s``/``padded_slots``/``wire_mb`` from the shared
     training tail.
     """
@@ -443,11 +736,16 @@ def train_als_streaming(
     timings = {} if timings is None else timings
     t_start = time.perf_counter()
 
+    warm_arrays = None
+    train_config = config
+    cache_entry: Optional[_PackEntry] = None
     entry = _cache_get(stream, config) if cache else None
     if entry is not None:
+        _stat_bump("hit")
         timings["pack_cache"] = "hit"
         timings["scan_s"] = timings["fold_s"] = 0.0
         timings["pack_exposed_s"] = 0.0
+        cache_entry = entry
         wire = entry.wire
         user_index, item_index = entry.user_index, entry.item_index
         compile_wait = _als.start_compile_async(
@@ -460,19 +758,68 @@ def train_als_streaming(
             wire.wire_mb,
         )
     else:
-        timings["pack_cache"] = "miss" if cache else "off"
-        packed = _scan_and_pack(stream, config, timings, queue_batches)
-        if packed is None:
-            return None
-        wire, user_index, item_index, compile_wait = packed
-        if cache:
-            _cache_put(stream, config, wire, user_index, item_index)
+        folded = None
+        if cache and delta:
+            stale = _cache_get_foldable(stream, config)
+            dfactory = getattr(stream, "delta_factory", None)
+            if stale is not None and dfactory is not None:
+                dstream = dfactory(stale.cursor)
+                if dstream is not None:
+                    folded = _fold_delta(stale, dstream, config, timings)
+        if folded is not None:
+            _stat_bump("fold")
+            timings["pack_cache"] = "fold"
+            timings["delta_events"] = folded["delta_events"]
+            timings["scan_s"] = timings["fold_s"] = 0.0
+            timings["pack_exposed_s"] = 0.0
+            wire = folded["wire"]
+            user_index = folded["user_index"]
+            item_index = folded["item_index"]
+            compile_wait = folded["compile_wait"]
+            warm_arrays = folded["warm"]
+            if warm_arrays is not None and 0 < warm_sweeps < config.iterations:
+                # warm-started factors recover full quality in a few
+                # sweeps after a small delta (ALX / GPU-MF, PAPERS.md);
+                # the iteration count is a dynamic scalar, so the warm
+                # executable is the cold one — no recompile
+                train_config = dataclasses.replace(
+                    config, iterations=warm_sweeps
+                )
+                timings["warm_sweeps"] = warm_sweeps
+            cache_entry = _cache_put(
+                stream, config, wire, user_index, item_index,
+                fingerprint=folded["fingerprint"],
+                cursor=folded["cursor"],
+            )
+            logger.info(
+                "streaming ALS: delta FOLD of %d events into cached "
+                "wire (%d users, %d items) — skipping full rescan",
+                folded["delta_events"], wire.n_users, wire.n_items,
+            )
+        else:
+            _stat_bump("miss" if cache else "off")
+            timings["pack_cache"] = "miss" if cache else "off"
+            packed = _scan_and_pack(stream, config, timings, queue_batches)
+            if packed is None:
+                return None
+            wire, user_index, item_index, compile_wait, cursor = packed
+            if cache:
+                cache_entry = _cache_put(
+                    stream, config, wire, user_index, item_index,
+                    cursor=cursor,
+                )
 
     # ship (async) first, then factor-state init: the RNG + small
     # factor/regularizer puts run while the wire chunks are in flight
     device_wire = _ship_wire(wire, n_chunks=ship_chunks)
     factor_state = _als.init_factor_state_single(
-        wire.counts_u, wire.counts_i, wire.n_users, wire.n_items, config
+        wire.counts_u, wire.counts_i, wire.n_users, wire.n_items,
+        train_config,
+        warm=(
+            None
+            if warm_arrays is None
+            else (warm_arrays.user_factors, warm_arrays.item_factors)
+        ),
     )
     t0 = time.perf_counter()
     # aux was enqueued last: fetching it (small) fences the serialized
@@ -483,7 +830,7 @@ def train_als_streaming(
     timings["device_put_exposed_s"] = time.perf_counter() - t0
 
     arrays = _als.train_from_wire(
-        wire, config,
+        wire, train_config,
         device_wire=device_wire,
         timings=timings,
         checkpoint_dir=checkpoint_dir,
@@ -492,6 +839,12 @@ def train_als_streaming(
         compile_wait=compile_wait,
         factor_state=factor_state,
     )
+    if cache_entry is not None:
+        # the trained factors ride the entry so the NEXT delta round can
+        # warm-start; plain attribute store under the cache lock (the
+        # entry may already have been evicted — harmless)
+        with _PACK_CACHE_LOCK:
+            cache_entry.arrays = arrays
     timings["stream_wall_s"] = time.perf_counter() - t_start
     if timer is not None:
         _attribute_phases(timer, timings)

@@ -40,7 +40,13 @@ class PhaseTimer:
 
     def __init__(self):
         self.records: List[PhaseRecord] = []
+        self.notes: Dict[str, object] = {}
         self._depth = 0
+
+    def note(self, key: str, value) -> None:
+        """Attach a non-duration annotation (cache outcomes, delta
+        sizes) shown in the summary — last write per key wins."""
+        self.notes[key] = value
 
     @contextlib.contextmanager
     def phase(self, name: str) -> Iterator[None]:
@@ -94,6 +100,11 @@ class PhaseTimer:
             lines.append(
                 f"(pipelining hid {hidden:.3f}s of host/compile work "
                 "under the phases above)"
+            )
+        if self.notes:
+            lines.append(
+                "notes: "
+                + " ".join(f"{k}={v}" for k, v in self.notes.items())
             )
         return "\n".join(lines)
 

@@ -39,7 +39,7 @@ ALLOWED = {
     ),
     (
         "data/storage/columnar.py",
-        "compared by IDENTITY, never by a reusable ``id()``).",
+        "compared by IDENTITY, never by a reusable ``id()``);",
     ),
     # groups items of ONE in-flight micro-batch; every keyed object is a
     # live strong reference in the same local list, so no id can alias
@@ -241,6 +241,86 @@ def _mutable_module_state_occurrences():
             if isinstance(node, ast.Global):
                 found.add((rel, lines[node.lineno - 1].strip()))
     return found
+
+
+# --- unbounded sleep-polling loops in daemon/loop code ---
+#
+# The bug class (round 9's `pio train --continuous` loop class): a
+# `while True:` that sleeps between rounds but checks no shutdown event
+# can only be killed, not stopped — SIGTERM handlers can't reach it, the
+# current round's model write races process death, and under pytest the
+# daemon outlives its storage universe. The sanctioned idiom is
+# `while not stop.is_set():` parking on `stop.wait(interval)` (see
+# workflow/continuous.py and cmd_compact's daemon mode). Scope: daemon/
+# loop code under workflow/ and tools/ — a `while True:` there that
+# calls sleep() and never consults an event is flagged; plain read
+# loops (no sleep, bounded by data) are not.
+
+_LOOP_LINT_DIRS = ("workflow", "tools")
+
+# (relative path, stripped source line of the `while` statement) pairs
+# reviewed as safe. Shrink-only: delete entries when the code they
+# excuse goes away. Empty today — both daemon loops are event-checked.
+WHILE_TRUE_SLEEP_ALLOWED: set = set()
+
+
+def _unbounded_poll_loops():
+    import ast
+
+    found = set()
+    for d in _LOOP_LINT_DIRS:
+        for path in sorted((PACKAGE / d).rglob("*.py")):
+            rel = f"{d}/" + path.relative_to(PACKAGE / d).as_posix()
+            source = path.read_text(encoding="utf-8")
+            lines = source.splitlines()
+            for node in ast.walk(ast.parse(source, filename=str(path))):
+                if not (
+                    isinstance(node, ast.While)
+                    and isinstance(node.test, ast.Constant)
+                    and node.test.value
+                ):
+                    continue  # only constant-true (`while True:`) loops
+                has_sleep = False
+                has_shutdown_check = False
+                for sub in ast.walk(node):
+                    if not isinstance(sub, ast.Call):
+                        continue
+                    fn = sub.func
+                    name = (
+                        fn.attr
+                        if isinstance(fn, ast.Attribute)
+                        else (fn.id if isinstance(fn, ast.Name) else None)
+                    )
+                    if name == "sleep":
+                        has_sleep = True
+                    elif name in ("is_set", "wait"):
+                        # Event.is_set guard, or Event.wait(interval)
+                        # doubling as the sleep — both shutdown-aware
+                        has_shutdown_check = True
+                if has_sleep and not has_shutdown_check:
+                    found.add((rel, lines[node.lineno - 1].strip()))
+    return found
+
+
+def test_no_unbounded_poll_loops_in_daemon_code():
+    found = _unbounded_poll_loops()
+    new = found - WHILE_TRUE_SLEEP_ALLOWED
+    assert not new, (
+        "unbounded `while True:` sleep-poll loop in workflow/ or tools/ "
+        "— a daemon loop that never checks a shutdown event can only be "
+        "killed, not stopped; park on `stop.wait(interval)` under "
+        "`while not stop.is_set():` (workflow/continuous.py is the "
+        f"reference shape) or justify an allowlist entry: {sorted(new)}"
+    )
+
+
+def test_poll_loop_allowlist_is_not_stale():
+    found = _unbounded_poll_loops()
+    stale = WHILE_TRUE_SLEEP_ALLOWED - found
+    assert not stale, (
+        f"poll-loop allowlist entries no longer in the tree: "
+        f"{sorted(stale)}"
+    )
 
 
 def test_no_mutable_module_state_in_segment_tier():

@@ -142,9 +142,11 @@ class TestStreamingParity:
 
 
 class TestPackCache:
-    def test_hit_after_noop_miss_after_insert(self, tmp_path):
+    def test_hit_after_noop_fold_after_insert(self, tmp_path):
         """Unchanged store ⇒ fingerprint match ⇒ scan+pack skipped;
-        ONE new event ⇒ fingerprint moves ⇒ miss (never stale-hit)."""
+        ONE new event ⇒ fingerprint moves ⇒ NEVER a stale hit — the
+        appended event arrives via the delta fold (round 9), and with
+        delta disabled the round is a plain miss."""
         storage = sqlite_storage(tmp_path)
         app_id = _seed_ratings(storage, n=8_000)
         store = PEventStore(storage)
@@ -179,8 +181,27 @@ class TestPackCache:
         r3 = train_als_streaming(
             store.stream_columns("sapp", **SCAN_KW), config, timings=t3
         )
-        assert t3["pack_cache"] == "miss"
+        assert t3["pack_cache"] == "fold"  # appended event: delta fold
+        assert t3["delta_events"] == 1
         assert "new-user" in r3.user_index  # the new event trained
+
+        # same insert shape with delta OFF is a plain miss (full repack)
+        storage.get_l_events().insert(
+            Event(
+                event="rate", entity_type="user", entity_id="new-user-2",
+                target_entity_type="item", target_entity_id="new-item",
+                properties={"rating": 2.0},
+                event_time=dt.datetime(2026, 7, 3, tzinfo=dt.timezone.utc),
+            ),
+            app_id,
+        )
+        t4 = {}
+        r4 = train_als_streaming(
+            store.stream_columns("sapp", **SCAN_KW), config, timings=t4,
+            delta=False,
+        )
+        assert t4["pack_cache"] == "miss"
+        assert "new-user-2" in r4.user_index
 
     def test_miss_after_delete(self, tmp_path):
         storage = sqlite_storage(tmp_path)
