@@ -49,6 +49,7 @@ from predictionio_tpu.api.http import (
     HandleFn,
     JsonHTTPServer,
     ReusePortUnavailable,
+    accepts_headers,
     bind_with_retries,
 )
 
@@ -93,6 +94,7 @@ class AsyncJsonHTTPServer:
         self.name = name
         self.ip = ip
         self.handle_fn = handle_fn
+        self._pass_headers = accepts_headers(handle_fn)
         # bind synchronously so construction fails loudly (port conflict,
         # missing SO_REUSEPORT) and .port is known before the loop spins
         self._sock = self._bind(ip, port, reuse_port)
@@ -261,9 +263,14 @@ class AsyncJsonHTTPServer:
                         ((status, {"message": message}), False)
                     )
                     break
-                _, method, path, query, body, form, keep_alive = req
+                _, method, path, query, body, form, headers, keep_alive = req
                 try:
-                    result = self.handle_fn(method, path, query, body, form)
+                    if self._pass_headers:
+                        result = self.handle_fn(
+                            method, path, query, body, form, headers=headers
+                        )
+                    else:
+                        result = self.handle_fn(method, path, query, body, form)
                 except Exception as e:
                     logger.exception(
                         "internal error handling %s %s", method, path
@@ -300,7 +307,8 @@ class AsyncJsonHTTPServer:
         """Parse one framed request. Returns None on clean EOF,
         ``("error", status, message)`` on an unrecoverable framing
         problem (the connection closes after the error response), else
-        ``("request", method, path, query, body, form, keep_alive)``."""
+        ``("request", method, path, query, body, form, headers,
+        keep_alive)`` — ``headers`` with lower-cased keys."""
         try:
             head = await reader.readuntil(b"\r\n\r\n")
         except asyncio.IncompleteReadError as e:
@@ -356,7 +364,10 @@ class AsyncJsonHTTPServer:
             keep_alive = "close" not in connection
         else:  # HTTP/1.0 defaults to one request per connection
             keep_alive = "keep-alive" in connection
-        return ("request", method, parsed.path, query, body, form, keep_alive)
+        return (
+            "request", method, parsed.path, query, body, form, headers,
+            keep_alive,
+        )
 
     async def _write_responses(
         self, pending: asyncio.Queue, writer: asyncio.StreamWriter

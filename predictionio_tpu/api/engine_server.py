@@ -37,7 +37,6 @@ import html
 import json
 import logging
 import queue
-import random
 import secrets
 import string
 import threading
@@ -53,6 +52,8 @@ from predictionio_tpu.api.engine_plugins import (
 from predictionio_tpu.api.aio_http import TRANSPORTS, make_http_server
 from predictionio_tpu.controller.engine import Engine, EngineParams
 from predictionio_tpu.data.storage import Storage, get_storage
+from predictionio_tpu.utils import metrics as _metrics
+from predictionio_tpu.utils import tracing as _tracing
 from predictionio_tpu.utils.serialize import loads_model
 from predictionio_tpu.workflow.context import WorkflowContext
 from predictionio_tpu.workflow.workflow_params import WorkflowParams
@@ -285,19 +286,34 @@ class _BatchingExecutor:
             max_workers=self.pipeline_depth, thread_name_prefix="serve"
         )
         # collector batch-size accounting (served-group granularity, the
-        # actual device batch): proves micro-batches coalesce under load
-        self._stats_lock = threading.Lock()
-        self._batch_count = 0
-        self._query_count = 0
-        self._batch_hist: Dict[int, int] = {}
+        # actual device batch): proves micro-batches coalesce under load.
+        # The instrument is the process-global registry's mergeable
+        # histogram (the /metrics family); stats() reports the delta
+        # since THIS executor was constructed.
+        self._m_batch_fill = _metrics.get_registry().histogram(
+            "pio_serving_batch_fill",
+            "Queries per served micro-batch (the device batch size)",
+            buckets=_metrics.BATCH_SIZE_BUCKETS,
+        )
+        self._m_batch_base = self._m_batch_fill.snapshot()
 
     def submit_nowait(
-        self, deployed: DeployedEngine, query: Any
+        self,
+        deployed: DeployedEngine,
+        query: Any,
+        trace: Optional["_tracing.TraceContext"] = None,
     ) -> "concurrent.futures.Future":
         """Enqueue one query; the returned future resolves to its
         prediction (or raises its per-query error) once the micro-batch
-        it rides is served."""
+        it rides is served. ``trace`` (the request's trace id + the http
+        span id) rides the queue entry so the executor can record the
+        batch/predict spans under the request's trace."""
         fut: "concurrent.futures.Future" = concurrent.futures.Future()
+        tinfo = None
+        if trace is not None:
+            # the batch span id is minted NOW so the predict span can
+            # parent on it even though both are recorded at serve time
+            tinfo = (trace, _tracing.new_span_id(), time.time())
         # the closed-check and the enqueue share the lock with close()'s
         # sentinel post, so a request can never land behind _STOP in the
         # queue (its future would never resolve)
@@ -307,24 +323,34 @@ class _BatchingExecutor:
             if self._worker is None or not self._worker.is_alive():
                 self._worker = threading.Thread(target=self._run, daemon=True)
                 self._worker.start()
-            self._queue.put((deployed, query, fut))
+            self._queue.put((deployed, query, fut, tinfo))
         return fut
 
     def submit(self, deployed: DeployedEngine, query: Any) -> Any:
         return self.submit_nowait(deployed, query).result()
 
     def stats(self) -> Dict[str, Any]:
-        """Served-batch accounting: count, mean fill, size histogram."""
-        with self._stats_lock:
-            batches = self._batch_count
-            queries = self._query_count
-            hist = dict(sorted(self._batch_hist.items()))
-        return {
-            "batches": batches,
-            "queries": queries,
-            "batch_fill_mean": (queries / batches) if batches else 0.0,
+        """Served-batch accounting since this executor was constructed:
+        count, mean fill, bucketed size histogram (keys are the
+        registry histogram's bucket upper bounds)."""
+        snap = self._m_batch_fill.snapshot().delta(self._m_batch_base)
+        # counts has one +Inf overflow slot beyond the finite bounds: a
+        # batch larger than the last bound (max_batch is user-settable
+        # past 1024) must not vanish from the histogram view
+        hist = {
+            int(bound): c
+            for bound, c in zip(snap.bounds, snap.counts)
+            if c
+        }
+        out = {
+            "batches": snap.count,
+            "queries": int(snap.sum),
+            "batch_fill_mean": (snap.sum / snap.count) if snap.count else 0.0,
             "batch_size_histogram": hist,
         }
+        if snap.counts[-1]:
+            out["batch_size_overflow"] = snap.counts[-1]
+        return out
 
     def close(self) -> None:
         """Stop the collector thread and release the serve-pool workers
@@ -352,8 +378,7 @@ class _BatchingExecutor:
             first = self._queue.get()
             if first is self._STOP:
                 return
-            deployed, query, slot = first
-            batch = [(deployed, query, slot)]
+            batch = [first]
             deadline = time.monotonic() + self.window_ms / 1000.0
             while len(batch) < self.max_batch:
                 timeout = deadline - time.monotonic()
@@ -368,7 +393,7 @@ class _BatchingExecutor:
                     break
                 batch.append(item)
             # group by deployed engine (a reload may be in flight)
-            groups: Dict[int, List[Tuple[DeployedEngine, Any, Any]]] = {}
+            groups: Dict[int, List[tuple]] = {}
             for item in batch:
                 groups.setdefault(id(item[0]), []).append(item)
             for items in groups.values():
@@ -381,12 +406,7 @@ class _BatchingExecutor:
                 ]
                 if not items:
                     continue
-                with self._stats_lock:
-                    self._batch_count += 1
-                    self._query_count += len(items)
-                    self._batch_hist[len(items)] = (
-                        self._batch_hist.get(len(items), 0) + 1
-                    )
+                self._m_batch_fill.observe(len(items))
                 # blocks while pipeline_depth batches are in flight — the
                 # next batch keeps accumulating in self._queue meanwhile
                 self._inflight.acquire()
@@ -399,16 +419,35 @@ class _BatchingExecutor:
                     # in flight): fail these futures instead of leaving
                     # their waiters pending forever
                     self._inflight.release()
-                    for _, _, f in items:
+                    for _, _, f, _ in items:
                         f.set_exception(
                             RuntimeError(f"server is shutting down: {e}")
                         )
 
     def _serve_and_release(self, dep: DeployedEngine, items) -> None:
+        t0 = time.time()
         try:
             self._serve_isolating(dep, items)
         finally:
             self._inflight.release()
+            t1 = time.time()
+            for _, _, _, tinfo in items:
+                if tinfo is None:
+                    continue
+                trace, batch_span_id, enqueued = tinfo
+                # predict: the device serve_batch call (incl. bisect
+                # retries); batch: queue wait + serve, the executor's
+                # whole share of the request
+                _tracing.record_span(
+                    "predict", trace.trace_id, parent_id=batch_span_id,
+                    start_s=t0, duration_s=t1 - t0,
+                    attrs={"batch_size": len(items)},
+                )
+                _tracing.record_span(
+                    "batch", trace.trace_id, span_id=batch_span_id,
+                    parent_id=trace.span_id, start_s=enqueued,
+                    duration_s=t1 - enqueued,
+                )
 
     def _serve_isolating(self, dep: DeployedEngine, items) -> None:
         """Serve a batch; on failure bisect it so the poison query is
@@ -416,8 +455,8 @@ class _BatchingExecutor:
         batched service (a serial per-query retry would multiply every
         innocent's latency by the batch size)."""
         try:
-            results = dep.serve_batch([q for _, q, _ in items])
-            for (_, _, f), r in zip(items, results):
+            results = dep.serve_batch([q for _, q, _, _ in items])
+            for (_, _, f, _), r in zip(items, results):
                 f.set_result(r)
         except Exception as e:
             if len(items) == 1:
@@ -457,16 +496,37 @@ class QueryAPI:
             max_workers=2, thread_name_prefix="qroutes"
         )
         self.server_start_time = _dt.datetime.now(_dt.timezone.utc)
-        self.request_count = 0
-        self.avg_serving_sec = 0.0
-        self.last_serving_sec = 0.0
+        # upgrade-check fields only; every serving stat lives in the
+        # process-global metrics registry (per-child locks, no shared
+        # hot-path lock)
         self._stats_lock = threading.Lock()
-        # serving-latency reservoir (algorithm R, fixed K): p50/p99
-        # estimates for status.json without unbounded sample growth. The
-        # RNG is a plain PRNG — it picks which sample to evict, nothing
-        # security-relevant — and is guarded by _stats_lock.
-        self._lat_reservoir: List[float] = []
-        self._lat_rng = random.Random(0x5EED)
+        # serving instruments: process-global families (the /metrics
+        # exposition), read as deltas against construction-time
+        # snapshots for this instance's status.json. The mergeable
+        # log-bucket histogram replaces the old 512-sample reservoir —
+        # a reservoir cannot aggregate across SO_REUSEPORT workers;
+        # bucket vectors add.
+        reg = _metrics.get_registry()
+        self._m_latency = reg.histogram(
+            "pio_serving_latency_seconds",
+            "End-to-end /queries.json serving latency",
+            buckets=_metrics.LATENCY_BUCKETS_S,
+        )
+        self._m_requests = reg.counter(
+            "pio_serving_requests_total",
+            "Completed /queries.json requests",
+        )
+        self._m_last = reg.gauge(
+            "pio_serving_last_seconds",
+            "Latency of the most recent served query",
+        )
+        self._m_feedback_dropped = reg.counter(
+            "pio_feedback_queue_dropped_total",
+            "Feedback posts dropped because the bounded queue was full",
+        )
+        self._lat_base = self._m_latency.snapshot()
+        self._requests_base = self._m_requests.snapshot()
+        self._feedback_dropped_base = self._m_feedback_dropped.snapshot()
         # feedback posts drain on ONE daemon worker (not a thread per
         # request — that would throttle the micro-batched hot path). The
         # queue is BOUNDED (config.feedback_queue_max): a down event
@@ -475,7 +535,6 @@ class QueryAPI:
         self._feedback_queue: "queue.Queue" = queue.Queue(
             maxsize=max(1, self.config.feedback_queue_max)
         )
-        self._feedback_dropped = 0
         self._feedback_worker: Optional[threading.Thread] = None
         self._feedback_lock = threading.Lock()
         self._feedback_closed = False
@@ -504,10 +563,6 @@ class QueryAPI:
             self._upgrade_stop.wait(self.config.upgrade_check_interval_s)
 
     _FEEDBACK_STOP = object()
-
-    # fixed reservoir size: ~0.2 KB of floats, yet p99 of a 512-sample
-    # reservoir is stable to a few percent at serving request rates
-    LAT_RESERVOIR_K = 512
 
     def close(self) -> None:
         """Release serving resources (the batching executor's collector,
@@ -555,8 +610,7 @@ class QueryAPI:
                         self._feedback_queue.get_nowait()
                     except queue.Empty:
                         continue  # the worker drained it; retry the put
-                    with self._stats_lock:
-                        self._feedback_dropped += 1
+                    self._m_feedback_dropped.inc()
 
     def _ensure_feedback_worker(self) -> None:
         with self._feedback_lock:
@@ -598,10 +652,11 @@ class QueryAPI:
         path: str,
         query: Optional[Dict[str, str]] = None,
         body: Optional[bytes] = None,
+        headers: Optional[Dict[str, str]] = None,
     ) -> Tuple[int, Any, str]:
         """Returns (status, payload, content_type)."""
         try:
-            return self._route(method, path, query or {}, body)
+            return self._route(method, path, query or {}, body, headers)
         except Exception as e:
             logger.exception("internal error handling %s %s", method, path)
             return 500, {"message": str(e)}, "application/json"
@@ -613,6 +668,7 @@ class QueryAPI:
         query: Optional[Dict[str, str]] = None,
         body: Optional[bytes] = None,
         form: Optional[Dict[str, str]] = None,
+        headers: Optional[Dict[str, str]] = None,
     ) -> Union[Tuple[int, Any, str], "concurrent.futures.Future"]:
         """Transport-facing dispatch for the event-loop frontend
         (api/aio_http.py): the /queries.json hot path returns a
@@ -624,7 +680,7 @@ class QueryAPI:
         the loop awaits the same way. Parse errors answer inline."""
         if path == "/queries.json" and method == "POST":
             try:
-                return self._handle_query_nowait(body)
+                return self._handle_query_nowait(body, headers)
             except Exception as e:
                 logger.exception(
                     "internal error handling POST /queries.json"
@@ -632,7 +688,7 @@ class QueryAPI:
                 return 500, {"message": str(e)}, "application/json"
         try:
             return self._route_pool.submit(
-                self.handle, method, path, query, body
+                self.handle, method, path, query, body, headers
             )
         except RuntimeError:  # pool shut down: server is stopping
             return (
@@ -640,14 +696,24 @@ class QueryAPI:
                 "application/json",
             )
 
-    def _route(self, method, path, query, body) -> Tuple[int, Any, str]:
+    def _route(
+        self, method, path, query, body, headers=None
+    ) -> Tuple[int, Any, str]:
         parts = [p for p in path.strip("/").split("/") if p]
         if not parts and method == "GET":
             return 200, self._status_html(), "text/html"
         if path == "/status.json" and method == "GET":
             return 200, self._status_json(), "application/json"
+        if path == "/metrics" and method == "GET":
+            return (
+                200,
+                _metrics.get_registry().render(),
+                _metrics.render_content_type(),
+            )
+        if path == "/debug/traces.json" and method == "GET":
+            return self._debug_traces(query)
         if path == "/queries.json" and method == "POST":
-            return self._handle_query(body)
+            return self._handle_query(body, headers)
         if path == "/reload" and method == "GET":
             if self._reload_fn is not None:
                 threading.Thread(target=self._reload_fn, daemon=True).start()
@@ -672,16 +738,33 @@ class QueryAPI:
             return 200, table[plugin_name].handle_rest(args), "application/json"
         return 404, {"message": "Not Found"}, "application/json"
 
+    # --- debug span dump (access-key gated when a key is configured) ---
+
+    def _debug_traces(self, query: Dict[str, str]) -> Tuple[int, Any, str]:
+        if self.config.access_key and not secrets.compare_digest(
+            query.get("accessKey", ""), self.config.access_key
+        ):
+            return (
+                401, {"message": "Invalid accessKey."}, "application/json"
+            )
+        return (
+            200,
+            {"spans": _tracing.dump(query.get("traceId") or None)},
+            "application/json",
+        )
+
     # --- the hot path (reference CreateServer.scala:473-624) ---
 
-    def _handle_query(self, body: Optional[bytes]) -> Tuple[int, Any, str]:
-        result = self._handle_query_nowait(body)
+    def _handle_query(
+        self, body: Optional[bytes], headers=None
+    ) -> Tuple[int, Any, str]:
+        result = self._handle_query_nowait(body, headers)
         if isinstance(result, concurrent.futures.Future):
             return result.result()
         return result
 
     def _handle_query_nowait(
-        self, body: Optional[bytes]
+        self, body: Optional[bytes], headers=None
     ) -> Union[Tuple[int, Any, str], "concurrent.futures.Future"]:
         """Parse + enqueue; the returned future completes (via the
         serve-pool thread that resolves the prediction, so feedback,
@@ -691,6 +774,17 @@ class QueryAPI:
         deployed = self.deployed  # snapshot against concurrent reload
         algorithms = deployed.algorithms
         query_time = _dt.datetime.now(_dt.timezone.utc)
+        # spans are recorded only for CLIENT-SUPPLIED trace ids
+        # (X-PIO-Trace-Id): minting + ring-buffer appends for every
+        # request would add a shared-lock touch to the hot path (the
+        # acceptance criterion forbids exactly that) and untraced
+        # traffic would evict the deliberately-traced requests from the
+        # bounded span ring — the same flood guard the storage gateway
+        # applies. tctx.span_id is the http span, recorded at finish.
+        if headers and headers.get(_tracing.TRACE_HEADER.lower()):
+            tctx, inbound_parent = _tracing.from_headers(headers)
+        else:
+            tctx, inbound_parent = None, None
         try:
             query_json = json.loads((body or b"").decode("utf-8"))
             query = algorithms[0].query_from_json(query_json)
@@ -698,14 +792,16 @@ class QueryAPI:
             logger.error("query %r is invalid: %s", body, e)
             return 400, {"message": str(e)}, "application/json"
 
-        prediction_fut = self._executor.submit_nowait(deployed, query)
+        prediction_fut = self._executor.submit_nowait(
+            deployed, query, trace=tctx
+        )
         out: "concurrent.futures.Future" = concurrent.futures.Future()
 
         def _finish(f: "concurrent.futures.Future") -> None:
             try:
                 result = self._finish_query(
                     deployed, query, query_json, f.result(), query_time,
-                    serving_start,
+                    serving_start, tctx, inbound_parent,
                 )
             except concurrent.futures.CancelledError:
                 return  # request was cancelled before its batch formed
@@ -732,7 +828,7 @@ class QueryAPI:
 
     def _finish_query(
         self, deployed, query, query_json, prediction, query_time,
-        serving_start,
+        serving_start, tctx=None, inbound_parent=None,
     ) -> Tuple[int, Any, str]:
         prediction_json = deployed.algorithms[0].result_to_json(prediction)
 
@@ -750,19 +846,16 @@ class QueryAPI:
         )
 
         elapsed = time.perf_counter() - serving_start
-        with self._stats_lock:
-            self.last_serving_sec = elapsed
-            self.avg_serving_sec = (
-                self.avg_serving_sec * self.request_count + elapsed
-            ) / (self.request_count + 1)
-            self.request_count += 1
-            # reservoir sample (algorithm R) for the p50/p99 estimates
-            if len(self._lat_reservoir) < self.LAT_RESERVOIR_K:
-                self._lat_reservoir.append(elapsed)
-            else:
-                j = self._lat_rng.randrange(self.request_count)
-                if j < self.LAT_RESERVOIR_K:
-                    self._lat_reservoir[j] = elapsed
+        # registry bookkeeping: per-child locks only, no shared hot-path
+        # lock (the old reservoir serialized every request on one mutex)
+        self._m_latency.observe(elapsed)
+        self._m_requests.inc()
+        self._m_last.set(elapsed)
+        if tctx is not None:
+            _tracing.record_span(
+                "http:/queries.json", tctx.trace_id, span_id=tctx.span_id,
+                parent_id=inbound_parent, duration_s=elapsed,
+            )
         return 200, prediction_json, "application/json"
 
     # --- feedback loop (reference CreateServer.scala:509-579) ---
@@ -803,50 +896,61 @@ class QueryAPI:
 
     # --- status page (reference CreateServer.scala:444-471 html.index) ---
 
-    @staticmethod
-    def _pctl(sorted_values: List[float], q: float) -> float:
-        if not sorted_values:
-            return 0.0
-        idx = min(
-            len(sorted_values) - 1, int(round(q * (len(sorted_values) - 1)))
-        )
-        return sorted_values[idx]
-
     def _status_json(self) -> dict:
+        """status.json is now a READ of the metrics registry (deltas
+        against construction-time snapshots — 'since this server
+        deployed'), not a walk of N private lock-guarded tallies. The
+        p50/p99 keys survive, estimated by bucket interpolation from the
+        mergeable log-bucket histogram that replaced the reservoir."""
+        from predictionio_tpu.ops.streaming import pack_cache_stats
+        from predictionio_tpu.workflow.continuous import (
+            continuous_round_stats,
+        )
+
         inst = self.deployed.engine_instance
         batch_stats = self._executor.stats()
+        lat = self._m_latency.snapshot().delta(self._lat_base)
+        requests = int(self._m_requests.value - self._requests_base)
         with self._stats_lock:
-            lat = sorted(self._lat_reservoir)
-            return {
-                "status": "alive",
-                "engineInstanceId": inst.id,
-                "engineFactory": inst.engine_factory,
-                "startTime": self.server_start_time.isoformat(),
-                "algorithms": [type(a).__name__ for a in self.deployed.algorithms],
-                "algorithmsParams": [
-                    repr(a.params) for a in self.deployed.algorithms
-                ],
-                "serving": type(self.deployed.serving).__name__,
-                "feedback": self.config.feedback,
-                "eventServerIp": self.config.event_server_ip,
-                "eventServerPort": self.config.event_server_port,
-                "requestCount": self.request_count,
-                "avgServingSec": self.avg_serving_sec,
-                "lastServingSec": self.last_serving_sec,
-                # reservoir-estimated latency percentiles (LAT_RESERVOIR_K
-                # samples under _stats_lock) alongside the running average
-                "p50ServingSec": self._pctl(lat, 0.50),
-                "p99ServingSec": self._pctl(lat, 0.99),
-                # collector batch accounting: does micro-batching engage?
-                "batchFillMean": round(batch_stats["batch_fill_mean"], 3),
-                "batchSizeHistogram": batch_stats["batch_size_histogram"],
-                # bounded feedback queue (drop-oldest when the event
-                # server lags; see ServerConfig.feedback_queue_max)
-                "feedbackQueueDropped": self._feedback_dropped,
-                # daily self-check (reference CreateServer.scala:253-260)
-                "upgradeStatus": self._upgrade_status,
-                "upgradeLastChecked": self._upgrade_checked_at,
-            }
+            upgrade_status = self._upgrade_status
+            upgrade_checked = self._upgrade_checked_at
+        return {
+            "status": "alive",
+            "engineInstanceId": inst.id,
+            "engineFactory": inst.engine_factory,
+            "startTime": self.server_start_time.isoformat(),
+            "algorithms": [type(a).__name__ for a in self.deployed.algorithms],
+            "algorithmsParams": [
+                repr(a.params) for a in self.deployed.algorithms
+            ],
+            "serving": type(self.deployed.serving).__name__,
+            "feedback": self.config.feedback,
+            "eventServerIp": self.config.event_server_ip,
+            "eventServerPort": self.config.event_server_port,
+            "requestCount": requests,
+            "avgServingSec": (lat.sum / lat.count) if lat.count else 0.0,
+            "lastServingSec": self._m_last.value,
+            # bucket-interpolated latency percentiles from the mergeable
+            # log-bucket histogram (quantile_from_buckets)
+            "p50ServingSec": lat.quantile(0.50),
+            "p99ServingSec": lat.quantile(0.99),
+            # collector batch accounting: does micro-batching engage?
+            "batchFillMean": round(batch_stats["batch_fill_mean"], 3),
+            "batchSizeHistogram": batch_stats["batch_size_histogram"],
+            # bounded feedback queue (drop-oldest when the event
+            # server lags; see ServerConfig.feedback_queue_max)
+            "feedbackQueueDropped": int(
+                self._m_feedback_dropped.value
+                - self._feedback_dropped_base
+            ),
+            # training-side registry families surfaced for the serving
+            # process (continuous retrain + hot-swap runs in-process)
+            "packCache": pack_cache_stats(),
+            "continuousRounds": continuous_round_stats(),
+            # daily self-check (reference CreateServer.scala:253-260)
+            "upgradeStatus": upgrade_status,
+            "upgradeLastChecked": upgrade_checked,
+        }
 
     def _status_html(self) -> str:
         s = self._status_json()
@@ -892,11 +996,13 @@ class EngineServer:
             stop_fn=self.shutdown,
         )
 
-        def handle(method, path, query, body, form=None):
-            return self.api.handle(method, path, query, body)
+        def handle(method, path, query, body, form=None, headers=None):
+            return self.api.handle(method, path, query, body, headers)
 
-        def handle_nowait(method, path, query, body, form=None):
-            return self.api.handle_nowait(method, path, query, body)
+        def handle_nowait(method, path, query, body, form=None, headers=None):
+            return self.api.handle_nowait(
+                method, path, query, body, form, headers
+            )
 
         # the event loop awaits the query route's future; the threaded
         # frontend cannot await, so it gets the blocking dispatch

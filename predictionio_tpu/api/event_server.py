@@ -60,6 +60,8 @@ from predictionio_tpu.data.webhooks.mailchimp import MailChimpConnector
 from predictionio_tpu.data.webhooks.segmentio import SegmentIOConnector
 from predictionio_tpu.api.plugins import EventServerPlugin, EventServerPluginContext
 from predictionio_tpu.api.stats import StatsTracker
+from predictionio_tpu.utils import metrics as _metrics
+from predictionio_tpu.utils import tracing as _tracing
 
 logger = logging.getLogger(__name__)
 
@@ -176,6 +178,14 @@ class EventAPI:
         )
 
         self._compaction_status = CachedCompactionStatus(self.storage)
+        # ingest bookkeeping in the process-global registry (the
+        # /metrics exposition; per-route ingested-event counters beside
+        # the storage tier's group-commit flush families)
+        self._m_ingested = _metrics.get_registry().counter(
+            "pio_events_ingested_total",
+            "Events accepted by the event server, by route",
+            labels=("route",),
+        )
         _LIVE_APIS.add(self)
 
     # --- auth (reference withAccessKey, EventServer.scala:81-107) ---
@@ -237,16 +247,19 @@ class EventAPI:
         query: Optional[Dict[str, str]] = None,
         body: Optional[bytes] = None,
         form: Optional[Dict[str, str]] = None,
+        headers: Optional[Dict[str, str]] = None,
     ) -> Tuple[int, Any]:
         """Route one request; returns (status, json-compatible payload)."""
         query = query or {}
         try:
-            return self._route(method, path, query, body, form)
+            return self._route(method, path, query, body, form, headers)
         except Exception as e:  # reference Common.exceptionHandler
             logger.exception("internal error handling %s %s", method, path)
             return _message(500, str(e))
 
-    def _route(self, method, path, query, body, form) -> Tuple[int, Any]:
+    def _route(
+        self, method, path, query, body, form, headers=None
+    ) -> Tuple[int, Any]:
         parts = [p for p in path.strip("/").split("/") if p]
 
         if not parts:
@@ -259,6 +272,23 @@ class EventAPI:
 
         if path == "/status.json" and method == "GET":
             return 200, self._status_json(query)
+
+        if path == "/metrics" and method == "GET":
+            # unauthenticated like status.json: process-level aggregates
+            # only, the health-probe class of information
+            return (
+                200,
+                _metrics.get_registry().render(),
+                _metrics.render_content_type(),
+            )
+
+        if path == "/debug/traces.json" and method == "GET":
+            # span dumps carry entity ids and timings — same class of
+            # information the data routes gate behind access keys
+            auth, err = self._authenticate(query)
+            if err:
+                return err
+            return 200, {"spans": _tracing.dump(query.get("traceId") or None)}
 
         if parts[0] == "plugins" and len(parts) >= 3 and method == "GET":
             auth, err = self._authenticate(query)
@@ -281,7 +311,7 @@ class EventAPI:
                 return err
             app_id, channel_id = auth
             if method == "POST":
-                return self._post_event(app_id, channel_id, body)
+                return self._post_event(app_id, channel_id, body, headers)
             if method == "GET":
                 return self._find_events(app_id, channel_id, query)
             return _message(405, "Method not allowed.")
@@ -293,7 +323,7 @@ class EventAPI:
             app_id, channel_id = auth
             if method != "POST":
                 return _message(405, "Method not allowed.")
-            return self._post_batch(app_id, channel_id, body)
+            return self._post_batch(app_id, channel_id, body, headers)
 
         if parts[0] == "events" and len(parts) == 2 and parts[1].endswith(".json"):
             auth, err = self._authenticate(query)
@@ -355,12 +385,19 @@ class EventAPI:
         import time as _time
 
         per_app = self._compaction_status.get()
+        # ingest totals are a read of the registry (same families the
+        # /metrics route exposes), not a private tally
+        ingested = {
+            key[0]: int(child.value)
+            for key, child in self._m_ingested.children()
+        }
         out = {
             "status": "alive",
             "transport": self.config.transport,
             "uptimeSec": round(
                 _time.monotonic() - self._started_monotonic, 3
             ),
+            "eventsIngested": ingested,
             "compaction": {
                 "apps": len(per_app),
                 "segments": sum(s["segments"] for s in per_app.values()),
@@ -393,9 +430,12 @@ class EventAPI:
 
     # --- event handlers ---
 
-    def _insert(self, app_id, channel_id, event: Event) -> Tuple[int, Any]:
+    def _insert(
+        self, app_id, channel_id, event: Event, route: str = "single"
+    ) -> Tuple[int, Any]:
         event_id = self._events.insert(event, app_id, channel_id)
         self.plugin_context.notify_sniffers(app_id, channel_id, event)
+        self._m_ingested.labels(route=route).inc()
         result = (201, {"eventId": event_id})
         if self.config.stats:
             self.stats.bookkeeping(app_id, result[0], event)
@@ -405,7 +445,9 @@ class EventAPI:
     # than or equal to 50 events")
     MAX_BATCH_EVENTS = 50
 
-    def _post_batch(self, app_id, channel_id, body) -> Tuple[int, Any]:
+    def _post_batch(
+        self, app_id, channel_id, body, headers=None
+    ) -> Tuple[int, Any]:
         """Reference batch route (EventServer.scala:161-233): a JSON
         array of up to 50 events, answered 200 with one status object
         per slot — 201 + eventId on success, 400/403 + message on a
@@ -414,6 +456,42 @@ class EventAPI:
         store as ONE ``insert_batch`` — the storage tier's group-commit
         unit, so the whole slice is one transaction per shard instead of
         50 commits."""
+        return self._traced_http(
+            "http:POST /batch/events.json",
+            headers,
+            lambda: self._post_batch_inner(app_id, channel_id, body),
+        )
+
+    def _traced_http(self, name, headers, fn) -> Tuple[int, Any]:
+        """Ingest-entry trace wrapper for CLIENT-SUPPLIED trace ids
+        (``X-PIO-Trace-Id``): make the trace ambient under an
+        ``insert`` span — the group-commit committer and the
+        storage-gateway RPC client pick it up from there — and record
+        the entry span when the handler returns. Untraced requests skip
+        tracing entirely: per-event span recording would put the shared
+        ring-buffer lock on the write hot path and flood the bounded
+        ring, evicting the requests an operator deliberately traced
+        (the storage gateway applies the same guard)."""
+        import time as _time
+
+        if not (headers and headers.get(_tracing.TRACE_HEADER.lower())):
+            return fn()
+        tctx, inbound = _tracing.from_headers(headers)
+        t0 = _time.time()
+        status = 500
+        try:
+            with _tracing.use(tctx), _tracing.span("insert"):
+                result = fn()
+            status = result[0]
+            return result
+        finally:
+            _tracing.record_span(
+                name, tctx.trace_id, span_id=tctx.span_id,
+                parent_id=inbound, start_s=t0,
+                duration_s=_time.time() - t0, attrs={"status": status},
+            )
+
+    def _post_batch_inner(self, app_id, channel_id, body) -> Tuple[int, Any]:
         try:
             payload = json.loads((body or b"").decode("utf-8"))
         except (json.JSONDecodeError, UnicodeDecodeError) as e:
@@ -465,12 +543,22 @@ class EventAPI:
                     }
                     continue
                 results[slot] = {"status": 201, "eventId": event_id}
+                self._m_ingested.labels(route="batch").inc()
                 self.plugin_context.notify_sniffers(app_id, channel_id, event)
                 if self.config.stats:
                     self.stats.bookkeeping(app_id, 201, event)
         return 200, results
 
-    def _post_event(self, app_id, channel_id, body) -> Tuple[int, Any]:
+    def _post_event(
+        self, app_id, channel_id, body, headers=None
+    ) -> Tuple[int, Any]:
+        return self._traced_http(
+            "http:POST /events.json",
+            headers,
+            lambda: self._post_event_inner(app_id, channel_id, body),
+        )
+
+    def _post_event_inner(self, app_id, channel_id, body) -> Tuple[int, Any]:
         try:
             payload = json.loads((body or b"").decode("utf-8"))
             event = Event.from_json(payload)
@@ -536,7 +624,7 @@ class EventAPI:
             EventValidationError,
         ) as e:
             return _message(400, str(e))
-        return self._insert(app_id, channel_id, event)
+        return self._insert(app_id, channel_id, event, route="webhook")
 
     def _webhook_form(
         self, app_id, channel_id, web, method, form
@@ -552,7 +640,7 @@ class EventAPI:
             event = to_event(connector, form or {})
         except (ConnectorException, EventValidationError) as e:
             return _message(400, str(e))
-        return self._insert(app_id, channel_id, event)
+        return self._insert(app_id, channel_id, event, route="webhook")
 
 
 class EventServer:
@@ -595,9 +683,10 @@ class EventServer:
             )
             pool = self._pool
 
-            def fn(method, path, query, body, form=None):
+            def fn(method, path, query, body, form=None, headers=None):
                 return pool.submit(
-                    self.api.handle, method, path, query, body, form
+                    self.api.handle, method, path, query, body, form,
+                    headers,
                 )
         else:
             fn = self.api.handle

@@ -11,6 +11,7 @@ shutdown initiated from a handler thread, as /stop does).
 
 from __future__ import annotations
 
+import inspect
 import json
 import logging
 import threading
@@ -21,8 +22,26 @@ from typing import Callable, Optional, Tuple
 
 logger = logging.getLogger(__name__)
 
-# (method, path, query, body, form) -> (status, payload[, content_type])
+# (method, path, query, body, form[, headers]) ->
+# (status, payload[, content_type])
 HandleFn = Callable[..., Tuple]
+
+
+def accepts_headers(fn: Callable) -> bool:
+    """Whether a request core takes the optional ``headers`` kwarg (the
+    lower-cased request-header dict both transports can supply). Probed
+    once at server construction so older 5-arg cores — and test
+    doubles — keep working unchanged."""
+    try:
+        sig = inspect.signature(fn)
+    except (TypeError, ValueError):
+        return False
+    for p in sig.parameters.values():
+        if p.kind == inspect.Parameter.VAR_KEYWORD:
+            return True
+        if p.name == "headers":
+            return True
+    return False
 
 # request-body ceiling shared by both transports (threaded here, the
 # event loop in api/aio_http.py): a hostile Content-Length must not make
@@ -62,6 +81,7 @@ class _ReusePortServer(_Server):
 
 class _Handler(BaseHTTPRequestHandler):
     handle_fn: HandleFn  # bound by JsonHTTPServer
+    pass_headers = False  # bound by JsonHTTPServer (accepts_headers)
 
     # HTTP/1.1 keep-alive: every response carries Content-Length, so
     # persistent connections are safe and spare concurrent clients a
@@ -103,7 +123,13 @@ class _Handler(BaseHTTPRequestHandler):
                 form = dict(urllib.parse.parse_qsl(body.decode("utf-8")))
             except UnicodeDecodeError:
                 form = {}
-        result = self.handle_fn(method, parsed.path, query, body, form)
+        if self.pass_headers:
+            headers = {k.lower(): v for k, v in self.headers.items()}
+            result = self.handle_fn(
+                method, parsed.path, query, body, form, headers=headers
+            )
+        else:
+            result = self.handle_fn(method, parsed.path, query, body, form)
         status, payload = result[0], result[1]
         out_type = result[2] if len(result) > 2 else "application/json"
         if out_type == "application/json" and not isinstance(payload, str):
@@ -178,7 +204,14 @@ class JsonHTTPServer:
     ):
         self.name = name
         self.ip = ip
-        handler = type("BoundHandler", (_Handler,), {"handle_fn": staticmethod(handle_fn)})
+        handler = type(
+            "BoundHandler",
+            (_Handler,),
+            {
+                "handle_fn": staticmethod(handle_fn),
+                "pass_headers": accepts_headers(handle_fn),
+            },
+        )
         # SO_REUSEPORT (``reuse_port``): several server PROCESSES bind the
         # same port and the kernel load-balances accepted connections —
         # the scale-out path past one GIL-bound accept loop (pio

@@ -25,14 +25,18 @@ Run via ``pio storagegateway [--port 7077]`` or programmatically with
 
 from __future__ import annotations
 
+import concurrent.futures
 import hmac
 import logging
+import time
 from typing import Any, Dict, Optional
 
-from predictionio_tpu.api.http import JsonHTTPServer
+from predictionio_tpu.api.aio_http import TRANSPORTS, make_http_server
 from predictionio_tpu.data.storage import Storage, get_storage
 from predictionio_tpu.data.storage.base import PartialBatchError, StorageError
 from predictionio_tpu.data.storage import wire
+from predictionio_tpu.utils import metrics as _metrics
+from predictionio_tpu.utils import tracing as _tracing
 
 logger = logging.getLogger(__name__)
 
@@ -79,20 +83,79 @@ def _trait_methods(trait_name: str) -> frozenset:
 
 _TRAIT_ALLOWLIST: Dict[str, frozenset] = {}
 
+# the levents RPC surface (_call_levents dispatch) — metric labels are
+# validated against this so client-supplied strings can't mint
+# unbounded label sets in the process-global registry
+_LEVENTS_METHODS = frozenset(
+    {
+        "init", "remove", "insert", "write", "insert_batch", "get",
+        "delete", "find", "aggregate_properties", "insert_columns",
+        "insert_columns_v2", "find_columns_native",
+        "aggregate_properties_of_entity",
+    }
+)
+
+
+def _rpc_metric_labels(dao: str, method: str) -> "tuple[str, str]":
+    """Label values for one RPC, collapsed to ``invalid`` unless they
+    name a real dao/method: labels come from the CLIENT, and a fuzzer
+    minting a fresh (dao, method) pair per request would otherwise grow
+    a new counter + histogram child in the registry forever."""
+    if dao not in _DAOS:
+        return "invalid", "invalid"
+    if dao == "levents":
+        return dao, (method if method in _LEVENTS_METHODS else "invalid")
+    trait = _DAOS[dao][2]
+    if trait not in _TRAIT_ALLOWLIST:
+        _TRAIT_ALLOWLIST[trait] = _trait_methods(trait)
+    return dao, (method if method in _TRAIT_ALLOWLIST[trait] else "invalid")
+
 class StorageGatewayCore:
     """Transport-independent RPC core (same pattern as QueryAPI)."""
 
     def __init__(self, storage: Optional[Storage] = None, secret: str = ""):
         self.storage = storage or get_storage()
         self.secret = secret
+        # per-method RPC observability (the gateway exposed NO stats
+        # before this): request counter by outcome + latency histogram,
+        # labeled (dao, method) — the RPC surface is a fixed allowlist,
+        # so cardinality is bounded by the base.py traits
+        reg = _metrics.get_registry()
+        self._m_rpcs = reg.counter(
+            "pio_gateway_rpc_total",
+            "Storage-gateway RPCs by dao, method, and outcome",
+            labels=("dao", "method", "outcome"),
+        )
+        self._m_rpc_seconds = reg.histogram(
+            "pio_gateway_rpc_seconds",
+            "Storage-gateway RPC handling latency",
+            labels=("dao", "method"),
+            buckets=_metrics.LATENCY_BUCKETS_S,
+        )
 
     # --- request entry ---
 
-    def handle(self, method, path, query, body, form):
+    def handle(self, method, path, query, body, form, headers=None):
         import json
 
         if path == "/status" and method == "GET":
             return 200, {"status": "alive", "daos": sorted(_DAOS)}
+        if path == "/metrics" and method == "GET":
+            return (
+                200,
+                _metrics.get_registry().render(),
+                _metrics.render_content_type(),
+            )
+        if path == "/debug/traces.json" and method == "GET":
+            # gated exactly like /rpc: whoever holds the shared secret
+            # may read spans (which carry dao/method names and timings)
+            if self.secret and not hmac.compare_digest(
+                (query or {}).get("secret", ""), self.secret
+            ):
+                return 401, {"error": "invalid or missing secret"}
+            return 200, {
+                "spans": _tracing.dump((query or {}).get("traceId") or None)
+            }
         if path != "/rpc" or method != "POST":
             return 404, {"error": f"unknown route {method} {path}"}
         try:
@@ -107,12 +170,26 @@ class StorageGatewayCore:
             given = payload.get("secret") or ""
             if not hmac.compare_digest(str(given), self.secret):
                 return 401, {"error": "invalid or missing secret"}
+        dao = str(payload.get("dao", ""))
+        rpc_method = str(payload.get("method", ""))
+        # RPC trace hop: the client's X-PIO-Trace-Id/-Parent-Span
+        # headers chain this process's span (and, through the ambient
+        # context, any group-commit flush it causes) under the caller's
+        t0 = time.perf_counter()
+        # only traced CALLERS get spans here: minting a fresh trace per
+        # RPC would flood the bounded ring during training scans
+        # (thousands of untraced RPCs) and evict the interesting chains
+        traced = bool(
+            headers and headers.get(_tracing.TRACE_HEADER.lower())
+        )
+        tctx, inbound = _tracing.from_headers(headers)
+        outcome = "error"
         try:
-            result = self.call(
-                payload.get("dao", ""),
-                payload.get("method", ""),
-                payload.get("args") or {},
-            )
+            # ambient context = this RPC's entry span, so a group-commit
+            # flush the call triggers chains under it
+            with _tracing.use(tctx if traced else None):
+                result = self.call(dao, rpc_method, payload.get("args") or {})
+            outcome = "ok"
             return 200, {"result": result}
         except PartialBatchError as e:
             # carry the per-event outcome across the wire — the client
@@ -131,6 +208,21 @@ class StorageGatewayCore:
         except Exception as e:  # backend bug — surface, don't hide
             logger.exception("gateway RPC failed")
             return 500, {"error": str(e), "type": type(e).__name__}
+        finally:
+            elapsed = time.perf_counter() - t0
+            ldao, lmethod = _rpc_metric_labels(dao, rpc_method)
+            self._m_rpcs.labels(
+                dao=ldao, method=lmethod, outcome=outcome
+            ).inc()
+            self._m_rpc_seconds.labels(dao=ldao, method=lmethod).observe(
+                elapsed
+            )
+            if traced:
+                _tracing.record_span(
+                    f"rpc:{dao}.{rpc_method}", tctx.trace_id,
+                    span_id=tctx.span_id, parent_id=inbound,
+                    duration_s=elapsed, attrs={"outcome": outcome},
+                )
 
     # --- dispatch ---
 
@@ -278,12 +370,21 @@ def _is_record(x: Any) -> bool:
 _LOOPBACK_IPS = ("localhost", "127.0.0.1", "::1")
 
 
-class StorageGatewayServer(JsonHTTPServer):
+class StorageGatewayServer:
     """Defaults to loopback: the gateway exposes read/write access to ALL
     storage, so a non-loopback bind without a shared secret must be an
     explicit opt-in (``allow_insecure=True``), not a constructor default.
     The CLI path (`pio storagegateway`) opts in after printing a warning.
+
+    Rides the shared transport selector (api/aio_http.py): ``async``
+    (default) is the event-loop frontend — RPC handlers block on the
+    store (group-commit COMMIT waits, scans), so they run on a bounded
+    pool whose future the loop awaits, exactly the event server's
+    shape; ``threaded`` is the stdlib thread-per-connection fallback.
+    Both serve ``GET /metrics``.
     """
+
+    HANDLER_THREADS = 16
 
     def __init__(
         self,
@@ -292,6 +393,7 @@ class StorageGatewayServer(JsonHTTPServer):
         port: int = DEFAULT_PORT,
         secret: str = "",
         allow_insecure: bool = False,
+        transport: str = "async",
     ):
         if not secret and not allow_insecure and ip not in _LOOPBACK_IPS:
             raise ValueError(
@@ -299,5 +401,46 @@ class StorageGatewayServer(JsonHTTPServer):
                 "or allow_insecure=True to expose unauthenticated storage "
                 "on a non-loopback interface"
             )
+        if transport not in TRANSPORTS:
+            raise ValueError(
+                f"unknown transport {transport!r} "
+                f"(expected one of {TRANSPORTS})"
+            )
         self.core = StorageGatewayCore(storage, secret=secret)
-        super().__init__(self.core.handle, ip, port, "StorageGateway")
+        self.transport = transport
+        self._pool: Optional[concurrent.futures.ThreadPoolExecutor] = None
+        if transport == "async":
+            self._pool = concurrent.futures.ThreadPoolExecutor(
+                max_workers=self.HANDLER_THREADS,
+                thread_name_prefix="gwhandler",
+            )
+            pool = self._pool
+            core = self.core
+
+            def fn(method, path, query, body, form=None, headers=None):
+                return pool.submit(
+                    core.handle, method, path, query, body, form, headers
+                )
+        else:
+            fn = self.core.handle
+        self._http = make_http_server(
+            fn, ip, port, "StorageGateway", transport=transport
+        )
+
+    @property
+    def port(self) -> int:
+        return self._http.port
+
+    def start(self) -> "StorageGatewayServer":
+        self._http.start()
+        return self
+
+    def serve_forever(self) -> None:
+        self._http.serve_forever()
+
+    def shutdown(self) -> None:
+        self._http.shutdown()
+        if self._pool is not None:
+            # wait=False: a handler parked on a wedged COMMIT must not
+            # hang shutdown (same contract as the event server's pool)
+            self._pool.shutdown(wait=False)

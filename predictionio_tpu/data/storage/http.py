@@ -123,15 +123,23 @@ class StorageClient(base.DAOCacheMixin):
         if self.secret:
             payload["secret"] = self.secret
         body = json.dumps(payload)
+        headers = {"Content-Type": "application/json"}
+        # propagate the ambient trace (ingest http span, training round)
+        # so the gateway's rpc span — and any group-commit flush it
+        # causes over there — chains under this caller's span
+        from predictionio_tpu.utils import tracing as _tracing
+
+        trace = _tracing.current()
+        if trace is not None:
+            headers[_tracing.TRACE_HEADER] = trace.trace_id
+            headers[_tracing.PARENT_HEADER] = trace.span_id
         idempotent = method in _IDEMPOTENT_METHODS
         last: Optional[Exception] = None
         for attempt in (0, 1):  # at most one reconnect
             conn, reused = self._conn()
             sent = False
             try:
-                conn.request(
-                    "POST", "/rpc", body, {"Content-Type": "application/json"}
-                )
+                conn.request("POST", "/rpc", body, headers)
                 sent = True
                 resp = conn.getresponse()
                 data = resp.read()

@@ -134,13 +134,19 @@ class _InsertUnit:
     (the ``/batch/events.json`` group), so a reader can never observe a
     torn unit."""
 
-    __slots__ = ("sql", "rows", "error", "done")
+    __slots__ = ("sql", "rows", "error", "done", "trace")
 
     def __init__(self, sql: str, rows: list):
         self.sql = sql
         self.rows = rows
         self.error: Optional[BaseException] = None
         self.done = threading.Event()
+        # the caller's ambient trace (if any), captured HERE because
+        # submit() runs on the caller's thread — the committer thread
+        # records its flush span into each unit's trace
+        from predictionio_tpu.utils import tracing as _tracing
+
+        self.trace = _tracing.current()
 
     # generous: a unit is at most one committer flush (~512 rows), but
     # it may queue behind a full backlog on a slow disk — this bound
@@ -175,12 +181,36 @@ class _GroupCommitter:
     _STOP = object()
 
     def __init__(self, shard: "_ShardState", max_rows: int, max_delay_s: float):
+        from predictionio_tpu.utils import metrics as _metrics
+
         self._shard = shard
         self._max_rows = max(1, int(max_rows))
         self._max_delay_s = max(0.0, float(max_delay_s))
         self._q: "_queue.Queue[_InsertUnit]" = _queue.Queue(maxsize=4096)
         self._thread: Optional[threading.Thread] = None
         self._start_lock = threading.Lock()
+        # per-shard flush accounting in the process-global registry
+        # (labels carry the shard file name, so a K-sharded store shows
+        # K series): flush count, rows per flush, commit latency
+        reg = _metrics.get_registry()
+        shard_name = os.path.basename(shard.path) or shard.path
+        self._m_flushes = reg.counter(
+            "pio_group_commit_flushes_total",
+            "Group-commit flushes (one multi-row COMMIT each)",
+            labels=("shard",),
+        ).labels(shard=shard_name)
+        self._m_flush_rows = reg.histogram(
+            "pio_group_commit_flush_rows",
+            "Rows coalesced into one group-commit flush",
+            labels=("shard",),
+            buckets=_metrics.ROW_COUNT_BUCKETS,
+        ).labels(shard=shard_name)
+        self._m_flush_seconds = reg.histogram(
+            "pio_group_commit_flush_seconds",
+            "Wall clock of one group-commit flush (execute + COMMIT)",
+            labels=("shard",),
+            buckets=_metrics.LATENCY_BUCKETS_S,
+        ).labels(shard=shard_name)
 
     def close(self, timeout: float = 10.0) -> None:
         """Drain-and-stop: queued units ahead of the sentinel still
@@ -249,6 +279,10 @@ class _GroupCommitter:
         return True
 
     def _commit_batch(self, batch: list) -> None:
+        from predictionio_tpu.utils import tracing as _tracing
+
+        t0 = _time.perf_counter()
+        t0_wall = _time.time()
         shard = self._shard
         with shard.lock:
             try:
@@ -285,6 +319,26 @@ class _GroupCommitter:
                                 pass
                             u.error = ue
             finally:
+                # bookkeeping BEFORE done.set(): a caller unblocked by
+                # its unit must observe the flush span/counters of the
+                # COMMIT that acked it (and never block on a recording
+                # failure)
+                try:
+                    elapsed = _time.perf_counter() - t0
+                    n_rows = sum(len(u.rows) for u in batch)
+                    self._m_flushes.inc()
+                    self._m_flush_rows.observe(n_rows)
+                    self._m_flush_seconds.observe(elapsed)
+                    for u in batch:
+                        if u.trace is not None:
+                            _tracing.record_span(
+                                "group-commit-flush", u.trace.trace_id,
+                                parent_id=u.trace.span_id, start_s=t0_wall,
+                                duration_s=elapsed,
+                                attrs={"rows": n_rows, "units": len(batch)},
+                            )
+                except Exception:
+                    logger.exception("group-commit flush bookkeeping failed")
                 for u in batch:
                     u.done.set()
 
@@ -2147,6 +2201,7 @@ class SQLiteLEvents(base.LEvents):
         self._sweep_orphan_segments(
             t, {s["path"] for s in segs}, now
         )
+        self._record_compaction_metrics(t, result, marks)
         if result["segments"]:
             logger.info(
                 "compacted app %s%s: %d events into %d segment(s)",
@@ -2154,6 +2209,42 @@ class SQLiteLEvents(base.LEvents):
                 result["sealed_events"], result["segments"],
             )
         return result
+
+    def _record_compaction_metrics(self, t: str, result: dict, marks) -> None:
+        """Registry bookkeeping for one compaction round: lifetime
+        totals (rounds, sealed events/segments, holdouts, physical
+        deletes) plus the per-store rowid watermark as a gauge — the
+        numbers ``CachedCompactionStatus`` recomputes with COUNT(*)
+        scans, available here for free as monotone counters."""
+        from predictionio_tpu.utils import metrics as _metrics
+
+        reg = _metrics.get_registry()
+        reg.counter(
+            "pio_compaction_rounds_total",
+            "Completed compaction rounds (per events table)",
+            labels=("table",),
+        ).labels(table=t).inc()
+        totals = reg.counter(
+            "pio_compaction_total",
+            "Lifetime compaction work by kind (sealed_events, segments, "
+            "holdouts_added, rows_deleted)",
+            labels=("table", "kind"),
+        )
+        for kind in (
+            "sealed_events", "segments", "holdouts_added", "rows_deleted"
+        ):
+            v = result.get(kind, 0)
+            if v:
+                totals.labels(table=t, kind=kind).inc(v)
+        wm = reg.gauge(
+            "pio_compaction_watermark",
+            "Per-store sealed-rowid watermark (rows at or below are "
+            "segment-resident)",
+            labels=("table", "store"),
+        )
+        for store_key, mark in (marks or {}).items():
+            watermark = mark[0] if isinstance(mark, tuple) else mark
+            wm.labels(table=t, store=str(store_key)).set(float(watermark))
 
     def _compact_store(
         self, t, key, store, marks, policy, cutoff, now

@@ -86,9 +86,18 @@ _PACK_CACHE_LOCK = threading.Lock()
 # warm-bench cases without growing with app count
 PACK_CACHE_MAX_ENTRIES = 4
 
-# lifetime hit/miss/fold counters (under _PACK_CACHE_LOCK), surfaced in
-# the training PhaseTimer summary — the cache is no longer silent
-_CACHE_STATS = {"hit": 0, "miss": 0, "fold": 0}
+
+def _cache_counter():
+    """The registry family behind the hit/miss/fold counters — one
+    ``pio_pack_cache_total{outcome=...}`` counter per outcome, visible
+    in every server's /metrics, not just the PhaseTimer text summary."""
+    from predictionio_tpu.utils import metrics as _metrics
+
+    return _metrics.get_registry().counter(
+        "pio_pack_cache_total",
+        "Pack-artifact cache lookups by outcome (hit/miss/fold)",
+        labels=("outcome",),
+    )
 
 
 def pack_cache_clear() -> None:
@@ -97,20 +106,20 @@ def pack_cache_clear() -> None:
     hit/miss/fold counters."""
     with _PACK_CACHE_LOCK:
         _PACK_CACHE.clear()
-        for k in _CACHE_STATS:
-            _CACHE_STATS[k] = 0
+    _cache_counter().reset()
 
 
 def pack_cache_stats() -> dict:
     """Lifetime {'hit', 'miss', 'fold'} counters (reset by
-    pack_cache_clear)."""
-    with _PACK_CACHE_LOCK:
-        return dict(_CACHE_STATS)
+    pack_cache_clear), read from the metrics registry."""
+    c = _cache_counter()
+    return {
+        k: int(c.labels(outcome=k).value) for k in ("hit", "miss", "fold")
+    }
 
 
 def _stat_bump(kind: str) -> None:
-    with _PACK_CACHE_LOCK:
-        _CACHE_STATS[kind] = _CACHE_STATS.get(kind, 0) + 1
+    _cache_counter().labels(outcome=kind).inc()
 
 
 def _cache_key(stream, config) -> Optional[tuple]:

@@ -519,9 +519,53 @@ def cmd_storagegateway(args) -> int:
     server = StorageGatewayServer(
         ip=args.ip, port=args.port, secret=args.secret,
         allow_insecure=True,  # the explicit --ip flag + warning above
+        transport=args.transport,
     )
     print(f"Storage gateway serving on {args.ip}:{server.port}")
     server.serve_forever()
+    return 0
+
+
+def cmd_trace(args) -> int:
+    """Fetch a server's /debug/traces.json span dump and print it as an
+    indented span tree (see docs/OBSERVABILITY.md for the span model)."""
+    import json as _json
+    import urllib.parse as _up
+    import urllib.request as _ur
+
+    from predictionio_tpu.utils.tracing import format_trace
+
+    params = {}
+    if args.trace_id:
+        params["traceId"] = args.trace_id
+    if args.access_key:
+        params["accessKey"] = args.access_key
+    if args.secret:
+        params["secret"] = args.secret
+    url = args.url.rstrip("/") + "/debug/traces.json"
+    if params:
+        url += "?" + _up.urlencode(params)
+    try:
+        with _ur.urlopen(url, timeout=10) as resp:
+            payload = _json.loads(resp.read().decode("utf-8"))
+    except Exception as e:
+        print(f"trace: fetching {url} failed: {e}", file=sys.stderr)
+        return 1
+    spans = payload.get("spans", [])
+    if not spans:
+        print("trace: no spans recorded")
+        return 0
+    if args.json:
+        print(_json.dumps(spans, indent=2))
+        return 0
+    # group by trace so unrelated requests don't interleave
+    by_trace: dict = {}
+    for s in spans:
+        by_trace.setdefault(s["traceId"], []).append(s)
+    for trace_id, group in by_trace.items():
+        print(f"trace {trace_id} ({len(group)} span(s)):")
+        tree = format_trace(group)
+        print("\n".join("  " + line for line in tree.splitlines()))
     return 0
 
 
@@ -946,7 +990,34 @@ def build_parser() -> argparse.ArgumentParser:
     gw.add_argument("--ip", default="localhost")
     gw.add_argument("--port", type=int, default=7077)
     gw.add_argument("--secret", default="")
+    gw.add_argument(
+        "--transport", choices=("async", "threaded"), default="async",
+        help="REST transport (event-loop frontend, or the stdlib "
+        "thread-per-connection fallback)",
+    )
     gw.set_defaults(func=cmd_storagegateway)
+
+    tr = sub.add_parser(
+        "trace",
+        help="dump request traces from a server's /debug/traces.json",
+    )
+    tr.add_argument(
+        "--url", default="http://localhost:8000",
+        help="server base URL (engine server :8000, event server :7070, "
+        "storage gateway :7077)",
+    )
+    tr.add_argument("--trace-id", default="", help="filter to one trace")
+    tr.add_argument(
+        "--access-key", default="",
+        help="access key (event/engine server gating)",
+    )
+    tr.add_argument(
+        "--secret", default="", help="shared secret (storage gateway)"
+    )
+    tr.add_argument(
+        "--json", action="store_true", help="raw span JSON, not the tree"
+    )
+    tr.set_defaults(func=cmd_trace)
 
     admin = sub.add_parser("adminserver", help="start the admin server")
     admin.add_argument("--ip", default="localhost")

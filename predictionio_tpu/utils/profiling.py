@@ -36,12 +36,22 @@ class PhaseRecord:
 
 
 class PhaseTimer:
-    """Collects named wall-clock phases (nested phases indent)."""
+    """Collects named wall-clock phases (nested phases indent).
+
+    Every timer owns a trace id (``utils.tracing``) and emits each phase
+    as a span into the process trace buffer, nested by the phase stack —
+    so a continuous-training round is ONE coherent trace from store poll
+    to checkpoint, dumpable via any server's /debug/traces.json or
+    ``pio trace`` beside the text summary."""
 
     def __init__(self):
+        from predictionio_tpu.utils import tracing as _tracing
+
         self.records: List[PhaseRecord] = []
         self.notes: Dict[str, object] = {}
         self._depth = 0
+        self.trace_id = _tracing.mint_trace_id()
+        self._span_stack: List[str] = []
 
     def note(self, key: str, value) -> None:
         """Attach a non-duration annotation (cache outcomes, delta
@@ -50,15 +60,29 @@ class PhaseTimer:
 
     @contextlib.contextmanager
     def phase(self, name: str) -> Iterator[None]:
+        from predictionio_tpu.utils import tracing as _tracing
+
         start = time.perf_counter()
+        start_wall = time.time()
+        # the span id is minted at ENTRY so nested phases can parent on
+        # it even though spans are recorded (as completed) at exit
+        span_id = _tracing.new_span_id()
+        parent_id = self._span_stack[-1] if self._span_stack else None
+        self._span_stack.append(span_id)
         self._depth += 1
         try:
             yield
         finally:
             self._depth -= 1
+            self._span_stack.pop()
             elapsed = time.perf_counter() - start
             self.records.append(
                 PhaseRecord(name, elapsed, self._depth, start)
+            )
+            _tracing.record_span(
+                f"phase:{name}", self.trace_id, span_id=span_id,
+                parent_id=parent_id, start_s=start_wall,
+                duration_s=elapsed,
             )
             logger.info("phase %s: %.3fs", name, elapsed)
 
@@ -68,11 +92,19 @@ class PhaseTimer:
         """Record an externally-measured phase. ``overlapped=True``
         marks busy time that was hidden under another phase (pipelined
         work) rather than serial wall clock."""
+        from predictionio_tpu.utils import tracing as _tracing
+
         self.records.append(
             PhaseRecord(
                 name, seconds, self._depth + 1, time.perf_counter(),
                 overlapped=overlapped,
             )
+        )
+        _tracing.record_span(
+            f"phase:{name}", self.trace_id,
+            parent_id=self._span_stack[-1] if self._span_stack else None,
+            duration_s=seconds,
+            attrs={"overlapped": True} if overlapped else None,
         )
 
     def totals(self) -> Dict[str, float]:

@@ -1,0 +1,237 @@
+"""Lightweight end-to-end request tracing.
+
+A trace id accepted via the ``X-PIO-Trace-Id`` header at an ingest or
+serving entry point (the header is the opt-in: untraced hot-path
+requests record nothing, so traced requests can't be evicted by bulk
+traffic and the hot path never touches the span ring's lock) is
+propagated — explicitly through the engine server's batching executor,
+via a ``contextvars`` context through the group-commit committer and
+the storage-gateway RPC client — so one request's path is a chain of
+spans. Training runs mint their own trace per ``PhaseTimer``:
+
+    serving:  http → batch → predict
+    ingest:   http → insert → group-commit-flush
+    remote:   http → rpc:<dao>.<method> (gateway process) → flush
+
+Spans land in a bounded process-global ring buffer (deque, oldest
+evicted first) dumpable via ``GET /debug/traces.json`` on every server
+(access-key gated) and ``pio trace``. This is deliberately NOT a
+distributed-tracing stack: no sampling config, no exporters, no clock
+sync — just enough to answer "where did this request's time go" across
+the subsystems this repo actually has. For device-side timelines, wrap
+the training call in ``utils.profiling.trace`` (jax.profiler).
+
+Like utils/metrics.py, this module is a sanctioned home for
+module-level observability state (tests/test_lint.py polices the rest
+of the package).
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import contextvars
+import secrets
+import threading
+import time
+from typing import Dict, Iterator, List, NamedTuple, Optional
+
+__all__ = [
+    "TRACE_HEADER",
+    "PARENT_HEADER",
+    "TraceContext",
+    "mint_trace_id",
+    "new_span_id",
+    "from_headers",
+    "current",
+    "use",
+    "record_span",
+    "span",
+    "dump",
+    "clear",
+    "format_trace",
+]
+
+TRACE_HEADER = "X-PIO-Trace-Id"
+PARENT_HEADER = "X-PIO-Parent-Span"
+
+# completed spans kept for /debug/traces.json; oldest evicted first
+MAX_SPANS = 4096
+
+_ID_RE_MAX = 64  # accepted header ids are clamped to this many chars
+
+
+class TraceContext(NamedTuple):
+    """What propagates: the trace id plus the span id of the caller
+    (the parent of whatever span the callee records)."""
+
+    trace_id: str
+    span_id: str
+
+
+def mint_trace_id() -> str:
+    return secrets.token_hex(8)
+
+
+def new_span_id() -> str:
+    return secrets.token_hex(4)
+
+
+def _sanitize(raw: str) -> str:
+    """Header-supplied ids go into JSON dumps and log lines verbatim —
+    keep them printable and bounded."""
+    cleaned = "".join(c for c in raw if c.isalnum() or c in "-_")
+    return cleaned[:_ID_RE_MAX]
+
+
+def from_headers(
+    headers: Optional[Dict[str, str]],
+) -> "tuple[TraceContext, Optional[str]]":
+    """Trace context for one inbound request: the ``X-PIO-Trace-Id``
+    header when present (client-chosen correlation id), a fresh mint
+    otherwise. Returns ``(ctx, inbound_parent_span_id)``: ``ctx.span_id``
+    is the id the entry-point span records under (children chain on it);
+    the inbound parent — the remote caller's span on a cross-process hop
+    — becomes the entry span's ``parentId``."""
+    trace_id = ""
+    parent = ""
+    if headers:
+        trace_id = _sanitize(headers.get(TRACE_HEADER.lower(), "") or "")
+        parent = _sanitize(headers.get(PARENT_HEADER.lower(), "") or "")
+    if not trace_id:
+        trace_id = mint_trace_id()
+    return TraceContext(trace_id, new_span_id()), (parent or None)
+
+
+# the contextvar carries the trace across same-thread call stacks
+# (event-server insert -> sqlite committer submit, storage client RPCs);
+# cross-THREAD propagation (the batching executor, the committer's flush
+# thread) is explicit — items carry their TraceContext.
+_CURRENT: "contextvars.ContextVar[Optional[TraceContext]]" = (
+    contextvars.ContextVar("pio_trace", default=None)
+)
+
+
+def current() -> Optional[TraceContext]:
+    return _CURRENT.get()
+
+
+@contextlib.contextmanager
+def use(ctx: Optional[TraceContext]) -> Iterator[None]:
+    """Bind ``ctx`` as the ambient trace for the block (no-op on None)."""
+    token = _CURRENT.set(ctx)
+    try:
+        yield
+    finally:
+        _CURRENT.reset(token)
+
+
+_SPANS: "collections.deque" = collections.deque(maxlen=MAX_SPANS)
+_SPANS_LOCK = threading.Lock()
+
+
+def record_span(
+    name: str,
+    trace_id: str,
+    span_id: Optional[str] = None,
+    parent_id: Optional[str] = None,
+    start_s: Optional[float] = None,
+    duration_s: float = 0.0,
+    attrs: Optional[dict] = None,
+) -> str:
+    """Append one completed span to the ring buffer. ``start_s`` is
+    epoch seconds (wall clock; defaults to now - duration)."""
+    sid = span_id or new_span_id()
+    now = time.time()
+    entry = {
+        "traceId": trace_id,
+        "spanId": sid,
+        "parentId": parent_id,
+        "name": name,
+        "startMs": round(
+            ((now - duration_s) if start_s is None else start_s) * 1000.0, 3
+        ),
+        "durationMs": round(duration_s * 1000.0, 3),
+    }
+    if attrs:
+        entry["attrs"] = attrs
+    with _SPANS_LOCK:
+        _SPANS.append(entry)
+    return sid
+
+
+@contextlib.contextmanager
+def span(
+    name: str,
+    ctx: Optional[TraceContext] = None,
+    attrs: Optional[dict] = None,
+) -> Iterator[Optional[TraceContext]]:
+    """Record a span around a block, parented on ``ctx`` (or the ambient
+    context). The child context becomes the AMBIENT context for the
+    block, so nested subsystems (committer submit, gateway RPC client)
+    chain under it without explicit plumbing. No-op (yields None) when
+    there is no trace."""
+    parent = ctx if ctx is not None else current()
+    if parent is None:
+        yield None
+        return
+    child = TraceContext(parent.trace_id, new_span_id())
+    token = _CURRENT.set(child)
+    t0 = time.time()
+    try:
+        yield child
+    finally:
+        _CURRENT.reset(token)
+        record_span(
+            name,
+            parent.trace_id,
+            span_id=child.span_id,
+            parent_id=parent.span_id,
+            start_s=t0,
+            duration_s=time.time() - t0,
+            attrs=attrs,
+        )
+
+
+def dump(
+    trace_id: Optional[str] = None, limit: int = MAX_SPANS
+) -> List[dict]:
+    """Spans (oldest first), optionally filtered to one trace. The
+    filter is sanitized the same way inbound header ids are, so a
+    client-chosen id with stripped characters still matches the id its
+    spans were recorded under."""
+    with _SPANS_LOCK:
+        spans = list(_SPANS)
+    if trace_id:
+        trace_id = _sanitize(trace_id)
+        spans = [s for s in spans if s["traceId"] == trace_id]
+    return spans[-limit:]
+
+
+def clear() -> None:
+    with _SPANS_LOCK:
+        _SPANS.clear()
+
+
+def format_trace(spans: List[dict]) -> str:
+    """Indent spans under their parents (the ``pio trace`` renderer).
+    Orphans (parent evicted from the ring) print at the root."""
+    by_parent: Dict[Optional[str], List[dict]] = {}
+    ids = {s["spanId"] for s in spans}
+    for s in sorted(spans, key=lambda x: x["startMs"]):
+        parent = s.get("parentId")
+        by_parent.setdefault(parent if parent in ids else None, []).append(s)
+
+    lines: List[str] = []
+
+    def walk(parent: Optional[str], depth: int) -> None:
+        for s in by_parent.get(parent, []):
+            attrs = s.get("attrs")
+            lines.append(
+                f"{'  ' * depth}{s['name']}: {s['durationMs']:.3f}ms"
+                + (f"  {attrs}" if attrs else "")
+            )
+            walk(s["spanId"], depth + 1)
+
+    walk(None, 0)
+    return "\n".join(lines)

@@ -32,9 +32,38 @@ import datetime as _dt
 import logging
 import threading
 import time
-from typing import Callable, Optional
+from typing import Callable, Dict, Optional
+
+from predictionio_tpu.utils import metrics as _metrics
 
 logger = logging.getLogger(__name__)
+
+_ROUND_OUTCOMES = ("trained", "skipped", "failed")
+
+
+def _round_counter() -> "_metrics.Counter":
+    return _metrics.get_registry().counter(
+        "pio_continuous_rounds_total",
+        "Continuous-training loop rounds by outcome",
+        labels=("outcome",),
+    )
+
+
+def _round_seconds() -> "_metrics.Histogram":
+    return _metrics.get_registry().histogram(
+        "pio_continuous_round_seconds",
+        "Wall clock of one continuous-training round (trained rounds)",
+        buckets=_metrics.LATENCY_BUCKETS_S,
+    )
+
+
+def continuous_round_stats() -> Dict[str, int]:
+    """Lifetime trained/skipped/failed round counts from the registry
+    (status.json's ``continuousRounds`` block)."""
+    c = _round_counter()
+    return {
+        k: int(c.labels(outcome=k).value) for k in _ROUND_OUTCOMES
+    }
 
 
 @dataclasses.dataclass
@@ -127,6 +156,7 @@ def continuous_train(
         )
         fp = poll_fingerprint(engine_params, ctx.storage)
         if trained_once and fp is not None and fp == last_fp:
+            _round_counter().labels(outcome="skipped").inc()
             report = RoundReport(
                 round=rounds + 1, skipped=True,
                 wall_s=time.perf_counter() - t0,
@@ -140,10 +170,16 @@ def continuous_train(
             instance = dataclasses.replace(
                 instance_template, id="", start_time=now, end_time=now
             )
-            instance_id = CoreWorkflow.run_train(
-                engine, engine_params, instance,
-                ctx=ctx, workflow_params=workflow_params,
-            )
+            try:
+                instance_id = CoreWorkflow.run_train(
+                    engine, engine_params, instance,
+                    ctx=ctx, workflow_params=workflow_params,
+                )
+            except BaseException:
+                _round_counter().labels(outcome="failed").inc()
+                raise
+            _round_counter().labels(outcome="trained").inc()
+            _round_seconds().observe(time.perf_counter() - t0)
             trained_once = True
             # the PRE-train fingerprint labels the round: events landing
             # during the train make the next poll differ, so they are

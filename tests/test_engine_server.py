@@ -522,9 +522,12 @@ class TestServingSatellites:
         _, s, _ = query_api.handle("GET", "/status.json")
         assert s["requestCount"] == 20
         assert 0 < s["p50ServingSec"] <= s["p99ServingSec"]
-        # percentile estimates come from a bounded reservoir
-        assert len(query_api._lat_reservoir) <= query_api.LAT_RESERVOIR_K
+        # percentile estimates come from the registry's mergeable
+        # log-bucket histogram (utils/metrics.py), bucket-interpolated
+        lat = query_api._m_latency.snapshot().delta(query_api._lat_base)
+        assert lat.count == 20
         hist = s["batchSizeHistogram"]
+        # serial handle() calls -> 20 size-1 batches, all in bucket 1
         assert sum(size * count for size, count in hist.items()) == 20
         assert s["batchFillMean"] >= 1.0
 

@@ -323,6 +323,105 @@ def test_poll_loop_allowlist_is_not_stale():
     )
 
 
+# --- module-level counter/stat state outside the metrics registry ---
+#
+# The bug class (this round's observability tentpole): ad-hoc stat
+# state at module level — a `_CACHE_STATS = {"hit": 0, ...}` dict, a
+# bare counter list — is invisible to /metrics, unmergeable across
+# SO_REUSEPORT workers, and needs its own lock discipline. The
+# sanctioned home is the process-global registry in utils/metrics.py
+# (utils/tracing.py is the tracing counterpart): register a Counter/
+# Gauge/Histogram family and every server's /metrics exposes it for
+# free. Scope: module-level assignments of PLAIN mutable containers
+# (dict/list/set literals or constructor calls) whose target name
+# looks stat-like; registry instrument handles (registry.counter(...))
+# are the replacement, not a violation.
+
+_STAT_STATE_EXEMPT_FILES = ("utils/metrics.py", "utils/tracing.py")
+
+_STAT_NAME = re.compile(
+    r"(?i)(^|_)(stats?|counts?|counters?|metrics?|hist|histogram|"
+    r"totals?|latenc\w*|timings?)(_|$|s$)"
+)
+
+_STAT_CONTAINER_CALLS = {
+    "dict", "list", "set", "Counter", "defaultdict", "OrderedDict",
+    "deque",
+}
+
+# (relative path, stripped source line) pairs reviewed as safe.
+# Shrink-only: delete entries when the code they excuse goes away.
+# Empty today — this PR migrated the offenders it seeded with
+# (ops/streaming.py's _CACHE_STATS dict, the engine server's reservoir
+# and executor tallies) into the registry.
+MODULE_STAT_STATE_ALLOWED: set = set()
+
+
+def _module_stat_state_occurrences():
+    import ast
+
+    found = set()
+    for path in sorted(PACKAGE.rglob("*.py")):
+        rel = path.relative_to(PACKAGE).as_posix()
+        if rel in _STAT_STATE_EXEMPT_FILES:
+            continue
+        source = path.read_text(encoding="utf-8")
+        lines = source.splitlines()
+        tree = ast.parse(source, filename=str(path))
+
+        def is_plain_container(node) -> bool:
+            if isinstance(
+                node,
+                (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp,
+                 ast.SetComp),
+            ):
+                return True
+            if isinstance(node, ast.Call):
+                fn = node.func
+                name = fn.attr if isinstance(fn, ast.Attribute) else (
+                    fn.id if isinstance(fn, ast.Name) else None
+                )
+                return name in _STAT_CONTAINER_CALLS
+            return False
+
+        for node in ast.iter_child_nodes(tree):
+            if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+                continue
+            targets = (
+                node.targets if isinstance(node, ast.Assign) else [node.target]
+            )
+            names = [
+                t.id for t in targets if isinstance(t, ast.Name)
+            ]
+            if not any(_STAT_NAME.search(n) for n in names):
+                continue
+            if node.value is not None and is_plain_container(node.value):
+                found.add((rel, lines[node.lineno - 1].strip()))
+    return found
+
+
+def test_no_module_level_stat_state_outside_metrics_registry():
+    found = _module_stat_state_occurrences()
+    new = found - MODULE_STAT_STATE_ALLOWED
+    assert not new, (
+        "module-level counter/stat state outside utils/metrics.py — "
+        "ad-hoc stat containers are invisible to /metrics and cannot "
+        "merge across SO_REUSEPORT workers; register a Counter/Gauge/"
+        "Histogram family in the process-global registry "
+        "(utils/metrics.py) instead, or justify an allowlist entry: "
+        f"{sorted(new)}"
+    )
+
+
+def test_module_stat_state_allowlist_is_not_stale():
+    found = _module_stat_state_occurrences()
+    stale = MODULE_STAT_STATE_ALLOWED - found
+    assert not stale, (
+        f"module-stat-state allowlist entries no longer in the tree: "
+        f"{sorted(stale)}"
+    )
+
+
 def test_no_mutable_module_state_in_segment_tier():
     found = _mutable_module_state_occurrences()
     new = found - MUTABLE_MODULE_STATE_ALLOWED

@@ -1,0 +1,486 @@
+"""Observability tentpole tests (utils/metrics.py + utils/tracing.py):
+exposition-format conformance, concurrent-increment correctness,
+histogram mergeability (the SO_REUSEPORT worker-fleet story), trace-id
+propagation across the subsystems, and /metrics on every server over
+both transports.
+"""
+
+import datetime as dt
+import http.client
+import json
+import threading
+
+import pytest
+
+from predictionio_tpu.data.event import Event
+from predictionio_tpu.data.storage import Storage, memory_storage
+from predictionio_tpu.data.storage.base import AccessKey, App
+from predictionio_tpu.utils import metrics as m
+from predictionio_tpu.utils import tracing as tr
+
+
+# --- the registry itself ---
+
+
+class TestExpositionFormat:
+    def test_one_help_and_type_line_per_family(self):
+        reg = m.MetricsRegistry()
+        c = reg.counter("a_total", "counts a", labels=("k",))
+        c.labels(k="x").inc()
+        c.labels(k="y").inc(2)
+        reg.gauge("g", "a gauge").set(1.5)
+        reg.histogram("h_seconds", "a hist", buckets=(0.1, 1.0)).observe(0.5)
+        text = reg.render()
+        lines = text.splitlines()
+        for fam in ("a_total", "g", "h_seconds"):
+            assert (
+                sum(1 for l in lines if l.startswith(f"# TYPE {fam} ")) == 1
+            )
+            assert (
+                sum(1 for l in lines if l.startswith(f"# HELP {fam} ")) == 1
+            )
+        assert "# TYPE a_total counter" in lines
+        assert "# TYPE g gauge" in lines
+        assert "# TYPE h_seconds histogram" in lines
+        # histogram structure: cumulative buckets, +Inf, _sum, _count
+        assert 'h_seconds_bucket{le="0.1"} 0' in lines
+        assert 'h_seconds_bucket{le="1"} 1' in lines
+        assert 'h_seconds_bucket{le="+Inf"} 1' in lines
+        assert "h_seconds_sum 0.5" in lines
+        assert "h_seconds_count 1" in lines
+
+    def test_label_escaping(self):
+        reg = m.MetricsRegistry()
+        c = reg.counter("esc_total", "escapes", labels=("v",))
+        c.labels(v='ba"ck\\slash\nnewline').inc()
+        text = reg.render()
+        assert 'esc_total{v="ba\\"ck\\\\slash\\nnewline"} 1' in text
+        # and the parser round-trips the rendered sample name
+        parsed = m.parse_exposition(text)
+        assert parsed['esc_total{v="ba\\"ck\\\\slash\\nnewline"}'] == 1.0
+
+    def test_kind_and_shape_mismatches_raise(self):
+        reg = m.MetricsRegistry()
+        reg.counter("x_total", "x")
+        with pytest.raises(ValueError, match="already registered"):
+            reg.gauge("x_total", "x")
+        reg.counter("y_total", "y", labels=("a",))
+        with pytest.raises(ValueError, match="label mismatch"):
+            reg.counter("y_total", "y", labels=("b",))
+        reg.histogram("z", "z", buckets=(1.0, 2.0))
+        with pytest.raises(ValueError, match="bucket"):
+            reg.histogram("z", "z", buckets=(1.0, 4.0))
+
+    def test_get_or_create_shares_the_family(self):
+        reg = m.MetricsRegistry()
+        a = reg.counter("shared_total", "s")
+        b = reg.counter("shared_total", "s")
+        a.inc()
+        b.inc()
+        assert a is b and a.value == 2
+
+
+class TestConcurrency:
+    def test_concurrent_counter_increments_all_land(self):
+        reg = m.MetricsRegistry()
+        c = reg.counter("cc_total", "c", labels=("t",))
+        child = c.labels(t="one")
+        n_threads, n_incs = 8, 5000
+
+        def worker():
+            for _ in range(n_incs):
+                child.inc()
+
+        threads = [threading.Thread(target=worker) for _ in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert child.value == n_threads * n_incs
+
+    def test_concurrent_histogram_observes_all_land(self):
+        reg = m.MetricsRegistry()
+        h = reg.histogram("ch", "c", buckets=m.BATCH_SIZE_BUCKETS)
+        n_threads, n_obs = 8, 2000
+
+        def worker(k):
+            for i in range(n_obs):
+                h.observe((i % 7) + k)
+
+        threads = [
+            threading.Thread(target=worker, args=(k,))
+            for k in range(n_threads)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        snap = h.snapshot()
+        assert snap.count == n_threads * n_obs
+        assert sum(snap.counts) == n_threads * n_obs
+
+
+class TestHistogramMerge:
+    def test_merge_equals_union_of_samples(self):
+        """Two SO_REUSEPORT workers' histograms, merged, estimate the
+        SAME p50/p99 as one combined worker — the property the old
+        512-sample reservoir structurally could not provide."""
+        import random
+
+        rng = random.Random(7)
+        w1, w2, combined = (
+            m.MetricsRegistry().histogram("lat", "l"),
+            m.MetricsRegistry().histogram("lat", "l"),
+            m.MetricsRegistry().histogram("lat", "l"),
+        )
+        s1 = [rng.lognormvariate(-5, 1) for _ in range(4000)]
+        s2 = [rng.lognormvariate(-4, 0.5) for _ in range(1000)]
+        for v in s1:
+            w1.observe(v)
+            combined.observe(v)
+        for v in s2:
+            w2.observe(v)
+            combined.observe(v)
+        merged = m.merge_snapshots([w1.snapshot(), w2.snapshot()])
+        for q in (0.5, 0.9, 0.99):
+            assert merged.quantile(q) == combined.quantile(q)
+        assert merged.count == combined.count
+        assert merged.sum == pytest.approx(combined.sum)
+
+    def test_quantile_interpolates_within_bucket(self):
+        h = m.MetricsRegistry().histogram("q", "q", buckets=(1.0, 2.0, 4.0))
+        for _ in range(100):
+            h.observe(1.5)  # all land in (1, 2]
+        p50 = h.quantile(0.5)
+        assert 1.0 < p50 < 2.0
+
+    def test_delta_view(self):
+        h = m.MetricsRegistry().histogram("d", "d", buckets=(1.0, 10.0))
+        h.observe(0.5)
+        base = h.snapshot()
+        h.observe(5.0)
+        h.observe(5.0)
+        delta = h.snapshot().delta(base)
+        assert delta.count == 2 and delta.sum == pytest.approx(10.0)
+
+    def test_mismatched_bounds_refuse_to_merge(self):
+        a = m.MetricsRegistry().histogram("a", "a", buckets=(1.0, 2.0))
+        b = m.MetricsRegistry().histogram("b", "b", buckets=(1.0, 4.0))
+        with pytest.raises(ValueError, match="bounds differ"):
+            m.merge_snapshots([a.snapshot(), b.snapshot()])
+
+
+# --- trace propagation ---
+
+
+class TestTraceViaEventServer:
+    def test_ingest_trace_chains_http_insert_flush(self, tmp_path):
+        """POST /events.json with X-PIO-Trace-Id on a sqlite store:
+        the span chain is http → insert → group-commit-flush."""
+        from predictionio_tpu.api.event_server import EventAPI
+
+        tr.clear()
+        config = {
+            "PIO_STORAGE_SOURCES_SQLITE_TYPE": "sqlite",
+            "PIO_STORAGE_SOURCES_SQLITE_PATH": str(tmp_path / "t.db"),
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "SQLITE",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "SQLITE",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "SQLITE",
+        }
+        storage = Storage(config)
+        app_id = storage.get_meta_data_apps().insert(App(id=0, name="t"))
+        storage.get_meta_data_access_keys().insert(
+            AccessKey(key="k", appid=app_id, events=())
+        )
+        storage.get_l_events().init(app_id)
+        api = EventAPI(storage=storage)
+        status, body = api.handle(
+            "POST",
+            "/events.json",
+            {"accessKey": "k"},
+            json.dumps(
+                {"event": "buy", "entityType": "user", "entityId": "u1"}
+            ).encode(),
+            headers={"x-pio-trace-id": "trace-ingest-1"},
+        )
+        assert status == 201, body
+        spans = tr.dump("trace-ingest-1")
+        names = {s["name"] for s in spans}
+        assert "http:POST /events.json" in names
+        assert "insert" in names
+        assert "group-commit-flush" in names
+        by_id = {s["spanId"]: s for s in spans}
+        flush = next(s for s in spans if s["name"] == "group-commit-flush")
+        insert = by_id[flush["parentId"]]
+        assert insert["name"] == "insert"
+        http_span = by_id[insert["parentId"]]
+        assert http_span["name"] == "http:POST /events.json"
+        # the span dump is access-key gated
+        status, _ = api.handle("GET", "/debug/traces.json", {})
+        assert status == 401
+        status, payload = api.handle(
+            "GET", "/debug/traces.json",
+            {"accessKey": "k", "traceId": "trace-ingest-1"},
+        )
+        assert status == 200
+        assert {s["name"] for s in payload["spans"]} >= {
+            "insert", "group-commit-flush"
+        }
+
+    def test_trace_propagates_event_server_to_gateway(self):
+        """An EventAPI whose storage is the http client: the trace id
+        accepted at ingest reaches the gateway process's rpc span."""
+        from predictionio_tpu.api.event_server import EventAPI
+        from predictionio_tpu.api.storage_gateway import StorageGatewayServer
+
+        tr.clear()
+        backing = memory_storage()
+        gw = StorageGatewayServer(backing, ip="127.0.0.1", port=0).start()
+        try:
+            name = "GWT"
+            config = {
+                f"PIO_STORAGE_SOURCES_{name}_TYPE": "http",
+                f"PIO_STORAGE_SOURCES_{name}_URL": (
+                    f"http://127.0.0.1:{gw.port}"
+                ),
+                "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": name,
+                "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": name,
+                "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": name,
+            }
+            storage = Storage(config)
+            app_id = storage.get_meta_data_apps().insert(App(id=0, name="g"))
+            storage.get_meta_data_access_keys().insert(
+                AccessKey(key="k", appid=app_id, events=())
+            )
+            storage.get_l_events().init(app_id)
+            status, body = EventAPI(storage=storage).handle(
+                "POST",
+                "/events.json",
+                {"accessKey": "k"},
+                json.dumps(
+                    {"event": "buy", "entityType": "user", "entityId": "u9"}
+                ).encode(),
+                headers={"x-pio-trace-id": "trace-gw-1"},
+            )
+            assert status == 201, body
+            spans = tr.dump("trace-gw-1")
+            names = {s["name"] for s in spans}
+            assert "rpc:levents.insert" in names
+            # the rpc span chains under the event server's insert span
+            # (cross-process hop via X-PIO-Parent-Span; in-process ring
+            # here because the test shares one interpreter)
+            rpc = next(s for s in spans if s["name"] == "rpc:levents.insert")
+            insert = next(s for s in spans if s["name"] == "insert")
+            assert rpc["parentId"] == insert["spanId"]
+        finally:
+            gw.shutdown()
+
+
+class TestTraceViaEngineServer:
+    def test_query_trace_chains_http_batch_predict(self, mem_storage):
+        from tests.test_engine_server import make_engine, train_instance
+        from predictionio_tpu.api.engine_server import (
+            EngineServer,
+            ServerConfig,
+        )
+
+        tr.clear()
+        train_instance(mem_storage)
+        server = EngineServer(
+            make_engine(), ServerConfig(port=0), storage=mem_storage
+        ).start()
+        try:
+            conn = http.client.HTTPConnection("localhost", server.port)
+            conn.request(
+                "POST", "/queries.json", json.dumps({"qx": 1}),
+                {
+                    "Content-Type": "application/json",
+                    "X-PIO-Trace-Id": "trace-query-1",
+                },
+            )
+            resp = conn.getresponse()
+            assert resp.status == 200
+            resp.read()
+            conn.request(
+                "GET", "/debug/traces.json?traceId=trace-query-1"
+            )
+            resp = conn.getresponse()
+            assert resp.status == 200
+            spans = json.loads(resp.read())["spans"]
+            conn.close()
+            by_name = {s["name"]: s for s in spans}
+            assert {"http:/queries.json", "batch", "predict"} <= set(by_name)
+            assert (
+                by_name["predict"]["parentId"] == by_name["batch"]["spanId"]
+            )
+            assert (
+                by_name["batch"]["parentId"]
+                == by_name["http:/queries.json"]["spanId"]
+            )
+        finally:
+            server.shutdown()
+
+
+# --- /metrics on every server, both transports ---
+
+
+def _http_get(port, path):
+    conn = http.client.HTTPConnection("localhost", port, timeout=10)
+    try:
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        return resp.status, resp.getheader("Content-Type"), resp.read()
+    finally:
+        conn.close()
+
+
+@pytest.mark.parametrize("transport", ["async", "threaded"])
+class TestMetricsRoutes:
+    def test_event_server_metrics(self, mem_storage, transport):
+        from predictionio_tpu.api.event_server import (
+            EventServer,
+            EventServerConfig,
+        )
+
+        apps = mem_storage.get_meta_data_apps()
+        app_id = apps.insert(App(id=0, name="me"))
+        mem_storage.get_meta_data_access_keys().insert(
+            AccessKey(key="k", appid=app_id, events=())
+        )
+        mem_storage.get_l_events().init(app_id)
+        server = EventServer(
+            storage=mem_storage,
+            config=EventServerConfig(port=0, transport=transport),
+        ).start()
+        try:
+            status, ctype, body = _http_get(server.port, "/metrics")
+            assert status == 200
+            assert ctype.startswith("text/plain")
+            parsed = m.parse_exposition(body.decode())
+            assert parsed  # Prometheus-parseable, non-empty
+        finally:
+            server.shutdown()
+
+    def test_engine_server_metrics(self, mem_storage, transport):
+        from tests.test_engine_server import make_engine, train_instance
+        from predictionio_tpu.api.engine_server import (
+            EngineServer,
+            ServerConfig,
+        )
+
+        train_instance(mem_storage)
+        server = EngineServer(
+            make_engine(),
+            ServerConfig(port=0, transport=transport),
+            storage=mem_storage,
+        ).start()
+        try:
+            conn = http.client.HTTPConnection("localhost", server.port)
+            conn.request(
+                "POST", "/queries.json", json.dumps({"qx": 2}),
+                {"Content-Type": "application/json"},
+            )
+            resp = conn.getresponse()
+            resp.read()
+            assert resp.status == 200
+            conn.close()
+            status, ctype, body = _http_get(server.port, "/metrics")
+            assert status == 200 and ctype.startswith("text/plain")
+            text = body.decode()
+            parsed = m.parse_exposition(text)
+            assert parsed
+            # the serving-latency bucket family is present
+            assert "pio_serving_latency_seconds_bucket" in text
+        finally:
+            server.shutdown()
+
+    def test_storage_gateway_metrics(self, transport):
+        from predictionio_tpu.api.storage_gateway import StorageGatewayServer
+
+        server = StorageGatewayServer(
+            memory_storage(), ip="127.0.0.1", port=0, transport=transport
+        ).start()
+        try:
+            # drive one RPC so the per-method families exist
+            s = Storage({
+                "PIO_STORAGE_SOURCES_G_TYPE": "http",
+                "PIO_STORAGE_SOURCES_G_URL": f"http://127.0.0.1:{server.port}",
+                "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "G",
+                "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "G",
+                "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "G",
+            })
+            assert s.get_meta_data_apps().get_all() == []
+            status, ctype, body = _http_get(server.port, "/metrics")
+            assert status == 200 and ctype.startswith("text/plain")
+            text = body.decode()
+            assert (
+                'pio_gateway_rpc_total{dao="apps",method="get_all",'
+                'outcome="ok"}' in text
+            )
+            assert "pio_gateway_rpc_seconds_bucket" in text
+        finally:
+            server.shutdown()
+
+
+class TestEndToEndFamilies:
+    def test_ingest_compaction_and_pack_cache_families_exposed(
+        self, tmp_path
+    ):
+        """The acceptance sweep: after ingest + a compaction round + a
+        pack-cache bump, /metrics carries flush counters, compaction
+        totals, and pack-cache counters."""
+        from predictionio_tpu.api.event_server import (
+            EventServer,
+            EventServerConfig,
+        )
+        from predictionio_tpu.ops.streaming import _stat_bump
+
+        config = {
+            "PIO_STORAGE_SOURCES_SQLITE_TYPE": "sqlite",
+            "PIO_STORAGE_SOURCES_SQLITE_PATH": str(tmp_path / "e.db"),
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "SQLITE",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "SQLITE",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "SQLITE",
+        }
+        storage = Storage(config)
+        app_id = storage.get_meta_data_apps().insert(App(id=0, name="ee"))
+        storage.get_meta_data_access_keys().insert(
+            AccessKey(key="k", appid=app_id, events=())
+        )
+        storage.get_l_events().init(app_id)
+        server = EventServer(
+            storage=storage,
+            config=EventServerConfig(port=0, compact=False),
+        ).start()
+        try:
+            conn = http.client.HTTPConnection("localhost", server.port)
+            for i in range(3):
+                conn.request(
+                    "POST", "/events.json?accessKey=k",
+                    json.dumps({
+                        "event": "rate", "entityType": "user",
+                        "entityId": f"u{i}", "targetEntityType": "item",
+                        "targetEntityId": f"i{i}",
+                        "properties": {"rating": 3.0},
+                    }),
+                    {"Content-Type": "application/json"},
+                )
+                resp = conn.getresponse()
+                resp.read()
+                assert resp.status == 201
+            conn.close()
+            # one explicit compaction round + one pack-cache outcome
+            storage.get_l_events().compact_app(app_id)
+            _stat_bump("miss")
+            _, _, body = _http_get(server.port, "/metrics")
+            text = body.decode()
+            assert "pio_group_commit_flushes_total" in text
+            assert "pio_events_ingested_total" in text
+            assert "pio_compaction_rounds_total" in text
+            assert "pio_pack_cache_total" in text
+            # status.json reads the same registry
+            _, _, sbody = _http_get(server.port, "/status.json")
+            status_json = json.loads(sbody)
+            assert status_json["eventsIngested"].get("single", 0) >= 3
+        finally:
+            server.shutdown()
