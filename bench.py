@@ -182,6 +182,7 @@ _SUMMARY_FIELDS = {
         "predict_p50_ms_minus_rtt", "predict_device_compute_ms",
         "predict_inproc_p50_ms", "rest_p50_ms", "rest_qps",
         "batch_fill_mean", "rest_single_client_p50_ms",
+        "healthz_p50_ms",
     ),
     "eventserver_ingest_events_per_sec": (
         "value", "single_event_events_per_sec",
@@ -192,7 +193,7 @@ _SUMMARY_FIELDS = {
     ),
     "als_ml20m_train_wall_clock": (
         "value", "device_loop_s", "loop_vs_roofline", "device_put_s",
-        "wire_mb",
+        "wire_mb", "convergence",
     ),
     "als_ml20m_store_to_model_wall_clock": (
         "value", "train_s", "store_scan_s", "train_pack_exposed_s",
@@ -201,7 +202,8 @@ _SUMMARY_FIELDS = {
     ),
     "delta_retrain_s": (
         "value", "cold_retrain_s", "delta_over_cold", "delta_rmse_gap",
-        "delta_events",
+        "delta_events", "delta_convergence", "cold_convergence",
+        "sweep_telemetry_overhead_frac",
     ),
 }
 
@@ -282,6 +284,80 @@ def measure_metrics_overhead_us(n: int = 20000) -> float:
         c.inc()
         g.set(0.001)
     return (time.perf_counter() - t0) / n * 1e6
+
+
+def measure_sweep_telemetry_overhead(
+    n_users=20_000, n_items=2_000, n_ratings=200_000, sweeps=16, reps=10
+):
+    """Per-sweep convergence-telemetry cost as a fraction of device
+    sweep time: the SAME synthetic wire trained with the telemetry
+    executable and the telemetry-free executable
+    (ALSConfig.sweep_telemetry static arg), ``sweeps`` sweeps per run
+    so dispatch noise amortizes, ``reps`` timed runs per variant
+    INTERLEAVED with the MIN taken per side (see the inline comment —
+    sequential medians billed box noise to one variant). The hard gate
+    is <2% — the telemetry is two elementwise reductions over the
+    factor matrices per sweep, which must stay noise against the
+    gather/einsum/Cholesky work."""
+    import numpy as np
+
+    from predictionio_tpu.ops.als import (
+        ALSConfig,
+        build_host_wire,
+        train_from_wire,
+    )
+
+    rng = np.random.default_rng(11)
+    u = rng.integers(0, n_users, n_ratings).astype(np.int32)
+    i = rng.integers(0, n_items, n_ratings).astype(np.int32)
+    r = (rng.integers(1, 11, n_ratings) / 2.0).astype(np.float32)
+
+    prepared = {}
+    for telemetry in (True, False):
+        config = ALSConfig(
+            rank=8, iterations=sweeps, reg=0.05, sweep_telemetry=telemetry
+        )
+        prepared[telemetry] = (
+            build_host_wire(u, i, r, n_users, n_items, config), config
+        )
+
+    def one_loop_s(telemetry: bool) -> float:
+        t = {}
+        wire, config = prepared[telemetry]
+        train_from_wire(wire, config, timings=t)
+        return t["device_loop_s"]
+
+    # warm BOTH executables, then interleave the timed reps and take
+    # the min — cold-cache effects and box noise land on both sides
+    # symmetrically instead of billing whichever variant ran first
+    # (a sequential median-of-3 measured a phantom ~3% on the 2-CPU
+    # build box; interleaved mins show <0.5%)
+    samples = {True: [], False: []}
+    for telemetry in (True, False):
+        one_loop_s(telemetry)
+    for _ in range(reps):
+        for telemetry in (True, False):
+            samples[telemetry].append(one_loop_s(telemetry))
+    with_tel = min(samples[True])
+    without = min(samples[False])
+    frac = max(0.0, (with_tel - without) / without)
+    return {
+        "sweep_telemetry_overhead_frac": round(frac, 5),
+        "sweep_s_with_telemetry": round(with_tel / sweeps, 5),
+        "sweep_s_without_telemetry": round(without / sweeps, 5),
+    }
+
+
+def convergence_curve(timings: dict, digits=5):
+    """The per-sweep factor-delta curve [[dx, dy], ...] from a train's
+    sweep telemetry (ops/als.py) — the summary-JSON form of the
+    registry's pio_train_sweep_factor_delta histogram."""
+    tel = timings.get("sweep_telemetry")
+    if not tel:
+        return None
+    return [
+        [round(row["dx"], digits), round(row["dy"], digits)] for row in tel
+    ]
 
 
 # --- config 1: recommendation ALS (headline) ---
@@ -552,6 +628,39 @@ def bench_rest_serving(
             f"registry overhead {overhead_us:.1f}us is no longer noise "
             f"against the in-proc serving p50 ({inproc_p50_us:.0f}us)"
         )
+
+        # liveness latency gate: /healthz is what orchestrators poll at
+        # high frequency across a fleet — it must answer in sub-ms. The
+        # gated figure is the request-core cost (handler dispatch +
+        # liveness payload, no socket); the keep-alive HTTP round trip
+        # is reported beside it for the end-to-end picture.
+        def healthz_one():
+            t0 = time.perf_counter()
+            status, _, _ = server.api.handle("GET", "/healthz")
+            assert status == 200, status
+            return (time.perf_counter() - t0) * 1000
+
+        for _ in range(20):
+            healthz_one()
+        healthz_ms = [healthz_one() for _ in range(300)]
+        healthz_p50_ms = pctl(healthz_ms, 50)
+        assert healthz_p50_ms < 1.0, (
+            f"/healthz p50 {healthz_p50_ms:.3f}ms — liveness must stay "
+            "sub-millisecond (no storage/daemon consultation allowed "
+            "on this route)"
+        )
+        hconn = http.client.HTTPConnection("localhost", server.port)
+        try:
+            http_healthz = []
+            for _ in range(50):
+                t0 = time.perf_counter()
+                hconn.request("GET", "/healthz")
+                resp = hconn.getresponse()
+                resp.read()
+                assert resp.status == 200, resp.status
+                http_healthz.append((time.perf_counter() - t0) * 1000)
+        finally:
+            hconn.close()
         return {
             "rest_p50_ms": round(pctl(lat, 50), 2),
             "rest_p99_ms": round(pctl(lat, 99), 2),
@@ -569,6 +678,8 @@ def bench_rest_serving(
             "predict_inproc_qps": round(1000.0 / max(pctl(inproc, 50), 1e-6), 1),
             "metrics_overhead_us_per_request": round(overhead_us, 2),
             "metrics_window_delta": window_metrics,
+            "healthz_p50_ms": round(healthz_p50_ms, 4),
+            "healthz_rest_p50_ms": round(pctl(http_healthz, 50), 3),
         }
     finally:
         server.shutdown()
@@ -748,6 +859,11 @@ def bench_ml20m(device_name):
             "rmse_subsample": round(sub_rmse, 4),
             "rmse_mllib_oracle_subsample": round(rmse_ref, 4),
             "rmse_vs_mllib_subsample": round(abs(sub_rmse - rmse_ref), 4),
+            # per-sweep [user, item] factor-delta RMS from the fused
+            # loop's telemetry output — the convergence curve behind
+            # device_loop_s (cost <2% of sweep time, gated in
+            # delta_train's dedicated overhead measure)
+            "convergence": convergence_curve(timings),
             "device": device_name,
         },
         baseline_s=SPARK_LOCAL_ALS_ML20M_S,
@@ -1915,6 +2031,15 @@ def bench_delta_train(device_name):
             res_cold.arrays, cols.entity_idx, cols.target_idx,
             cols.values,
         )
+        # convergence-telemetry overhead gate (<2% of device sweep
+        # time) on a dedicated small wire, so the comparison runs the
+        # same geometry with/without the telemetry executable
+        overhead = measure_sweep_telemetry_overhead()
+        assert overhead["sweep_telemetry_overhead_frac"] < 0.02, (
+            "per-sweep telemetry overhead "
+            f"{overhead['sweep_telemetry_overhead_frac']:.4f} of sweep "
+            "time — the convergence instrumentation must stay noise"
+        )
         emit(
             {
                 "metric": "delta_retrain_s",
@@ -1944,6 +2069,13 @@ def bench_delta_train(device_name):
                 "cold_device_loop_s": round(
                     t_cold.get("device_loop_s", 0.0), 3
                 ),
+                # per-sweep [user, item] factor-delta RMS: the warm
+                # (2-sweep) round should land orders of magnitude below
+                # the cold round's first sweeps — the convergence
+                # evidence behind the reduced sweep budget
+                "delta_convergence": convergence_curve(t_delta),
+                "cold_convergence": convergence_curve(t_cold),
+                **overhead,
                 "seed_s": round(seed_s, 3),
                 "device": device_name,
             }

@@ -51,7 +51,10 @@ from predictionio_tpu.api.http import (
     ReusePortUnavailable,
     accepts_headers,
     bind_with_retries,
+    record_http_error,
+    request_trace_id,
 )
+from predictionio_tpu.utils import metrics as _metrics
 
 logger = logging.getLogger(__name__)
 
@@ -81,7 +84,16 @@ class AsyncJsonHTTPServer:
     Bind retries and their tunables are shared with the threaded
     frontend (``JsonHTTPServer.BIND_RETRIES``) so operational overrides
     cover both transports.
+
+    While serving, a monitor coroutine samples event-loop scheduling lag
+    (how late a timer fires vs. when it asked to) into the
+    ``pio_eventloop_lag_seconds{server=...}`` gauge every
+    ``LAG_INTERVAL_S`` — the single-threaded frontend's one scarce
+    resource is loop time, and a handler that blocks inline shows up
+    here before it shows up as tail latency.
     """
+
+    LAG_INTERVAL_S = 0.5
 
     def __init__(
         self,
@@ -202,6 +214,23 @@ class AsyncJsonHTTPServer:
             loop.close()
             self._finished.set()
 
+    async def _monitor_loop_lag(self) -> None:
+        """Sample scheduling lag: sleep LAG_INTERVAL_S and record how far
+        past the deadline the wake-up landed. A loop wedged by an inline
+        blocking call reports the stall as soon as it unwedges; a healthy
+        loop reports ~0."""
+        loop = asyncio.get_running_loop()
+        gauge = _metrics.get_registry().gauge(
+            "pio_eventloop_lag_seconds",
+            "Asyncio event-loop scheduling lag (timer lateness), sampled",
+            labels=("server",),
+        ).labels(server=self.name)
+        interval = self.LAG_INTERVAL_S
+        while True:
+            t0 = loop.time()
+            await asyncio.sleep(interval)
+            gauge.set(max(0.0, loop.time() - t0 - interval))
+
     async def _serve(self) -> None:
         self._stop_event = asyncio.Event()
         with self._shutdown_lock:
@@ -213,10 +242,12 @@ class AsyncJsonHTTPServer:
             backlog=128,  # parity with _Server.request_queue_size
             limit=MAX_HEADER_BYTES,
         )
+        lag_task = asyncio.ensure_future(self._monitor_loop_lag())
         self._started.set()
         try:
             await self._stop_event.wait()
         finally:
+            lag_task.cancel()
             server.close()
             await server.wait_closed()
             live = [t for t in self._conn_tasks if not t.done()]
@@ -260,10 +291,12 @@ class AsyncJsonHTTPServer:
                 if req[0] == "error":
                     _, status, message = req
                     await pending.put(
-                        ((status, {"message": message}), False)
+                        ((status, {"message": message}), False,
+                         "(framing)", None)
                     )
                     break
                 _, method, path, query, body, form, headers, keep_alive = req
+                trace_id = request_trace_id(headers)
                 try:
                     if self._pass_headers:
                         result = self.handle_fn(
@@ -273,10 +306,13 @@ class AsyncJsonHTTPServer:
                         result = self.handle_fn(method, path, query, body, form)
                 except Exception as e:
                     logger.exception(
-                        "internal error handling %s %s", method, path
+                        "internal error handling %s %s", method, path,
+                        extra=(
+                            {"traceId": trace_id} if trace_id else None
+                        ),
                     )
                     result = (500, {"message": str(e)})
-                await pending.put((result, keep_alive))
+                await pending.put((result, keep_alive, path, trace_id))
                 if not keep_alive:
                     break
         except (ConnectionError, asyncio.IncompleteReadError):
@@ -383,7 +419,7 @@ class AsyncJsonHTTPServer:
             item = await pending.get()
             if item is _CLOSE:
                 return
-            result, keep_alive = item
+            result, keep_alive, route, trace_id = item
             if discarding:
                 if isinstance(result, concurrent.futures.Future):
                     # best effort: an uncollected query still queued in
@@ -400,19 +436,26 @@ class AsyncJsonHTTPServer:
             except asyncio.CancelledError:
                 raise
             except Exception as e:
-                logger.exception("deferred handler failed")
+                logger.exception(
+                    "deferred handler failed",
+                    extra={"traceId": trace_id} if trace_id else None,
+                )
                 result = (500, {"message": str(e)})
+            status = None
             try:
                 # rendering is inside the invariant too: a payload
                 # json.dumps can't encode (or a malformed handler tuple)
                 # must produce a 500, not kill the writer and wedge the
                 # reader on the bounded queue
                 head, data = self._render(result, keep_alive)
+                status = result[0]
             except Exception as e:
                 logger.exception("unrenderable handler result %r", result)
                 head, data = self._render(
                     (500, {"message": str(e)}), keep_alive
                 )
+                status = 500
+            record_http_error(self.name, route, status, trace_id)
             try:
                 writer.write(head + data)
                 await writer.drain()

@@ -52,6 +52,7 @@ from predictionio_tpu.api.engine_plugins import (
 from predictionio_tpu.api.aio_http import TRANSPORTS, make_http_server
 from predictionio_tpu.controller.engine import Engine, EngineParams
 from predictionio_tpu.data.storage import Storage, get_storage
+from predictionio_tpu.utils import health as _health
 from predictionio_tpu.utils import metrics as _metrics
 from predictionio_tpu.utils import tracing as _tracing
 from predictionio_tpu.utils.serialize import loads_model
@@ -296,6 +297,11 @@ class _BatchingExecutor:
             buckets=_metrics.BATCH_SIZE_BUCKETS,
         )
         self._m_batch_base = self._m_batch_fill.snapshot()
+        # watchdog: a serve_batch wedged in a stuck device/relay call
+        # degrades /readyz once it overruns the deadline (executors of
+        # one process share the heartbeat — either stalling is a
+        # process-level routing signal); idle executors never stall
+        self._hb = _health.heartbeat("serving-executor", deadline_s=120.0)
 
     def submit_nowait(
         self,
@@ -427,7 +433,8 @@ class _BatchingExecutor:
     def _serve_and_release(self, dep: DeployedEngine, items) -> None:
         t0 = time.time()
         try:
-            self._serve_isolating(dep, items)
+            with self._hb.busy():
+                self._serve_isolating(dep, items)
         finally:
             self._inflight.release()
             t1 = time.time()
@@ -527,6 +534,13 @@ class QueryAPI:
         self._lat_base = self._m_latency.snapshot()
         self._requests_base = self._m_requests.snapshot()
         self._feedback_dropped_base = self._m_feedback_dropped.snapshot()
+        # /readyz: a deployed model with its serving components is the
+        # engine server's one hard readiness requirement; daemon-stall
+        # checks (executor, feedback drainer, continuous trainer) are
+        # global. ttl 0: the check is attribute reads, no caching needed.
+        self._ready_probes = (
+            _health.TTLProbe("model", self._probe_model, ttl_s=0.0),
+        )
         # feedback posts drain on ONE daemon worker (not a thread per
         # request — that would throttle the micro-batched hot path). The
         # queue is BOUNDED (config.feedback_queue_max): a down event
@@ -623,26 +637,33 @@ class QueryAPI:
                 self._feedback_worker.start()
 
     def _drain_feedback(self) -> None:
+        # watchdog (busy only around the post: an empty queue is idle,
+        # not stalled); the urlopen timeout bounds each unit at 10 s
+        hb = _health.heartbeat("feedback-drainer", deadline_s=60.0)
         while True:
             item = self._feedback_queue.get()
             if item is self._FEEDBACK_STOP:
                 return
             url, data = item
-            try:
-                req = urllib.request.Request(
-                    url,
-                    data=json.dumps(data).encode("utf-8"),
-                    headers={"Content-Type": "application/json"},
-                    method="POST",
-                )
-                with urllib.request.urlopen(req, timeout=10) as resp:
-                    if resp.status != 201:
-                        logger.error(
-                            "Feedback event failed. Status code: %d. Data: %s",
-                            resp.status, json.dumps(data),
-                        )
-            except Exception as e:
-                logger.error("Feedback event failed: %s", e)
+            with hb.busy():
+                self._post_feedback(url, data)
+
+    def _post_feedback(self, url, data) -> None:
+        try:
+            req = urllib.request.Request(
+                url,
+                data=json.dumps(data).encode("utf-8"),
+                headers={"Content-Type": "application/json"},
+                method="POST",
+            )
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                if resp.status != 201:
+                    logger.error(
+                        "Feedback event failed. Status code: %d. Data: %s",
+                        resp.status, json.dumps(data),
+                    )
+        except Exception as e:
+            logger.error("Feedback event failed: %s", e)
 
     # --- dispatch ---
 
@@ -686,6 +707,11 @@ class QueryAPI:
                     "internal error handling POST /queries.json"
                 )
                 return 500, {"message": str(e)}, "application/json"
+        if path == "/healthz" and method == "GET":
+            # liveness inline on the loop (non-blocking dict build): a
+            # route pool wedged by third-party plugin code must not make
+            # the orchestrator restart an otherwise-serving process
+            return 200, _health.liveness(), "application/json"
         try:
             return self._route_pool.submit(
                 self.handle, method, path, query, body, headers
@@ -696,12 +722,22 @@ class QueryAPI:
                 "application/json",
             )
 
+    def _probe_model(self) -> None:
+        dep = self.deployed
+        if dep is None or not dep.models or not dep.algorithms:
+            raise RuntimeError("no model deployed")
+
     def _route(
         self, method, path, query, body, headers=None
     ) -> Tuple[int, Any, str]:
         parts = [p for p in path.strip("/").split("/") if p]
         if not parts and method == "GET":
             return 200, self._status_html(), "text/html"
+        if path == "/healthz" and method == "GET":
+            return 200, _health.liveness(), "application/json"
+        if path == "/readyz" and method == "GET":
+            ok, payload = _health.readiness(self._ready_probes)
+            return (200 if ok else 503), payload, "application/json"
         if path == "/status.json" and method == "GET":
             return 200, self._status_json(), "application/json"
         if path == "/metrics" and method == "GET":

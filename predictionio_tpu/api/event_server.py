@@ -60,6 +60,7 @@ from predictionio_tpu.data.webhooks.mailchimp import MailChimpConnector
 from predictionio_tpu.data.webhooks.segmentio import SegmentIOConnector
 from predictionio_tpu.api.plugins import EventServerPlugin, EventServerPluginContext
 from predictionio_tpu.api.stats import StatsTracker
+from predictionio_tpu.utils import health as _health
 from predictionio_tpu.utils import metrics as _metrics
 from predictionio_tpu.utils import tracing as _tracing
 
@@ -186,7 +187,16 @@ class EventAPI:
             "Events accepted by the event server, by route",
             labels=("route",),
         )
+        # /readyz: the store must answer a cheap metadata read (TTL-
+        # cached so an unauthenticated readiness poller cannot turn the
+        # probe into a storage load); stalled-daemon checks are global
+        self._ready_probes = (
+            _health.TTLProbe("store", self._probe_store),
+        )
         _LIVE_APIS.add(self)
+
+    def _probe_store(self) -> None:
+        self.storage.get_meta_data_apps().get_all()
 
     # --- auth (reference withAccessKey, EventServer.scala:81-107) ---
 
@@ -272,6 +282,18 @@ class EventAPI:
 
         if path == "/status.json" and method == "GET":
             return 200, self._status_json(query)
+
+        if path == "/healthz" and method == "GET":
+            # liveness: answers while the frontend runs handlers at all;
+            # never consults storage or daemons (that's readiness)
+            return 200, _health.liveness()
+
+        if path == "/readyz" and method == "GET":
+            # readiness: store reachable + no registered background
+            # daemon (committers, compactor, continuous trainer) stalled
+            # past its deadline — 503 tells the balancer to drain us
+            ok, payload = _health.readiness(self._ready_probes)
+            return (200 if ok else 503), payload
 
         if path == "/metrics" and method == "GET":
             # unauthenticated like status.json: process-level aggregates
@@ -684,6 +706,13 @@ class EventServer:
             pool = self._pool
 
             def fn(method, path, query, body, form=None, headers=None):
+                if path == "/healthz" and method == "GET":
+                    # liveness answers INLINE on the loop (pure dict
+                    # build, non-blocking): a handler pool saturated
+                    # with parked COMMIT waits must not read as "dead"
+                    return self.api.handle(
+                        method, path, query, body, form, headers
+                    )
                 return pool.submit(
                     self.api.handle, method, path, query, body, form,
                     headers,

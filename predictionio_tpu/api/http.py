@@ -27,6 +27,61 @@ logger = logging.getLogger(__name__)
 HandleFn = Callable[..., Tuple]
 
 
+def request_trace_id(headers) -> Optional[str]:
+    """The client's ``X-PIO-Trace-Id`` (lower-cased header dict),
+    sanitized for log/label use — or None. Transport-layer error
+    accounting runs OUTSIDE any ``tracing.use`` block, so the id is
+    plumbed explicitly."""
+    if not headers:
+        return None
+    from predictionio_tpu.utils import tracing as _tracing
+
+    raw = headers.get(_tracing.TRACE_HEADER.lower()) or ""
+    # the tracing layer's own sanitizer, so the id on transport-layer
+    # error logs is byte-identical to the id its spans record under
+    # (the documented traceId join key)
+    return _tracing._sanitize(raw) or None
+
+
+def record_http_error(
+    server: str, route: str, status, trace_id: Optional[str] = None
+) -> None:
+    """Transport-layer error accounting, shared by BOTH frontends: every
+    5xx response (and framing-level 4xxs, which never reach a handler —
+    ``route`` is ``"(framing)"`` there) increments
+    ``pio_http_errors_total{server,route,status}``, and 5xxs emit a
+    structured error log carrying the request's trace id so the failure
+    joins against /debug/traces.json. Before this counter, an unhandled
+    handler exception 500'd with no accounting at all — invisible to
+    /metrics, visible only to the client. Route label cardinality is
+    bounded in practice: 4xxs on arbitrary fuzzed paths are NOT counted
+    (they'd mint a label per path), only framing errors and 5xxs, which
+    occur on real routes."""
+    if not isinstance(status, int):
+        return
+    if route in ("/healthz", "/readyz"):
+        # a readiness 503 is deliberate backpressure, not an error — a
+        # draining worker polled every second must not spam the error
+        # log or inflate the error counter
+        return
+    framing = route == "(framing)"
+    if status < 500 and not (framing and status >= 400):
+        return
+    from predictionio_tpu.utils import metrics as _metrics
+
+    _metrics.get_registry().counter(
+        "pio_http_errors_total",
+        "HTTP error responses recorded at the transport layer",
+        labels=("server", "route", "status"),
+    ).labels(server=server, route=route[:64], status=str(status)).inc()
+    if status >= 500:
+        logger.error(
+            "%s: %s answered %d",
+            server, route, status,
+            extra={"traceId": trace_id} if trace_id else None,
+        )
+
+
 def accepts_headers(fn: Callable) -> bool:
     """Whether a request core takes the optional ``headers`` kwarg (the
     lower-cased request-header dict both transports can supply). Probed
@@ -82,6 +137,7 @@ class _ReusePortServer(_Server):
 class _Handler(BaseHTTPRequestHandler):
     handle_fn: HandleFn  # bound by JsonHTTPServer
     pass_headers = False  # bound by JsonHTTPServer (accepts_headers)
+    server_name = "HTTP"  # bound by JsonHTTPServer (error accounting)
 
     # HTTP/1.1 keep-alive: every response carries Content-Length, so
     # persistent connections are safe and spare concurrent clients a
@@ -99,17 +155,20 @@ class _Handler(BaseHTTPRequestHandler):
         # we can't read and drop the connection when length is unknowable
         if "chunked" in (self.headers.get("Transfer-Encoding") or "").lower():
             self.close_connection = True
+            record_http_error(self.server_name, "(framing)", 501)
             self.send_error(501, "chunked transfer encoding not supported")
             return
         try:
             length = int(self.headers.get("Content-Length") or 0)
         except ValueError:
             self.close_connection = True
+            record_http_error(self.server_name, "(framing)", 400)
             self.send_error(400, "invalid Content-Length")
             return
         if length > MAX_BODY_BYTES:
             # refuse BEFORE reading (the async frontend does the same)
             self.close_connection = True
+            record_http_error(self.server_name, "(framing)", 413)
             self.send_error(413, "request body too large")
             return
         body = self.rfile.read(length) if length > 0 else b""
@@ -123,14 +182,26 @@ class _Handler(BaseHTTPRequestHandler):
                 form = dict(urllib.parse.parse_qsl(body.decode("utf-8")))
             except UnicodeDecodeError:
                 form = {}
-        if self.pass_headers:
-            headers = {k.lower(): v for k, v in self.headers.items()}
-            result = self.handle_fn(
-                method, parsed.path, query, body, form, headers=headers
+        headers = {k.lower(): v for k, v in self.headers.items()}
+        trace_id = request_trace_id(headers)
+        try:
+            if self.pass_headers:
+                result = self.handle_fn(
+                    method, parsed.path, query, body, form, headers=headers
+                )
+            else:
+                result = self.handle_fn(method, parsed.path, query, body, form)
+        except Exception as e:
+            # request cores catch internally; this is the transport-layer
+            # backstop so a raising core still answers (and is counted)
+            # instead of silently dropping the connection
+            logger.exception(
+                "internal error handling %s %s", method, parsed.path,
+                extra={"traceId": trace_id} if trace_id else None,
             )
-        else:
-            result = self.handle_fn(method, parsed.path, query, body, form)
+            result = (500, {"message": str(e)})
         status, payload = result[0], result[1]
+        record_http_error(self.server_name, parsed.path, status, trace_id)
         out_type = result[2] if len(result) > 2 else "application/json"
         if out_type == "application/json" and not isinstance(payload, str):
             data = json.dumps(payload).encode("utf-8")
@@ -210,6 +281,7 @@ class JsonHTTPServer:
             {
                 "handle_fn": staticmethod(handle_fn),
                 "pass_headers": accepts_headers(handle_fn),
+                "server_name": name,
             },
         )
         # SO_REUSEPORT (``reuse_port``): several server PROCESSES bind the

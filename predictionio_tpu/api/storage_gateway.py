@@ -35,6 +35,7 @@ from predictionio_tpu.api.aio_http import TRANSPORTS, make_http_server
 from predictionio_tpu.data.storage import Storage, get_storage
 from predictionio_tpu.data.storage.base import PartialBatchError, StorageError
 from predictionio_tpu.data.storage import wire
+from predictionio_tpu.utils import health as _health
 from predictionio_tpu.utils import metrics as _metrics
 from predictionio_tpu.utils import tracing as _tracing
 
@@ -132,6 +133,16 @@ class StorageGatewayCore:
             labels=("dao", "method"),
             buckets=_metrics.LATENCY_BUCKETS_S,
         )
+        # /readyz: the owned store must answer a cheap metadata read
+        # (TTL-cached against readiness-poller load); the gateway also
+        # inherits the process's daemon-stall checks — its sqlite
+        # committers register their own heartbeats
+        self._ready_probes = (
+            _health.TTLProbe("store", self._probe_store),
+        )
+
+    def _probe_store(self) -> None:
+        self.storage.get_meta_data_apps().get_all()
 
     # --- request entry ---
 
@@ -140,6 +151,11 @@ class StorageGatewayCore:
 
         if path == "/status" and method == "GET":
             return 200, {"status": "alive", "daos": sorted(_DAOS)}
+        if path == "/healthz" and method == "GET":
+            return 200, _health.liveness()
+        if path == "/readyz" and method == "GET":
+            ok, payload = _health.readiness(self._ready_probes)
+            return (200 if ok else 503), payload
         if path == "/metrics" and method == "GET":
             return (
                 200,
@@ -418,6 +434,12 @@ class StorageGatewayServer:
             core = self.core
 
             def fn(method, path, query, body, form=None, headers=None):
+                if path == "/healthz" and method == "GET":
+                    # liveness inline on the loop: a handler pool full
+                    # of parked COMMIT waits must not read as "dead"
+                    return core.handle(
+                        method, path, query, body, form, headers
+                    )
                 return pool.submit(
                     core.handle, method, path, query, body, form, headers
                 )

@@ -551,6 +551,10 @@ class SegmentCompactor:
     by the event server (``EventServerConfig.compact``) or a standalone
     ``pio compact`` run; everything is instance state."""
 
+    # watchdog deadline for one compaction round (seal + manifest
+    # commit); a round silent past this while mid-work degrades /readyz
+    HEARTBEAT_DEADLINE_S = 300.0
+
     def __init__(
         self,
         storage,
@@ -558,6 +562,8 @@ class SegmentCompactor:
         interval_s: float = 60.0,
         apps: Optional[Sequence[int]] = None,
     ):
+        from predictionio_tpu.utils import health as _health
+
         self.storage = storage
         self.policy = policy or CompactionPolicy()
         self.interval_s = max(1.0, float(interval_s))
@@ -566,6 +572,11 @@ class SegmentCompactor:
         self._stop = threading.Event()
         self._lock = threading.Lock()
         self._started = False
+        # per-app worker threads share one heartbeat: any app's round
+        # stalling is a process-level readiness signal
+        self._hb = _health.heartbeat(
+            "segment-compactor", deadline_s=self.HEARTBEAT_DEADLINE_S
+        )
 
     @staticmethod
     def supported(storage) -> bool:
@@ -588,7 +599,8 @@ class SegmentCompactor:
     def run_once(self, app_id: int, channel_id: Optional[int] = None) -> dict:
         """One synchronous compaction round for one app/channel."""
         le = self.storage.get_l_events()
-        return le.compact_app(app_id, channel_id, policy=self.policy)
+        with self._hb.busy():
+            return le.compact_app(app_id, channel_id, policy=self.policy)
 
     def compact_all_once(self) -> Dict[int, dict]:
         """One round over every app (and its channels) — the ``pio

@@ -180,7 +180,15 @@ class _GroupCommitter:
 
     _STOP = object()
 
+    # watchdog deadline for one flush: a healthy multi-row COMMIT is
+    # milliseconds; a flush silent past this long while mid-batch flips
+    # every in-process server's /readyz to 503 (utils/health.py). Class
+    # attribute so tests (and operators with slow disks) can tune it
+    # before opening storage.
+    HEARTBEAT_DEADLINE_S = 30.0
+
     def __init__(self, shard: "_ShardState", max_rows: int, max_delay_s: float):
+        from predictionio_tpu.utils import health as _health
         from predictionio_tpu.utils import metrics as _metrics
 
         self._shard = shard
@@ -211,6 +219,18 @@ class _GroupCommitter:
             labels=("shard",),
             buckets=_metrics.LATENCY_BUCKETS_S,
         ).labels(shard=shard_name)
+        # daemon watchdog: busy exactly for the span of one flush, so a
+        # wedged COMMIT (locked file, dead disk) reads as a stall while
+        # an idle committer stays healthy. Keyed by shard file name like
+        # the flush metrics — committers of one process that share a
+        # basename share the verdict, which is what readiness wants.
+        self._hb = _health.heartbeat(
+            f"sqlite-committer:{shard_name}",
+            deadline_s=self.HEARTBEAT_DEADLINE_S,
+        )
+        # a same-named heartbeat may predate this committer (an earlier
+        # store in this process); the CURRENT class deadline wins
+        self._hb.deadline_s = float(self.HEARTBEAT_DEADLINE_S)
 
     def close(self, timeout: float = 10.0) -> None:
         """Drain-and-stop: queued units ahead of the sentinel still
@@ -284,7 +304,7 @@ class _GroupCommitter:
         t0 = _time.perf_counter()
         t0_wall = _time.time()
         shard = self._shard
-        with shard.lock:
+        with self._hb.busy(), shard.lock:
             try:
                 for u in batch:
                     shard.conn.executemany(u.sql, u.rows)

@@ -51,6 +51,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from predictionio_tpu.parallel.mesh import pad_to_multiple
+from predictionio_tpu.utils import metrics as _metrics
 
 logger = logging.getLogger(__name__)
 
@@ -93,6 +94,13 @@ class ALSConfig:
     # max gathered slots per device chunk (bounds the [chunk, L, k]
     # gather buffer; ~4M slots * rank 32 * bf16 = 256 MB)
     chunk_slots: int = 4_194_304
+    # per-sweep convergence telemetry from the fused loop (factor-delta
+    # RMS per side, written into a fixed [TELEMETRY_SLOTS, 4] output —
+    # no host callback inside the jit). Two elementwise reductions over
+    # the factor matrices per sweep: noise against the gather/einsum/
+    # Cholesky work (bench.py gates the overhead at <2% of sweep time).
+    # Off = a separate executable (the flag is a static jit arg).
+    sweep_telemetry: bool = True
 
     def __post_init__(self):
         if self.reg_mode not in ("weighted", "plain"):
@@ -587,10 +595,18 @@ def _constrain(a: jax.Array, sharding) -> jax.Array:
     )
 
 
+# per-sweep telemetry rows the fused loop can record before the ring
+# wraps (sweeps past this many stop recording — mode="drop" scatter);
+# each row is [dx_rms, dy_rms, x_rms, y_rms] float32, so the whole
+# buffer is ~1 KB and rides the existing factor fetch
+TELEMETRY_SLOTS = 64
+
+
 @functools.partial(
     jax.jit,
     static_argnames=(
         "implicit", "compute_dtype", "rep_sharding", "row_sharding",
+        "telemetry",
     ),
     donate_argnums=(0, 1),
 )
@@ -610,7 +626,8 @@ def _run_iterations(
     compute_dtype: str,
     rep_sharding,  # NamedSharding(P()) or None — replicate for gathers
     row_sharding,  # NamedSharding(P(axis)) or None
-) -> Tuple[jax.Array, jax.Array]:
+    telemetry: bool = True,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """The whole training loop as ONE XLA program: lax.fori_loop over
     iterations, each half-iteration a chunked gather/einsum accumulation
     plus one batched solve. One dispatch covers all iterations — no host
@@ -619,7 +636,15 @@ def _run_iterations(
     per-step device_puts. The trip count is a runtime value so warm-up,
     checkpoint chunks, and resumes all reuse the same executable. The
     regularizer (with reg and, in weighted mode, per-row counts baked in)
-    arrives as data, so sweeping reg reuses the executable too."""
+    arrives as data, so sweeping reg reuses the executable too.
+
+    With ``telemetry`` (the convergence tentpole), sweep ``i`` also
+    writes [RMS(X_i - X_{i-1}), RMS(Y_i - Y_{i-1}), RMS(X_i), RMS(Y_i)]
+    into row ``i`` of a fixed [TELEMETRY_SLOTS, 4] output — the
+    factor-delta convergence proxy, computed IN the loop (two cheap
+    elementwise reductions per side; on a mesh the sharded mean lowers
+    to a psum) and fetched alongside the factors, never via a host
+    callback inside the jit."""
     k = X.shape[-1]
     zeros_g = jnp.zeros((k, k), jnp.float32)
 
@@ -632,13 +657,22 @@ def _run_iterations(
         )
         return _constrain(X, row_sharding)
 
-    def body(_, carry):
-        X, Y = carry
-        X = half(X, Y, user_pack, user_lam, user_has_obs)
-        Y = half(Y, X, item_pack, item_lam, item_has_obs)
-        return (X, Y)
+    def _rms(a):
+        return jnp.sqrt(jnp.mean(jnp.square(a.astype(jnp.float32))))
 
-    return jax.lax.fori_loop(0, n_iters, body, (X, Y))
+    def body(i, carry):
+        X, Y, tel = carry
+        Xn = half(X, Y, user_pack, user_lam, user_has_obs)
+        Yn = half(Y, Xn, item_pack, item_lam, item_has_obs)
+        if telemetry:
+            row = jnp.stack(
+                [_rms(Xn - X), _rms(Yn - Y), _rms(Xn), _rms(Yn)]
+            )
+            tel = tel.at[i].set(row, mode="drop")
+        return (Xn, Yn, tel)
+
+    tel0 = jnp.zeros((TELEMETRY_SLOTS, 4), jnp.float32)
+    return jax.lax.fori_loop(0, n_iters, body, (X, Y, tel0))
 
 
 @functools.partial(
@@ -1150,10 +1184,16 @@ def start_compile_async(
         _padded_rows(n_users, 1), _padded_rows(n_items, 1),
         geo_u.n_chunks, geo_u.sc, L_u, geo_i.n_chunks, geo_i.sc, L_i,
         config.rank, config.implicit_prefs, config.compute_dtype,
+        config.sweep_telemetry,
     )
     with _WARMED_LOCK:
-        if geo_key in _WARMED_GEOMETRIES:
-            return lambda: {"busy_s": 0.0}
+        warmed = geo_key in _WARMED_GEOMETRIES
+    if warmed:
+        # geometry-bucket hit: the warm-up skip the continuous loop
+        # relies on every round (accounted so /metrics can show the
+        # AOT cache doing its job)
+        _record_compile("cached")
+        return lambda: {"busy_s": 0.0}
 
     rec: dict = {}
 
@@ -1188,6 +1228,7 @@ def start_compile_async(
                     implicit=config.implicit_prefs,
                     compute_dtype=config.compute_dtype,
                     rep_sharding=None, row_sharding=None,
+                    telemetry=config.sweep_telemetry,
                 )
                 _fence(out)
             with _WARMED_LOCK:
@@ -1195,6 +1236,9 @@ def start_compile_async(
         except Exception as e:  # pragma: no cover - defensive
             rec["error"] = repr(e)
         rec["busy_s"] = _time.perf_counter() - t0
+        _record_compile(
+            "error" if "error" in rec else "warmed", rec["busy_s"]
+        )
 
     th = threading.Thread(target=work, daemon=True, name="als-warm-compile")
     th.start()
@@ -1531,6 +1575,103 @@ def train_als(
     )
 
 
+# --- training telemetry (the observability tentpole's device-loop leg):
+# per-sweep convergence rows recorded by the fused loop land in the
+# process-global metrics registry, so /metrics on any in-process server
+# (and status.json via continuous.py) carries the convergence state of
+# the latest round. Families are get-or-create per call — a dict lookup,
+# training-round granularity, not a hot path. ---
+
+
+def _record_compile(outcome: str, busy_s: float = 0.0) -> None:
+    """Compile/AOT-cache accounting: ``outcome`` is ``warmed`` (a
+    background start_compile_async warm-up built+executed the
+    executable), ``cached`` (the geometry bucket was already warm — the
+    warm-up skip), ``inline`` (training compiled on the caller's
+    thread), or ``error``."""
+    reg = _metrics.get_registry()
+    reg.counter(
+        "pio_als_compile_total",
+        "ALS iteration-executable compile events by outcome",
+        labels=("outcome",),
+    ).labels(outcome=outcome).inc()
+    if busy_s:
+        reg.counter(
+            "pio_als_compile_seconds_total",
+            "Cumulative seconds spent compiling/warming ALS executables",
+        ).inc(busy_s)
+    with _WARMED_LOCK:
+        n_warm = len(_WARMED_GEOMETRIES)
+    reg.gauge(
+        "pio_als_warm_geometries",
+        "Distinct bucketed geometries whose iteration executable this "
+        "process has warmed",
+    ).set(n_warm)
+
+
+def _fetch_telemetry(tel_parts) -> Optional[np.ndarray]:
+    """Concatenate the per-chunk telemetry buffers into one [n_sweeps, 4]
+    host array (rows past TELEMETRY_SLOTS per chunk were dropped by the
+    in-loop scatter). Multi-host-sharded outputs skip telemetry rather
+    than force a cross-process gather."""
+    rows = []
+    for tel, n in tel_parts:
+        k = min(int(n), TELEMETRY_SLOTS)
+        if k <= 0:
+            continue
+        if not getattr(tel, "is_fully_addressable", True):
+            return None
+        rows.append(np.asarray(jax.device_get(tel))[:k])
+    if not rows:
+        return None
+    return np.concatenate(rows, axis=0)
+
+
+def _record_sweep_telemetry(
+    sweep_rows: np.ndarray,
+    device_loop_s: Optional[float],
+    n_executed: Optional[int] = None,
+) -> None:
+    reg = _metrics.get_registry()
+    # the telemetry buffer caps at TELEMETRY_SLOTS rows per fused-loop
+    # call; the sweep counter (and the per-sweep time gauge) must count
+    # EXECUTED sweeps, not fetched rows, or a >64-sweep round undercounts
+    n = len(sweep_rows)
+    executed = n if n_executed is None else int(n_executed)
+    reg.counter(
+        "pio_train_sweeps_total", "ALS sweeps executed by the fused loop"
+    ).inc(executed)
+    h = reg.histogram(
+        "pio_train_sweep_factor_delta",
+        "Per-sweep factor-delta RMS (the convergence proxy), by side",
+        labels=("side",),
+        buckets=_metrics.CONVERGENCE_BUCKETS,
+    )
+    g_last = reg.gauge(
+        "pio_train_last_factor_delta",
+        "Factor-delta RMS of the latest round's final sweep, by side",
+        labels=("side",),
+    )
+    for side, col in (("user", 0), ("item", 1)):
+        child = h.labels(side=side)
+        for v in sweep_rows[:, col]:
+            if np.isfinite(v):
+                child.observe(float(v))
+        last = float(sweep_rows[-1, col])
+        if np.isfinite(last):
+            g_last.labels(side=side).set(last)
+    if device_loop_s is not None and executed:
+        reg.histogram(
+            "pio_train_device_loop_seconds",
+            "Fused-device-loop wall clock per training round",
+            buckets=_metrics.LATENCY_BUCKETS_S,
+        ).observe(device_loop_s)
+        reg.gauge(
+            "pio_train_sweep_seconds",
+            "Average device seconds per sweep, latest round",
+        ).set(device_loop_s / executed)
+
+
 def _train_packed(
     user_pack,
     item_pack,
@@ -1573,6 +1714,7 @@ def _train_packed(
             compute_dtype=config.compute_dtype,
             rep_sharding=rep_sharding,
             row_sharding=row_sharding,
+            telemetry=config.sweep_telemetry,
         )
 
     if compile_wait is not None:
@@ -1592,6 +1734,7 @@ def _train_packed(
             with _device_loop_guard():
                 _fence(run_iters(X + 0, Y + 0, 0))
             timings["compile_s"] = _time.perf_counter() - t_phase
+            _record_compile("inline", timings["compile_s"])
     elif timings is not None:
         # compile outside the timed loop: a ZERO-iteration run builds the
         # same executable the real run reuses (dynamic trip count).
@@ -1601,6 +1744,7 @@ def _train_packed(
         with _device_loop_guard():
             _fence(run_iters(X + 0, Y + 0, 0))
         timings["compile_s"] = _time.perf_counter() - t_phase
+        _record_compile("inline", timings["compile_s"])
 
     from predictionio_tpu.workflow.checkpoint import StepCheckpointer
 
@@ -1656,13 +1800,16 @@ def _train_packed(
     # jax.profiler.trace — no pack/transfer/compile events mixed in
     # (bench.py --trace-loop reduces the trace to docs/ALS_LOOP_TRACE.json).
     # Covers both the single-program path and the checkpoint-chunked loop.
+    tel_parts: List[Tuple[jax.Array, int]] = []
     try:
         with _device_loop_guard(), _profiler_trace(profile_dir):
             if not ckpt.enabled:
                 # the entire loop is one device program
                 if config.iterations > start_it:
+                    n_sweeps = config.iterations - start_it
                     t_phase = _time.perf_counter()
-                    X, Y = run_iters(X, Y, config.iterations - start_it)
+                    X, Y, tel = run_iters(X, Y, n_sweeps)
+                    tel_parts.append((tel, n_sweeps))
                     if timings is not None or profile_dir is not None:
                         _fence((X, Y))
                     if timings is not None:
@@ -1677,7 +1824,8 @@ def _train_packed(
                 while it < config.iterations:
                     chunk = min(checkpoint_every, config.iterations - it)
                     t_phase = _time.perf_counter()
-                    X, Y = run_iters(X, Y, chunk)
+                    X, Y, tel = run_iters(X, Y, chunk)
+                    tel_parts.append((tel, chunk))
                     if timings is not None:
                         _fence((X, Y))
                         timings["device_loop_s"] = timings.get(
@@ -1721,6 +1869,21 @@ def _train_packed(
             X_host, Y_host = np.asarray(X_host), np.asarray(Y_host)
         else:
             X_host, Y_host = _fetch_global(X), _fetch_global(Y)
+        sweep_rows = _fetch_telemetry(tel_parts) if config.sweep_telemetry else None
+    if sweep_rows is not None and len(sweep_rows):
+        _record_sweep_telemetry(
+            sweep_rows,
+            None if timings is None else timings.get("device_loop_s"),
+            n_executed=sum(n for _, n in tel_parts),
+        )
+        if timings is not None:
+            timings["sweep_telemetry"] = [
+                {
+                    "dx": float(r[0]), "dy": float(r[1]),
+                    "x_rms": float(r[2]), "y_rms": float(r[3]),
+                }
+                for r in sweep_rows
+            ]
     # OWN the returned factors: on the CPU backend device_get is
     # zero-copy (owndata=False views over XLA-owned buffers). A model —
     # or the delta fold's warm-start seed — outlives the jax.Arrays it

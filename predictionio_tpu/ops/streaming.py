@@ -701,6 +701,17 @@ def _attribute_phases(timer, timings: dict) -> None:
     )
     if "delta_events" in timings:
         note("delta_events", timings["delta_events"])
+    # convergence telemetry from the fused loop (ops/als.py): the sweep
+    # count and the final factor-delta RMS are the round's convergence
+    # headline; the full curve stays in timings["sweep_telemetry"] and
+    # the registry histograms
+    tel = timings.get("sweep_telemetry")
+    if tel:
+        note("sweeps", len(tel))
+        note(
+            "final_factor_delta",
+            f"user={tel[-1]['dx']:.2e} item={tel[-1]['dy']:.2e}",
+        )
 
 
 def train_als_streaming(

@@ -202,22 +202,30 @@ def _train_continuous(
             break
 
     def on_round(rep) -> None:
+        # structured (trace-correlated) status, not stderr print: a
+        # continuous daemon's per-round output is operational telemetry
+        # an operator greps/joins by traceId, exactly what the JSON log
+        # format exists for (PIO_LOG_FORMAT=json)
         if rep.skipped:
-            print(
-                f"round {rep.round}: store unchanged, skipped "
-                f"({rep.wall_s:.3f}s)",
-                flush=True,
+            logger.info(
+                "round %d: store unchanged, skipped (%.3fs)",
+                rep.round, rep.wall_s,
             )
             return
-        extra = (
-            f", {rep.delta_events} delta events"
-            if rep.delta_events is not None
-            else ""
-        )
-        print(
-            f"round {rep.round}: instance {rep.instance_id} in "
-            f"{rep.wall_s:.3f}s (pack_cache={rep.pack_cache}{extra})",
-            flush=True,
+        logger.info(
+            "round %d: instance %s in %.3fs (pack_cache=%s%s%s)",
+            rep.round, rep.instance_id, rep.wall_s, rep.pack_cache,
+            (
+                f", {rep.delta_events} delta events"
+                if rep.delta_events is not None
+                else ""
+            ),
+            (
+                f", {rep.sweeps} sweeps, final delta "
+                f"{rep.final_factor_delta}"
+                if rep.sweeps is not None
+                else ""
+            ),
         )
 
     print(
@@ -475,7 +483,9 @@ def cmd_compact(args) -> int:
         else:
             results = compactor.compact_all_once()
         for app_id, r in results.items():
-            print(f"app {app_id}: {r}")
+            # structured status (not stderr print): daemon rounds are
+            # operational telemetry, joinable against traces/metrics
+            logger.info("compact app %d: %s", app_id, r)
 
     run_round()
     if args.interval > 0:
@@ -567,6 +577,34 @@ def cmd_trace(args) -> int:
         tree = format_trace(group)
         print("\n".join("  " + line for line in tree.splitlines()))
     return 0
+
+
+def cmd_top(args) -> int:
+    """Live fleet console over /metrics + /healthz + /readyz
+    (tools/top.py): one row per server URL, refreshed every --interval
+    seconds; --once prints a single frame (scripting/tests)."""
+    import signal
+    import threading
+
+    from predictionio_tpu.tools.top import run_top
+
+    stop = threading.Event()
+
+    def _request_stop(signum, frame):
+        stop.set()
+
+    if not args.once:
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            try:
+                signal.signal(sig, _request_stop)
+            except ValueError:  # not the main thread (tests)
+                break
+    return run_top(
+        args.url,
+        interval_s=args.interval,
+        iterations=1 if args.once else None,
+        stop_event=stop,
+    )
 
 
 def cmd_adminserver(args) -> int:
@@ -1019,6 +1057,25 @@ def build_parser() -> argparse.ArgumentParser:
     )
     tr.set_defaults(func=cmd_trace)
 
+    top = sub.add_parser(
+        "top",
+        help="live console over a fleet's /metrics + /healthz + /readyz",
+    )
+    top.add_argument(
+        "--url", action="append", required=True,
+        help="server base URL (repeatable: one row per server — event "
+        "servers, engine servers, storage gateways, any mix)",
+    )
+    top.add_argument(
+        "--interval", type=float, default=2.0,
+        help="seconds between refreshes (default 2)",
+    )
+    top.add_argument(
+        "--once", action="store_true",
+        help="print one frame and exit (scripting)",
+    )
+    top.set_defaults(func=cmd_top)
+
     admin = sub.add_parser("adminserver", help="start the admin server")
     admin.add_argument("--ip", default="localhost")
     admin.add_argument("--port", type=int, default=7071)
@@ -1093,10 +1150,11 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def main(argv: Optional[List[str]] = None) -> int:
-    logging.basicConfig(
-        level=logging.INFO,
-        format="[%(levelname)s] [%(name)s] %(message)s",
-    )
+    # structured logging (utils/logging.py): text by default, JSON
+    # lines with trace/span correlation under PIO_LOG_FORMAT=json
+    from predictionio_tpu.utils.logging import setup_logging
+
+    setup_logging(level=logging.INFO)
     args = build_parser().parse_args(argv)
     try:
         return args.func(args)

@@ -1,0 +1,244 @@
+"""``pio top``: a live operator console over /metrics + /healthz + /readyz.
+
+One screen per refresh for a FLEET of servers (event servers, engine
+servers, storage gateways — any mix of URLs): liveness, readiness,
+request/ingest rates (counter deltas between scrapes), serving latency
+quantiles reconstructed from the exposition's cumulative histogram
+buckets (the same ``quantile_from_buckets`` estimator status.json
+uses), event-loop lag, HTTP error and continuous-training round
+counters. Everything is derived from the three public endpoints — the
+console holds no privileged access and works against any worker of an
+SO_REUSEPORT fleet.
+
+The refresh loop is shutdown-aware (stop-event idiom, the while-True
+lint's sanctioned shape) and degrades per-server: an unreachable URL
+renders as ``down`` instead of killing the console.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+import urllib.error
+import urllib.request
+from typing import Dict, List, Optional
+
+from predictionio_tpu.utils.metrics import (
+    parse_exposition,
+    quantile_from_buckets,
+)
+
+_LE_RE = re.compile(r'le="([^"]+)"')
+
+
+def _family_name(sample_key: str) -> str:
+    return sample_key.split("{", 1)[0]
+
+
+def counter_sum(samples: Dict[str, float], family: str) -> float:
+    """Sum a counter family across its label sets."""
+    total = 0.0
+    for key, value in samples.items():
+        if _family_name(key) == family:
+            total += value
+    return total
+
+
+def gauge_max(samples: Dict[str, float], family: str) -> Optional[float]:
+    vals = [v for k, v in samples.items() if _family_name(k) == family]
+    return max(vals) if vals else None
+
+
+def histogram_quantile(
+    samples: Dict[str, float], family: str, q: float
+) -> Optional[float]:
+    """Quantile from the exposition's cumulative ``_bucket`` samples,
+    summed across label sets (bounds are fixed per family, so cumulative
+    vectors add — the SO_REUSEPORT merge property)."""
+    by_le: Dict[float, float] = {}
+    for key, value in samples.items():
+        if _family_name(key) != f"{family}_bucket":
+            continue
+        m = _LE_RE.search(key)
+        if not m:
+            continue
+        le = m.group(1)
+        bound = float("inf") if le == "+Inf" else float(le)
+        by_le[bound] = by_le.get(bound, 0.0) + value
+    if not by_le:
+        return None
+    bounds = sorted(b for b in by_le if b != float("inf"))
+    cum = [by_le[b] for b in bounds] + [by_le.get(float("inf"), 0.0)]
+    counts = [int(c - (cum[i - 1] if i else 0.0)) for i, c in enumerate(cum)]
+    if sum(counts) <= 0:
+        return None
+    return quantile_from_buckets(bounds, counts, q)
+
+
+def fetch_server(base_url: str, timeout: float = 5.0) -> dict:
+    """One snapshot of a server's health + readiness + metrics. Network
+    failures degrade to ``{"up": False}`` — the console must keep
+    rendering a fleet with a dead member."""
+    base = base_url.rstrip("/")
+    out: dict = {"url": base_url, "up": False, "ready": None}
+    try:
+        with urllib.request.urlopen(base + "/healthz", timeout=timeout) as r:
+            out["up"] = r.status == 200
+            out["health"] = json.loads(r.read().decode("utf-8"))
+    except Exception as e:
+        out["error"] = str(e)
+        return out
+    try:
+        req = urllib.request.Request(base + "/readyz")
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            out["ready"] = r.status == 200
+            out["ready_detail"] = json.loads(r.read().decode("utf-8"))
+    except urllib.error.HTTPError as e:  # 503 carries the detail payload
+        out["ready"] = False
+        try:
+            out["ready_detail"] = json.loads(e.read().decode("utf-8"))
+        except Exception:
+            pass
+    except Exception:
+        pass
+    try:
+        with urllib.request.urlopen(base + "/metrics", timeout=timeout) as r:
+            out["metrics"] = parse_exposition(r.read().decode("utf-8"))
+    except Exception:
+        out["metrics"] = {}
+    return out
+
+
+_WORK_COUNTERS = (
+    # "work done" counters per server kind; the rate column sums them
+    "pio_serving_requests_total",
+    "pio_events_ingested_total",
+    "pio_gateway_rpc_total",
+)
+
+
+def _row(snap: dict, prev: Optional[dict], elapsed_s: float) -> dict:
+    if not snap.get("up"):
+        return {"url": snap["url"], "live": "DOWN", "ready": "-"}
+    m = snap.get("metrics", {})
+    row: dict = {
+        "url": snap["url"],
+        "live": "ok",
+        "ready": (
+            "ok" if snap.get("ready")
+            else ("503" if snap.get("ready") is False else "-")
+        ),
+        "uptime_s": snap.get("health", {}).get("uptimeSec"),
+    }
+    work = sum(counter_sum(m, c) for c in _WORK_COUNTERS)
+    if prev is not None and prev.get("up") and elapsed_s > 0:
+        pm = prev.get("metrics", {})
+        prev_work = sum(counter_sum(pm, c) for c in _WORK_COUNTERS)
+        row["rate"] = max(0.0, (work - prev_work) / elapsed_s)
+    row["requests"] = int(work)
+    p50 = histogram_quantile(m, "pio_serving_latency_seconds", 0.5)
+    p99 = histogram_quantile(m, "pio_serving_latency_seconds", 0.99)
+    if p50 is not None:
+        row["p50_ms"], row["p99_ms"] = p50 * 1e3, (p99 or 0.0) * 1e3
+    lag = gauge_max(m, "pio_eventloop_lag_seconds")
+    if lag is not None:
+        row["lag_ms"] = lag * 1e3
+    errors = counter_sum(m, "pio_http_errors_total")
+    if errors:
+        row["errors"] = int(errors)
+    rounds = counter_sum(m, "pio_continuous_rounds_total")
+    if rounds:
+        row["rounds"] = int(rounds)
+    delta = gauge_max(m, "pio_train_last_factor_delta")
+    if delta is not None:
+        row["last_delta"] = delta
+    stalled = snap.get("ready_detail", {}).get("stalledDaemons") or {}
+    if stalled:
+        row["stalled"] = ",".join(sorted(stalled))
+    return row
+
+
+_COLUMNS = (
+    ("url", "SERVER", 28),
+    ("live", "LIVE", 5),
+    ("ready", "READY", 6),
+    ("rate", "REQ/S", 8),
+    ("requests", "TOTAL", 9),
+    ("p50_ms", "P50ms", 8),
+    ("p99_ms", "P99ms", 8),
+    ("lag_ms", "LAGms", 7),
+    ("errors", "ERR", 5),
+    ("rounds", "ROUNDS", 7),
+    ("last_delta", "CONV", 9),
+    ("stalled", "STALLED", 20),
+)
+
+
+def _fmt(value) -> str:
+    if value is None:
+        return "-"
+    if isinstance(value, float):
+        return f"{value:.4g}"
+    return str(value)
+
+
+def render(rows: List[dict]) -> str:
+    lines = [
+        " ".join(title.ljust(width) for _, title, width in _COLUMNS)
+    ]
+    for row in rows:
+        # pad to the column width but never truncate: a long stalled-
+        # daemon list pushes its row wide rather than hiding daemons
+        lines.append(
+            " ".join(
+                _fmt(row.get(key)).ljust(width)
+                for key, _, width in _COLUMNS
+            ).rstrip()
+        )
+    return "\n".join(lines)
+
+
+def run_top(
+    urls: List[str],
+    interval_s: float = 2.0,
+    iterations: Optional[int] = None,
+    stop_event: Optional[threading.Event] = None,
+    out=None,
+    clear: bool = True,
+) -> int:
+    """The console loop: scrape, diff against the previous scrape for
+    rates, render. ``iterations=1`` is the scriptable one-shot
+    (``pio top --once``); interactive runs clear the screen per frame
+    and stop on the event (wired to SIGINT/SIGTERM by the CLI)."""
+    import sys
+    import time
+
+    out = out if out is not None else sys.stdout
+    stop = stop_event if stop_event is not None else threading.Event()
+    prev: Dict[str, dict] = {}
+    prev_t: Optional[float] = None
+    n = 0
+    while not stop.is_set():
+        snaps = [fetch_server(u) for u in urls]
+        # rates use the MEASURED time between scrape cycles, not the
+        # nominal interval: slow scrapes (a DOWN member eating its
+        # connect timeout) must not inflate every other server's REQ/S
+        now = time.monotonic()
+        elapsed_s = (now - prev_t) if prev_t is not None else 0.0
+        prev_t = now
+        rows = [
+            _row(s, prev.get(s["url"]), elapsed_s) for s in snaps
+        ]
+        frame = render(rows)
+        if clear and iterations != 1:
+            out.write("\x1b[2J\x1b[H")
+        out.write(frame + "\n")
+        out.flush()
+        prev = {s["url"]: s for s in snaps}
+        n += 1
+        if iterations is not None and n >= iterations:
+            break
+        if stop.wait(interval_s):
+            break
+    return 0

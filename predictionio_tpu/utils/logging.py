@@ -1,0 +1,140 @@
+"""Trace-correlated structured logging.
+
+Every module in this package already logs through its own
+``logging.getLogger(__name__)``; this module supplies the HANDLER layer:
+a JSON-lines formatter (``PIO_LOG_FORMAT=json``) whose records carry the
+ambient trace/span ids from ``utils.tracing``'s contextvar, and a text
+formatter (the default) that appends ``traceId=…`` when a trace is
+ambient. Either way, a log line emitted anywhere under a traced request
+— the event server's insert path, a gateway RPC, a continuous-training
+round (every PhaseTimer mints a trace) — joins against the span dump at
+``/debug/traces.json`` on the ``traceId`` field, so "what did this
+request log" is one grep, not a timestamp correlation exercise.
+
+JSON field contract (docs/OBSERVABILITY.md):
+
+    ts       ISO-8601 UTC with milliseconds
+    level    logging level name
+    logger   dotted module logger name
+    message  rendered message
+    traceId  ambient (or record-supplied) trace id — the join key
+    spanId   ambient (or record-supplied) span id
+    exc      traceback text, when the record carries exc_info
+    + any extra= fields the call site attached (json-encodable values)
+
+Call sites never change: ``logger.info(...)`` keeps working, and a
+transport that wants an explicit id on a record passes
+``extra={"traceId": tid}`` (which wins over the ambient context —
+transport-layer errors fire outside any ``tracing.use`` block).
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import json
+import logging
+import os
+import sys
+from typing import Optional
+
+__all__ = ["JsonFormatter", "TextFormatter", "setup_logging"]
+
+# logging.LogRecord attributes that are plumbing, not payload — anything
+# ELSE on a record (extra= fields) is emitted as a JSON field
+_RESERVED = frozenset(
+    logging.LogRecord(
+        "", 0, "", 0, "", (), None
+    ).__dict__
+) | {"message", "asctime", "taskName"}
+
+
+def _ambient_ids() -> "tuple[Optional[str], Optional[str]]":
+    from predictionio_tpu.utils import tracing as _tracing
+
+    ctx = _tracing.current()
+    if ctx is None:
+        return None, None
+    return ctx.trace_id, ctx.span_id
+
+
+class JsonFormatter(logging.Formatter):
+    """One JSON object per line; trace ids from the record's ``extra``
+    fields when present, the ambient tracing contextvar otherwise."""
+
+    def format(self, record: logging.LogRecord) -> str:
+        out = {
+            "ts": _dt.datetime.fromtimestamp(
+                record.created, _dt.timezone.utc
+            ).isoformat(timespec="milliseconds"),
+            "level": record.levelname,
+            "logger": record.name,
+            "message": record.getMessage(),
+        }
+        trace_id = getattr(record, "traceId", None)
+        span_id = getattr(record, "spanId", None)
+        if trace_id is None:
+            trace_id, ambient_span = _ambient_ids()
+            if span_id is None:
+                span_id = ambient_span
+        if trace_id:
+            out["traceId"] = trace_id
+        if span_id:
+            out["spanId"] = span_id
+        for key, value in record.__dict__.items():
+            if key in _RESERVED or key in ("traceId", "spanId"):
+                continue
+            try:
+                json.dumps(value)
+            except (TypeError, ValueError):
+                value = repr(value)
+            out[key] = value
+        if record.exc_info:
+            out["exc"] = self.formatException(record.exc_info)
+        return json.dumps(out)
+
+
+class TextFormatter(logging.Formatter):
+    """The human format the CLI always printed, plus the trace join key
+    when one is ambient (or attached): ``[INFO] [pkg.mod] message
+    traceId=abc123``."""
+
+    def format(self, record: logging.LogRecord) -> str:
+        base = f"[{record.levelname}] [{record.name}] {record.getMessage()}"
+        trace_id = getattr(record, "traceId", None)
+        if trace_id is None:
+            trace_id, _ = _ambient_ids()
+        if trace_id:
+            base += f" traceId={trace_id}"
+        if record.exc_info:
+            base += "\n" + self.formatException(record.exc_info)
+        return base
+
+
+def make_formatter(fmt: Optional[str] = None) -> logging.Formatter:
+    fmt = (fmt or os.environ.get("PIO_LOG_FORMAT") or "text").lower()
+    if fmt == "json":
+        return JsonFormatter()
+    if fmt == "text":
+        return TextFormatter()
+    raise ValueError(f"PIO_LOG_FORMAT must be json|text, got {fmt!r}")
+
+
+def setup_logging(
+    level: int = logging.INFO,
+    fmt: Optional[str] = None,
+    stream=None,
+) -> logging.Handler:
+    """Install the structured handler on the root logger (CLI entry
+    points call this; library importers never do — a library must not
+    hijack its host's logging). Idempotent: a handler this function
+    installed earlier is replaced, foreign handlers are left alone."""
+    root = logging.getLogger()
+    handler = logging.StreamHandler(stream if stream is not None else sys.stderr)
+    handler.setFormatter(make_formatter(fmt))
+    handler._pio_structured = True  # type: ignore[attr-defined]
+    for h in list(root.handlers):
+        if getattr(h, "_pio_structured", False):
+            root.removeHandler(h)
+    root.addHandler(handler)
+    root.setLevel(level)
+    return handler

@@ -58,6 +58,7 @@ __all__ = [
     "LATENCY_BUCKETS_S",
     "BATCH_SIZE_BUCKETS",
     "ROW_COUNT_BUCKETS",
+    "CONVERGENCE_BUCKETS",
 ]
 
 _NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
@@ -89,6 +90,9 @@ LATENCY_BUCKETS_S = log_buckets(1e-4, 100.0)
 BATCH_SIZE_BUCKETS = log_buckets(1.0, 1024.0)
 # group-commit flush rows / sealed-row counts: 1 .. 65536, ×4
 ROW_COUNT_BUCKETS = log_buckets(1.0, 65536.0, 4.0)
+# per-sweep ALS factor-delta RMS (convergence telemetry): spans the
+# warm-start tail (~1e-6) through a cold first sweep (~1), ×2
+CONVERGENCE_BUCKETS = log_buckets(1e-6, 4.0)
 
 
 def _escape_label_value(v: str) -> str:

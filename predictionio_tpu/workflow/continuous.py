@@ -34,9 +34,15 @@ import threading
 import time
 from typing import Callable, Dict, Optional
 
+from predictionio_tpu.utils import health as _health
 from predictionio_tpu.utils import metrics as _metrics
 
 logger = logging.getLogger(__name__)
+
+# a trained round parks inside hb.busy() for the whole train; the
+# watchdog deadline must exceed any healthy round. Tests (and operators
+# with known round budgets) tighten hb.deadline_s directly.
+ROUND_DEADLINE_S = 3600.0
 
 _ROUND_OUTCOMES = ("trained", "skipped", "failed")
 
@@ -79,6 +85,10 @@ class RoundReport:
     pack_cache: Optional[str] = None  # hit/miss/fold for this round
     delta_events: Optional[int] = None
     timer_summary: str = ""
+    # convergence telemetry from the fused device loop (ops/als.py):
+    # sweep count and the final sweep's factor-delta RMS per side
+    sweeps: Optional[int] = None
+    final_factor_delta: Optional[str] = None
 
 
 def poll_fingerprint(engine_params, storage) -> Optional[tuple]:
@@ -146,6 +156,10 @@ def continuous_train(
     rounds = 0
     last_fp: Optional[tuple] = None
     trained_once = False
+    # watchdog: a round that wedges (a hung scan, a stuck device call)
+    # flips every in-process server's /readyz to 503 once it overruns
+    # the deadline — the signal the hot-swap/fleet tier routes on
+    hb = _health.heartbeat("continuous-train", deadline_s=ROUND_DEADLINE_S)
     while not stop.is_set():
         t0 = time.perf_counter()
         ctx = workflow_context(
@@ -171,10 +185,11 @@ def continuous_train(
                 instance_template, id="", start_time=now, end_time=now
             )
             try:
-                instance_id = CoreWorkflow.run_train(
-                    engine, engine_params, instance,
-                    ctx=ctx, workflow_params=workflow_params,
-                )
+                with hb.busy():
+                    instance_id = CoreWorkflow.run_train(
+                        engine, engine_params, instance,
+                        ctx=ctx, workflow_params=workflow_params,
+                    )
             except BaseException:
                 _round_counter().labels(outcome="failed").inc()
                 raise
@@ -193,14 +208,22 @@ def continuous_train(
                 pack_cache=notes.get("pack_cache"),
                 delta_events=notes.get("delta_events"),
                 timer_summary=ctx.timer.summary(),
+                sweeps=notes.get("sweeps"),
+                final_factor_delta=notes.get("final_factor_delta"),
             )
             logger.info(
-                "continuous round %d: %s in %.3fs (%s%s)",
+                "continuous round %d: %s in %.3fs (%s%s%s)",
                 report.round, instance_id, report.wall_s,
                 report.pack_cache or "n/a",
                 (
                     f", {report.delta_events} delta events"
                     if report.delta_events is not None
+                    else ""
+                ),
+                (
+                    f", {report.sweeps} sweeps, final delta "
+                    f"{report.final_factor_delta}"
+                    if report.sweeps is not None
                     else ""
                 ),
             )

@@ -146,6 +146,13 @@ class CoreWorkflow:
             )
             with profiling.trace(workflow_params.profile_dir):
                 models = engine.train(ctx, engine_params, workflow_params)
+            # resource telemetry for the round: device memory_stats()
+            # where the backend provides it, host RSS fallback — gauges
+            # the continuous loop / hot-swap operator watches between
+            # rounds (a leaking round shows here before it OOMs)
+            from predictionio_tpu.utils import health as _health
+
+            _health.record_memory_gauges()
             if ctx.timer.records:
                 logger.info("training phases:\n%s", ctx.timer.summary())
                 hidden = ctx.timer.overlapped_total()

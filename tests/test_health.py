@@ -1,0 +1,407 @@
+"""Health/readiness watchdog tests: the heartbeat registry
+(utils/health.py), /healthz + /readyz on all three servers over both
+transports, fault-injected daemon stalls degrading readiness (and
+recovering), the event-loop lag gauge, and the `pio top` console."""
+
+import io
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from predictionio_tpu.utils import health as health_mod
+from predictionio_tpu.utils import metrics as metrics_mod
+
+
+def _get(base, path, timeout=10):
+    try:
+        with urllib.request.urlopen(base + path, timeout=timeout) as r:
+            return r.status, json.loads(r.read().decode("utf-8"))
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read().decode("utf-8"))
+
+
+class TestHeartbeat:
+    def test_idle_heartbeat_never_stalls(self):
+        hb = health_mod.Heartbeat("t-idle", deadline_s=0.0)
+        time.sleep(0.01)
+        assert not hb.stalled()  # busy == 0: nothing to prove
+
+    def test_busy_past_deadline_stalls_and_recovers(self):
+        hb = health_mod.Heartbeat("t-busy", deadline_s=0.05)
+        with hb.busy():
+            assert not hb.stalled()  # just beat on entry
+            time.sleep(0.12)
+            assert hb.stalled()
+            hb.beat()  # a mid-round beat clears the stall
+            assert not hb.stalled()
+            time.sleep(0.12)
+            assert hb.stalled()
+        assert not hb.stalled()  # unit completed: recovered
+
+    def test_nested_busy_counts(self):
+        hb = health_mod.Heartbeat("t-nest", deadline_s=10.0)
+        with hb.busy(), hb.busy():
+            assert hb.status()["busy"] == 2
+        assert hb.status()["busy"] == 0
+
+    def test_registry_get_or_create_and_unregister(self):
+        a = health_mod.heartbeat("t-reg", deadline_s=1.0)
+        b = health_mod.heartbeat("t-reg", deadline_s=99.0)
+        assert a is b
+        assert a.deadline_s == 1.0  # first registration pins it
+        assert any(h.name == "t-reg" for h in health_mod.heartbeats())
+        health_mod.unregister("t-reg")
+        assert not any(h.name == "t-reg" for h in health_mod.heartbeats())
+
+    def test_readiness_reports_stalled_daemon(self):
+        hb = health_mod.heartbeat("t-stall", deadline_s=0.01)
+        try:
+            with hb.busy():
+                time.sleep(0.05)
+                ok, payload = health_mod.readiness()
+                assert not ok
+                assert "t-stall" in payload["stalledDaemons"]
+            ok, _ = health_mod.readiness()
+            assert ok
+        finally:
+            health_mod.unregister("t-stall")
+
+    def test_ttl_probe_caches_failures_and_successes(self):
+        calls = {"n": 0}
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise RuntimeError("down")
+
+        p = health_mod.TTLProbe("p", flaky, ttl_s=0.05)
+        ok1, detail = p.check()
+        assert not ok1 and "down" in detail
+        ok2, _ = p.check()  # cached failure, no second call
+        assert not ok2 and calls["n"] == 1
+        time.sleep(0.06)
+        ok3, _ = p.check()
+        assert ok3 and calls["n"] == 2
+
+    def test_liveness_is_cheap_and_ok(self):
+        out = health_mod.liveness()
+        assert out["status"] == "ok" and out["uptimeSec"] >= 0
+
+    def test_memory_gauges_record_rss(self):
+        out = health_mod.record_memory_gauges()
+        # Linux build/test boxes always have /proc
+        assert out.get("host_rss_bytes", 0) > 0
+        rendered = metrics_mod.get_registry().render()
+        assert "pio_host_rss_bytes" in rendered
+
+
+@pytest.fixture(params=["async", "threaded"])
+def transport(request):
+    return request.param
+
+
+class TestEventServerHealth:
+    def test_healthz_readyz(self, mem_storage, transport):
+        from predictionio_tpu.api.event_server import (
+            EventServer,
+            EventServerConfig,
+        )
+
+        srv = EventServer(
+            mem_storage,
+            EventServerConfig(port=0, transport=transport, compact=False),
+        ).start()
+        try:
+            base = f"http://localhost:{srv.port}"
+            status, payload = _get(base, "/healthz")
+            assert status == 200 and payload["status"] == "ok"
+            status, payload = _get(base, "/readyz")
+            assert status == 200
+            assert payload["probes"]["store"] == "ok"
+        finally:
+            srv.shutdown()
+
+
+class TestEngineServerHealth:
+    def test_healthz_readyz(self, mem_storage, transport):
+        from tests.test_engine_server import make_engine, train_instance
+        from tests import fake_engine as fe
+        from predictionio_tpu.api.engine_server import (
+            EngineServer,
+            ServerConfig,
+        )
+
+        fe.reset_counters()
+        train_instance(mem_storage)
+        srv = EngineServer(
+            make_engine(),
+            ServerConfig(port=0, transport=transport),
+            mem_storage,
+        ).start()
+        try:
+            base = f"http://localhost:{srv.port}"
+            status, payload = _get(base, "/healthz")
+            assert status == 200 and payload["status"] == "ok"
+            status, payload = _get(base, "/readyz")
+            assert status == 200
+            assert payload["probes"]["model"] == "ok"
+        finally:
+            srv.shutdown()
+
+    def test_readyz_503_without_model(self, mem_storage):
+        """An engine server whose deployed state vanished (mid-swap
+        failure) degrades readiness, not liveness."""
+        from tests.test_engine_server import make_engine, train_instance
+        from tests import fake_engine as fe
+        from predictionio_tpu.api.engine_server import (
+            DeployedEngine,
+            QueryAPI,
+            ServerConfig,
+        )
+
+        fe.reset_counters()
+        train_instance(mem_storage)
+        dep = DeployedEngine.from_storage(make_engine(), mem_storage)
+        api = QueryAPI(dep, ServerConfig(port=0, upgrade_check_interval_s=0))
+        try:
+            status, _, _ = api.handle("GET", "/readyz")
+            assert status == 200
+            api.deployed = None
+            status, payload, _ = api.handle("GET", "/readyz")
+            assert status == 503
+            assert "model" in payload["probes"]
+            status, _, _ = api.handle("GET", "/healthz")
+            assert status == 200  # liveness unaffected
+        finally:
+            api.close()
+
+
+class TestGatewayHealth:
+    def test_healthz_readyz(self, mem_storage, transport):
+        from predictionio_tpu.api.storage_gateway import (
+            StorageGatewayServer,
+        )
+
+        srv = StorageGatewayServer(
+            mem_storage, port=0, transport=transport
+        ).start()
+        try:
+            base = f"http://localhost:{srv.port}"
+            status, payload = _get(base, "/healthz")
+            assert status == 200 and payload["status"] == "ok"
+            status, payload = _get(base, "/readyz")
+            assert status == 200
+            assert payload["probes"]["store"] == "ok"
+        finally:
+            srv.shutdown()
+
+
+def _sqlite_storage(path):
+    from predictionio_tpu.data.storage import Storage
+    from predictionio_tpu.data.storage.base import AccessKey, App
+
+    storage = Storage(
+        {
+            "PIO_STORAGE_SOURCES_S_TYPE": "sqlite",
+            "PIO_STORAGE_SOURCES_S_PATH": str(path),
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "S",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "S",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "S",
+        }
+    )
+    storage.get_meta_data_apps().insert(App(id=1, name="a"))
+    storage.get_meta_data_access_keys().insert(
+        AccessKey(key="k", appid=1, events=())
+    )
+    return storage
+
+
+class TestStalledCommitterDegradesReadiness:
+    @pytest.mark.parametrize("transport", ["async", "threaded"])
+    def test_wedged_commit_flips_readyz_and_recovers(
+        self, tmp_path, monkeypatch, transport
+    ):
+        """The acceptance fault injection: a committer wedged between
+        execute and COMMIT (the commit_fault hook) goes busy-and-silent;
+        once it overruns its deadline, /readyz answers 503 naming the
+        stalled daemon — and flips back to 200 after the flush finally
+        lands. /healthz stays 200 throughout (liveness != readiness)."""
+        from predictionio_tpu.api.event_server import (
+            EventServer,
+            EventServerConfig,
+        )
+        from predictionio_tpu.data.storage.sqlite import _GroupCommitter
+
+        monkeypatch.setattr(_GroupCommitter, "HEARTBEAT_DEADLINE_S", 0.2)
+        storage = _sqlite_storage(tmp_path / "stall.db")
+        srv = EventServer(
+            storage,
+            EventServerConfig(port=0, transport=transport, compact=False),
+        ).start()
+        release = threading.Event()
+        try:
+            base = f"http://localhost:{srv.port}"
+            le = storage.get_l_events()
+            le.init(1)
+            shard = le._c.event_shards[0]
+            shard.commit_fault = lambda: release.wait(30)
+
+            body = json.dumps(
+                {
+                    "event": "rate",
+                    "entityType": "user",
+                    "entityId": "u1",
+                    "targetEntityType": "item",
+                    "targetEntityId": "i1",
+                    "properties": {"rating": 3.0},
+                }
+            ).encode("utf-8")
+
+            def post():
+                try:
+                    urllib.request.urlopen(
+                        urllib.request.Request(
+                            base + "/events.json?accessKey=k",
+                            data=body,
+                            headers={"Content-Type": "application/json"},
+                        ),
+                        timeout=60,
+                    ).read()
+                except Exception:
+                    pass
+
+            t = threading.Thread(target=post, daemon=True)
+            t.start()
+
+            status = None
+            deadline = time.time() + 15
+            while time.time() < deadline:
+                status, payload = _get(base, "/readyz")
+                if status == 503:
+                    break
+                time.sleep(0.05)
+            assert status == 503, "stalled committer never degraded readyz"
+            assert any(
+                name.startswith("sqlite-committer:")
+                for name in payload["stalledDaemons"]
+            ), payload
+            # liveness is unaffected: restart-worthy != drain-worthy
+            assert _get(base, "/healthz")[0] == 200
+        finally:
+            shard.commit_fault = None
+            release.set()
+        try:
+            t.join(timeout=15)
+            status = None
+            deadline = time.time() + 15
+            while time.time() < deadline:
+                status, _ = _get(base, "/readyz")
+                if status == 200:
+                    break
+                time.sleep(0.05)
+            assert status == 200, "readyz never recovered after the flush"
+        finally:
+            srv.shutdown()
+
+
+class TestEventLoopLagGauge:
+    def test_lag_gauge_sampled_on_async_transport(self, mem_storage):
+        from predictionio_tpu.api.aio_http import AsyncJsonHTTPServer
+        from predictionio_tpu.api.event_server import (
+            EventServer,
+            EventServerConfig,
+        )
+
+        old = AsyncJsonHTTPServer.LAG_INTERVAL_S
+        AsyncJsonHTTPServer.LAG_INTERVAL_S = 0.05
+        srv = EventServer(
+            mem_storage,
+            EventServerConfig(port=0, transport="async", compact=False),
+        ).start()
+        try:
+            deadline = time.time() + 5
+            seen = False
+            while time.time() < deadline and not seen:
+                rendered = metrics_mod.get_registry().render()
+                seen = (
+                    'pio_eventloop_lag_seconds{server="Event Server"}'
+                    in rendered
+                )
+                time.sleep(0.05)
+            assert seen, "lag gauge never sampled"
+        finally:
+            srv.shutdown()
+            AsyncJsonHTTPServer.LAG_INTERVAL_S = old
+
+
+class TestPioTop:
+    def test_run_top_renders_fleet_row(self, mem_storage):
+        from predictionio_tpu.api.event_server import (
+            EventServer,
+            EventServerConfig,
+        )
+        from predictionio_tpu.tools.top import fetch_server, run_top
+
+        srv = EventServer(
+            mem_storage, EventServerConfig(port=0, compact=False)
+        ).start()
+        try:
+            base = f"http://localhost:{srv.port}"
+            snap = fetch_server(base)
+            assert snap["up"] and snap["ready"]
+            out = io.StringIO()
+            rc = run_top([base], iterations=1, out=out, clear=False)
+            assert rc == 0
+            frame = out.getvalue()
+            assert "SERVER" in frame and "READY" in frame
+            assert base in frame and "ok" in frame
+        finally:
+            srv.shutdown()
+
+    def test_run_top_down_server_renders_down(self):
+        from predictionio_tpu.tools.top import run_top
+
+        out = io.StringIO()
+        rc = run_top(
+            ["http://127.0.0.1:1"], iterations=1, out=out, clear=False
+        )
+        assert rc == 0
+        assert "DOWN" in out.getvalue()
+
+    def test_cli_top_once(self, mem_storage, capsys):
+        from predictionio_tpu.api.event_server import (
+            EventServer,
+            EventServerConfig,
+        )
+        from predictionio_tpu.tools.cli import main
+
+        srv = EventServer(
+            mem_storage, EventServerConfig(port=0, compact=False)
+        ).start()
+        try:
+            rc = main(
+                ["top", "--once", "--url", f"http://localhost:{srv.port}"]
+            )
+            assert rc == 0
+            assert "SERVER" in capsys.readouterr().out
+        finally:
+            srv.shutdown()
+
+    def test_histogram_quantile_reconstruction(self):
+        """The console's quantile matches quantile_from_buckets over the
+        same samples, reconstructed purely from exposition text."""
+        from predictionio_tpu.tools.top import histogram_quantile
+
+        reg = metrics_mod.MetricsRegistry()
+        h = reg.histogram(
+            "t_lat_seconds", "x", buckets=metrics_mod.LATENCY_BUCKETS_S
+        )
+        for v in (0.001, 0.002, 0.004, 0.008, 0.5):
+            h.observe(v)
+        samples = metrics_mod.parse_exposition(reg.render())
+        q = histogram_quantile(samples, "t_lat_seconds", 0.5)
+        assert q == pytest.approx(h.quantile(0.5))

@@ -337,7 +337,14 @@ def test_poll_loop_allowlist_is_not_stale():
 # looks stat-like; registry instrument handles (registry.counter(...))
 # are the replacement, not a violation.
 
-_STAT_STATE_EXEMPT_FILES = ("utils/metrics.py", "utils/tracing.py")
+_STAT_STATE_EXEMPT_FILES = (
+    "utils/metrics.py",
+    "utils/tracing.py",
+    # the heartbeat/watchdog registry is the third sanctioned home for
+    # module-level observability state (process-global by design, like
+    # the metrics registry it records into)
+    "utils/health.py",
+)
 
 _STAT_NAME = re.compile(
     r"(?i)(^|_)(stats?|counts?|counters?|metrics?|hist|histogram|"
@@ -419,6 +426,67 @@ def test_module_stat_state_allowlist_is_not_stale():
     assert not stale, (
         f"module-stat-state allowlist entries no longer in the tree: "
         f"{sorted(stale)}"
+    )
+
+
+# --- print() outside the CLI tier ---
+#
+# The bug class (this round's structured-logging tentpole): ad-hoc
+# print(...) status output in library code bypasses the logging tree
+# entirely — no level, no logger name, no trace correlation, invisible
+# to PIO_LOG_FORMAT=json — and in daemons it interleaves raw on stderr
+# with the structured stream. The sanctioned idiom is the module's
+# ``logging.getLogger(__name__)`` (utils/logging.py formats it, with
+# the ambient trace id attached). Scope: the whole package EXCEPT
+# tools/ — the CLI's command OUTPUT (app listings, exported counts) is
+# its user interface and legitimately prints; its daemon-loop status
+# lines went through the logger this round.
+
+_PRINT_EXEMPT_PREFIX = "tools/"
+
+# (relative path, stripped source line) pairs reviewed as safe.
+# Shrink-only: delete entries when the code they excuse goes away.
+# Empty today — library code was already print-free.
+PRINT_ALLOWED: set = set()
+
+
+def _print_call_occurrences():
+    import ast
+
+    found = set()
+    for path in sorted(PACKAGE.rglob("*.py")):
+        rel = path.relative_to(PACKAGE).as_posix()
+        if rel.startswith(_PRINT_EXEMPT_PREFIX):
+            continue
+        source = path.read_text(encoding="utf-8")
+        lines = source.splitlines()
+        for node in ast.walk(ast.parse(source, filename=str(path))):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == "print"
+            ):
+                found.add((rel, lines[node.lineno - 1].strip()))
+    return found
+
+
+def test_no_print_outside_tools():
+    found = _print_call_occurrences()
+    new = found - PRINT_ALLOWED
+    assert not new, (
+        "print(...) in library code — status output must ride the "
+        "logging tree (logging.getLogger(__name__)) so it carries "
+        "level/logger/trace-id and respects PIO_LOG_FORMAT=json "
+        "(utils/logging.py); CLI user output belongs in tools/. "
+        f"Justify an allowlist entry otherwise: {sorted(new)}"
+    )
+
+
+def test_print_allowlist_is_not_stale():
+    found = _print_call_occurrences()
+    stale = PRINT_ALLOWED - found
+    assert not stale, (
+        f"print allowlist entries no longer in the tree: {sorted(stale)}"
     )
 
 

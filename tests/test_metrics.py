@@ -484,3 +484,279 @@ class TestEndToEndFamilies:
             assert status_json["eventsIngested"].get("single", 0) >= 3
         finally:
             server.shutdown()
+
+
+# --- trace-correlated structured logging (utils/logging.py) ---
+
+
+class TestStructuredLogging:
+    def _record(self, logger_name="pkg.mod", msg="hello", extra=None):
+        import logging
+
+        rec = logging.LogRecord(
+            logger_name, logging.INFO, __file__, 1, msg, (), None
+        )
+        if extra:
+            for k, v in extra.items():
+                setattr(rec, k, v)
+        return rec
+
+    def test_json_formatter_carries_ambient_trace(self):
+        from predictionio_tpu.utils.logging import JsonFormatter
+
+        ctx = tr.TraceContext("trace-abc", "span-1")
+        with tr.use(ctx):
+            line = JsonFormatter().format(self._record())
+        out = json.loads(line)
+        assert out["traceId"] == "trace-abc"
+        assert out["spanId"] == "span-1"
+        assert out["level"] == "INFO" and out["logger"] == "pkg.mod"
+        assert out["message"] == "hello"
+        assert out["ts"].endswith("+00:00") or out["ts"].endswith("Z")
+
+    def test_json_formatter_record_trace_wins_over_ambient(self):
+        from predictionio_tpu.utils.logging import JsonFormatter
+
+        with tr.use(tr.TraceContext("ambient", "s0")):
+            line = JsonFormatter().format(
+                self._record(extra={"traceId": "explicit"})
+            )
+        assert json.loads(line)["traceId"] == "explicit"
+
+    def test_json_formatter_includes_extra_fields_and_exc(self):
+        import logging
+
+        from predictionio_tpu.utils.logging import JsonFormatter
+
+        try:
+            raise ValueError("boom")
+        except ValueError:
+            import sys
+
+            rec = logging.LogRecord(
+                "x", logging.ERROR, __file__, 1, "failed", (),
+                sys.exc_info(),
+            )
+        rec.route = "/queries.json"
+        out = json.loads(JsonFormatter().format(rec))
+        assert out["route"] == "/queries.json"
+        assert "ValueError: boom" in out["exc"]
+        assert "traceId" not in out  # no ambient trace, none invented
+
+    def test_text_formatter_appends_trace(self):
+        from predictionio_tpu.utils.logging import TextFormatter
+
+        with tr.use(tr.TraceContext("t-xyz", "s")):
+            line = TextFormatter().format(self._record())
+        assert line == "[INFO] [pkg.mod] hello traceId=t-xyz"
+        line = TextFormatter().format(self._record())
+        assert line == "[INFO] [pkg.mod] hello"
+
+    def test_setup_logging_env_selects_json_and_is_idempotent(
+        self, monkeypatch
+    ):
+        import io
+        import logging
+
+        from predictionio_tpu.utils.logging import (
+            JsonFormatter,
+            setup_logging,
+        )
+
+        monkeypatch.setenv("PIO_LOG_FORMAT", "json")
+        root = logging.getLogger()
+        before = list(root.handlers)
+        stream = io.StringIO()
+        h1 = setup_logging(stream=stream)
+        try:
+            assert isinstance(h1.formatter, JsonFormatter)
+            h2 = setup_logging(stream=stream)  # replaces, not stacks
+            ours = [
+                h for h in root.handlers
+                if getattr(h, "_pio_structured", False)
+            ]
+            assert ours == [h2]
+            logging.getLogger("pio.test.structured").info("ping")
+            out = stream.getvalue().strip().splitlines()[-1]
+            assert json.loads(out)["message"] == "ping"
+        finally:
+            for h in list(root.handlers):
+                if getattr(h, "_pio_structured", False):
+                    root.removeHandler(h)
+            for h in before:
+                if h not in root.handlers:
+                    root.addHandler(h)
+
+    def test_bad_format_env_raises(self, monkeypatch):
+        from predictionio_tpu.utils.logging import make_formatter
+
+        monkeypatch.setenv("PIO_LOG_FORMAT", "yaml")
+        with pytest.raises(ValueError, match="json|text"):
+            make_formatter()
+
+
+# --- transport-layer HTTP error accounting (satellite: the 500s that
+# previously vanished from /metrics) ---
+
+
+class TestHttpErrorCounter:
+    def _error_count(self, server, route, status):
+        reg = m.get_registry()
+        c = reg.counter(
+            "pio_http_errors_total",
+            "HTTP error responses recorded at the transport layer",
+            labels=("server", "route", "status"),
+        )
+        return c.labels(server=server, route=route, status=str(status)).value
+
+    @pytest.mark.parametrize("transport", ["async", "threaded"])
+    def test_handler_exception_counts_and_500s(self, transport):
+        from predictionio_tpu.api.aio_http import make_http_server
+
+        def exploding(method, path, query, body, form=None):
+            raise RuntimeError("kaboom")
+
+        srv = make_http_server(
+            exploding, "localhost", 0, "ErrSrv", transport=transport
+        )
+        srv.start()
+        try:
+            before = self._error_count("ErrSrv", "/boom.json", 500)
+            conn = http.client.HTTPConnection("localhost", srv.port)
+            conn.request("GET", "/boom.json")
+            resp = conn.getresponse()
+            resp.read()
+            assert resp.status == 500
+            conn.close()
+            assert (
+                self._error_count("ErrSrv", "/boom.json", 500)
+                == before + 1
+            )
+        finally:
+            srv.shutdown()
+
+    @pytest.mark.parametrize("transport", ["async", "threaded"])
+    def test_framing_errors_count_under_framing_route(self, transport):
+        from predictionio_tpu.api.aio_http import make_http_server
+
+        def ok(method, path, query, body, form=None):
+            return 200, {}
+
+        srv = make_http_server(
+            ok, "localhost", 0, "FrameSrv", transport=transport
+        )
+        srv.start()
+        try:
+            before = self._error_count("FrameSrv", "(framing)", 413)
+            conn = http.client.HTTPConnection("localhost", srv.port)
+            conn.putrequest("POST", "/x")
+            conn.putheader("Content-Length", str(64 * 1024 * 1024))
+            conn.endheaders()
+            resp = conn.getresponse()
+            resp.read()
+            assert resp.status == 413
+            conn.close()
+            assert (
+                self._error_count("FrameSrv", "(framing)", 413)
+                == before + 1
+            )
+        finally:
+            srv.shutdown()
+
+    def test_readyz_503_is_not_counted_as_error(self):
+        from predictionio_tpu.api.http import record_http_error
+
+        before = self._error_count("X", "/readyz", 503)
+        record_http_error("X", "/readyz", 503)
+        assert self._error_count("X", "/readyz", 503) == before
+
+    def test_4xx_on_arbitrary_route_not_counted(self):
+        from predictionio_tpu.api.http import record_http_error
+
+        before = self._error_count("X", "/fuzzed", 404)
+        record_http_error("X", "/fuzzed", 404)
+        assert self._error_count("X", "/fuzzed", 404) == before
+
+
+# --- per-sweep convergence telemetry from the fused ALS loop ---
+
+
+class TestSweepTelemetry:
+    def _train(self, iterations=4, **config_kwargs):
+        import numpy as np
+
+        from predictionio_tpu.ops.als import ALSConfig, train_als
+
+        rng = np.random.default_rng(7)
+        n = 1500
+        u = rng.integers(0, 120, n)
+        i = rng.integers(0, 40, n)
+        r = (rng.integers(1, 11, n) / 2.0).astype(np.float32)
+        timings = {}
+        model = train_als(
+            u, i, r, 120, 40,
+            ALSConfig(rank=4, iterations=iterations, **config_kwargs),
+            timings=timings,
+        )
+        return model, timings
+
+    def test_per_sweep_rows_recorded_and_converging(self):
+        _, timings = self._train(iterations=5)
+        tel = timings["sweep_telemetry"]
+        assert len(tel) == 5
+        for row in tel:
+            assert set(row) == {"dx", "dy", "x_rms", "y_rms"}
+            assert row["dx"] >= 0 and row["x_rms"] > 0
+        # ALS contracts: later sweeps move the factors less
+        assert tel[-1]["dx"] < tel[0]["dx"]
+        assert tel[-1]["dy"] < tel[0]["dy"]
+
+    def test_registry_families_populated(self):
+        reg = m.get_registry()
+        sweeps = reg.counter(
+            "pio_train_sweeps_total",
+            "ALS sweeps executed by the fused loop",
+        )
+        before = sweeps.value
+        self._train(iterations=3)
+        assert sweeps.value == before + 3
+        text = reg.render()
+        assert "pio_train_sweep_factor_delta_bucket" in text
+        assert 'pio_train_last_factor_delta{side="user"}' in text
+        assert "pio_train_sweep_seconds" in text
+        assert "pio_als_compile_total" in text
+
+    def test_telemetry_off_is_supported(self):
+        _, timings = self._train(iterations=3, sweep_telemetry=False)
+        assert "sweep_telemetry" not in timings
+
+    def test_factor_parity_with_and_without_telemetry(self):
+        """The telemetry writes must not perturb the training math: same
+        seed, same data, factors match to float tolerance across the two
+        executables."""
+        import numpy as np
+
+        m_on, _ = self._train(iterations=3)
+        m_off, _ = self._train(iterations=3, sweep_telemetry=False)
+        np.testing.assert_allclose(
+            m_on.user_factors, m_off.user_factors, rtol=2e-5, atol=2e-6
+        )
+
+    def test_checkpointed_chunks_concatenate_telemetry(self, tmp_path):
+        import numpy as np
+
+        from predictionio_tpu.ops.als import ALSConfig, train_als
+
+        rng = np.random.default_rng(8)
+        n = 800
+        u = rng.integers(0, 80, n)
+        i = rng.integers(0, 30, n)
+        r = (rng.integers(1, 11, n) / 2.0).astype(np.float32)
+        timings = {}
+        train_als(
+            u, i, r, 80, 30, ALSConfig(rank=4, iterations=5),
+            checkpoint_dir=str(tmp_path / "ck"), checkpoint_every=2,
+            timings=timings,
+        )
+        # chunks of 2+2+1 sweeps still yield one 5-row curve
+        assert len(timings["sweep_telemetry"]) == 5
