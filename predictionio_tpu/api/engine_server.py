@@ -121,6 +121,16 @@ class ServerConfig:
     # feedbackQueueDropped) — a down event server must not grow the
     # queue without bound
     feedback_queue_max: int = 4096
+    # bind with SO_REUSEPORT so several engine-server PROCESSES share
+    # one port (the `pio deploy --workers` fleet; the kernel balances
+    # accepted connections across workers)
+    reuse_port: bool = False
+    # comma-separated jax device indices this server's prepared serving
+    # state pins to (e.g. "0" for one chip per SO_REUSEPORT worker,
+    # "0,1" for a 2-device mesh slice). None = the full default mesh.
+    # The pinned mesh is what prepare_serving row-shards the resident
+    # item factors over (ops/retrieval.py).
+    serving_devices: Optional[str] = None
 
     def __post_init__(self):
         if self.feedback and not self.access_key:
@@ -133,6 +143,25 @@ class ServerConfig:
                 f"unknown transport {self.transport!r} "
                 f"(expected one of {TRANSPORTS})"
             )
+
+
+def _mesh_from_device_spec(spec: str):
+    """A 1-D data mesh over the named jax device indices ("0" or
+    "0,2,3"): each `pio deploy --workers` worker pins its prepared
+    serving state to its own device or mesh slice."""
+    import jax
+
+    from predictionio_tpu.parallel.mesh import make_mesh
+
+    idxs = [int(p) for p in str(spec).split(",") if p.strip() != ""]
+    devs = jax.devices()
+    bad = [i for i in idxs if not 0 <= i < len(devs)]
+    if not idxs or bad:
+        raise ValueError(
+            f"serving_devices {spec!r} names invalid device indices "
+            f"{bad} (have {len(devs)} devices)"
+        )
+    return make_mesh({"data": len(idxs)}, [devs[i] for i in idxs])
 
 
 class DeployedEngine:
@@ -1020,9 +1049,23 @@ class EngineServer:
         self.engine = engine
         self.config = config or ServerConfig()
         self.storage = storage or get_storage()
+        # deploy-time serving context: pins the prepared serving state
+        # (resident sharded factors) to this worker's device slice, and
+        # is REUSED by /reload so a hot model swap re-uploads onto the
+        # same devices
+        self._serving_ctx: Optional[WorkflowContext] = None
+        if self.config.serving_devices:
+            self._serving_ctx = WorkflowContext(
+                mode="Serving",
+                storage=self.storage,
+                mesh=_mesh_from_device_spec(self.config.serving_devices),
+            )
         if deployed is None:
             deployed = DeployedEngine.from_storage(
-                engine, self.storage, self.config.engine_instance_id
+                engine,
+                self.storage,
+                self.config.engine_instance_id,
+                ctx=self._serving_ctx,
             )
         self.api = QueryAPI(
             deployed,
@@ -1047,6 +1090,7 @@ class EngineServer:
         )
         self._http = make_http_server(
             fn, self.config.ip, self.config.port, "Engine Server",
+            reuse_port=self.config.reuse_port,
             transport=self.config.transport,
         )
 
@@ -1077,6 +1121,7 @@ class EngineServer:
                 engine_id=current.engine_id,
                 engine_version=current.engine_version,
                 engine_variant=current.engine_variant,
+                ctx=self._serving_ctx,
             )
             self.api.deployed = fresh
             logger.info(

@@ -34,9 +34,15 @@ from predictionio_tpu.controller import (
 )
 from predictionio_tpu.controller.engine import Engine
 from predictionio_tpu.data.bimap import BiMap
+from predictionio_tpu.data.constraints import (
+    ConstraintCache,
+    read_constraint_items,
+)
 from predictionio_tpu.data.store import LEventStore, PEventStore
+from predictionio_tpu.ops import retrieval
 from predictionio_tpu.ops.als import ALSConfig, train_als
-from predictionio_tpu.ops.similarity import SimilarityScorer
+from predictionio_tpu.ops.retrieval import ItemRetriever
+from predictionio_tpu.ops.similarity import SimilarityScorer, normalize_rows
 
 logger = logging.getLogger(__name__)
 
@@ -175,6 +181,17 @@ class ECommAlgorithmParams(Params):
     num_iterations: int = 20
     lambda_: float = 0.01
     seed: Optional[int] = None
+    # serving-time TTL of the unavailableItems constraint cache
+    # (data/constraints.py): past this age a query batch serves the
+    # cached set and kicks an out-of-band refresh — the store is never
+    # on the hot path. Training-time predicts (no prepare_serving) keep
+    # the reference's read-per-predict semantics.
+    constraint_ttl_s: float = 5.0
+    # deploy-time warm-up coverage for the retrieval executables: keep
+    # warm_max_batch >= the server's --max-batch, or the first saturated
+    # micro-batch pays its compile on live traffic (docs/PERF.md)
+    warm_num: int = 16
+    warm_max_batch: int = 128
 
 
 @dataclasses.dataclass
@@ -195,17 +212,54 @@ class ECommModel:
     _serving_mesh: Optional[object] = dataclasses.field(
         default=None, repr=False, compare=False
     )
+    # sharded on-device retrieval state (ops/retrieval.py), built by
+    # prepare_serving: mesh-resident item factors + candidacy masks.
+    # Device state; never pickled — a hot reload rebuilds it.
+    _retriever: Optional[ItemRetriever] = dataclasses.field(
+        default=None, repr=False, compare=False
+    )
+    _constraints: Optional[ConstraintCache] = dataclasses.field(
+        default=None, repr=False, compare=False
+    )
+    _normed_host: Optional[np.ndarray] = dataclasses.field(
+        default=None, repr=False, compare=False
+    )
+    _cat_items: Optional[Dict[str, np.ndarray]] = dataclasses.field(
+        default=None, repr=False, compare=False
+    )
 
     def __getstate__(self):
         state = self.__dict__.copy()
         state["_scorer"] = None
         state["_inv_item"] = None
         state["_serving_mesh"] = None
+        state["_retriever"] = None
+        state["_constraints"] = None
+        state["_normed_host"] = None
+        state["_cat_items"] = None
         return state
 
     def attach_serving_mesh(self, mesh) -> None:
         self._serving_mesh = mesh
         self._scorer = None
+
+    @property
+    def normed_host(self) -> np.ndarray:
+        """Host L2-normalized factors for building cosine query vectors
+        (the retrieval path never ships the normalized CATALOG to device
+        — the retriever folds norms into the resident state)."""
+        if self._normed_host is None:
+            self._normed_host = normalize_rows(self.item_factors)
+        return self._normed_host
+
+    def category_items(self, categories) -> np.ndarray:
+        """Dense indices of items carrying at least one of the given
+        categories (the host category loop of `_candidate_mask`, turned
+        into a precomputed inverted index consumed as an on-device
+        inclusion list)."""
+        if self._cat_items is None:
+            self._cat_items = retrieval.build_category_index(self.items)
+        return retrieval.category_candidates(self._cat_items, categories)
 
     @property
     def scorer(self) -> SimilarityScorer:
@@ -294,23 +348,16 @@ class ECommAlgorithm(BaseAlgorithm):
 
     def _unavailable_items(self) -> Set[str]:
         """Latest $set on the 'constraint'/'unavailableItems' entity
-        (reference :considers the single latest event)."""
+        (reference considers the single latest event). Training-time
+        path: one inline store read per predict/batch, exactly the
+        reference semantics. The SERVING path never calls this — the
+        prepared serving state holds a ConstraintCache whose TTL'd
+        background refresh feeds the on-device mask instead."""
         try:
-            events = list(
-                LEventStore().find_by_entity(
-                    app_name=self.params.app_name,
-                    entity_type="constraint",
-                    entity_id="unavailableItems",
-                    event_names=["$set"],
-                    limit=1,
-                    latest=True,
-                )
-            )
-            if events:
-                return set(events[0].properties.get_or_else("items", []))
+            return set(read_constraint_items(self.params.app_name))
         except Exception as e:
             logger.error("Error when reading unavailableItems: %s", e)
-        return set()
+            return set()
 
     def _candidate_mask(
         self, model: ECommModel, query: Query, black_list: Set[str]
@@ -337,19 +384,60 @@ class ECommAlgorithm(BaseAlgorithm):
         return mask
 
     def prepare_serving(self, ctx, model: ECommModel) -> ECommModel:
-        """Row-shard the candidate matrix over the workflow mesh at
-        deploy (see SimilarityScorer's mesh mode)."""
-        if ctx is not None:
-            model.attach_serving_mesh(ctx.mesh)
+        """Build the prepared serving state (registered with the engine
+        server's DeployedEngine, so the upload happens ONCE at deploy,
+        not per batch): item factors resident on device — row-sharded
+        over the workflow mesh when it has >1 device — plus the
+        unavailableItems constraint as a resident on-device candidacy
+        mask, kept fresh by the TTL'd out-of-band refresh of a
+        ConstraintCache. Replaces the host post-filter for every served
+        query."""
+        mesh = ctx.mesh if ctx is not None else None
+        if mesh is not None:
+            model.attach_serving_mesh(mesh)
+        retriever = ItemRetriever(
+            model.item_factors, mesh=mesh, component="ecommerce"
+        )
+        cache = ConstraintCache(
+            self.params.app_name, ttl_s=self.params.constraint_ttl_s
+        )
+
+        def apply_mask(items) -> None:
+            retriever.set_excluded_ids(
+                np.asarray(
+                    [
+                        model.item_index[i]
+                        for i in items
+                        if i in model.item_index
+                    ],
+                    np.int64,
+                )
+            )
+
+        apply_mask(cache.get())  # deploy-time prime (inline read is fine here)
+        cache.on_change(apply_mask)
+        model._retriever = retriever
+        model._constraints = cache
         return model
 
     def warm(self, model: ECommModel) -> None:
-        """Pre-compile the unknown-user similar-items path's cosine-sum
-        executables (the known-user path is a host matmul; see
-        BaseAlgorithm.warm)."""
-        model.scorer.warm(max_q=16)
+        """Pre-compile the serving executables (see BaseAlgorithm.warm):
+        the fused retrieval programs for the prepared state (raw-dot for
+        known users, cosine for the similar-items fallback), or the
+        legacy cosine-sum path when serving was not prepared."""
+        if model._retriever is not None:
+            p = self.params
+            model._retriever.warm(
+                n=p.warm_num, max_batch=p.warm_max_batch,
+                flag_combos=((True, False), (True, True)),
+            )
+        else:
+            model.scorer.warm(max_q=16)
 
     def predict(self, model: ECommModel, query: Query) -> PredictedResult:
+        if model._retriever is not None:
+            [(_, result)] = self._batch_predict_device(model, [(0, query)])
+            return result
         return self._predict_one(model, query, self._unavailable_items())
 
     def _predict_one(
@@ -366,11 +454,13 @@ class ECommAlgorithm(BaseAlgorithm):
                 return PredictedResult()
         return self._finish(model, query, scores, unavailable)
 
-    def _similar_to_recent(
+    def _recent_item_idx(
         self, model: ECommModel, query: Query
-    ) -> Optional[np.ndarray]:
-        """Unknown user: cosine-sum against the 10 most recent similar-event
-        items (reference predictNewUser)."""
+    ) -> Optional[List[int]]:
+        """Dense indices of the user's 10 most recent similar-event
+        items (reference predictNewUser's recent-items rule) — the ONE
+        place that rule lives; both the host cosine-sum path and the
+        device retrieval path score against these rows."""
         try:
             recent = list(
                 LEventStore().find_by_entity(
@@ -391,14 +481,26 @@ class ECommAlgorithm(BaseAlgorithm):
             for e in recent
             if e.target_entity_id in model.item_index
         ]
-        if not recent_idx:
+        return recent_idx or None
+
+    def _similar_to_recent(
+        self, model: ECommModel, query: Query
+    ) -> Optional[np.ndarray]:
+        """Unknown user: cosine-sum against the 10 most recent similar-event
+        items (reference predictNewUser)."""
+        recent_idx = self._recent_item_idx(model, query)
+        if recent_idx is None:
             return None
         return model.scorer.cosine_sum(model.scorer.normed[recent_idx])
 
     def batch_predict(self, model, queries) -> List[Tuple[int, PredictedResult]]:
         """Known users score as ONE [B, k] x [k, n_items] matmul; unknown
         users fall back to the per-query similar-items path. The
-        query-independent unavailableItems constraint reads once per batch."""
+        query-independent unavailableItems constraint reads once per batch.
+        With a prepared serving state the whole batch routes through the
+        sharded on-device retrieval path instead."""
+        if model._retriever is not None:
+            return self._batch_predict_device(model, queries)
         unavailable = self._unavailable_items()
         known = [
             (qi, model.user_index[q.user])
@@ -421,6 +523,112 @@ class ECommAlgorithm(BaseAlgorithm):
             else:
                 out.append((qi, self._predict_one(model, q, unavailable)))
         return out
+
+    # --- the sharded on-device retrieval path (prepared serving state) ---
+
+    def _batch_predict_device(
+        self, model: ECommModel, queries
+    ) -> List[Tuple[int, PredictedResult]]:
+        """The round-12 serving hot path: one fused score+mask+top_k
+        batch per scoring mode, exact-parity with the host `_finish`
+        path. Known users score raw dot products against the resident
+        factors; unknown users ride the same kernel in cosine mode with
+        a summed-normalized-recents query vector. The unavailableItems
+        set never reads the store here — `cache.get()` is the TTL tick
+        that drives the out-of-band mask refresh."""
+        model._constraints.get()
+        known_meta, known_rows = [], []
+        cos_meta, cos_rows = [], []
+        out: List[Tuple[int, PredictedResult]] = []
+        for qi, q in queries:
+            user_idx = model.user_index.get(q.user)
+            if user_idx is not None and np.any(
+                model.user_factors[user_idx]
+            ):
+                known_meta.append((qi, q))
+                known_rows.append(model.user_factors[user_idx])
+                continue
+            logger.info("no userFeature found for user %s", q.user)
+            qvec = self._recent_query_vector(model, q)
+            if qvec is None:
+                out.append((qi, PredictedResult()))
+            else:
+                cos_meta.append((qi, q))
+                cos_rows.append(qvec)
+        out += self._retrieve_group(
+            model, known_meta, known_rows, normalize=False
+        )
+        out += self._retrieve_group(
+            model, cos_meta, cos_rows, normalize=True
+        )
+        return out
+
+    def _recent_query_vector(
+        self, model: ECommModel, query: Query
+    ) -> Optional[np.ndarray]:
+        """Unknown-user cosine query vector: the sum of the normalized
+        factor rows of the 10 most recent similar-event items — the same
+        value `_similar_to_recent`'s cosine_sum scores against, folded
+        to one [k] row so it batches with other queries."""
+        recent_idx = self._recent_item_idx(model, query)
+        if recent_idx is None:
+            return None
+        return model.normed_host[recent_idx].sum(axis=0)
+
+    def _exclude_for(self, model: ECommModel, query: Query) -> np.ndarray:
+        """Per-query exclusion indices: query blackList + (unseen_only)
+        the user's seen items. The unavailableItems set is NOT here — it
+        is the resident global mask."""
+        black = set(query.black_list or ())
+        black |= self._seen_items(query)
+        return np.asarray(
+            [model.item_index[i] for i in black if i in model.item_index],
+            np.int64,
+        )
+
+    def _include_for(
+        self, model: ECommModel, query: Query
+    ) -> Optional[np.ndarray]:
+        """Per-query inclusion indices (None = unrestricted; empty =
+        NO candidates): whiteList ∩ category index."""
+        return retrieval.include_candidates(
+            model.item_index, query.white_list, query.categories,
+            model.category_items,
+        )
+
+    def _retrieve_group(
+        self, model: ECommModel, meta, rows, *, normalize: bool
+    ) -> List[Tuple[int, PredictedResult]]:
+        if not meta:
+            return []
+        retriever = model._retriever
+        n_req = retrieval.pow2_topk_width(
+            max(q.num for _, q in meta), retriever.n_items
+        )
+        scores, idx = retriever.topn(
+            np.stack(rows).astype(np.float32),
+            n_req,
+            exclude=[self._exclude_for(model, q) for _, q in meta],
+            include=[self._include_for(model, q) for _, q in meta],
+            positive_only=True,
+            normalize=normalize,
+        )
+        inv_item = model.inv_item
+        trimmed = retrieval.trimmed_results(
+            scores, idx, [q.num for _, q in meta]
+        )
+        return [
+            (
+                qi,
+                PredictedResult(
+                    item_scores=tuple(
+                        ItemScore(item=inv_item[int(i)], score=float(s))
+                        for i, s in zip(ids, ss)
+                    )
+                ),
+            )
+            for (qi, _), (ids, ss) in zip(meta, trimmed)
+        ]
 
     def _finish(
         self,

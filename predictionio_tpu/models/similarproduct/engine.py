@@ -34,7 +34,9 @@ from predictionio_tpu.controller import (
 from predictionio_tpu.controller.engine import Engine
 from predictionio_tpu.data.bimap import BiMap
 from predictionio_tpu.data.store import PEventStore
+from predictionio_tpu.ops import retrieval
 from predictionio_tpu.ops.als import ALSConfig, train_als
+from predictionio_tpu.ops.retrieval import ItemRetriever
 from predictionio_tpu.ops.similarity import SimilarityScorer, normalize_rows
 
 logger = logging.getLogger(__name__)
@@ -195,6 +197,11 @@ class ALSAlgorithmParams(Params):
     # cosine-sum executables for (wider queries still work but pay a
     # one-time cold compile on live traffic)
     warm_max_query_items: int = 16
+    # deploy-time warm-up coverage for the retrieval executables: keep
+    # warm_max_batch >= the server's --max-batch, or the first saturated
+    # micro-batch pays its compile on live traffic (docs/PERF.md)
+    warm_num: int = 16
+    warm_max_batch: int = 128
 
 
 @dataclasses.dataclass
@@ -216,17 +223,44 @@ class SPModel:
     _serving_mesh: Optional[object] = dataclasses.field(
         default=None, repr=False, compare=False
     )
+    # sharded on-device retrieval state (ops/retrieval.py), built by
+    # prepare_serving. Device state; never pickled.
+    _retriever: Optional[ItemRetriever] = dataclasses.field(
+        default=None, repr=False, compare=False
+    )
+    _normed_host: Optional[np.ndarray] = dataclasses.field(
+        default=None, repr=False, compare=False
+    )
+    _cat_items: Optional[Dict[str, np.ndarray]] = dataclasses.field(
+        default=None, repr=False, compare=False
+    )
 
     def __getstate__(self):
         state = self.__dict__.copy()
         state["_scorer"] = None
         state["_inv_index"] = None
         state["_serving_mesh"] = None
+        state["_retriever"] = None
+        state["_normed_host"] = None
+        state["_cat_items"] = None
         return state
 
     def attach_serving_mesh(self, mesh) -> None:
         self._serving_mesh = mesh
         self._scorer = None
+
+    @property
+    def normed_host(self) -> np.ndarray:
+        if self._normed_host is None:
+            self._normed_host = normalize_rows(self.item_factors)
+        return self._normed_host
+
+    def category_items(self, categories) -> np.ndarray:
+        """Dense indices of items carrying one of the given categories
+        (inverted index consumed as an on-device inclusion list)."""
+        if self._cat_items is None:
+            self._cat_items = retrieval.build_category_index(self.items)
+        return retrieval.category_candidates(self._cat_items, categories)
 
     @property
     def scorer(self) -> SimilarityScorer:
@@ -242,9 +276,86 @@ class SPModel:
             self._inv_index = self.item_index.inverse()
         return self._inv_index
 
+    def _retrieval_spec(self, query: Query):
+        """(query vector, exclusion idx, inclusion idx or None) for the
+        on-device retrieval path, or None when no query item has
+        factors. The query vector is the sum of the normalized query-
+        item rows — cosine_sum's math folded to one [k] row; exclusions
+        are the query items themselves plus the blackList; whiteList ∩
+        category index becomes the inclusion list."""
+        query_idx = [
+            self.item_index[i] for i in query.items if i in self.item_index
+        ]
+        if not query_idx:
+            return None
+        qvec = self.normed_host[query_idx].sum(axis=0)
+        excl = set(query_idx)
+        for i in query.black_list or ():
+            if i in self.item_index:
+                excl.add(self.item_index[i])
+        wl = retrieval.include_candidates(
+            self.item_index, query.white_list, query.categories,
+            self.category_items,
+        )
+        return qvec, np.asarray(sorted(excl), np.int64), wl
+
+    def similar_batch(self, queries) -> List[Tuple[int, PredictedResult]]:
+        """Batched on-device retrieval: every query of the micro-batch
+        rides ONE fused cosine score+mask+top_k program over the
+        resident sharded factors (requires prepare_serving)."""
+        out: List[Tuple[int, PredictedResult]] = []
+        meta, rows, excludes, includes = [], [], [], []
+        for qi, q in queries:
+            spec = self._retrieval_spec(q)
+            if spec is None:
+                logger.info("no item factors for query items %s", q.items)
+                out.append((qi, PredictedResult()))
+                continue
+            qvec, excl, incl = spec
+            meta.append((qi, q))
+            rows.append(qvec)
+            excludes.append(excl)
+            includes.append(incl)
+        if not meta:
+            return out
+        n_req = retrieval.pow2_topk_width(
+            max(q.num for _, q in meta), self._retriever.n_items
+        )
+        scores, idx = self._retriever.topn(
+            np.stack(rows).astype(np.float32),
+            n_req,
+            exclude=excludes,
+            include=includes,
+            positive_only=True,
+            normalize=True,
+        )
+        inv = self.inv_index
+        trimmed = retrieval.trimmed_results(
+            scores, idx, [q.num for _, q in meta]
+        )
+        out += [
+            (
+                qi,
+                PredictedResult(
+                    item_scores=tuple(
+                        ItemScore(item=inv[int(i)], score=float(s))
+                        for i, s in zip(ids, ss)
+                    )
+                ),
+            )
+            for (qi, _), (ids, ss) in zip(meta, trimmed)
+        ]
+        return out
+
     def similar(self, query: Query) -> PredictedResult:
         """Reference ALSAlgorithm.predict: sum-of-cosines scoring with
-        candidacy filtering and top-num selection."""
+        candidacy filtering and top-num selection. With a prepared
+        serving state the scoring+masking+selection runs fused on
+        device (similar_batch); the host path below is the
+        training-time and parity-oracle implementation."""
+        if self._retriever is not None:
+            [(_, result)] = self.similar_batch([(0, query)])
+            return result
         query_idx = [
             self.item_index[i] for i in query.items if i in self.item_index
         ]
@@ -348,18 +459,39 @@ class ALSAlgorithm(BaseAlgorithm):
     def predict(self, model: SPModel, query: Query) -> PredictedResult:
         return model.similar(query)
 
+    def batch_predict(self, model: SPModel, queries):
+        """With a prepared serving state the whole micro-batch scores as
+        ONE fused retrieval program (model.similar_batch); otherwise the
+        default per-query host path."""
+        if model._retriever is not None:
+            return model.similar_batch(queries)
+        return [(i, self.predict(model, q)) for i, q in queries]
+
     def prepare_serving(self, ctx, model: SPModel) -> SPModel:
-        """Row-shard the candidate matrix over the workflow mesh at
-        deploy (see SimilarityScorer's mesh mode)."""
-        if ctx is not None:
-            model.attach_serving_mesh(ctx.mesh)
+        """Build the prepared serving state: item factors resident on
+        device, row-sharded over the workflow mesh when it has >1
+        device (ops/retrieval.py) — candidacy rules apply as on-device
+        masks instead of a host post-filter."""
+        mesh = ctx.mesh if ctx is not None else None
+        if mesh is not None:
+            model.attach_serving_mesh(mesh)
+        model._retriever = ItemRetriever(
+            model.item_factors, mesh=mesh, component="similarproduct"
+        )
         return model
 
     def warm(self, model: SPModel) -> None:
-        """Compile the cosine-sum executables for every padded query-item
-        width up to warm_max_query_items before taking traffic (see
-        BaseAlgorithm.warm)."""
-        model.scorer.warm(max_q=self.params.warm_max_query_items)
+        """Compile the serving executables before taking traffic (see
+        BaseAlgorithm.warm): the fused cosine retrieval programs for a
+        prepared state, the cosine-sum path otherwise."""
+        if model._retriever is not None:
+            model._retriever.warm(
+                n=self.params.warm_num,
+                max_batch=self.params.warm_max_batch,
+                flag_combos=((True, True),),
+            )
+        else:
+            model.scorer.warm(max_q=self.params.warm_max_query_items)
 
     def result_to_json(self, result: PredictedResult):
         return {

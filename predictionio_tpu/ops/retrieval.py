@@ -1,0 +1,668 @@
+"""Sharded on-device top-N retrieval: mesh-resident item factors, fused
+score+top-k per shard, cross-shard merge, and on-device candidacy masks.
+
+This is the ALX serving recipe (PAPERS.md, arXiv:2112.02194) applied to
+the query path: where ``ServingFactors`` (ops/als.py) REPLICATES the
+catalog on every device and data-parallelizes over query rows, this
+module ROW-SHARDS the item-factor matrix over the mesh — the layout that
+keeps scaling once the catalog outgrows a single device's HBM — and
+never materializes the full [B, N] score matrix anywhere:
+
+1. **Per-shard fused score+top-k** (``shard_map``): every device holds
+   its factor rows resident between queries, scores the whole query
+   batch against its slice with one [B, k] x [k, N/S] matmul, applies
+   the candidacy masks as ``-inf`` IN the same program, and runs
+   ``lax.top_k`` over its slice. No collective in this stage.
+2. **Cross-shard merge**: each shard contributes its top
+   ``min(n, rows_per_shard)`` candidates (score + global-id bits packed
+   in one buffer); only those B x S x n_local rows cross the
+   interconnect (sharded→replicated constraint), and one final
+   ``top_k`` over the concatenated candidates yields the EXACT global
+   top-N — every global top-n element is by construction within its own
+   shard's top-n, so the merge loses nothing. Tie-breaking matches a
+   full-matrix ``top_k`` (lowest index wins): within a shard ``top_k``
+   orders ties by local index, and the merge concatenates shards in
+   ascending-offset order.
+3. **Candidacy as on-device masks**: business rules (ecommerce's
+   unavailable/blacklist/seen sets, similarproduct's query-item
+   exclusion) stop being a host post-filter over the full score row.
+   A RESIDENT global mask (refreshed out-of-band on constraint-entity
+   change, see data/constraints.py) plus small per-query
+   inclusion/exclusion id lists travel as indices and scatter into the
+   mask on device; masked scores become ``-inf`` before ``top_k``.
+
+The single-device fallback is the SAME kernel fused into one jit
+(score + mask + top_k, one dispatch) — 1-device serving no longer
+materializes the full score row per query on host, and the parity tests
+cover both shapes. The final packed buffer rides the
+``_topn_packed``-style score+index-bits layout (and the row-sharded
+output pinning lesson of ``_topn_packed_sharded``): one fetch per batch,
+indices as raw int32 bits so ids >= 2^24 survive.
+
+Metrics (utils/metrics.py conventions, visible in ``pio top``):
+``pio_retrieval_shard_topk_seconds`` / ``pio_retrieval_merge_seconds``
+(every batch off-mesh; SAMPLED on the sharded path — the split needs a
+host sync), ``pio_retrieval_mask_refresh_total{component,outcome}``,
+``pio_retrieval_mask_age_seconds{component}``, and
+``pio_retrieval_resident_bytes{component}``.
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from predictionio_tpu.ops.similarity import pad_rows_pow2, pow2_at_least
+from predictionio_tpu.parallel.mesh import pad_to_multiple
+from predictionio_tpu.utils import metrics as _metrics
+
+logger = logging.getLogger(__name__)
+
+# how often the sharded path takes the host sync that splits shard-topk
+# vs merge timing (see ItemRetriever.topn)
+_SPLIT_SAMPLE_EVERY = 16
+
+
+def _reciprocal_norms(factors: np.ndarray) -> np.ndarray:
+    """1/||y|| per row, 0 for zero rows — multiplying raw dot scores by
+    this yields cosine-against-normalized-candidates, so ONE resident
+    factor matrix serves both raw-dot (known-user) and cosine
+    (similar-items) scoring instead of two catalog-sized copies."""
+    norms = np.linalg.norm(np.asarray(factors, np.float32), axis=1)
+    return np.where(norms > 0, 1.0 / np.where(norms == 0, 1.0, norms), 0.0).astype(
+        np.float32
+    )
+
+
+def _mask_scores(scores, allow0, excl, incl, has_incl, positive_only):
+    """Shared mask application: ``allow0`` is the resident [rows] mask,
+    ``excl``/``incl`` are per-query id lists already mapped into THIS
+    score block's index space with out-of-range values pointing past the
+    last row (``mode="drop"`` discards them — sentinel-padded slots and,
+    on a shard, ids owned by other shards). ``has_incl`` flags queries
+    with a whitelist: only their rows intersect with the scattered
+    inclusion mask."""
+    b = jnp.arange(scores.shape[0], dtype=jnp.int32)[:, None]
+    allow = jnp.broadcast_to(allow0[None, :], scores.shape)
+    allow = allow.at[b, excl].set(False, mode="drop")
+    inc = jnp.zeros(scores.shape, bool).at[b, incl].set(True, mode="drop")
+    allow = allow & (inc | ~has_incl[:, None])
+    if positive_only:
+        allow = allow & (scores > 0)
+    return jnp.where(allow, scores, -jnp.inf)
+
+
+def _pack(scores, idx):
+    # scores + raw int32 index bits in ONE buffer: one device->host fetch
+    # per batch, no float cast of ids (2^24 mantissa cliff on large
+    # catalogs) — the _topn_packed layout from ops/als.py
+    return jnp.concatenate(
+        [scores, jax.lax.bitcast_convert_type(idx, jnp.float32)], axis=1
+    )
+
+
+def unpack_topn(packed: np.ndarray, n: int) -> Tuple[np.ndarray, np.ndarray]:
+    """(scores [B, n], global item idx [B, n]) from the packed buffer."""
+    packed = np.asarray(packed)
+    return (
+        packed[:, :n],
+        np.ascontiguousarray(packed[:, n:]).view(np.int32),
+    )
+
+
+def pow2_topk_width(max_num: int, n_items: int) -> int:
+    """The top-k width to request for a batch whose largest query wants
+    ``max_num`` results: a power of two (min 16) so varying ``num``s
+    share O(log) compiled executables, clamped to the catalog."""
+    return min(max(16, pow2_at_least(max_num)), n_items)
+
+
+def trimmed_results(
+    scores: np.ndarray, idx: np.ndarray, nums: Sequence[int]
+) -> List[Tuple[np.ndarray, np.ndarray]]:
+    """Per-query ``(item idx, scores)`` pairs from a ``topn`` result,
+    trimmed to each query's ``num`` and to its live candidates (masked
+    slots carry ``-inf`` and sort to the tail, so the live rows are a
+    prefix — this is the k > live-candidate-count edge)."""
+    out = []
+    for r, num in enumerate(nums):
+        row_s, row_i = scores[r], idx[r]
+        take = min(int(num), int((row_s > -np.inf).sum()))
+        out.append((row_i[:take], row_s[:take]))
+    return out
+
+
+def build_category_index(items) -> Dict[str, np.ndarray]:
+    """items dict (dense idx -> object with ``.categories``) inverted
+    to category -> sorted dense indices: the host category loop of the
+    templates' candidate masks, precomputed once and consumed as an
+    on-device inclusion list."""
+    by_cat: Dict[str, list] = {}
+    for idx, item in items.items():
+        for c in item.categories:
+            by_cat.setdefault(c, []).append(idx)
+    return {c: np.asarray(sorted(v), np.int64) for c, v in by_cat.items()}
+
+
+def category_candidates(
+    index: Dict[str, np.ndarray], categories
+) -> np.ndarray:
+    """Union of the index rows for the given categories (empty array =
+    no item carries any of them, i.e. NO candidates)."""
+    arrs = [index[c] for c in categories if c in index]
+    if not arrs:
+        return np.zeros(0, np.int64)
+    return np.unique(np.concatenate(arrs))
+
+
+def include_candidates(
+    item_index, white_list, categories, category_items
+) -> Optional[np.ndarray]:
+    """The per-query inclusion list both templates share: the
+    ``whiteList`` mapped through the item index, intersected with the
+    category candidates (``category_items`` is the model's cached
+    inverted-index lookup). ``None`` = unrestricted; an EMPTY array =
+    NO candidates — matching the host paths' all-False whitelist
+    mask."""
+    wl: Optional[np.ndarray] = None
+    if white_list is not None:
+        wl = np.asarray(
+            [item_index[i] for i in white_list if i in item_index],
+            np.int64,
+        )
+    if categories is not None:
+        cat = category_items(categories)
+        wl = cat if wl is None else np.intersect1d(wl, cat)
+    return wl
+
+
+@functools.partial(
+    jax.jit, static_argnames=("n", "positive_only", "normalize")
+)
+def _fused_topn_single(
+    q, Y, rn, allow0, excl, incl, has_incl, n, positive_only, normalize
+):
+    """The single-device path as ONE program: matmul + optional cosine
+    scaling + mask scatter + top_k, no [B, N] score materialization on
+    host and no host post-filter (the pre-round-12 ecommerce predict
+    computed the full score row in numpy and masked it in Python)."""
+    scores = jnp.dot(q, Y.T, preferred_element_type=jnp.float32)
+    if normalize:
+        scores = scores * rn[None, :]
+    scores = _mask_scores(scores, allow0, excl, incl, has_incl, positive_only)
+    s, i = jax.lax.top_k(scores, n)
+    return _pack(s, i)
+
+
+def _shard_topk_kernel(
+    q, Y, rn, allow0, excl, incl, has_incl,
+    *, axis, n_local, positive_only, normalize,
+):
+    """Per-shard body (runs under shard_map): local slice views of the
+    resident arrays, replicated query block, NO collective — each shard
+    emits its own packed top-n_local candidates with GLOBAL ids."""
+    rows_l = Y.shape[0]
+    off = jax.lax.axis_index(axis).astype(jnp.int32) * rows_l
+
+    def localize(g):
+        # ids owned by other shards map to rows_l (out of range, dropped
+        # by the scatter) rather than subtracting into negative values,
+        # which .at[] would WRAP NumPy-style back into this shard
+        return jnp.where((g >= off) & (g < off + rows_l), g - off, rows_l)
+
+    scores = jnp.dot(q, Y.T, preferred_element_type=jnp.float32)
+    if normalize:
+        scores = scores * rn[None, :]
+    scores = _mask_scores(
+        scores, allow0, localize(excl), localize(incl), has_incl,
+        positive_only,
+    )
+    s, i = jax.lax.top_k(scores, n_local)
+    return _pack(s, i + off)
+
+
+@functools.partial(jax.jit, static_argnames=("n", "n_local", "rep_s"))
+def _merge_candidates(packed, n, n_local, rep_s):
+    """Cross-shard merge: the ONLY sharded→replicated hop, and it moves
+    just the B x S x n_local candidate rows (scores + id bits), never
+    the score matrix. One final top_k over the concatenation is exact
+    (each shard already surfaced every global-top-n element it owns).
+    ``rep_s`` pins the output replicated the same way
+    ``_topn_packed_sharded`` pins its output row-sharded: as a hashable
+    static, so XLA's propagation cannot choose a different layout on
+    some backend/core-count combination."""
+    x = jax.lax.with_sharding_constraint(packed, rep_s)
+    B = x.shape[0]
+    S = x.shape[1] // (2 * n_local)
+    x = x.reshape(B, S, 2, n_local)
+    s_cand = x[:, :, 0, :].reshape(B, S * n_local)
+    i_cand = jax.lax.bitcast_convert_type(
+        x[:, :, 1, :], jnp.int32
+    ).reshape(B, S * n_local)
+    s, j = jax.lax.top_k(s_cand, n)
+    return _pack(s, jnp.take_along_axis(i_cand, j, axis=1))
+
+
+# --- metric families (get-or-create per call: dict lookups at batch
+# granularity, following the utils/metrics conventions) ---
+
+
+def _m_shard_seconds():
+    return _metrics.get_registry().histogram(
+        "pio_retrieval_shard_topk_seconds",
+        "Device time of the fused per-shard score+mask+top_k stage "
+        "(single-device: the whole fused retrieval program, every "
+        "batch; sharded: sampled batches only — the split needs a "
+        "host sync)",
+        buckets=_metrics.LATENCY_BUCKETS_S,
+    )
+
+
+def _m_merge_seconds():
+    return _metrics.get_registry().histogram(
+        "pio_retrieval_merge_seconds",
+        "Time of the cross-shard candidate merge (the "
+        "sharded->replicated hop + final top_k + result fetch; "
+        "sampled batches only)",
+        buckets=_metrics.LATENCY_BUCKETS_S,
+    )
+
+
+def _m_mask_refresh():
+    return _metrics.get_registry().counter(
+        "pio_retrieval_mask_refresh_total",
+        "Resident candidacy-mask refreshes by outcome "
+        "(refreshed=rebuilt+uploaded, unchanged=skipped)",
+        labels=("component", "outcome"),
+    )
+
+
+def _m_mask_age():
+    return _metrics.get_registry().gauge(
+        "pio_retrieval_mask_age_seconds",
+        "Seconds since the resident candidacy mask was last refreshed",
+        labels=("component",),
+    )
+
+
+def _m_resident_bytes():
+    return _metrics.get_registry().gauge(
+        "pio_retrieval_resident_bytes",
+        "Bytes of retrieval state resident on device (factors + norms "
+        "+ mask)",
+        labels=("component",),
+    )
+
+
+class ItemRetriever:
+    """Device-resident top-N retrieval over one item-factor matrix.
+
+    Upload-once semantics: construct at ``prepare_serving`` (the engine
+    server's prepared-serving state owns the instance), after which each
+    query batch ships only [B, k] query rows plus the small per-query
+    id lists up, and one packed [B, 2n] buffer down.
+
+    With a ``mesh`` the factor rows (and the norm/mask vectors) shard
+    over ``axis`` and stay resident between queries; without one (or on
+    a 1-device mesh) everything lives on ``device`` (default backend
+    device) and retrieval is the fused single-program path. Rows are
+    zero-padded so the row count divides the shard count; padding rows
+    are permanently masked out.
+    """
+
+    def __init__(
+        self,
+        item_factors: np.ndarray,
+        mesh: Optional[Mesh] = None,
+        axis: str = "data",
+        component: str = "retrieval",
+        device=None,
+    ):
+        if mesh is not None and mesh.shape[axis] == 1:
+            # collapse to the fused single-device path, but KEEP the
+            # mesh's device: a `pio deploy --workers` worker pinned to
+            # one device arrives here as a 1-device mesh, and dropping
+            # it would land every worker's resident factors on the
+            # process-default device 0
+            if device is None:
+                device = mesh.devices.flat[0]
+            mesh = None
+        self.mesh = mesh
+        self._axis = axis
+        self.component = component
+        factors = np.asarray(item_factors, np.float32)
+        self.n_items, self.rank = factors.shape
+        n_shards = mesh.shape[axis] if mesh is not None else 1
+        self._n_shards = n_shards
+        n_pad = pad_to_multiple(max(self.n_items, 1), n_shards)
+        self._n_pad = n_pad
+        padded = np.zeros((n_pad, self.rank), np.float32)
+        padded[: self.n_items] = factors
+        rn = np.zeros(n_pad, np.float32)
+        rn[: self.n_items] = _reciprocal_norms(factors)
+        self._valid = np.zeros(n_pad, bool)
+        self._valid[: self.n_items] = True
+        self._excluded_ids: Optional[np.ndarray] = None
+        if mesh is None:
+            self._device = device
+            put = lambda a: (
+                jax.device_put(a, device) if device is not None
+                else jax.device_put(a)
+            )
+            self._y_dev = put(padded)
+            self._rn_dev = put(rn)
+            self._allow_dev = put(self._valid)
+            self._rep_q = None
+        else:
+            self._device = None
+            self._y_dev = jax.device_put(
+                padded, NamedSharding(mesh, P(axis, None))
+            )
+            self._rn_dev = jax.device_put(rn, NamedSharding(mesh, P(axis)))
+            self._allow_dev = jax.device_put(
+                self._valid, NamedSharding(mesh, P(axis))
+            )
+            self._rep_q = NamedSharding(mesh, P())
+            self._rep_out = NamedSharding(mesh, P(None, None))
+            # per-(n_local, flags) jitted shard_map stage-1 executables
+            self._stage1_cache: Dict[tuple, object] = {}
+        self._batches = 0
+        self._mask_stamp = time.monotonic()
+        _m_mask_age().labels(component=component).set(0.0)
+        _m_resident_bytes().labels(component=component).set(
+            padded.nbytes + rn.nbytes + self._valid.nbytes
+        )
+        logger.info(
+            "ItemRetriever[%s]: %d items (rank %d) resident %s",
+            component, self.n_items, self.rank,
+            f"row-sharded over {n_shards} devices" if mesh is not None
+            else "on one device",
+        )
+
+    # --- resident global mask (the out-of-band-refreshed constraint set) ---
+
+    def set_excluded_ids(self, idx) -> bool:
+        """Replace the resident exclusion set (dense item indices, e.g.
+        the ecommerce ``unavailableItems`` constraint mapped through the
+        item index). Rebuilds and re-uploads the sharded mask only when
+        the set actually changed; returns whether it did. Called from
+        the constraint cache's background refresh thread — the swap is a
+        single reference assignment, so in-flight batches keep the mask
+        they started with."""
+        idx = np.unique(np.asarray(idx, np.int64)) if len(idx) else np.zeros(
+            0, np.int64
+        )
+        idx = idx[(idx >= 0) & (idx < self.n_items)]
+        if self._excluded_ids is not None and np.array_equal(
+            idx, self._excluded_ids
+        ):
+            _m_mask_refresh().labels(
+                component=self.component, outcome="unchanged"
+            ).inc()
+            self._touch_mask()
+            return False
+        allow = self._valid.copy()
+        allow[idx] = False
+        if self.mesh is None:
+            dev = self._device
+            self._allow_dev = (
+                jax.device_put(allow, dev) if dev is not None
+                else jax.device_put(allow)
+            )
+        else:
+            self._allow_dev = jax.device_put(
+                allow, NamedSharding(self.mesh, P(self._axis))
+            )
+        self._excluded_ids = idx
+        _m_mask_refresh().labels(
+            component=self.component, outcome="refreshed"
+        ).inc()
+        self._touch_mask()
+        return True
+
+    def _touch_mask(self) -> None:
+        self._mask_stamp = time.monotonic()
+        _m_mask_age().labels(component=self.component).set(0.0)
+
+    @property
+    def mask_age_s(self) -> float:
+        return time.monotonic() - self._mask_stamp
+
+    @property
+    def resident_bytes(self) -> int:
+        return int(
+            self._y_dev.nbytes + self._rn_dev.nbytes + self._allow_dev.nbytes
+        )
+
+    # --- the hot path ---
+
+    def _assemble_idx(
+        self, lists, b_pad: int
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """Per-query id lists -> a sentinel-padded [b_pad, W] int32 block
+        (W the next power of two, so executables bucket O(log) widths)
+        plus the has-list flag vector. The sentinel is n_pad: out of
+        range on every shard and on the single device, so the mask
+        scatter drops it."""
+        has = np.zeros(b_pad, bool)
+        width = 1
+        rows: List[np.ndarray] = []
+        for a in lists:
+            if a is None:
+                rows.append(np.zeros(0, np.int64))
+                continue
+            a = np.asarray(a, np.int64)
+            rows.append(a)
+            width = max(width, len(a))
+        width = pow2_at_least(width)
+        out = np.full((b_pad, width), self._n_pad, np.int32)
+        for r, a in enumerate(rows):
+            if len(a):
+                out[r, : len(a)] = a
+            has[r] = lists[r] is not None
+        return out, has
+
+    def topn(
+        self,
+        query_rows: np.ndarray,
+        n: int,
+        *,
+        exclude: Optional[Sequence] = None,
+        include: Optional[Sequence] = None,
+        positive_only: bool = False,
+        normalize: bool = False,
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """Exact masked top-``n`` for a query batch.
+
+        ``exclude``/``include`` are per-query dense item-index arrays
+        (``None`` entries mean no list for that query; an ``include``
+        entry restricts the query's candidates to exactly that set —
+        an empty array means NO candidates, matching whitelist
+        semantics). ``positive_only`` drops non-positive scores (the
+        templates' ``scores > 0`` rule); ``normalize`` scores against
+        L2-normalized candidates (the cosine/similar-items path).
+        Returns (scores [B, n], item idx [B, n]); slots past a query's
+        live-candidate count carry ``-inf`` — the k > live-candidates
+        edge is the caller filtering those out.
+        """
+        q = np.atleast_2d(np.asarray(query_rows, np.float32))
+        b = q.shape[0]
+        if not (0 < n <= self.n_items):
+            raise ValueError(
+                f"n must be in [1, {self.n_items}], got {n}"
+            )
+        qp = pad_rows_pow2(q, 8)
+        b_pad = qp.shape[0]
+        excl, _ = self._assemble_idx(
+            list(exclude or []) + [None] * (b_pad - b), b_pad
+        )
+        incl, has_incl = self._assemble_idx(
+            list(include or []) + [None] * (b_pad - b), b_pad
+        )
+        _m_mask_age().labels(component=self.component).set(self.mask_age_s)
+        if self.mesh is None:
+            t0 = time.perf_counter()
+            dev = self._device
+            put = lambda a: (
+                jax.device_put(a, dev) if dev is not None else jnp.asarray(a)
+            )
+            packed = _fused_topn_single(
+                put(qp), self._y_dev, self._rn_dev, self._allow_dev,
+                put(excl), put(incl), put(has_incl),
+                n, positive_only, normalize,
+            )
+            host = np.asarray(packed)[:b]
+            _m_shard_seconds().observe(time.perf_counter() - t0)
+            return unpack_topn(host, n)
+
+        rep = self._rep_q
+        q_dev = jax.device_put(qp, rep)
+        excl_dev = jax.device_put(excl, rep)
+        incl_dev = jax.device_put(incl, rep)
+        has_dev = jax.device_put(has_incl, rep)
+        n_local = min(n, self._n_pad // self._n_shards)
+        stage1 = self._stage1(n_local, positive_only, normalize)
+        # the shard-vs-merge timing split needs a host sync between the
+        # two programs, which would serialize an otherwise back-to-back
+        # dispatch on EVERY batch — so the split is SAMPLED (first
+        # batch, then every _SPLIT_SAMPLE_EVERY-th); unsampled batches
+        # run barrier-free and record nothing in these families
+        self._batches += 1
+        split = self._batches % _SPLIT_SAMPLE_EVERY == 1
+        t0 = time.perf_counter()
+        cand = stage1(
+            q_dev, self._y_dev, self._rn_dev, self._allow_dev,
+            excl_dev, incl_dev, has_dev,
+        )
+        if split:
+            jax.block_until_ready(cand)
+            t1 = time.perf_counter()
+            _m_shard_seconds().observe(t1 - t0)
+        packed = _merge_candidates(cand, n, n_local, self._rep_out)
+        host = np.asarray(packed)[:b]
+        if split:
+            _m_merge_seconds().observe(time.perf_counter() - t1)
+        return unpack_topn(host, n)
+
+    def _stage1(self, n_local: int, positive_only: bool, normalize: bool):
+        key = (n_local, positive_only, normalize)
+        fn = self._stage1_cache.get(key)
+        if fn is None:
+            kernel = functools.partial(
+                _shard_topk_kernel,
+                axis=self._axis, n_local=n_local,
+                positive_only=positive_only, normalize=normalize,
+            )
+            axis = self._axis
+            fn = jax.jit(
+                shard_map(
+                    kernel,
+                    mesh=self.mesh,
+                    in_specs=(
+                        P(None, None),  # q: replicated
+                        P(axis, None),  # Y: row-sharded
+                        P(axis),        # rn
+                        P(axis),        # allow
+                        P(None, None),  # excl (global ids, replicated)
+                        P(None, None),  # incl
+                        P(None,),       # has_incl
+                    ),
+                    # per-shard candidate blocks concatenate along the
+                    # candidate dim: the stage-1 output STAYS sharded
+                    out_specs=P(None, axis),
+                    check_rep=False,
+                )
+            )
+            self._stage1_cache[key] = fn
+        return fn
+
+    def warm(
+        self,
+        n: int = 16,
+        max_batch: int = 128,
+        flag_combos: Sequence[Tuple[bool, bool]] = ((True, False),),
+        exclude_widths: Sequence[int] = (1, 16, 64),
+    ) -> None:
+        """Deploy-time compile of the padded-batch executables the
+        serving path can hit (O(log max_batch) per flag combo x
+        exclude width; see BaseAlgorithm.warm). ``flag_combos`` lists
+        the (positive_only, normalize) pairs the engine serves with;
+        ``exclude_widths`` the per-query exclusion-list widths to
+        pre-trace — the id-list block pads to a power of two, so a
+        query arriving with a blacklist/seen set is a DIFFERENT traced
+        shape than a bare query, and without warming it the first such
+        query would pay an XLA compile inside a live batch. 1/16/64
+        cover bare queries and the common seen/blacklist sizes; rarer
+        widths (and whitelists) still compile on first use."""
+        n = min(n, self.n_items)
+        k = self.rank
+        for positive_only, normalize in flag_combos:
+            for w in exclude_widths:
+                excl_row = np.zeros(w, np.int64) if w > 1 else None
+                b = 8
+                while True:
+                    self.topn(
+                        np.zeros((b, k), np.float32), n,
+                        exclude=(
+                            [excl_row] * b if excl_row is not None else None
+                        ),
+                        positive_only=positive_only, normalize=normalize,
+                    )
+                    if b >= max_batch:
+                        break
+                    b *= 2
+
+
+def naive_topn_reference(
+    item_factors: np.ndarray,
+    query_rows: np.ndarray,
+    n: int,
+    *,
+    exclude: Optional[Sequence] = None,
+    include: Optional[Sequence] = None,
+    positive_only: bool = False,
+    normalize: bool = False,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """The naive path the sharded retriever must match id-for-id: ONE
+    full [B, N] score matrix (device matmul — the same contraction the
+    sharded kernel runs per slice), then a HOST post-filter and sort per
+    query. This is both the parity oracle for tests and the
+    ``retrieval_vs_naive_speedup`` denominator in the saturation bench —
+    it is what serving did before round 12."""
+    Y = np.asarray(item_factors, np.float32)
+    q = np.atleast_2d(np.asarray(query_rows, np.float32))
+    scores = np.asarray(
+        jnp.dot(jnp.asarray(q), jnp.asarray(Y).T,
+                preferred_element_type=jnp.float32)
+    ).copy()
+    if normalize:
+        scores *= _reciprocal_norms(Y)[None, :]
+    b, N = scores.shape
+    out_s = np.full((b, n), -np.inf, np.float32)
+    out_i = np.zeros((b, n), np.int32)
+    for r in range(b):
+        row = scores[r]
+        allow = np.ones(N, bool)
+        inc_list = include[r] if include is not None else None
+        if inc_list is not None:
+            wl = np.zeros(N, bool)
+            wl[np.asarray(inc_list, np.int64)] = True
+            allow &= wl
+        exc_list = exclude[r] if exclude is not None else None
+        if exc_list is not None and len(exc_list):
+            allow[np.asarray(exc_list, np.int64)] = False
+        if positive_only:
+            allow &= row > 0
+        masked = np.where(allow, row, -np.inf)
+        order = np.argsort(-masked, kind="stable")[:n]
+        out_s[r, : len(order)] = masked[order]
+        out_i[r, : len(order)] = order
+    return out_s, out_i

@@ -28,6 +28,14 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from predictionio_tpu.parallel.mesh import shard_batch
 
 
+def pow2_at_least(n: int, floor: int = 1) -> int:
+    """Next power of two >= n (and >= floor) — THE serving bucketing
+    rule (cosine-sum rows, ALS top-N batches, retrieval top-k and
+    id-list widths), centralized so executables bucket identically
+    everywhere and the rule can't drift."""
+    return max(floor, 1 << (max(n, 1) - 1).bit_length())
+
+
 def pad_rows_pow2(rows: np.ndarray, min_rows: int) -> np.ndarray:
     """Pad the leading axis with zero rows to the next power of two
     (>= min_rows), so executables bucket by O(log) widths instead of one
@@ -35,7 +43,7 @@ def pad_rows_pow2(rows: np.ndarray, min_rows: int) -> np.ndarray:
     serving top-N (ops/als.py) so the bucketing rule can't drift."""
     rows = np.asarray(rows, np.float32)
     n = rows.shape[0]
-    n_pad = max(min_rows, 1 << (max(n, 1) - 1).bit_length())
+    n_pad = pow2_at_least(n, min_rows)
     if n_pad == n:
         return rows
     return np.concatenate(

@@ -280,9 +280,20 @@ def cmd_eval(args) -> int:
 
 
 def cmd_deploy(args) -> int:
-    """Reference Console.deploy:869 -> CreateServer."""
+    """Reference Console.deploy:869 -> CreateServer. With ``--workers N``
+    this becomes the serving analog of ``eventserver --workers``: N
+    engine-server PROCESSES bind the same port via SO_REUSEPORT (the
+    kernel balances accepted connections), each with its OWN prepared
+    serving state — resident sharded item factors pinned to its own
+    device or mesh slice (``--serving-device``, auto-round-robined over
+    the visible devices when not given). One GIL per worker, one device
+    slice per worker: the multi-worker saturation shape of the
+    retrieval tier (docs/PERF.md)."""
     from predictionio_tpu.api.engine_server import ServerConfig, create_server
 
+    workers = max(1, int(getattr(args, "workers", 1) or 1))
+    if workers > 1:
+        return _deploy_worker_fleet(args, workers)
     variant = load_variant(args.variant)
     engine, _ = engine_from_variant(variant)
     config = ServerConfig(
@@ -297,11 +308,139 @@ def cmd_deploy(args) -> int:
         max_batch=args.max_batch,
         pipeline_depth=args.pipeline_depth,
         transport=args.transport,
+        reuse_port=bool(getattr(args, "reuse_port", False)),
+        serving_devices=getattr(args, "serving_device", None),
     )
     server = create_server(engine, config)
     print(f"Engine server serving on {args.ip}:{server.port}")
     server.serve_forever()
     return 0
+
+
+def _deploy_worker_fleet(args, workers: int) -> int:
+    """Spawn the SO_REUSEPORT engine-server fleet (the eventserver
+    --workers recipe applied to serving): per-worker subprocesses with
+    a device assignment each, shared-storage validation, signal
+    forwarding, and a bind-failure grace check."""
+    import signal
+    import subprocess
+    import time as _time
+
+    if args.port == 0:
+        print(
+            "deploy: --workers requires a fixed --port (port 0 would "
+            "give every worker its own ephemeral port)",
+            file=sys.stderr,
+        )
+        return 2
+    from predictionio_tpu.data.storage import get_storage
+
+    # every worker must see the SAME trained instance + models: a
+    # per-process memory store would leave N-1 workers with nothing
+    # (or worse, nothing to deploy at all)
+    storage = get_storage()
+    for repo in ("METADATA", "MODELDATA", "EVENTDATA"):
+        if storage.repository_type(repo) == "memory":
+            print(
+                f"deploy: --workers needs a multi-process-shared {repo} "
+                "store (sqlite file, localfs, or http gateway); the "
+                "'memory' backend would give each worker a private "
+                "store",
+                file=sys.stderr,
+            )
+            return 2
+
+    # device assignment: an explicit --serving-device list is dealt
+    # round-robin across workers (each worker gets a disjoint slice);
+    # otherwise each worker pins one of the visible devices in turn
+    # (no pinning on a single-device host — nothing to partition)
+    if getattr(args, "serving_device", None):
+        pool = [p for p in str(args.serving_device).split(",") if p.strip()]
+    else:
+        import jax
+
+        n_dev = len(jax.devices())
+        pool = [str(i) for i in range(n_dev)] if n_dev > 1 else []
+
+    def worker_devices(w: int) -> Optional[str]:
+        if not pool:
+            return None
+        mine = pool[w % len(pool) :: workers] if len(pool) >= workers else [
+            pool[w % len(pool)]
+        ]
+        return ",".join(mine)
+
+    def worker_cmd(w: int) -> list:
+        cmd = [
+            sys.executable, "-m", "predictionio_tpu.tools.cli",
+            "deploy", "-v", args.variant,
+            "--ip", args.ip, "--port", str(args.port),
+            "--workers", "1", "--reuse-port",
+            "--transport", args.transport,
+            "--batch-window-ms", str(args.batch_window_ms),
+            "--max-batch", str(args.max_batch),
+            "--pipeline-depth", str(args.pipeline_depth),
+            "--event-server-ip", args.event_server_ip,
+            "--event-server-port", str(args.event_server_port),
+        ]
+        if args.engine_instance_id:
+            cmd += ["--engine-instance-id", args.engine_instance_id]
+        if args.feedback:
+            cmd += ["--feedback"]
+        if args.accesskey:
+            cmd += ["--accesskey", args.accesskey]
+        devs = worker_devices(w)
+        if devs is not None:
+            cmd += ["--serving-device", devs]
+        return cmd
+
+    procs = [subprocess.Popen(worker_cmd(w)) for w in range(workers)]
+    shutdown = {"requested": False}
+
+    def forward(signum, frame):
+        shutdown["requested"] = True
+        for p in procs:
+            p.terminate()
+
+    signal.signal(signal.SIGTERM, forward)
+    signal.signal(signal.SIGINT, forward)
+    # grace check: a worker that failed to bind or to load the model
+    # dies quickly — report a partial fleet instead of printing success
+    from predictionio_tpu.api.http import JsonHTTPServer
+
+    _time.sleep(
+        1.0 + JsonHTTPServer.BIND_RETRIES * JsonHTTPServer.BIND_RETRY_DELAY_S
+    )
+    # workers found dead here failed to START — unless the operator
+    # already SIGTERMed the fleet during the grace window (a short-lived
+    # deploy in a test/bench), which is a clean stop, not a failure
+    dead = [p for p in procs if p.poll() is not None]
+    if dead and not shutdown["requested"]:
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+        for p in procs:
+            p.wait()
+        print(
+            f"deploy: {len(dead)}/{workers} workers failed to start "
+            "(see tracebacks above); aborting",
+            file=sys.stderr,
+        )
+        return 1
+    if not shutdown["requested"]:
+        print(
+            f"Engine server: {workers} workers sharing "
+            f"{args.ip}:{args.port} (SO_REUSEPORT, one prepared serving "
+            "state per worker)"
+        )
+    rc = 0
+    for p in procs:
+        code = p.wait()
+        if shutdown["requested"] and code < 0:
+            # worker killed by the signal we forwarded: a clean stop
+            code = 0
+        rc = code or rc
+    return rc
 
 
 def cmd_undeploy(args) -> int:
@@ -961,6 +1100,24 @@ def build_parser() -> argparse.ArgumentParser:
         "future-based micro-batch handoff (in-flight queries are queue "
         "entries, thousands of connections cost no OS threads); "
         "'threaded' = stdlib thread-per-connection fallback",
+    )
+    deploy.add_argument(
+        "--workers", type=int, default=1,
+        help="engine-server worker processes sharing the port via "
+        "SO_REUSEPORT, each with its own prepared serving state pinned "
+        "to its own device/mesh slice (requires multi-process-shared "
+        "storage: sqlite file, localfs models, or gateway)",
+    )
+    deploy.add_argument(
+        "--reuse-port", action="store_true",
+        help="bind with SO_REUSEPORT (set automatically for workers)",
+    )
+    deploy.add_argument(
+        "--serving-device",
+        help="comma-separated jax device indices to pin the prepared "
+        "serving state (resident sharded item factors) to, e.g. '0' or "
+        "'0,1'; with --workers the list is dealt round-robin across "
+        "workers (default: auto round-robin over all visible devices)",
     )
     deploy.set_defaults(func=cmd_deploy)
 

@@ -153,6 +153,16 @@ def _row(snap: dict, prev: Optional[dict], elapsed_s: float) -> dict:
     delta = gauge_max(m, "pio_train_last_factor_delta")
     if delta is not None:
         row["last_delta"] = delta
+    # retrieval tier: device-resident factor bytes (summed across the
+    # server's retriever components) and the staleness of the resident
+    # candidacy mask — an engine server whose mask age grows past the
+    # constraint TTL has a wedged out-of-band refresh
+    resident = counter_sum(m, "pio_retrieval_resident_bytes")
+    if resident:
+        row["resident_mb"] = resident / 2**20
+    mask_age = gauge_max(m, "pio_retrieval_mask_age_seconds")
+    if mask_age is not None:
+        row["mask_age_s"] = mask_age
     stalled = snap.get("ready_detail", {}).get("stalledDaemons") or {}
     if stalled:
         row["stalled"] = ",".join(sorted(stalled))
@@ -171,6 +181,8 @@ _COLUMNS = (
     ("errors", "ERR", 5),
     ("rounds", "ROUNDS", 7),
     ("last_delta", "CONV", 9),
+    ("resident_mb", "RES_MB", 7),
+    ("mask_age_s", "MASKs", 6),
     ("stalled", "STALLED", 20),
 )
 

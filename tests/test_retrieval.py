@@ -1,0 +1,686 @@
+"""Sharded on-device top-N retrieval (ops/retrieval.py) — exact-parity
+tests against the naive full-matmul reference across 1/2/4-way shard
+counts (mask semantics included: blacklist, unavailable, seen-item
+exclusion, whitelist/categories, and the k > live-candidate-count edge),
+the TTL constraint cache, the ecommerce/similarproduct serving paths,
+and the resident-factors-survive-hot-reload regression."""
+
+import copy
+import datetime as dt
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from predictionio_tpu.data import storage as storage_mod
+from predictionio_tpu.data.event import DataMap, Event
+from predictionio_tpu.data.storage.base import App
+from predictionio_tpu.ops.retrieval import (
+    ItemRetriever,
+    naive_topn_reference,
+)
+from predictionio_tpu.parallel import make_mesh
+from predictionio_tpu.utils import metrics as metrics_mod
+from predictionio_tpu.workflow.context import WorkflowContext, workflow_context
+
+
+def _mesh_or_none(shards):
+    if shards == 1:
+        return None
+    if len(jax.devices()) < shards:
+        pytest.skip(f"needs {shards} virtual devices")
+    return make_mesh({"data": shards}, jax.devices()[:shards])
+
+
+def _family_value(name, **labels):
+    samples = metrics_mod.parse_exposition(
+        metrics_mod.get_registry().render()
+    )
+    if labels:
+        inner = ",".join(
+            f'{k}="{v}"' for k, v in sorted(labels.items())
+        )
+        return samples.get(f"{name}{{{inner}}}", 0.0)
+    return samples.get(name, 0.0)
+
+
+class TestRetrieverParity:
+    """Sharded retrieval == naive full matmul top-N, id-for-id."""
+
+    @pytest.mark.parametrize("shards", [1, 2, 4])
+    def test_exact_parity_with_masks(self, shards):
+        mesh = _mesh_or_none(shards)
+        rng = np.random.default_rng(shards)
+        N, k, B, n = 57, 8, 5, 12  # 57 does not divide 2 or 4 (padding)
+        Y = rng.standard_normal((N, k)).astype(np.float32)
+        q = rng.standard_normal((B, k)).astype(np.float32)
+        # blacklist / empty-whitelist / whitelist / heavy exclusion mixes
+        exclude = [
+            None,
+            np.array([0, 1, 2]),
+            np.array([], np.int64),
+            np.arange(50),
+            None,
+        ]
+        include = [
+            None,
+            None,
+            np.array([3, 4, 5, 9]),
+            None,
+            np.array([], np.int64),
+        ]
+        r = ItemRetriever(Y, mesh=mesh, component=f"parity{shards}")
+        for positive_only in (False, True):
+            for normalize in (False, True):
+                s, i = r.topn(
+                    q, n, exclude=exclude, include=include,
+                    positive_only=positive_only, normalize=normalize,
+                )
+                es, ei = naive_topn_reference(
+                    Y, q, n, exclude=exclude, include=include,
+                    positive_only=positive_only, normalize=normalize,
+                )
+                live = es > -np.inf
+                assert (s > -np.inf).sum() == live.sum()
+                np.testing.assert_array_equal(i[live], ei[live])
+                np.testing.assert_allclose(
+                    s[live], es[live], rtol=1e-5, atol=1e-6
+                )
+
+    @pytest.mark.parametrize("shards", [2, 4])
+    def test_global_mask_parity(self, shards):
+        mesh = _mesh_or_none(shards)
+        rng = np.random.default_rng(10 + shards)
+        Y = rng.standard_normal((41, 6)).astype(np.float32)
+        q = rng.standard_normal((3, 6)).astype(np.float32)
+        banned = np.array([1, 7, 20, 39])
+        r = ItemRetriever(Y, mesh=mesh, component=f"gmask{shards}")
+        assert r.set_excluded_ids(banned) is True
+        s, i = r.topn(q, 10)
+        es, ei = naive_topn_reference(Y, q, 10, exclude=[banned] * 3)
+        live = es > -np.inf
+        np.testing.assert_array_equal(i[live], ei[live])
+
+    def test_k_exceeds_live_candidates(self):
+        rng = np.random.default_rng(2)
+        Y = rng.standard_normal((10, 4)).astype(np.float32)
+        r = ItemRetriever(Y, component="edge")
+        s, i = r.topn(
+            rng.standard_normal((1, 4)).astype(np.float32), 8,
+            exclude=[np.arange(7)],
+        )
+        # only 3 live candidates: the rest of the requested 8 slots are
+        # -inf (the caller's filter contract)
+        assert int((s[0] > -np.inf).sum()) == 3
+        assert set(i[0][: 3]) == {7, 8, 9}
+
+    def test_factors_actually_sharded_and_output_replicated(self):
+        mesh = _mesh_or_none(4)
+        Y = np.eye(12, 4, dtype=np.float32)
+        r = ItemRetriever(Y, mesh=mesh, component="shardcheck")
+        assert not r._y_dev.sharding.is_fully_replicated
+        assert len(r._y_dev.sharding.device_set) == 4
+        # padded to 12 rows / 4 shards -> 3 rows per device
+        assert {
+            s.data.shape[0] for s in r._y_dev.addressable_shards
+        } == {3}
+        assert r.resident_bytes > 0
+
+    def test_one_device_mesh_keeps_its_device_pin(self):
+        """A `pio deploy --workers` worker pinned to ONE device arrives
+        as a 1-device mesh; collapsing it to the fused single-device
+        path must keep that device — dropping it would land every
+        fleet worker's resident factors on the default device 0."""
+        if len(jax.devices()) < 2:
+            pytest.skip("needs 2 virtual devices")
+        dev1 = jax.devices()[1]
+        mesh = make_mesh({"data": 1}, [dev1])
+        r = ItemRetriever(
+            np.eye(6, 4, dtype=np.float32), mesh=mesh, component="pincheck"
+        )
+        assert r.mesh is None  # collapsed to the fused path
+        assert r._y_dev.sharding.device_set == {dev1}
+        assert r._allow_dev.sharding.device_set == {dev1}
+        s, i = r.topn(np.ones((1, 4), np.float32), 3)
+        ref_s, ref_i = naive_topn_reference(
+            np.eye(6, 4, dtype=np.float32), np.ones((1, 4), np.float32), 3
+        )
+        assert np.array_equal(i, ref_i)
+        r.set_excluded_ids(np.array([0]))  # mask re-upload stays pinned
+        assert r._allow_dev.sharding.device_set == {dev1}
+
+    def test_mask_refresh_metrics_and_semantics(self):
+        rng = np.random.default_rng(5)
+        Y = rng.standard_normal((20, 4)).astype(np.float32)
+        r = ItemRetriever(Y, mesh=_mesh_or_none(2), component="maskmetrics")
+        before_ref = _family_value(
+            "pio_retrieval_mask_refresh_total",
+            component="maskmetrics", outcome="refreshed",
+        )
+        before_unch = _family_value(
+            "pio_retrieval_mask_refresh_total",
+            component="maskmetrics", outcome="unchanged",
+        )
+        assert r.set_excluded_ids(np.array([3, 4])) is True
+        assert r.set_excluded_ids(np.array([4, 3])) is False  # same set
+        assert r.set_excluded_ids(np.array([5])) is True
+        assert (
+            _family_value(
+                "pio_retrieval_mask_refresh_total",
+                component="maskmetrics", outcome="refreshed",
+            )
+            - before_ref
+            == 2
+        )
+        assert (
+            _family_value(
+                "pio_retrieval_mask_refresh_total",
+                component="maskmetrics", outcome="unchanged",
+            )
+            - before_unch
+            == 1
+        )
+        q = rng.standard_normal((1, 4)).astype(np.float32)
+        _, i = r.topn(q, 19)
+        assert 5 not in i[0][: int((_[0] > -np.inf).sum())]
+
+    def test_timing_families_recorded(self):
+        rng = np.random.default_rng(6)
+        Y = rng.standard_normal((16, 4)).astype(np.float32)
+        r = ItemRetriever(Y, mesh=_mesh_or_none(2), component="timing")
+        before_shard = _family_value(
+            "pio_retrieval_shard_topk_seconds_count"
+        )
+        before_merge = _family_value("pio_retrieval_merge_seconds_count")
+        r.topn(rng.standard_normal((2, 4)).astype(np.float32), 4)
+        assert (
+            _family_value("pio_retrieval_shard_topk_seconds_count")
+            > before_shard
+        )
+        assert (
+            _family_value("pio_retrieval_merge_seconds_count")
+            > before_merge
+        )
+
+
+class TestConstraintCache:
+    def _storage_with_constraint(self, items):
+        s = storage_mod.memory_storage()
+        storage_mod.set_storage(s)
+        app_id = s.get_meta_data_apps().insert(App(id=0, name="capp"))
+        ev = s.get_l_events()
+        ev.init(app_id)
+        ev.insert(
+            Event(
+                event="$set", entity_type="constraint",
+                entity_id="unavailableItems",
+                properties=DataMap({"items": list(items)}),
+            ),
+            app_id,
+        )
+        return s, app_id
+
+    def test_miss_then_hit_counting(self, mem_storage):
+        from predictionio_tpu.data.constraints import ConstraintCache
+
+        s, _ = self._storage_with_constraint(["x", "y"])
+        try:
+            cache = ConstraintCache("capp", ttl_s=60.0, storage=s)
+            miss0 = _family_value(
+                "pio_constraint_cache_total", outcome="miss"
+            )
+            hit0 = _family_value(
+                "pio_constraint_cache_total", outcome="hit"
+            )
+            assert cache.get() == {"x", "y"}  # first read: miss
+            assert cache.get() == {"x", "y"}  # cached: hit
+            assert cache.get() == {"x", "y"}
+            assert (
+                _family_value("pio_constraint_cache_total", outcome="miss")
+                - miss0
+                == 1
+            )
+            assert (
+                _family_value("pio_constraint_cache_total", outcome="hit")
+                - hit0
+                == 2
+            )
+        finally:
+            storage_mod.set_storage(None)
+
+    def test_stale_get_serves_cached_and_never_blocks(self):
+        """A store stall past the TTL cannot block a batch: get()
+        returns the cached set immediately and refreshes out-of-band."""
+        from predictionio_tpu.data.constraints import ConstraintCache
+
+        release = threading.Event()
+        calls = []
+
+        def slow_reader():
+            calls.append(time.monotonic())
+            if len(calls) > 1:
+                release.wait(10.0)  # the 'stalled store'
+            return frozenset({"a"}) if len(calls) == 1 else frozenset(
+                {"a", "b"}
+            )
+
+        cache = ConstraintCache("app", ttl_s=0.01, reader=slow_reader)
+        assert cache.get() == {"a"}
+        time.sleep(0.05)  # expire the TTL
+        t0 = time.monotonic()
+        assert cache.get() == {"a"}  # stale value served instantly
+        assert time.monotonic() - t0 < 1.0
+        changed = []
+        cache.on_change(lambda items: changed.append(set(items)))
+        release.set()
+        deadline = time.monotonic() + 5.0
+        while not changed and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert changed == [{"a", "b"}]
+        assert cache.get() == {"a", "b"}
+
+    def test_error_serves_cached_and_counts(self):
+        from predictionio_tpu.data.constraints import ConstraintCache
+
+        state = {"fail": False}
+
+        def reader():
+            if state["fail"]:
+                raise RuntimeError("store down")
+            return frozenset({"k"})
+
+        cache = ConstraintCache("app", ttl_s=0.0, reader=reader)
+        assert cache.get() == {"k"}
+        state["fail"] = True
+        err0 = _family_value("pio_constraint_cache_total", outcome="error")
+        assert cache.get() == {"k"}  # cached value survives the error
+        assert (
+            _family_value("pio_constraint_cache_total", outcome="error")
+            - err0
+            == 1
+        )
+
+    def test_failed_first_read_error_primes(self):
+        """A store that is down at deploy must not leave the cache
+        unprimed — that would put a blocking inline read on EVERY
+        batch. The failed first read primes the empty set; the TTL tick
+        retries out-of-band and listeners fire once the store
+        recovers."""
+        from predictionio_tpu.data.constraints import ConstraintCache
+
+        state = {"fail": True}
+        calls = []
+
+        def reader():
+            calls.append(1)
+            if state["fail"]:
+                raise RuntimeError("store down at deploy")
+            return frozenset({"z"})
+
+        cache = ConstraintCache("app", ttl_s=0.2, reader=reader)
+        assert cache.get() == frozenset()  # failed prime -> empty set
+        n_after_prime = len(calls)
+        assert cache.get() == frozenset()  # HIT: no inline read per batch
+        assert len(calls) == n_after_prime
+        changed = []
+        cache.on_change(lambda items: changed.append(set(items)))
+        state["fail"] = False
+        time.sleep(0.25)  # expire the TTL
+        deadline = time.monotonic() + 5.0
+        while not changed and time.monotonic() < deadline:
+            cache.get()  # the TTL tick that kicks the background retry
+            time.sleep(0.01)
+        assert changed == [{"z"}]
+        assert cache.get() == {"z"}
+
+
+@pytest.fixture(scope="module")
+def ecomm_world():
+    """One trained ecommerce model + populated store shared by the
+    serving-parity tests (module-scoped: training is the expensive
+    part)."""
+    s = storage_mod.memory_storage()
+    storage_mod.set_storage(s)
+    app_id = s.get_meta_data_apps().insert(App(id=0, name="ecapp"))
+    ev = s.get_l_events()
+    ev.init(app_id)
+    t0 = dt.datetime(2026, 7, 1, tzinfo=dt.timezone.utc)
+
+    def put(event, etype, eid, target=None, props=None, t=t0):
+        ev.insert(
+            Event(
+                event=event, entity_type=etype, entity_id=eid,
+                target_entity_type="item" if target else None,
+                target_entity_id=target,
+                properties=DataMap(props or {}), event_time=t,
+            ),
+            app_id,
+        )
+
+    rng = np.random.default_rng(3)
+    for i in range(12):
+        put(
+            "$set", "item", f"i{i}",
+            props={
+                "categories": ["electronics"] if i < 6 else ["books"]
+            },
+        )
+    for uid in range(20):
+        put("$set", "user", f"u{uid}")
+        pref = 0 if uid % 2 == 0 else 6
+        for j in range(5):
+            put(
+                "rate", "user", f"u{uid}",
+                target=f"i{pref + int(rng.integers(0, 5))}",
+                props={"rating": float(rng.integers(3, 6))},
+                t=t0 + dt.timedelta(minutes=j),
+            )
+    put("view", "user", "newbie", target="i0")
+    put(
+        "$set", "constraint", "unavailableItems",
+        props={"items": ["i2"]},
+    )
+
+    from predictionio_tpu.models.ecommerce.engine import (
+        DataSource,
+        DataSourceParams,
+        ECommAlgorithm,
+        ECommAlgorithmParams,
+        Preparator,
+    )
+
+    ctx = WorkflowContext(mode="training", storage=s)
+    td = DataSource(DataSourceParams(app_name="ecapp")).read_training(ctx)
+    pd = Preparator().prepare(ctx, td)
+    algo = ECommAlgorithm(
+        ECommAlgorithmParams(
+            app_name="ecapp", rank=8, num_iterations=10, seed=4,
+            unseen_only=True, seen_events=("rate",),
+        )
+    )
+    model = algo.train(ctx, pd)
+    yield s, app_id, algo, model
+    storage_mod.set_storage(None)
+
+
+class TestECommerceRetrievalServing:
+    QUERY_MIX = [
+        dict(user="u0", num=5),
+        dict(user="u1", num=3, black_list=("i7",)),
+        dict(user="u2", num=8, categories=("books",)),
+        dict(user="u3", num=4, white_list=("i0", "i1", "i2", "i9")),
+        dict(user="newbie", num=5),       # unknown user: cosine fallback
+        dict(user="ghost", num=5),        # no history at all
+        dict(user="u4", num=5, white_list=()),  # empty whitelist
+    ]
+
+    @pytest.mark.parametrize("shards", [1, 4])
+    def test_device_path_matches_host_path(self, ecomm_world, shards):
+        """The full serving semantics — unavailable constraint (resident
+        mask), seen-item exclusion (unseen_only), blacklist, categories,
+        whitelist, unknown-user cosine fallback — byte-identical item
+        lists between the prepared (on-device) and legacy (host
+        post-filter) paths, on 1 device and on a 4-way mesh."""
+        from predictionio_tpu.models.ecommerce.engine import Query
+
+        _, _, algo, model = ecomm_world
+        mesh = _mesh_or_none(shards)
+        legacy = copy.deepcopy(model)
+        prepped = algo.prepare_serving(
+            workflow_context(mode="Serving", mesh=mesh)
+            if mesh is not None
+            else None,
+            copy.deepcopy(model),
+        )
+        assert prepped._retriever is not None
+        queries = [Query(**kw) for kw in self.QUERY_MIX]
+        dev = dict(algo.batch_predict(prepped, list(enumerate(queries))))
+        host = dict(algo.batch_predict(legacy, list(enumerate(queries))))
+        for i in range(len(queries)):
+            assert [x.item for x in dev[i].item_scores] == [
+                x.item for x in host[i].item_scores
+            ], queries[i]
+            np.testing.assert_allclose(
+                [x.score for x in dev[i].item_scores],
+                [x.score for x in host[i].item_scores],
+                rtol=1e-4,
+            )
+
+    def test_constraint_change_refreshes_resident_mask(self, ecomm_world):
+        from predictionio_tpu.models.ecommerce.engine import Query
+
+        s, app_id, algo, model = ecomm_world
+        prepped = algo.prepare_serving(None, copy.deepcopy(model))
+        baseline = algo.predict(prepped, Query(user="u0", num=3))
+        banned = baseline.item_scores[0].item
+        s.get_l_events().insert(
+            Event(
+                event="$set", entity_type="constraint",
+                entity_id="unavailableItems",
+                properties=DataMap({"items": ["i2", banned]}),
+            ),
+            app_id,
+        )
+        # drive the out-of-band refresh deterministically (in production
+        # the TTL kick from a later batch does this on a background
+        # thread; refresh() is the same code path, inline)
+        assert prepped._constraints.refresh() is True
+        result = algo.predict(prepped, Query(user="u0", num=3))
+        assert all(x.item != banned for x in result.item_scores)
+
+    def test_store_stall_does_not_block_serving(self, ecomm_world):
+        """The satellite fix: predict_batch never reads the constraint
+        entity inline once the cache is primed — a wedged store changes
+        nothing about batch latency."""
+        from predictionio_tpu.models.ecommerce.engine import Query
+
+        _, _, algo, model = ecomm_world
+        prepped = algo.prepare_serving(None, copy.deepcopy(model))
+
+        def wedged():
+            raise AssertionError(
+                "serving read the constraint store inline"
+            )
+
+        # cache primed at prepare_serving; replace the reader with a
+        # tripwire and expire the TTL: get() must serve cached and only
+        # the BACKGROUND thread may touch (and trip) the reader
+        prepped._constraints._reader = wedged
+        prepped._constraints._loaded_at = -1e9
+        result = algo.predict(prepped, Query(user="u0", num=3))
+        assert result.item_scores
+
+
+class TestSimilarProductRetrievalServing:
+    @pytest.fixture(scope="class")
+    def sp_world(self):
+        s = storage_mod.memory_storage()
+        storage_mod.set_storage(s)
+        app_id = s.get_meta_data_apps().insert(App(id=0, name="spapp"))
+        ev = s.get_l_events()
+        ev.init(app_id)
+        rng = np.random.default_rng(7)
+        for i in range(15):
+            ev.insert(
+                Event(
+                    event="$set", entity_type="item", entity_id=f"p{i}",
+                    properties=DataMap(
+                        {"categories": ["a"] if i < 8 else ["b"]}
+                    ),
+                ),
+                app_id,
+            )
+        for uid in range(25):
+            for _ in range(6):
+                ev.insert(
+                    Event(
+                        event="view", entity_type="user",
+                        entity_id=f"v{uid}",
+                        target_entity_type="item",
+                        target_entity_id=f"p{int(rng.integers(0, 15))}",
+                    ),
+                    app_id,
+                )
+        from predictionio_tpu.models.similarproduct import engine as sp
+
+        ctx = WorkflowContext(mode="training", storage=s)
+        td = sp.DataSource(
+            sp.DataSourceParams(app_name="spapp")
+        ).read_training(ctx)
+        pd = sp.Preparator().prepare(ctx, td)
+        algo = sp.ALSAlgorithm(
+            sp.ALSAlgorithmParams(rank=8, num_iterations=10, seed=1)
+        )
+        model = algo.train(ctx, pd)
+        yield algo, model
+        storage_mod.set_storage(None)
+
+    @pytest.mark.parametrize("shards", [1, 2])
+    def test_similar_parity(self, sp_world, shards):
+        from predictionio_tpu.models.similarproduct.engine import Query
+
+        algo, model = sp_world
+        mesh = _mesh_or_none(shards)
+        legacy = copy.deepcopy(model)
+        prepped = algo.prepare_serving(
+            workflow_context(mode="Serving", mesh=mesh)
+            if mesh is not None
+            else None,
+            copy.deepcopy(model),
+        )
+        assert prepped._retriever is not None
+        queries = [
+            Query(items=("p0", "p3"), num=5),
+            Query(items=("p1",), num=4, black_list=("p2",)),
+            Query(items=("p5", "p9"), num=6, categories=("b",)),
+            Query(items=("p4",), num=3, white_list=("p6", "p7", "p8")),
+            Query(items=("zzz",), num=3),  # no factors -> empty
+        ]
+        dev = dict(algo.batch_predict(prepped, list(enumerate(queries))))
+        for i, q in enumerate(queries):
+            host = legacy.similar(q)
+            assert [x.item for x in dev[i].item_scores] == [
+                x.item for x in host.item_scores
+            ], q
+            np.testing.assert_allclose(
+                [x.score for x in dev[i].item_scores],
+                [x.score for x in host.item_scores],
+                rtol=1e-4,
+            )
+        # query items never come back
+        for i, q in enumerate(queries):
+            assert not set(q.items) & {
+                x.item for x in dev[i].item_scores
+            }
+
+
+class TestHotReloadResidentFactors:
+    def test_pickle_roundtrip_then_prepare_deploy_rebuilds(
+        self, ecomm_world
+    ):
+        """Model persistence drops device state by contract
+        (__getstate__); prepare_deploy must rebuild the resident
+        retriever, and serving through the rebuilt state must match."""
+        import pickle
+
+        from predictionio_tpu.models.ecommerce.engine import Query
+
+        _, _, algo, model = ecomm_world
+        prepped = algo.prepare_serving(None, copy.deepcopy(model))
+        before = algo.predict(prepped, Query(user="u0", num=3))
+        revived = pickle.loads(pickle.dumps(prepped))
+        assert revived._retriever is None  # device state never pickles
+        revived = algo.prepare_serving(None, revived)
+        assert revived._retriever is not None
+        assert revived._retriever.resident_bytes > 0
+        after = algo.predict(revived, Query(user="u0", num=3))
+        assert [s.item for s in after.item_scores] == [
+            s.item for s in before.item_scores
+        ]
+
+    def test_engine_server_reload_keeps_factors_resident(
+        self, ecomm_world
+    ):
+        """The regression gate: after an EngineServer hot reload the NEW
+        prepared serving state has its own device-resident factors (no
+        silent fallback to the host path) and the OLD snapshot still
+        serves in-flight queries."""
+        import datetime as _dt
+        import json as _json
+
+        from predictionio_tpu.api.engine_server import (
+            EngineServer,
+            ServerConfig,
+        )
+        from predictionio_tpu.data.storage.base import EngineInstance
+        from predictionio_tpu.models.ecommerce.engine import (
+            ecommerce_engine,
+        )
+        from predictionio_tpu.workflow.core_workflow import CoreWorkflow
+
+        s, _, _, _ = ecomm_world
+        engine = ecommerce_engine()
+        params = engine.jvalue_to_engine_params(
+            {
+                "datasource": {"params": {"app_name": "ecapp"}},
+                "algorithms": [
+                    {
+                        "name": "ecomm",
+                        "params": {
+                            "app_name": "ecapp", "rank": 8,
+                            "num_iterations": 5, "seed": 4,
+                        },
+                    }
+                ],
+            }
+        )
+        now = _dt.datetime.now(_dt.timezone.utc)
+        iid = CoreWorkflow.run_train(
+            engine, params,
+            EngineInstance(
+                id="", status="", start_time=now, end_time=now,
+                engine_id="ec", engine_version="1",
+                engine_variant="engine.json",
+                engine_factory=(
+                    "predictionio_tpu.models.ecommerce.engine."
+                    "ECommerceEngineFactory"
+                ),
+            ),
+            ctx=WorkflowContext(mode="training", storage=s),
+        )
+        assert iid
+        server = EngineServer(
+            engine, ServerConfig(port=0), storage=s
+        ).start()
+        try:
+            old_model = server.api.deployed.models[0]
+            assert old_model._retriever is not None
+            old_bytes = old_model._retriever.resident_bytes
+
+            def query():
+                status, body, _ = server.api.handle(
+                    "POST", "/queries.json",
+                    body=_json.dumps({"user": "u0", "num": 3}).encode(),
+                )
+                assert status == 200
+                return [x["item"] for x in body["itemScores"]]
+
+            before = query()
+            server.reload()
+            fresh_model = server.api.deployed.models[0]
+            assert fresh_model is not old_model
+            assert fresh_model._retriever is not None
+            assert fresh_model._retriever is not old_model._retriever
+            assert fresh_model._retriever.resident_bytes == old_bytes
+            assert query() == before
+            # the old snapshot (in-flight queries during a reload) still
+            # has ITS resident factors and still serves
+            from predictionio_tpu.models.ecommerce.engine import Query
+
+            algo = server.api.deployed.algorithms[0]
+            old_result = algo.predict(old_model, Query(user="u0", num=3))
+            assert [x.item for x in old_result.item_scores] == before
+        finally:
+            server.shutdown()
