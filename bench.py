@@ -1523,6 +1523,280 @@ def bench_concurrent_ingest(device_name):
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+# --- config 7c: model-quality observability (ISSUE 11) ---
+
+
+def measure_attribution_overhead(
+    n_batches: int = 60, batch_size: int = 50, reps: int = 5
+):
+    """Ingest-path cost of the online feedback join, as a fraction of
+    /batch/events.json throughput: the SAME in-proc batch workload
+    against an EventAPI with the commit-hook attribution observer
+    enabled vs disabled, reps INTERLEAVED with the min taken per side
+    (box noise lands on both symmetrically). The hard gate is <2% —
+    the observer is two attribute checks per event for events that
+    carry no prId, which is the overwhelming ingest majority."""
+    from predictionio_tpu.api.event_server import EventAPI, EventServerConfig
+    from predictionio_tpu.data import storage as storage_mod
+    from predictionio_tpu.data.storage.base import AccessKey, App
+
+    def make_api(attribution: bool) -> EventAPI:
+        storage = storage_mod.memory_storage()
+        app_id = storage.get_meta_data_apps().insert(App(id=0, name="q"))
+        storage.get_meta_data_access_keys().insert(
+            AccessKey(key="k", appid=app_id, events=())
+        )
+        storage.get_l_events().init(app_id)
+        return EventAPI(
+            storage=storage,
+            config=EventServerConfig(port=0, attribution=attribution),
+        )
+
+    apis = {True: make_api(True), False: make_api(False)}
+    payloads = [
+        json.dumps([
+            {
+                "event": "rate",
+                "entityType": "user",
+                "entityId": f"u{b}-{j}",
+                "targetEntityType": "item",
+                "targetEntityId": f"i{j % 97}",
+                "properties": {"rating": float(j % 5 + 1)},
+            }
+            for j in range(batch_size)
+        ]).encode()
+        for b in range(n_batches)
+    ]
+
+    def one_window_s(attribution: bool) -> float:
+        api = apis[attribution]
+        t0 = time.perf_counter()
+        for body in payloads:
+            status, results = api.handle(
+                "POST", "/batch/events.json", {"accessKey": "k"}, body
+            )
+            assert status == 200, status
+        return time.perf_counter() - t0
+
+    for attribution in (True, False):  # warm both paths
+        one_window_s(attribution)
+    samples = {True: [], False: []}
+    for _ in range(reps):
+        for attribution in (True, False):
+            samples[attribution].append(one_window_s(attribution))
+    with_hook = min(samples[True])
+    without = min(samples[False])
+    n_events = n_batches * batch_size
+    return {
+        "attribution_overhead_frac": round(
+            max(0.0, (with_hook - without) / without), 5
+        ),
+        "batch_ingest_events_per_sec_with_hook": round(
+            n_events / with_hook, 1
+        ),
+        "batch_ingest_events_per_sec_without_hook": round(
+            n_events / without, 1
+        ),
+    }
+
+
+def bench_quality(device_name):
+    """Model-quality observability end to end: the serving window drives
+    the full feedback→attribution join (queries through an engine server
+    with feedback on, conversion events carrying the served prIds back
+    through the event server) and reports the attributed hit-rate
+    deltas off /metrics; `pio replay`'s self-replay runs as a
+    zero-divergence smoke against the capture the window produced; and
+    the ingest-path attribution hook is hard-gated <2% of
+    /batch/events.json throughput."""
+    import http.client
+
+    from predictionio_tpu.api.engine_server import EngineServer, ServerConfig
+    from predictionio_tpu.api.event_server import (
+        EventServer,
+        EventServerConfig,
+    )
+    from predictionio_tpu.data import storage as storage_mod
+    from predictionio_tpu.data.event import DataMap, Event
+    from predictionio_tpu.data.storage.base import (
+        AccessKey,
+        App,
+        EngineInstance,
+    )
+    from predictionio_tpu.models.recommendation.engine import (
+        recommendation_engine,
+    )
+    from predictionio_tpu.models.recommendation.evaluation import (
+        _engine_params,
+    )
+    from predictionio_tpu.workflow import quality as quality_mod
+    from predictionio_tpu.workflow.context import WorkflowContext
+    from predictionio_tpu.workflow.core_workflow import CoreWorkflow
+    import datetime as dt
+
+    u, i, r = synth_ml100k()
+    storage = storage_mod.memory_storage()
+    app_id = storage.get_meta_data_apps().insert(App(id=0, name="default"))
+    storage.get_meta_data_access_keys().insert(
+        AccessKey(key="qkey", appid=app_id, events=())
+    )
+    events = storage.get_l_events()
+    events.init(app_id)
+    for uu, ii, rr in zip(
+        u[:20_000].tolist(), i[:20_000].tolist(), r[:20_000].tolist()
+    ):
+        events.insert(
+            Event(
+                event="rate",
+                entity_type="user",
+                entity_id=f"u{uu}",
+                target_entity_type="item",
+                target_entity_id=f"i{ii}",
+                properties=DataMap({"rating": rr}),
+            ),
+            app_id,
+        )
+    now = dt.datetime.now(dt.timezone.utc)
+    CoreWorkflow.run_train(
+        recommendation_engine(),
+        _engine_params(rank=RANK, reg=0.05, eval_k=0),
+        EngineInstance(
+            id="", status="", start_time=now, end_time=now,
+            engine_id="bench", engine_version="1",
+            engine_variant="engine.json",
+            engine_factory="predictionio_tpu.models.recommendation",
+        ),
+        ctx=WorkflowContext(mode="training", storage=storage),
+    )
+    quality_mod.get_capture().clear()
+    quality_mod.get_attribution().clear()
+    es = EventServer(
+        storage=storage, config=EventServerConfig(port=0)
+    ).start()
+    server = EngineServer(
+        recommendation_engine(),
+        ServerConfig(
+            port=0, feedback=True, access_key="qkey",
+            event_server_port=es.port,
+        ),
+        storage=storage,
+    ).start()
+    try:
+        scrape_before = scrape_metrics(es.port)
+
+        def query(uid):
+            conn = http.client.HTTPConnection("localhost", server.port)
+            try:
+                conn.request(
+                    "POST", "/queries.json",
+                    json.dumps({"user": f"u{uid}", "num": 5}),
+                    {"Content-Type": "application/json"},
+                )
+                resp = conn.getresponse()
+                body = json.loads(resp.read())
+                assert resp.status == 200, resp.status
+                return body
+            finally:
+                conn.close()
+
+        n_queries = 40
+        responses = [query(j % N_USERS) for j in range(n_queries)]
+        served = [
+            b for b in responses if b.get("prId") and b.get("itemScores")
+        ]
+        # the feedback predict events drain asynchronously; the
+        # attribution table must see a prId before its conversion rides
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if len(quality_mod.get_attribution()) >= len(served):
+                break
+            time.sleep(0.05)
+        assert len(quality_mod.get_attribution()) >= len(served) > 0
+
+        def post_event(payload):
+            conn = http.client.HTTPConnection("localhost", es.port)
+            try:
+                conn.request(
+                    "POST", "/events.json?accessKey=qkey",
+                    json.dumps(payload),
+                    {"Content-Type": "application/json"},
+                )
+                resp = conn.getresponse()
+                resp.read()
+                assert resp.status == 201, resp.status
+            finally:
+                conn.close()
+
+        # conversions: every 2nd served prediction converts on its
+        # top item; the rest emit a non-served item (outcome=miss)
+        for k, body in enumerate(served):
+            target = (
+                body["itemScores"][0]["item"] if k % 2 == 0 else "i-none"
+            )
+            post_event({
+                "event": "buy",
+                "entityType": "user",
+                "entityId": "u0",
+                "targetEntityType": "item",
+                "targetEntityId": target,
+                "prId": body["prId"],
+            })
+        window = metrics_delta(
+            scrape_before, scrape_metrics(es.port),
+            ("pio_online_attributed_total", "pio_events_ingested_total"),
+        )
+        converted = sum(
+            v for k, v in window.items()
+            if k.startswith("pio_online_attributed_total")
+            and 'outcome="converted"' in k
+        )
+        missed = sum(
+            v for k, v in window.items()
+            if k.startswith("pio_online_attributed_total")
+            and 'outcome="miss"' in k
+        )
+        expected_converted = (len(served) + 1) // 2
+        assert converted == expected_converted, (converted, window)
+        hit_rate = converted / (converted + missed)
+
+        # self-replay smoke: the capture the window just produced,
+        # replayed against the SAME deployed instance, must report
+        # exactly zero divergence (the pio replay determinism gate)
+        records = quality_mod.get_capture().dump()
+        assert len(records) >= n_queries
+        replay = quality_mod.replay_capture(records, server.api.deployed)
+        assert replay["diverged"] == 0, replay
+        assert replay["jaccard_mean"] == 1.0, replay
+        assert replay["rank_displacement_max"] == 0.0, replay
+
+        overhead = measure_attribution_overhead()
+        assert overhead["attribution_overhead_frac"] < 0.02, overhead
+
+        emit(
+            {
+                "metric": "model_quality_observability",
+                "value": overhead["attribution_overhead_frac"],
+                "unit": "frac_ingest_overhead",
+                "queries_served": n_queries,
+                "attributed_hit_rate": round(hit_rate, 4),
+                "attributed_converted": int(converted),
+                "attributed_miss": int(missed),
+                "replay_queries": replay["queries"],
+                "replay_diverged": replay["diverged"],
+                "replay_jaccard_mean": replay["jaccard_mean"],
+                "replay_rank_displacement_max": (
+                    replay["rank_displacement_max"]
+                ),
+                **overhead,
+                "metrics_window_delta": window,
+                "device": device_name,
+            }
+        )
+    finally:
+        server.shutdown()
+        es.shutdown()
+
+
 # --- config 2: classification NaiveBayes ---
 
 
@@ -2547,6 +2821,7 @@ BENCHES = {
     "ml20m_store": bench_ml20m_store,
     "ingestion": bench_ingestion,
     "concurrent_ingest": bench_concurrent_ingest,
+    "quality": bench_quality,
     "segment_scan": bench_segment_scan,
     "delta_train": bench_delta_train,
     "serving_saturation": bench_serving_saturation,

@@ -475,9 +475,14 @@ class AsyncJsonHTTPServer:
             data = str(payload).encode("utf-8")
         reason = _REASONS.get(status, "Unknown")
         conn_header = "" if keep_alive else "Connection: close\r\n"
+        # handlers may return a fully-qualified content type (the
+        # Prometheus exposition carries its own charset parameter) —
+        # only bare types get the default charset appended
+        if "charset=" not in out_type:
+            out_type = f"{out_type}; charset=utf-8"
         head = (
             f"HTTP/1.1 {status} {reason}\r\n"
-            f"Content-Type: {out_type}; charset=utf-8\r\n"
+            f"Content-Type: {out_type}\r\n"
             f"Content-Length: {len(data)}\r\n"
             f"{conn_header}\r\n"
         ).encode("latin-1")

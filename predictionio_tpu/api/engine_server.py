@@ -31,9 +31,11 @@ so throughput scales with batch size instead of request count.
 from __future__ import annotations
 
 import concurrent.futures
+import copy
 import dataclasses
 import datetime as _dt
 import html
+import itertools
 import json
 import logging
 import queue
@@ -56,10 +58,19 @@ from predictionio_tpu.utils import health as _health
 from predictionio_tpu.utils import metrics as _metrics
 from predictionio_tpu.utils import tracing as _tracing
 from predictionio_tpu.utils.serialize import loads_model
+from predictionio_tpu.workflow import quality as _quality
 from predictionio_tpu.workflow.context import WorkflowContext
 from predictionio_tpu.workflow.workflow_params import WorkflowParams
 
 logger = logging.getLogger(__name__)
+
+
+def _version_of(deployed) -> str:
+    """The model-version label of a deployed engine: the persisted
+    round's engine instance id (test doubles without one label as
+    'unknown')."""
+    inst = getattr(deployed, "engine_instance", None)
+    return str(getattr(inst, "id", None) or "unknown")
 
 _ALPHANUMERIC = string.ascii_letters + string.digits
 
@@ -131,6 +142,12 @@ class ServerConfig:
     # The pinned mesh is what prepare_serving row-shards the resident
     # item factors over (ops/retrieval.py).
     serving_devices: Optional[str] = None
+    # prediction capture (workflow/quality.py): every Nth served query
+    # is recorded into the bounded process-global capture ring —
+    # (query, result ids/scores, version, trace id) — dumped at the
+    # gated GET /debug/predictions.json and replayable via `pio
+    # replay`. 1 = every query, 0 disables capture entirely.
+    capture_sample: int = 1
 
     def __post_init__(self):
         if self.feedback and not self.access_key:
@@ -318,14 +335,21 @@ class _BatchingExecutor:
         # collector batch-size accounting (served-group granularity, the
         # actual device batch): proves micro-batches coalesce under load.
         # The instrument is the process-global registry's mergeable
-        # histogram (the /metrics family); stats() reports the delta
-        # since THIS executor was constructed.
+        # histogram (the /metrics family), labeled by the MODEL VERSION
+        # the batch was served from — a /reload swap's fill profile is
+        # diffable per version straight off /metrics. stats() reports
+        # the all-versions delta since THIS executor was constructed.
         self._m_batch_fill = _metrics.get_registry().histogram(
             "pio_serving_batch_fill",
-            "Queries per served micro-batch (the device batch size)",
+            "Queries per served micro-batch (the device batch size), "
+            "by model version",
+            labels=("version",),
             buckets=_metrics.BATCH_SIZE_BUCKETS,
         )
-        self._m_batch_base = self._m_batch_fill.snapshot()
+        self._m_batch_bases = {
+            key[0]: child.snapshot()
+            for key, child in self._m_batch_fill.children()
+        }
         # watchdog: a serve_batch wedged in a stuck device/relay call
         # degrades /readyz once it overruns the deadline (executors of
         # one process share the heartbeat — either stalling is a
@@ -365,10 +389,24 @@ class _BatchingExecutor:
         return self.submit_nowait(deployed, query).result()
 
     def stats(self) -> Dict[str, Any]:
-        """Served-batch accounting since this executor was constructed:
-        count, mean fill, bucketed size histogram (keys are the
-        registry histogram's bucket upper bounds)."""
-        snap = self._m_batch_fill.snapshot().delta(self._m_batch_base)
+        """Served-batch accounting since this executor was constructed
+        (merged across model versions): count, mean fill, bucketed size
+        histogram (keys are the registry histogram's bucket upper
+        bounds)."""
+        snaps = []
+        for key, child in self._m_batch_fill.children():
+            snap = child.snapshot()
+            base = self._m_batch_bases.get(key[0])
+            if base is not None:
+                snap = snap.delta(base)
+            snaps.append(snap)
+        if snaps:
+            snap = _metrics.merge_snapshots(snaps)
+        else:
+            bounds = self._m_batch_fill.bounds
+            snap = _metrics.HistogramSnapshot(
+                bounds, (0,) * (len(bounds) + 1), 0.0, 0
+            )
         # counts has one +Inf overflow slot beyond the finite bounds: a
         # batch larger than the last bound (max_batch is user-settable
         # past 1024) must not vanish from the histogram view
@@ -441,7 +479,9 @@ class _BatchingExecutor:
                 ]
                 if not items:
                     continue
-                self._m_batch_fill.observe(len(items))
+                self._m_batch_fill.labels(
+                    version=_version_of(items[0][0])
+                ).observe(len(items))
                 # blocks while pipeline_depth batches are in flight — the
                 # next batch keeps accumulating in self._queue meanwhile
                 self._inflight.acquire()
@@ -461,9 +501,10 @@ class _BatchingExecutor:
 
     def _serve_and_release(self, dep: DeployedEngine, items) -> None:
         t0 = time.time()
+        outcomes: List[tuple] = []
         try:
             with self._hb.busy():
-                self._serve_isolating(dep, items)
+                self._serve_isolating(dep, items, outcomes)
         finally:
             self._inflight.release()
             t1 = time.time()
@@ -484,23 +525,35 @@ class _BatchingExecutor:
                     parent_id=trace.span_id, start_s=enqueued,
                     duration_s=t1 - enqueued,
                 )
+            # futures resolve strictly AFTER the batch/predict spans are
+            # recorded: a client that got its response may immediately
+            # read /debug/traces.json and must find the whole chain
+            for f, exc, result in outcomes:
+                if exc is not None:
+                    f.set_exception(exc)
+                else:
+                    f.set_result(result)
 
-    def _serve_isolating(self, dep: DeployedEngine, items) -> None:
+    def _serve_isolating(
+        self, dep: DeployedEngine, items, outcomes: List[tuple]
+    ) -> None:
         """Serve a batch; on failure bisect it so the poison query is
         located in O(log n) batched calls and its batchmates still get
         batched service (a serial per-query retry would multiply every
-        innocent's latency by the batch size)."""
+        innocent's latency by the batch size). Outcomes are collected as
+        (future, exception, result) rather than resolved here so the
+        caller controls when waiters wake."""
         try:
             results = dep.serve_batch([q for _, q, _, _ in items])
             for (_, _, f, _), r in zip(items, results):
-                f.set_result(r)
+                outcomes.append((f, None, r))
         except Exception as e:
             if len(items) == 1:
-                items[0][2].set_exception(e)
+                outcomes.append((items[0][2], e, None))
                 return
             mid = len(items) // 2
-            self._serve_isolating(dep, items[:mid])
-            self._serve_isolating(dep, items[mid:])
+            self._serve_isolating(dep, items[:mid], outcomes)
+            self._serve_isolating(dep, items[mid:], outcomes)
 
 
 class QueryAPI:
@@ -543,26 +596,54 @@ class QueryAPI:
         # a reservoir cannot aggregate across SO_REUSEPORT workers;
         # bucket vectors add.
         reg = _metrics.get_registry()
-        self._m_latency = reg.histogram(
+        # per-VERSION attribution: every serving family carries the
+        # model version (the deployed engine instance id), so a /reload
+        # swap's latency and quality are diffable per version off one
+        # /metrics scrape. Requests record under the version of the
+        # DeployedEngine snapshot that actually served them, so the two
+        # versions' sample windows around a swap are disjoint.
+        self._m_latency_fam = reg.histogram(
             "pio_serving_latency_seconds",
-            "End-to-end /queries.json serving latency",
+            "End-to-end /queries.json serving latency, by model version",
+            labels=("version",),
             buckets=_metrics.LATENCY_BUCKETS_S,
         )
-        self._m_requests = reg.counter(
+        self._m_requests_fam = reg.counter(
             "pio_serving_requests_total",
-            "Completed /queries.json requests",
+            "Completed /queries.json requests, by model version",
+            labels=("version",),
         )
-        self._m_last = reg.gauge(
+        self._m_last_fam = reg.gauge(
             "pio_serving_last_seconds",
-            "Latency of the most recent served query",
+            "Latency of the most recent served query, by model version",
+            labels=("version",),
+        )
+        self._m_model_info = reg.gauge(
+            "pio_model_info",
+            "1 for the model version this server is actively serving, "
+            "0 for versions it swapped out",
+            labels=("engine", "version"),
         )
         self._m_feedback_dropped = reg.counter(
             "pio_feedback_queue_dropped_total",
             "Feedback posts dropped because the bounded queue was full",
         )
-        self._lat_base = self._m_latency.snapshot()
-        self._requests_base = self._m_requests.snapshot()
+        # per-instance "since this server deployed" views: snapshot every
+        # pre-existing version child now (the families are process-global
+        # and other servers may have populated them); versions this
+        # server binds later enter the tables at bind time (zero for
+        # fresh children)
+        self._lat_bases: Dict[str, _metrics.HistogramSnapshot] = {
+            vid: child.snapshot()
+            for (vid,), child in self._m_latency_fam.children()
+        }
+        self._req_bases: Dict[str, float] = {
+            vid: child.value
+            for (vid,), child in self._m_requests_fam.children()
+        }
         self._feedback_dropped_base = self._m_feedback_dropped.snapshot()
+        self._capture_count = itertools.count(1)
+        self._bind_version_metrics(deployed)
         # /readyz: a deployed model with its serving components is the
         # engine server's one hard readiness requirement; daemon-stall
         # checks (executor, feedback drainer, continuous trainer) are
@@ -589,6 +670,68 @@ class QueryAPI:
             threading.Thread(
                 target=self._upgrade_check_loop, daemon=True
             ).start()
+
+    def _bind_version_metrics(self, deployed) -> None:
+        """Point the current-version instrument handles at ``deployed``'s
+        model version and flip ``pio_model_info`` — called at
+        construction and by :meth:`bind_deployed` on every /reload swap.
+        """
+        vid = _version_of(deployed)
+        inst = getattr(deployed, "engine_instance", None)
+        engine_label = str(
+            getattr(inst, "engine_id", None)
+            or getattr(inst, "engine_factory", None)
+            or "unknown"
+        )
+        self._m_latency = self._m_latency_fam.labels(version=vid)
+        self._m_requests = self._m_requests_fam.labels(version=vid)
+        self._m_last = self._m_last_fam.labels(version=vid)
+        if vid not in self._lat_bases:
+            self._lat_bases[vid] = self._m_latency.snapshot()
+        if vid not in self._req_bases:
+            self._req_bases[vid] = self._m_requests.value
+        # compat handles for the current version's "since deployed" view
+        self._lat_base = self._lat_bases[vid]
+        self._requests_base = self._req_bases[vid]
+        self._m_model_info.labels(engine=engine_label, version=vid).set(1)
+        self._active_model_label = (engine_label, vid)
+
+    def bind_deployed(self, deployed) -> None:
+        """Swap the serving snapshot (the /reload path): queries in
+        flight keep the old DeployedEngine and keep recording under its
+        version label; new queries record under the new one — the two
+        versions' sample windows are disjoint by construction."""
+        old_label = getattr(self, "_active_model_label", None)
+        self.deployed = deployed
+        self._bind_version_metrics(deployed)
+        if old_label is not None and old_label != self._active_model_label:
+            self._m_model_info.labels(
+                engine=old_label[0], version=old_label[1]
+            ).set(0)
+
+    def _serving_totals(self) -> Tuple["_metrics.HistogramSnapshot", int]:
+        """Latency histogram + request count summed across every model
+        version this server served, as deltas against the construction/
+        bind-time bases — the status.json 'since this server deployed'
+        view over the labeled process-global families."""
+        snaps = []
+        for (vid,), child in self._m_latency_fam.children():
+            snap = child.snapshot()
+            base = self._lat_bases.get(vid)
+            if base is not None:
+                snap = snap.delta(base)
+            snaps.append(snap)
+        if snaps:
+            lat = _metrics.merge_snapshots(snaps)
+        else:
+            bounds = self._m_latency_fam.bounds
+            lat = _metrics.HistogramSnapshot(
+                bounds, (0,) * (len(bounds) + 1), 0.0, 0
+            )
+        requests = 0
+        for (vid,), child in self._m_requests_fam.children():
+            requests += int(child.value - self._req_bases.get(vid, 0.0))
+        return lat, requests
 
     def _upgrade_check_loop(self) -> None:
         from predictionio_tpu.tools.upgrade import check_for_upgrade
@@ -673,16 +816,42 @@ class QueryAPI:
             item = self._feedback_queue.get()
             if item is self._FEEDBACK_STOP:
                 return
-            url, data = item
+            url, data, tinfo = item if len(item) == 3 else (*item, None)
             with hb.busy():
-                self._post_feedback(url, data)
+                if tinfo is None:
+                    self._post_feedback(url, data)
+                    continue
+                # propagate the serving trace onto the feedback POST and
+                # record the hop: the event server's ingest spans parent
+                # on this feedback-post span, which parents on the
+                # request's http span
+                trace_id, parent_span = tinfo
+                span_id = _tracing.new_span_id()
+                t0 = time.time()
+                try:
+                    self._post_feedback(
+                        url, data,
+                        headers={
+                            _tracing.TRACE_HEADER: trace_id,
+                            _tracing.PARENT_HEADER: span_id,
+                        },
+                    )
+                finally:
+                    _tracing.record_span(
+                        "feedback-post", trace_id, span_id=span_id,
+                        parent_id=parent_span, start_s=t0,
+                        duration_s=time.time() - t0,
+                    )
 
-    def _post_feedback(self, url, data) -> None:
+    def _post_feedback(self, url, data, headers=None) -> None:
         try:
             req = urllib.request.Request(
                 url,
                 data=json.dumps(data).encode("utf-8"),
-                headers={"Content-Type": "application/json"},
+                headers={
+                    "Content-Type": "application/json",
+                    **(headers or {}),
+                },
                 method="POST",
             )
             with urllib.request.urlopen(req, timeout=10) as resp:
@@ -777,6 +946,8 @@ class QueryAPI:
             )
         if path == "/debug/traces.json" and method == "GET":
             return self._debug_traces(query)
+        if path == "/debug/predictions.json" and method == "GET":
+            return self._debug_predictions(query)
         if path == "/queries.json" and method == "POST":
             return self._handle_query(body, headers)
         if path == "/reload" and method == "GET":
@@ -815,6 +986,45 @@ class QueryAPI:
         return (
             200,
             {"spans": _tracing.dump(query.get("traceId") or None)},
+            "application/json",
+        )
+
+    def _debug_predictions(self, query: Dict[str, str]) -> Tuple[int, Any, str]:
+        """The capture-ring dump. The payload is directly persistable as
+        a capture file for ``pio replay`` (workflow/quality.py documents
+        the record format). Unlike the span dump (opt-in trace ids, no
+        bodies), these records hold full query/result payloads — so the
+        endpoint REQUIRES a configured access key; a keyless deployment
+        keeps capturing (shadow scoring reads the ring in-process) but
+        refuses to serve it."""
+        if not self.config.access_key:
+            return (
+                403,
+                {
+                    "message": "predictions dump requires a configured "
+                    "access key (deploy with --accesskey)."
+                },
+                "application/json",
+            )
+        if not secrets.compare_digest(
+            query.get("accessKey", ""), self.config.access_key
+        ):
+            return (
+                401, {"message": "Invalid accessKey."}, "application/json"
+            )
+        limit = None
+        if query.get("limit"):
+            try:
+                limit = int(query["limit"])
+            except ValueError:
+                return 400, {"message": "invalid limit"}, "application/json"
+        return (
+            200,
+            {
+                "predictions": _quality.get_capture().dump(
+                    limit=limit, version=query.get("version") or None
+                )
+            },
             "application/json",
         )
 
@@ -896,11 +1106,29 @@ class QueryAPI:
         serving_start, tctx=None, inbound_parent=None,
     ) -> Tuple[int, Any, str]:
         prediction_json = deployed.algorithms[0].result_to_json(prediction)
+        # the capture baseline is the RAW model output (pre-stamp,
+        # pre-plugin): `pio replay` re-runs exactly the model path, so a
+        # self-replay against the same instance is byte-comparable. The
+        # sampling draw is an atomic itertools counter (done callbacks
+        # run on concurrent batch threads), and the snapshot is a deep
+        # copy — a plugin blocker may mutate the response's nested
+        # structures in place and must not corrupt the capture.
+        do_capture = self.config.capture_sample > 0 and (
+            next(self._capture_count) % self.config.capture_sample == 0
+        )
+        raw_json = copy.deepcopy(prediction_json) if do_capture else None
+        version = _version_of(deployed)
+        # per-version attribution: stamp the model version onto every
+        # served prediction, so clients (and the feedback event) can
+        # name the exact persisted round that produced it
+        if isinstance(prediction_json, dict):
+            prediction_json = dict(prediction_json, modelVersion=version)
 
+        pr_id = None
         if self.config.feedback:
-            prediction_json = self._feedback(
+            prediction_json, pr_id = self._feedback(
                 deployed, query, query_json, prediction, prediction_json,
-                query_time,
+                query_time, tctx,
             )
 
         prediction_json = self.plugin_context.run_blockers(
@@ -912,10 +1140,21 @@ class QueryAPI:
 
         elapsed = time.perf_counter() - serving_start
         # registry bookkeeping: per-child locks only, no shared hot-path
-        # lock (the old reservoir serialized every request on one mutex)
-        self._m_latency.observe(elapsed)
-        self._m_requests.inc()
-        self._m_last.set(elapsed)
+        # lock. The children are the SERVING deployed's version — during
+        # a /reload swap, in-flight queries still record under the old
+        # version while new ones record under the new.
+        self._m_latency_fam.labels(version=version).observe(elapsed)
+        self._m_requests_fam.labels(version=version).inc()
+        self._m_last_fam.labels(version=version).set(elapsed)
+        if do_capture:
+            _quality.get_capture().record(
+                version=version,
+                query_json=query_json,
+                result_json=raw_json,
+                pr_id=pr_id,
+                trace_id=tctx.trace_id if tctx is not None else None,
+                latency_s=elapsed,
+            )
         if tctx is not None:
             _tracing.record_span(
                 "http:/queries.json", tctx.trace_id, span_id=tctx.span_id,
@@ -927,7 +1166,7 @@ class QueryAPI:
 
     def _feedback(
         self, deployed, query, query_json, prediction, prediction_json,
-        query_time,
+        query_time, tctx=None,
     ):
         org = getattr(prediction, "pr_id", None)
         new_pr_id = org if org else _gen_pr_id()
@@ -951,13 +1190,19 @@ class QueryAPI:
             f"{self.config.event_server_port}/events.json?"
             + urllib.parse.urlencode({"accessKey": self.config.access_key})
         )
-        self._enqueue_feedback((url, data))
+        # traced requests carry (trace id, http span id) onto the queue
+        # so the drainer's POST propagates X-PIO-Trace-Id — the ingest
+        # span chain joins the serving trace instead of dead-ending here
+        tinfo = (tctx.trace_id, tctx.span_id) if tctx is not None else None
+        self._enqueue_feedback((url, data, tinfo))
         self._ensure_feedback_worker()
 
-        # inject the fresh prId into the response if the result carries one
-        if hasattr(prediction, "pr_id") and isinstance(prediction_json, dict):
+        # inject the fresh prId into the response: it is the attribution
+        # join key the client must echo on subsequent events (reference
+        # CreateServer.scala:525 returns it the same way)
+        if isinstance(prediction_json, dict):
             prediction_json = dict(prediction_json, prId=new_pr_id)
-        return prediction_json
+        return prediction_json, new_pr_id
 
     # --- status page (reference CreateServer.scala:444-471 html.index) ---
 
@@ -974,14 +1219,17 @@ class QueryAPI:
 
         inst = self.deployed.engine_instance
         batch_stats = self._executor.stats()
-        lat = self._m_latency.snapshot().delta(self._lat_base)
-        requests = int(self._m_requests.value - self._requests_base)
+        lat, requests = self._serving_totals()
         with self._stats_lock:
             upgrade_status = self._upgrade_status
             upgrade_checked = self._upgrade_checked_at
         return {
             "status": "alive",
             "engineInstanceId": inst.id,
+            # the model-version label every serving metric carries
+            # (pio_model_info flips on /reload)
+            "modelVersion": _version_of(self.deployed),
+            "predictionCapture": _quality.get_capture().stats(),
             "engineFactory": inst.engine_factory,
             "startTime": self.server_start_time.isoformat(),
             "algorithms": [type(a).__name__ for a in self.deployed.algorithms],
@@ -1123,7 +1371,11 @@ class EngineServer:
                 engine_variant=current.engine_variant,
                 ctx=self._serving_ctx,
             )
-            self.api.deployed = fresh
+            # bind_deployed swaps the snapshot AND re-points the
+            # per-version serving metrics + pio_model_info at the fresh
+            # instance (in-flight queries keep recording under the old
+            # version label)
+            self.api.bind_deployed(fresh)
             logger.info(
                 "reloaded engine instance %s", fresh.engine_instance.id
             )

@@ -118,6 +118,14 @@ class EventServerConfig:
     # stays available via `pio compact`.
     compact: bool = True
     compact_interval_s: float = 60.0
+    # online feedback join (workflow/quality.py): committed feedback
+    # `predict` events populate the prId→served-prediction table, and
+    # committed events carrying a prId join against it, emitting
+    # pio_online_attributed_total{version,outcome} + rank/time-to-
+    # conversion histograms. Runs via the generic commit hook
+    # (EventAPI.add_commit_observer); overhead is hard-gated <2% of
+    # batch-ingest throughput by `bench.py --only quality`.
+    attribution: bool = True
 
     def __post_init__(self):
         if self.transport not in TRANSPORTS:
@@ -193,7 +201,34 @@ class EventAPI:
         self._ready_probes = (
             _health.TTLProbe("store", self._probe_store),
         )
+        # the commit hook: observers run AFTER events commit, on the
+        # ingest path, with the committed Event objects. The online
+        # feedback join registers here; the per-user-cache tier's
+        # change notifications (ROADMAP) will ride the same hook.
+        self._commit_observers: list = []
+        if self.config.attribution:
+            from predictionio_tpu.workflow.quality import (
+                attribution_observer,
+            )
+
+            self.add_commit_observer(attribution_observer())
         _LIVE_APIS.add(self)
+
+    def add_commit_observer(self, fn) -> None:
+        """Register ``fn(app_id, channel_id, events)`` to run after each
+        successful insert/batch commit. Observers must be cheap (they
+        sit on the ingest path) and must not raise — failures are
+        logged and swallowed."""
+        self._commit_observers.append(fn)
+
+    def _notify_commit(self, app_id, channel_id, events) -> None:
+        if not self._commit_observers or not events:
+            return
+        for obs in self._commit_observers:
+            try:
+                obs(app_id, channel_id, events)
+            except Exception:
+                logger.exception("commit observer failed")
 
     def _probe_store(self) -> None:
         self.storage.get_meta_data_apps().get_all()
@@ -432,6 +467,12 @@ class EventAPI:
                 ),
             },
         }
+        if self.config.attribution:
+            from predictionio_tpu.workflow.quality import get_attribution
+
+            # the online feedback join (cross-app aggregate: version
+            # labels are engine-instance ids, not app data)
+            out["attribution"] = get_attribution().stats()
         key = (query or {}).get("accessKey")
         if key:
             access_key = self._lookup_access_key(key)
@@ -458,6 +499,7 @@ class EventAPI:
         event_id = self._events.insert(event, app_id, channel_id)
         self.plugin_context.notify_sniffers(app_id, channel_id, event)
         self._m_ingested.labels(route=route).inc()
+        self._notify_commit(app_id, channel_id, (event,))
         result = (201, {"eventId": event_id})
         if self.config.stats:
             self.stats.bookkeeping(app_id, result[0], event)
@@ -557,6 +599,7 @@ class EventAPI:
                 # failed slots (a blanket 500 would make it re-post the
                 # committed slice under fresh ids)
                 event_ids, failed = e.event_ids, e.failed_ids
+            committed = []
             for (slot, event), event_id in zip(pending, event_ids):
                 if event_id in failed:
                     results[slot] = {
@@ -566,9 +609,11 @@ class EventAPI:
                     continue
                 results[slot] = {"status": 201, "eventId": event_id}
                 self._m_ingested.labels(route="batch").inc()
+                committed.append(event)
                 self.plugin_context.notify_sniffers(app_id, channel_id, event)
                 if self.config.stats:
                     self.stats.bookkeeping(app_id, 201, event)
+            self._notify_commit(app_id, channel_id, committed)
         return 200, results
 
     def _post_event(

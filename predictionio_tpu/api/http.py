@@ -209,7 +209,11 @@ class _Handler(BaseHTTPRequestHandler):
             # str payloads are sent verbatim (pre-rendered JSON, HTML, text)
             data = str(payload).encode("utf-8")
         self.send_response(status)
-        self.send_header("Content-Type", f"{out_type}; charset=utf-8")
+        # fully-qualified content types (the Prometheus exposition's
+        # "; version=0.0.4; charset=utf-8") pass through verbatim
+        if "charset=" not in out_type:
+            out_type = f"{out_type}; charset=utf-8"
+        self.send_header("Content-Type", out_type)
         self.send_header("Content-Length", str(len(data)))
         self.end_headers()
         self.wfile.write(data)

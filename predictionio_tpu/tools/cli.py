@@ -227,6 +227,16 @@ def _train_continuous(
                 else ""
             ),
         )
+        if rep.shadow:
+            logger.info(
+                "round %d shadow: %s vs live %s — %s (jaccard %.4f, "
+                "displacement %.2f, %d queries)",
+                rep.round, rep.shadow["candidateVersion"],
+                rep.shadow["liveVersion"], rep.shadow["verdict"],
+                rep.shadow["jaccard_mean"],
+                rep.shadow["rank_displacement_mean"],
+                rep.shadow["queries"],
+            )
 
     print(
         f"Continuous training every {args.interval:g}s "
@@ -240,6 +250,8 @@ def _train_continuous(
         stop_event=stop,
         max_rounds=args.max_rounds,
         on_round=on_round,
+        shadow_queries=getattr(args, "shadow_queries", 0) or 0,
+        shadow_min_jaccard=getattr(args, "shadow_min_jaccard", 0.5),
     )
     print(f"Continuous training stopped after {rounds} round(s).")
     return 0
@@ -718,6 +730,61 @@ def cmd_trace(args) -> int:
     return 0
 
 
+def cmd_replay(args) -> int:
+    """``pio replay``: re-run a prediction capture (a saved
+    ``/debug/predictions.json`` dump or a JSON-lines capture file)
+    against a persisted model instance and report divergence — the
+    deterministic regression oracle for model swaps. A self-replay
+    against the instance that produced the capture reports exactly
+    zero divergence (jaccard 1.0, rank displacement 0)."""
+    from predictionio_tpu.api.engine_server import DeployedEngine
+    from predictionio_tpu.data.storage import get_storage
+    from predictionio_tpu.workflow.quality import (
+        load_capture,
+        replay_capture,
+    )
+
+    records = load_capture(args.capture)
+    if args.version:
+        records = [r for r in records if r.get("version") == args.version]
+    if args.num:
+        records = records[-args.num:]
+    if not records:
+        print("replay: capture holds no matching records", file=sys.stderr)
+        return 1
+    variant = load_variant(args.variant)
+    engine, _ = engine_from_variant(variant)
+    deployed = DeployedEngine.from_storage(
+        engine, get_storage(), engine_instance_id=args.engine_instance_id
+    )
+    report = replay_capture(records, deployed, batch=args.batch)
+    captured_versions = sorted(
+        {r.get("version", "unknown") for r in records}
+    )
+    print(
+        f"replayed {report['queries']} queries "
+        f"(captured from {', '.join(captured_versions)}) against "
+        f"{report['targetVersion']}"
+    )
+    print(
+        f"  jaccard mean {report['jaccard_mean']:.6f} "
+        f"min {report['jaccard_min']:.6f}"
+    )
+    print(
+        f"  rank displacement mean {report['rank_displacement_mean']:.4f} "
+        f"max {report['rank_displacement_max']:.4f}"
+    )
+    print(f"  score delta mean {report['score_delta_mean']:.3e}")
+    print(f"  diverged: {report['diverged']}/{report['queries']}")
+    if args.json:
+        with open(args.json, "w", encoding="utf-8") as f:
+            json.dump(report, f, indent=2)
+        print(f"  report written to {args.json}")
+    if args.fail_on_divergence and report["diverged"]:
+        return 1
+    return 0
+
+
 def cmd_top(args) -> int:
     """Live fleet console over /metrics + /healthz + /readyz
     (tools/top.py): one row per server URL, refreshed every --interval
@@ -1054,6 +1121,17 @@ def build_parser() -> argparse.ArgumentParser:
         help="stop the continuous loop after N rounds (default: run "
         "until signalled)",
     )
+    train.add_argument(
+        "--shadow-queries", type=int, default=0,
+        help="with --continuous: shadow-score each trained round "
+        "against the previous instance on the newest N captured "
+        "queries (0 disables; see workflow/quality.py)",
+    )
+    train.add_argument(
+        "--shadow-min-jaccard", type=float, default=0.5,
+        help="mean-jaccard floor below which a shadow-scored round's "
+        "verdict is 'diverged' (default 0.5)",
+    )
     train.set_defaults(func=cmd_train)
 
     ev = sub.add_parser("eval", help="run an evaluation")
@@ -1213,6 +1291,41 @@ def build_parser() -> argparse.ArgumentParser:
         "--json", action="store_true", help="raw span JSON, not the tree"
     )
     tr.set_defaults(func=cmd_trace)
+
+    rp = sub.add_parser(
+        "replay",
+        help="re-run a prediction capture against a persisted model "
+        "instance and report divergence (jaccard@n, rank displacement, "
+        "score delta)",
+    )
+    rp.add_argument(
+        "--capture", required=True,
+        help="capture file: a saved /debug/predictions.json dump or "
+        "JSON-lines records (workflow/quality.py format)",
+    )
+    rp.add_argument("-v", "--variant", default="engine.json")
+    rp.add_argument(
+        "--engine-instance-id",
+        help="target instance (default: latest COMPLETED)",
+    )
+    rp.add_argument(
+        "--version",
+        help="replay only records captured from this model version",
+    )
+    rp.add_argument(
+        "--num", type=int, default=0,
+        help="replay only the newest N records (default: all)",
+    )
+    rp.add_argument(
+        "--batch", type=int, default=64,
+        help="queries per serve_batch call during replay",
+    )
+    rp.add_argument("--json", help="write the full report JSON here")
+    rp.add_argument(
+        "--fail-on-divergence", action="store_true",
+        help="exit nonzero when any replayed query diverged",
+    )
+    rp.set_defaults(func=cmd_replay)
 
     top = sub.add_parser(
         "top",

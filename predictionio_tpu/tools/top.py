@@ -36,6 +36,40 @@ def _family_name(sample_key: str) -> str:
     return sample_key.split("{", 1)[0]
 
 
+def _label_value(sample_key: str, label: str) -> Optional[str]:
+    m = re.search(rf'{label}="([^"]*)"', sample_key)
+    return m.group(1) if m else None
+
+
+def active_model_version(samples: Dict[str, float]) -> Optional[str]:
+    """The version label(s) of ``pio_model_info`` samples at 1 — what
+    this server is actively serving (a swap flips the old one to 0)."""
+    active = [
+        _label_value(key, "version")
+        for key, value in samples.items()
+        if _family_name(key) == "pio_model_info" and value == 1.0
+    ]
+    active = sorted(v for v in active if v)
+    return ",".join(active) if active else None
+
+
+def attributed_hit_rate(samples: Dict[str, float]) -> Optional[float]:
+    """converted / (converted + miss) over the online feedback-join
+    counters, summed across versions ('unknown' outcomes — expired or
+    foreign prIds — are excluded from the denominator)."""
+    converted = missed = 0.0
+    for key, value in samples.items():
+        if _family_name(key) != "pio_online_attributed_total":
+            continue
+        outcome = _label_value(key, "outcome")
+        if outcome == "converted":
+            converted += value
+        elif outcome == "miss":
+            missed += value
+    denom = converted + missed
+    return (converted / denom) if denom else None
+
+
 def counter_sum(samples: Dict[str, float], family: str) -> float:
     """Sum a counter family across its label sets."""
     total = 0.0
@@ -163,6 +197,18 @@ def _row(snap: dict, prev: Optional[dict], elapsed_s: float) -> dict:
     mask_age = gauge_max(m, "pio_retrieval_mask_age_seconds")
     if mask_age is not None:
         row["mask_age_s"] = mask_age
+    # model-quality columns: the actively served version(s) and the
+    # online attributed hit rate (converted / attributed, across the
+    # fleet's feedback join) — an engine server shows VERSION, an event
+    # server (the ingest side of the join) shows HIT%
+    version = active_model_version(m)
+    if version is not None:
+        row["version"] = (
+            version if len(version) <= 12 else version[:11] + "…"
+        )
+    hit = attributed_hit_rate(m)
+    if hit is not None:
+        row["hit_rate"] = round(hit * 100.0, 1)
     stalled = snap.get("ready_detail", {}).get("stalledDaemons") or {}
     if stalled:
         row["stalled"] = ",".join(sorted(stalled))
@@ -179,6 +225,8 @@ _COLUMNS = (
     ("p99_ms", "P99ms", 8),
     ("lag_ms", "LAGms", 7),
     ("errors", "ERR", 5),
+    ("version", "VERSION", 12),
+    ("hit_rate", "HIT%", 6),
     ("rounds", "ROUNDS", 7),
     ("last_delta", "CONV", 9),
     ("resident_mb", "RES_MB", 7),

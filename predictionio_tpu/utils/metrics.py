@@ -572,7 +572,10 @@ class MetricsRegistry:
 
 
 def render_content_type() -> str:
-    return "text/plain; version=0.0.4"
+    """The Prometheus text exposition content type, fully qualified —
+    the transports send it verbatim (they only append a charset to
+    types that lack one)."""
+    return "text/plain; version=0.0.4; charset=utf-8"
 
 
 def parse_exposition(text: str) -> Dict[str, float]:

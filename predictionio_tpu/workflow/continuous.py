@@ -89,6 +89,14 @@ class RoundReport:
     # sweep count and the final sweep's factor-delta RMS per side
     sweeps: Optional[int] = None
     final_factor_delta: Optional[str] = None
+    # shadow-scoring verdict (workflow/quality.py shadow_score): the
+    # candidate instance scored against the previous round's (live)
+    # instance on the captured query sample — jaccard/displacement/
+    # score-delta plus the 'comparable'/'diverged' verdict the future
+    # swap pipeline consumes as its refuse-swap signal. None when
+    # shadow scoring is disabled, no previous instance exists yet, or
+    # the capture ring is empty.
+    shadow: Optional[Dict] = None
 
 
 def poll_fingerprint(engine_params, storage) -> Optional[tuple]:
@@ -114,6 +122,34 @@ def poll_fingerprint(engine_params, storage) -> Optional[tuple]:
         return None
 
 
+def _shadow_round(
+    engine, storage, live_instance_id, candidate_instance_id,
+    shadow_queries: int, shadow_min_jaccard: float,
+) -> Optional[Dict]:
+    """Shadow-score one trained round; never fails the loop (a broken
+    shadow comparison is an observability gap, not a training error)."""
+    from predictionio_tpu.workflow import quality as _quality
+
+    records = _quality.get_capture().sample(shadow_queries)
+    if not records:
+        return None
+    try:
+        shadow = _quality.shadow_score(
+            engine, storage, live_instance_id, candidate_instance_id,
+            records, min_jaccard=shadow_min_jaccard,
+        )
+        logger.info(
+            "shadow round: %s vs %s on %d captured queries — %s "
+            "(jaccard %.4f)",
+            candidate_instance_id, live_instance_id, shadow["queries"],
+            shadow["verdict"], shadow["jaccard_mean"],
+        )
+        return shadow
+    except Exception:
+        logger.exception("shadow scoring failed")
+        return None
+
+
 def continuous_train(
     engine,
     engine_params,
@@ -126,6 +162,8 @@ def continuous_train(
     stop_event: Optional[threading.Event] = None,
     max_rounds: Optional[int] = None,
     on_round: Optional[Callable[[RoundReport], None]] = None,
+    shadow_queries: int = 0,
+    shadow_min_jaccard: float = 0.5,
 ) -> int:
     """Run the poll→delta-fold→warm-train→checkpoint loop until
     ``stop_event`` is set (or ``max_rounds`` rounds ran — tests/bench).
@@ -138,7 +176,16 @@ def continuous_train(
     live in the single-device streaming pipeline (algorithms collapse a
     trivial mesh onto it), and a continuous retrain at delta cost never
     needs the full slice — mesh-parallel retraining is the ROADMAP's
-    ALX-style sharded item. Pass an explicit mesh to override."""
+    ALX-style sharded item. Pass an explicit mesh to override.
+
+    ``shadow_queries`` > 0 shadow-scores every trained round: the fresh
+    candidate instance is served against the PREVIOUS round's instance
+    on the newest ``shadow_queries`` records of the process-global
+    prediction capture (workflow/quality.py), and the verdict —
+    ``comparable`` when the mean jaccard clears ``shadow_min_jaccard``
+    — lands in ``RoundReport.shadow`` and the ``pio_shadow_*``
+    families. This runs on the training loop only, never the serving
+    path."""
     from predictionio_tpu.workflow.context import workflow_context
     from predictionio_tpu.workflow.core_workflow import CoreWorkflow
 
@@ -156,6 +203,9 @@ def continuous_train(
     rounds = 0
     last_fp: Optional[tuple] = None
     trained_once = False
+    # the "live" reference for shadow scoring: the previous trained
+    # round's instance (what a deployed server would be serving now)
+    live_instance_id: Optional[str] = None
     # watchdog: a round that wedges (a hung scan, a stuck device call)
     # flips every in-process server's /readyz to 503 once it overruns
     # the deadline — the signal the hot-swap/fleet tier routes on
@@ -211,6 +261,13 @@ def continuous_train(
                 sweeps=notes.get("sweeps"),
                 final_factor_delta=notes.get("final_factor_delta"),
             )
+            if shadow_queries > 0 and live_instance_id and instance_id:
+                report.shadow = _shadow_round(
+                    engine, ctx.storage, live_instance_id, instance_id,
+                    shadow_queries, shadow_min_jaccard,
+                )
+            if instance_id:
+                live_instance_id = instance_id
             logger.info(
                 "continuous round %d: %s in %.3fs (%s%s%s)",
                 report.round, instance_id, report.wall_s,

@@ -1,0 +1,583 @@
+"""Online model-quality observability: attribution, capture, replay, shadow.
+
+PRs 6–7 made the *system* observable; this module makes the *model*
+observable in production. Four cooperating pieces, all keyed by the two
+identifiers the serving tier already emits:
+
+- the **model version** — the engine instance id of the persisted round
+  a prediction was served from (stamped on every response and on every
+  feedback ``predict`` event as ``engineInstanceId``);
+- the **prId** — the 64-char correlation id the feedback loop mints per
+  served prediction (reference CreateServer.scala:525) so subsequent
+  user events can be attributed back to the prediction that caused them.
+
+1. :class:`AttributionTable` — a bounded, TTL'd table of recently
+   served predictions (prId → version, served item ids), fed by the
+   event server's commit hook from the feedback ``predict`` events
+   themselves (the join key rides the ordinary event stream, so it
+   works across processes with zero extra plumbing). Events arriving
+   with a ``prId`` join against it and emit
+   ``pio_online_attributed_total{version,outcome}`` plus
+   rank-of-conversion and time-to-conversion histograms — real
+   CTR-style quality per model version, computed on the ingest path.
+2. :class:`PredictionCapture` — a sampled bounded ring of served
+   predictions (query, result item ids/scores, version, trace id),
+   dumped at the engine server's gated ``GET /debug/predictions.json``
+   and persistable to a capture file.
+3. :func:`replay_capture` — re-run a capture against any persisted
+   model instance and report divergence (jaccard@n, rank displacement,
+   score delta). A self-replay against the instance that produced the
+   capture reports exactly zero divergence — the deterministic
+   regression oracle for model swaps.
+4. :func:`shadow_score` — score a freshly trained candidate instance
+   against the live instance on the captured query sample (the
+   continuous-training loop calls it per round), recording
+   ``pio_shadow_*`` families and a per-round verdict — the refuse-swap
+   signal the zero-downtime deployment pipeline consumes.
+
+Like utils/tracing.py, this module is a sanctioned home for bounded
+module-level observability state (the process-global capture ring and
+attribution table); every counter lives in the metrics registry.
+"""
+
+from __future__ import annotations
+
+import collections
+import hashlib
+import json
+import logging
+import threading
+import time
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
+
+from predictionio_tpu.utils import metrics as _metrics
+
+logger = logging.getLogger(__name__)
+
+__all__ = [
+    "AttributionTable",
+    "PredictionCapture",
+    "attribution_observer",
+    "compare_topn",
+    "extract_items",
+    "get_attribution",
+    "get_capture",
+    "load_capture",
+    "replay_capture",
+    "save_capture",
+    "shadow_score",
+]
+
+# response/result keys the serving tier injects after the model ran —
+# stripped before the generic whole-result comparison so a replayed
+# result (no prId minted, same model) still matches its capture
+_VOLATILE_RESULT_KEYS = ("prId", "modelVersion")
+
+ATTRIBUTION_OUTCOMES = ("converted", "miss", "unknown")
+
+
+def extract_items(result_json: Any) -> Tuple[Tuple[str, ...], Tuple[float, ...]]:
+    """The ordered (item ids, scores) of a served prediction's JSON.
+
+    Engines speaking the reference wire format (``itemScores`` — the
+    recommendation/similarproduct/ecommerce templates) yield their real
+    ranked item lists. Any other result shape degrades to ONE pseudo
+    item — a digest of the canonical result JSON — so identity
+    comparisons (jaccard 1.0 vs 0.0) still work for arbitrary engines.
+    """
+    if isinstance(result_json, dict):
+        scores = result_json.get("itemScores")
+        if isinstance(scores, list) and all(
+            isinstance(s, dict) and "item" in s for s in scores
+        ):
+            return (
+                tuple(str(s["item"]) for s in scores),
+                tuple(float(s.get("score", 0.0)) for s in scores),
+            )
+        result_json = {
+            k: v
+            for k, v in result_json.items()
+            if k not in _VOLATILE_RESULT_KEYS
+        }
+    blob = json.dumps(result_json, sort_keys=True, default=str)
+    digest = hashlib.sha1(blob.encode("utf-8")).hexdigest()
+    return (digest,), (0.0,)
+
+
+def compare_topn(
+    a_items: Sequence[str],
+    a_scores: Sequence[float],
+    b_items: Sequence[str],
+    b_scores: Sequence[float],
+) -> Dict[str, float]:
+    """Divergence between two ranked result lists.
+
+    - ``jaccard``: set overlap of the served ids (1.0 when both empty);
+    - ``rank_displacement``: mean |rank_a − rank_b| over the common ids
+      (0.0 when nothing is common — jaccard carries that signal);
+    - ``score_delta``: mean |score_a − score_b| over the common ids.
+    """
+    sa, sb = set(a_items), set(b_items)
+    union = sa | sb
+    common = sa & sb
+    jaccard = (len(common) / len(union)) if union else 1.0
+    pos_a = {item: i for i, item in enumerate(a_items)}
+    pos_b = {item: i for i, item in enumerate(b_items)}
+    score_a = dict(zip(a_items, a_scores))
+    score_b = dict(zip(b_items, b_scores))
+    if common:
+        displacement = sum(
+            abs(pos_a[i] - pos_b[i]) for i in common
+        ) / len(common)
+        score_delta = sum(
+            abs(score_a.get(i, 0.0) - score_b.get(i, 0.0)) for i in common
+        ) / len(common)
+    else:
+        displacement = 0.0
+        score_delta = 0.0
+    return {
+        "jaccard": jaccard,
+        "rank_displacement": displacement,
+        "score_delta": score_delta,
+    }
+
+
+# --- attribution: the prId → served-prediction join on the ingest path ---
+
+
+def _attributed_counter() -> "_metrics.Counter":
+    return _metrics.get_registry().counter(
+        "pio_online_attributed_total",
+        "Ingested events joined against recently served predictions, "
+        "by model version and outcome (converted = the event's target "
+        "item was in the served list)",
+        labels=("version", "outcome"),
+    )
+
+
+def _conversion_rank_hist() -> "_metrics.Histogram":
+    return _metrics.get_registry().histogram(
+        "pio_online_conversion_rank",
+        "1-based rank of the converted item within its served list",
+        labels=("version",),
+        buckets=_metrics.BATCH_SIZE_BUCKETS,
+    )
+
+
+def _time_to_conversion_hist() -> "_metrics.Histogram":
+    return _metrics.get_registry().histogram(
+        "pio_online_time_to_conversion_seconds",
+        "Serve-to-feedback-event delay for converted predictions",
+        labels=("version",),
+        buckets=_metrics.LATENCY_BUCKETS_S,
+    )
+
+
+class AttributionTable:
+    """Bounded TTL'd prId → (version, served item ids, t) table.
+
+    Registered from feedback ``predict`` events (entityType ``pio_pr``,
+    entityId = the served prId — reference CreateServer.scala:509-579);
+    queried by every ingested event that carries a ``prId``. Both sides
+    run on the event server's ingest path, so each operation is one
+    lock + dict op — the overhead is hard-gated <2% of batch-ingest
+    throughput by ``bench.py --only quality``.
+    """
+
+    def __init__(self, ttl_s: float = 900.0, max_entries: int = 65536):
+        self.ttl_s = float(ttl_s)
+        self.max_entries = int(max_entries)
+        self._entries: "collections.OrderedDict[str, tuple]" = (
+            collections.OrderedDict()
+        )
+        self._lock = threading.Lock()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+
+    def register(
+        self,
+        pr_id: str,
+        version: str,
+        items: Sequence[str],
+        t: Optional[float] = None,
+    ) -> None:
+        now = time.time() if t is None else t
+        with self._lock:
+            self._entries.pop(pr_id, None)
+            self._entries[pr_id] = (version, tuple(items), now)
+            while len(self._entries) > self.max_entries:
+                self._entries.popitem(last=False)
+
+    def register_from_event(self, event) -> None:
+        """Register a feedback ``predict`` event: entityId is the served
+        prId, ``engineInstanceId`` the model version, ``prediction`` the
+        served result JSON."""
+        props = event.properties
+        version = str(props.get_opt("engineInstanceId") or "unknown")
+        items, _ = extract_items(props.get_opt("prediction"))
+        self.register(event.entity_id, version, items)
+
+    def observe(self, event, now: Optional[float] = None) -> Optional[str]:
+        """Join one prId-carrying event; returns the outcome recorded
+        (``converted`` / ``miss`` / ``unknown``), or None when the
+        event carries no prId."""
+        pr_id = event.pr_id
+        if not pr_id:
+            return None
+        now = time.time() if now is None else now
+        with self._lock:
+            entry = self._entries.get(pr_id)
+            if entry is not None and now - entry[2] > self.ttl_s:
+                self._entries.pop(pr_id, None)
+                entry = None
+        if entry is None:
+            _attributed_counter().labels(
+                version="unknown", outcome="unknown"
+            ).inc()
+            return "unknown"
+        version, items, t_served = entry
+        target = event.target_entity_id
+        rank0 = items.index(target) if target in items else -1
+        outcome = "converted" if rank0 >= 0 else "miss"
+        _attributed_counter().labels(version=version, outcome=outcome).inc()
+        if rank0 >= 0:
+            _conversion_rank_hist().labels(version=version).observe(rank0 + 1)
+            _time_to_conversion_hist().labels(version=version).observe(
+                max(0.0, now - t_served)
+            )
+        return outcome
+
+    def stats(self) -> Dict[str, Any]:
+        """Registry-backed attribution summary (status.json / tests):
+        per-version outcome counts plus the derived hit rate
+        (converted / (converted + miss))."""
+        per_version: Dict[str, Dict[str, int]] = {}
+        for (version, outcome), child in _attributed_counter().children():
+            per_version.setdefault(version, {})[outcome] = int(child.value)
+        out: Dict[str, Any] = {"tracked": len(self), "versions": {}}
+        for version, counts in per_version.items():
+            converted = counts.get("converted", 0)
+            missed = counts.get("miss", 0)
+            denom = converted + missed
+            out["versions"][version] = {
+                **counts,
+                "hitRate": (converted / denom) if denom else 0.0,
+            }
+        return out
+
+
+def attribution_observer(table: Optional[AttributionTable] = None):
+    """The event-server commit-hook observer (EventAPI registers it when
+    ``EventServerConfig.attribution`` is on): feedback ``predict``
+    events populate the table, prId-carrying events join against it.
+    The hook point (``EventAPI.add_commit_observer``) is deliberately
+    generic — the per-user-cache tier's change notifications (ROADMAP)
+    ride the same hook."""
+    table = table if table is not None else get_attribution()
+
+    def observe(app_id, channel_id, events) -> None:
+        for e in events:
+            if e.entity_type == "pio_pr" and e.event == "predict":
+                table.register_from_event(e)
+            elif e.pr_id:
+                table.observe(e)
+
+    return observe
+
+
+# --- prediction capture: the sampled serving-record ring ---
+
+
+def _captured_counter() -> "_metrics.Counter":
+    return _metrics.get_registry().counter(
+        "pio_predictions_captured_total",
+        "Served predictions recorded into the capture ring, by version",
+        labels=("version",),
+    )
+
+
+class PredictionCapture:
+    """Bounded ring of served-prediction records. Each record is a JSON
+    dict — the capture *file* format is exactly these records, one per
+    line (or a ``{"predictions": [...]}`` dump / plain JSON array, the
+    shapes ``load_capture`` accepts):
+
+    ``{"prId", "version", "query", "result", "items", "scores",
+    "traceId", "tMs", "latencyMs"}``
+
+    ``items``/``scores`` are extracted at capture time so the replay
+    comparison never depends on how an engine's result JSON evolves.
+    """
+
+    def __init__(self, capacity: int = 1024):
+        self._records: "collections.deque" = collections.deque(
+            maxlen=int(capacity)
+        )
+        self._lock = threading.Lock()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._records)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._records.clear()
+
+    def record(
+        self,
+        version: str,
+        query_json: Any,
+        result_json: Any,
+        pr_id: Optional[str] = None,
+        trace_id: Optional[str] = None,
+        latency_s: float = 0.0,
+    ) -> dict:
+        items, scores = extract_items(result_json)
+        entry = {
+            "prId": pr_id,
+            "version": version,
+            "query": query_json,
+            "result": result_json,
+            "items": list(items),
+            "scores": [round(s, 8) for s in scores],
+            "traceId": trace_id,
+            "tMs": round(time.time() * 1000.0, 3),
+            "latencyMs": round(latency_s * 1000.0, 3),
+        }
+        with self._lock:
+            self._records.append(entry)
+        _captured_counter().labels(version=version).inc()
+        return entry
+
+    def dump(
+        self, limit: Optional[int] = None, version: Optional[str] = None
+    ) -> List[dict]:
+        with self._lock:
+            records = list(self._records)
+        if version:
+            records = [r for r in records if r.get("version") == version]
+        if limit is not None:
+            records = records[-int(limit):]
+        return records
+
+    def sample(self, n: int) -> List[dict]:
+        """The newest ``n`` records — the shadow-scoring query sample."""
+        return self.dump(limit=n)
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            records = list(self._records)
+        versions: Dict[str, int] = {}
+        for r in records:
+            versions[r.get("version", "unknown")] = (
+                versions.get(r.get("version", "unknown"), 0) + 1
+            )
+        return {"records": len(records), "versions": versions}
+
+
+def save_capture(path: str, records: Iterable[dict]) -> int:
+    """Persist capture records as JSON lines; returns the count."""
+    n = 0
+    with open(path, "w", encoding="utf-8") as f:
+        for r in records:
+            f.write(json.dumps(r) + "\n")
+            n += 1
+    return n
+
+
+def load_capture(path: str) -> List[dict]:
+    """Load a capture file: JSON lines (``save_capture``), a JSON array,
+    or a ``{"predictions": [...]}`` object (a saved
+    ``/debug/predictions.json`` response) all work."""
+    with open(path, "r", encoding="utf-8") as f:
+        text = f.read().strip()
+    if not text:
+        return []
+    if text[0] in "[{":
+        try:
+            obj = json.loads(text)
+        except json.JSONDecodeError:
+            obj = None
+        if isinstance(obj, dict) and isinstance(
+            obj.get("predictions"), list
+        ):
+            return obj["predictions"]
+        if isinstance(obj, list):
+            return obj
+    return [json.loads(line) for line in text.splitlines() if line.strip()]
+
+
+# --- replay: the deterministic divergence oracle ---
+
+
+def _serve_records(deployed, records: List[dict], batch: int = 64):
+    """Re-serve each record's query through ``deployed`` (its own
+    micro-batch path, ``serve_batch``) and yield per-record
+    (items, scores) extracted from the fresh result JSON."""
+    algo = deployed.algorithms[0]
+    for start in range(0, len(records), max(1, batch)):
+        chunk = records[start:start + max(1, batch)]
+        queries = [algo.query_from_json(r["query"]) for r in chunk]
+        results = deployed.serve_batch(queries)
+        for prediction in results:
+            yield extract_items(algo.result_to_json(prediction))
+
+
+def replay_capture(
+    records: List[dict],
+    deployed,
+    batch: int = 64,
+    score_tol: float = 1e-5,
+) -> Dict[str, Any]:
+    """Re-run a capture against ``deployed`` and report divergence
+    against the recorded results. A self-replay (same instance the
+    capture was recorded from) must report jaccard 1.0 / displacement 0
+    — asserted by tests and the bench smoke."""
+    n = 0
+    diverged = 0
+    jaccards: List[float] = []
+    displacements: List[float] = []
+    score_deltas: List[float] = []
+    worst: Optional[dict] = None
+    for record, (items, scores) in zip(
+        records, _serve_records(deployed, records, batch=batch)
+    ):
+        cmp = compare_topn(
+            record.get("items") or (),
+            record.get("scores") or (),
+            items,
+            scores,
+        )
+        n += 1
+        jaccards.append(cmp["jaccard"])
+        displacements.append(cmp["rank_displacement"])
+        score_deltas.append(cmp["score_delta"])
+        is_diverged = (
+            cmp["jaccard"] < 1.0
+            or cmp["rank_displacement"] > 0
+            or cmp["score_delta"] > score_tol
+        )
+        if is_diverged:
+            diverged += 1
+            if worst is None or cmp["jaccard"] < worst["jaccard"]:
+                worst = {**cmp, "query": record.get("query")}
+    report: Dict[str, Any] = {
+        "queries": n,
+        "diverged": diverged,
+        "targetVersion": deployed.engine_instance.id,
+        "jaccard_mean": (sum(jaccards) / n) if n else 1.0,
+        "jaccard_min": min(jaccards) if jaccards else 1.0,
+        "rank_displacement_mean": (
+            (sum(displacements) / n) if n else 0.0
+        ),
+        "rank_displacement_max": max(displacements) if displacements else 0.0,
+        "score_delta_mean": (sum(score_deltas) / n) if n else 0.0,
+    }
+    if worst is not None:
+        report["worst"] = worst
+    return report
+
+
+# --- shadow scoring: candidate vs live on the captured sample ---
+
+
+def _shadow_rounds_counter() -> "_metrics.Counter":
+    return _metrics.get_registry().counter(
+        "pio_shadow_rounds_total",
+        "Shadow-scored continuous-training rounds by verdict",
+        labels=("verdict",),
+    )
+
+
+def shadow_score(
+    engine,
+    storage,
+    live_instance_id: str,
+    candidate_instance_id: str,
+    records: List[dict],
+    min_jaccard: float = 0.0,
+    batch: int = 64,
+) -> Dict[str, Any]:
+    """Score a candidate model instance against the live one on the
+    captured query sample. Runs on the continuous-train loop ONLY —
+    never on the serving path. Returns the per-round quality verdict
+    (``comparable`` when the mean jaccard clears ``min_jaccard``,
+    ``diverged`` otherwise) and records the ``pio_shadow_*`` families.
+    """
+    from predictionio_tpu.api.engine_server import DeployedEngine
+
+    t0 = time.perf_counter()
+    live = DeployedEngine.from_storage(
+        engine, storage, engine_instance_id=live_instance_id
+    )
+    candidate = DeployedEngine.from_storage(
+        engine, storage, engine_instance_id=candidate_instance_id
+    )
+    live_results = list(_serve_records(live, records, batch=batch))
+    cand_results = list(_serve_records(candidate, records, batch=batch))
+    n = 0
+    jaccards: List[float] = []
+    displacements: List[float] = []
+    score_deltas: List[float] = []
+    for (li, ls), (ci, cs) in zip(live_results, cand_results):
+        cmp = compare_topn(li, ls, ci, cs)
+        n += 1
+        jaccards.append(cmp["jaccard"])
+        displacements.append(cmp["rank_displacement"])
+        score_deltas.append(cmp["score_delta"])
+    jaccard_mean = (sum(jaccards) / n) if n else 1.0
+    verdict = "comparable" if jaccard_mean >= min_jaccard else "diverged"
+    report = {
+        "verdict": verdict,
+        "queries": n,
+        "liveVersion": live_instance_id,
+        "candidateVersion": candidate_instance_id,
+        "jaccard_mean": jaccard_mean,
+        "jaccard_min": min(jaccards) if jaccards else 1.0,
+        "rank_displacement_mean": (
+            (sum(displacements) / n) if n else 0.0
+        ),
+        "score_delta_mean": (sum(score_deltas) / n) if n else 0.0,
+        "wall_s": round(time.perf_counter() - t0, 4),
+    }
+    reg = _metrics.get_registry()
+    _shadow_rounds_counter().labels(verdict=verdict).inc()
+    reg.counter(
+        "pio_shadow_queries_total",
+        "Captured queries scored by shadow evaluation",
+    ).inc(n)
+    reg.gauge(
+        "pio_shadow_last_jaccard",
+        "Mean jaccard of the latest shadow-scored round "
+        "(candidate vs live on the captured sample)",
+    ).set(jaccard_mean)
+    reg.gauge(
+        "pio_shadow_last_rank_displacement",
+        "Mean rank displacement of the latest shadow-scored round",
+    ).set(report["rank_displacement_mean"])
+    reg.gauge(
+        "pio_shadow_last_score_delta",
+        "Mean score delta of the latest shadow-scored round",
+    ).set(report["score_delta_mean"])
+    return report
+
+
+# THE process-global capture ring and attribution table (one per worker
+# process, like the metrics/tracing/health registries; bounded by
+# construction). Servers and the continuous-train loop share them.
+_CAPTURE = PredictionCapture()
+_ATTRIBUTION = AttributionTable()
+
+
+def get_capture() -> PredictionCapture:
+    return _CAPTURE
+
+
+def get_attribution() -> AttributionTable:
+    return _ATTRIBUTION

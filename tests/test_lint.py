@@ -490,6 +490,112 @@ def test_print_allowlist_is_not_stale():
     )
 
 
+# --- Prometheus unit-suffix conventions for registry families ---
+#
+# The bug class (this round's model-quality tentpole): a family named
+# `pio_foo_ms` or a histogram called `pio_bar_total` renders fine but
+# breaks every downstream consumer convention — Prometheus tooling
+# assumes counters end `_total` and time/size series use base units
+# (`_seconds`/`_bytes`). This lint walks every registry registration in
+# the package (reg.counter/gauge/histogram with a literal name) and
+# enforces: counters end `_total` (counters of seconds/bytes end
+# `_seconds_total`/`_bytes_total`), non-counters never end `_total`,
+# time series use `_seconds`, size series `_bytes`, and nobody uses a
+# non-base unit suffix. utils/metrics.py (the registry itself) is
+# exempt; the allowlist is seeded EMPTY and shrink-only.
+
+_METRIC_KINDS = ("counter", "gauge", "histogram")
+
+_NON_BASE_UNIT_SUFFIXES = (
+    "_ms", "_millis", "_milliseconds", "_us", "_micros", "_microseconds",
+    "_ns", "_nanos", "_minutes", "_hours", "_days", "_kb", "_mb", "_gb",
+    "_kib", "_mib", "_gib", "_percent",
+)
+
+# (relative path, family name) pairs reviewed as acceptable deviations.
+# Seeded empty — every family in the tree conforms; shrink-only.
+METRIC_NAME_ALLOWED: set = set()
+
+
+def _metric_name_violation(name: str, kind: str):
+    for suf in _NON_BASE_UNIT_SUFFIXES:
+        if name.endswith(suf):
+            return (
+                f"non-base unit suffix {suf!r} — use _seconds/_bytes "
+                "base units"
+            )
+    if kind == "counter":
+        if not name.endswith("_total"):
+            return "counter families must end _total"
+        if "seconds" in name and not name.endswith("_seconds_total"):
+            return "a counter of seconds must end _seconds_total"
+        if "bytes" in name and not name.endswith("_bytes_total"):
+            return "a counter of bytes must end _bytes_total"
+    else:
+        if name.endswith("_total"):
+            return f"a {kind} must not end _total (counters only)"
+        if "seconds" in name and not name.endswith("_seconds"):
+            return f"a {kind} of seconds must end _seconds"
+        if "bytes" in name and not name.endswith("_bytes"):
+            return f"a {kind} of bytes must end _bytes"
+    return None
+
+
+def _metric_name_occurrences():
+    import ast
+
+    found = set()
+    for path in sorted(PACKAGE.rglob("*.py")):
+        rel = path.relative_to(PACKAGE).as_posix()
+        if rel == "utils/metrics.py":
+            continue  # the registry itself (docstrings, generic helpers)
+        tree = ast.parse(
+            path.read_text(encoding="utf-8"), filename=str(path)
+        )
+        for node in ast.walk(tree):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _METRIC_KINDS
+            ):
+                continue
+            if not (
+                node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)
+            ):
+                continue  # dynamic names are out of scope for the lint
+            name = node.args[0].value
+            reason = _metric_name_violation(name, node.func.attr)
+            if reason:
+                found.add((rel, name, reason))
+    return found
+
+
+def test_metric_families_follow_unit_suffix_conventions():
+    found = _metric_name_occurrences()
+    new = {
+        (rel, name, reason)
+        for rel, name, reason in found
+        if (rel, name) not in METRIC_NAME_ALLOWED
+    }
+    assert not new, (
+        "registry family name violates Prometheus unit-suffix "
+        "conventions (counters end _total, time in _seconds, sizes in "
+        "_bytes, no _ms/_mb-style suffixes); rename the family or "
+        f"justify an allowlist entry: {sorted(new)}"
+    )
+
+
+def test_metric_name_allowlist_is_not_stale():
+    found = {(rel, name) for rel, name, _ in _metric_name_occurrences()}
+    stale = METRIC_NAME_ALLOWED - found
+    assert not stale, (
+        f"metric-name allowlist entries no longer in the tree: "
+        f"{sorted(stale)}"
+    )
+
+
 def test_no_mutable_module_state_in_segment_tier():
     found = _mutable_module_state_occurrences()
     new = found - MUTABLE_MODULE_STATE_ALLOWED

@@ -323,6 +323,10 @@ class TestTraceViaEngineServer:
 
 # --- /metrics on every server, both transports ---
 
+# the Prometheus text exposition content type, exactly as scrapers
+# negotiate it — asserted verbatim on every server and both transports
+EXPOSITION_CTYPE = "text/plain; version=0.0.4; charset=utf-8"
+
 
 def _http_get(port, path):
     conn = http.client.HTTPConnection("localhost", port, timeout=10)
@@ -355,7 +359,7 @@ class TestMetricsRoutes:
         try:
             status, ctype, body = _http_get(server.port, "/metrics")
             assert status == 200
-            assert ctype.startswith("text/plain")
+            assert ctype == EXPOSITION_CTYPE
             parsed = m.parse_exposition(body.decode())
             assert parsed  # Prometheus-parseable, non-empty
         finally:
@@ -385,12 +389,20 @@ class TestMetricsRoutes:
             assert resp.status == 200
             conn.close()
             status, ctype, body = _http_get(server.port, "/metrics")
-            assert status == 200 and ctype.startswith("text/plain")
+            assert status == 200 and ctype == EXPOSITION_CTYPE
             text = body.decode()
             parsed = m.parse_exposition(text)
             assert parsed
-            # the serving-latency bucket family is present
+            # the serving-latency bucket family is present, labeled by
+            # the model version that served the query
             assert "pio_serving_latency_seconds_bucket" in text
+            vid = server.api.deployed.engine_instance.id
+            assert f'pio_serving_requests_total{{version="{vid}"}}' in text
+            # the active-model gauge names the served version
+            assert (
+                f'pio_model_info{{engine="fake",version="{vid}"}} 1'
+                in text
+            )
         finally:
             server.shutdown()
 
@@ -411,7 +423,7 @@ class TestMetricsRoutes:
             })
             assert s.get_meta_data_apps().get_all() == []
             status, ctype, body = _http_get(server.port, "/metrics")
-            assert status == 200 and ctype.startswith("text/plain")
+            assert status == 200 and ctype == EXPOSITION_CTYPE
             text = body.decode()
             assert (
                 'pio_gateway_rpc_total{dao="apps",method="get_all",'
