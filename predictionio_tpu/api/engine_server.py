@@ -30,6 +30,7 @@ so throughput scales with batch size instead of request count.
 
 from __future__ import annotations
 
+import collections
 import concurrent.futures
 import copy
 import dataclasses
@@ -148,6 +149,13 @@ class ServerConfig:
     # gated GET /debug/predictions.json and replayable via `pio
     # replay`. 1 = every query, 0 disables capture entirely.
     capture_sample: int = 1
+    # how many displaced DeployedEngines a /reload swap keeps prepared
+    # (warm, factors resident) in the server's LRU — the reference's
+    # multi-variant admin tier, and the promotion pipeline's instant-
+    # rollback store. Evicted entries drain (last in-flight batch
+    # resolves) and then release their device buffers. 0 = drain +
+    # release immediately on swap.
+    retained_states: int = 1
 
     def __post_init__(self):
         if self.feedback and not self.access_key:
@@ -212,6 +220,16 @@ class DeployedEngine:
         ensure_compilation_cache()
         for algo, model in zip(self.algorithms, self.models):
             algo.warm(model)
+        # in-flight batch accounting: the promotion pipeline's drain
+        # stage waits on this before freeing the displaced instance's
+        # device-resident serving state (release_serving). The condition
+        # also serializes release() against new serve_batch entrants, so
+        # a straggler that races past a swap either runs on the intact
+        # device state or — after release — on the algorithms' host
+        # fallback path, never on half-freed buffers.
+        self._inflight = 0
+        self._inflight_cond = threading.Condition()
+        self._released = False
 
     @classmethod
     def from_storage(
@@ -284,16 +302,84 @@ class DeployedEngine:
         May be called concurrently (up to ServerConfig.pipeline_depth
         batches in flight): algorithms/serving with mutable predict-time
         state must lock it or deploy with pipeline_depth=1."""
-        supplemented = [self.serving.supplement(q) for q in queries]
-        indexed = list(enumerate(supplemented))
-        per_algo: List[Dict[int, Any]] = [
-            dict(algo.batch_predict(model, indexed))
-            for algo, model in zip(self.algorithms, self.models)
-        ]
-        return [
-            self.serving.serve(q, [pa[i] for pa in per_algo])
-            for i, q in enumerate(queries)
-        ]
+        with self._inflight_cond:
+            self._inflight += 1
+        try:
+            supplemented = [self.serving.supplement(q) for q in queries]
+            indexed = list(enumerate(supplemented))
+            per_algo: List[Dict[int, Any]] = [
+                dict(algo.batch_predict(model, indexed))
+                for algo, model in zip(self.algorithms, self.models)
+            ]
+            return [
+                self.serving.serve(q, [pa[i] for pa in per_algo])
+                for i, q in enumerate(queries)
+            ]
+        finally:
+            with self._inflight_cond:
+                self._inflight -= 1
+                self._inflight_cond.notify_all()
+
+    # --- drain/release: the promotion pipeline's displaced-instance
+    # lifecycle (free resident device factors only after the last
+    # in-flight batch resolves) ---
+
+    @property
+    def inflight(self) -> int:
+        with self._inflight_cond:
+            return self._inflight
+
+    @property
+    def released(self) -> bool:
+        return self._released
+
+    def drain(self, timeout_s: float, on_progress=None) -> bool:
+        """Wait (bounded) for every in-flight serve_batch to resolve.
+        ``on_progress`` fires whenever the in-flight count moves — the
+        promotion pipeline feeds it the watchdog heartbeat's ``beat``,
+        so a drain that is MAKING progress never reads as stalled while
+        a wedged one degrades /readyz once the deadline passes."""
+        deadline = time.monotonic() + max(0.0, timeout_s)
+        with self._inflight_cond:
+            last = self._inflight
+            while self._inflight > 0:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._inflight_cond.wait(min(0.2, remaining))
+                if self._inflight != last:
+                    last = self._inflight
+                    if on_progress is not None:
+                        on_progress()
+        return True
+
+    def release(self, timeout_s: float = 0.0) -> bool:
+        """Free the device-resident serving state (each algorithm's
+        ``release_serving``) once nothing is in flight; returns whether
+        it released. The hooks run UNDER the in-flight condition, so a
+        serve_batch racing in behind the release observes the nulled
+        device state (and takes the host fallback path) — never a
+        half-freed buffer. A straggler that keeps the state wedged past
+        ``timeout_s`` blocks the release: its buffers are freed by
+        refcount when it finally resolves, never underneath it."""
+        deadline = time.monotonic() + max(0.0, timeout_s)
+        with self._inflight_cond:
+            while self._inflight > 0:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._inflight_cond.wait(min(0.2, remaining))
+            if self._released:
+                return True
+            self._released = True
+            for algo, model in zip(self.algorithms, self.models):
+                try:
+                    algo.release_serving(model)
+                except Exception:
+                    logger.exception(
+                        "release_serving failed for %s", type(algo).__name__
+                    )
+        return True
 
 
 class _BatchingExecutor:
@@ -950,10 +1036,40 @@ class QueryAPI:
             return self._debug_predictions(query)
         if path == "/queries.json" and method == "POST":
             return self._handle_query(body, headers)
-        if path == "/reload" and method == "GET":
-            if self._reload_fn is not None:
-                threading.Thread(target=self._reload_fn, daemon=True).start()
-            return 200, "Reloading...", "text/plain"
+        if path == "/reload" and method in ("GET", "POST"):
+            # synchronous: the promotion pipeline (and any fleet
+            # orchestrator) needs the success/failure verdict in the
+            # response, and under the async transport this runs on the
+            # route pool, never the event loop. ``engineInstanceId``
+            # pins the target version so an SO_REUSEPORT fleet converges
+            # on ONE instance instead of racing "latest"; omitted, the
+            # reference's latest-COMPLETED semantics apply.
+            if self._reload_fn is None:
+                return 200, "Reloading... (no reload hook)", "text/plain"
+            target_id = query.get("engineInstanceId") or None
+            try:
+                new_id = self._reload_fn(target_id)
+            except Exception as e:
+                # the swap never happened: the old snapshot keeps
+                # serving, and the 500 names the cause (store down,
+                # corrupt/missing instance) instead of a silent log line
+                logger.exception("reload failed; keeping current instance")
+                return (
+                    500,
+                    {
+                        "message": (
+                            f"reload failed ({type(e).__name__}: {e}); "
+                            "still serving engine instance "
+                            f"{_version_of(self.deployed)}"
+                        )
+                    },
+                    "application/json",
+                )
+            return (
+                200,
+                f"Reloading... now serving engine instance {new_id}",
+                "text/plain",
+            )
         if path == "/stop" and method == "GET":
             if self._stop_fn is not None:
                 t = threading.Timer(1.0, self._stop_fn)
@@ -1216,6 +1332,7 @@ class QueryAPI:
         from predictionio_tpu.workflow.continuous import (
             continuous_round_stats,
         )
+        from predictionio_tpu.workflow.promotion import promotion_stats
 
         inst = self.deployed.engine_instance
         batch_stats = self._executor.stats()
@@ -1260,6 +1377,9 @@ class QueryAPI:
             # process (continuous retrain + hot-swap runs in-process)
             "packCache": pack_cache_stats(),
             "continuousRounds": continuous_round_stats(),
+            # promotion-pipeline outcomes (workflow/promotion.py): the
+            # in-process view of pio_promotion_total
+            "promotion": promotion_stats(),
             # daily self-check (reference CreateServer.scala:253-260)
             "upgradeStatus": upgrade_status,
             "upgradeLastChecked": upgrade_checked,
@@ -1284,7 +1404,20 @@ class EngineServer:
     """The MasterActor equivalent (reference CreateServer.scala:262-384):
     binds the HTTP frontend (event-loop by default, thread-per-connection
     via ``ServerConfig.transport='threaded'``), hot-swaps serving state
-    on /reload, undeploys on /stop."""
+    on /reload, undeploys on /stop.
+
+    A swap retires the displaced DeployedEngine into a small LRU of
+    prepared serving states (``ServerConfig.retained_states`` — the
+    reference's multi-variant admin tier): a rollback ``/reload`` back
+    to a retained instance is one reference flip, no store read, no
+    recompile. Evicted entries drain behind the in-flight batch
+    boundary and then free their device-resident factors, on a
+    background thread watched by the ``serving-drain`` heartbeat."""
+
+    # bounded drain of evicted serving states; a drain wedged past the
+    # heartbeat deadline degrades /readyz (utils/health.py semantics)
+    DRAIN_TIMEOUT_S = 60.0
+    DRAIN_DEADLINE_S = 120.0
 
     def __init__(
         self,
@@ -1315,6 +1448,18 @@ class EngineServer:
                 self.config.engine_instance_id,
                 ctx=self._serving_ctx,
             )
+        # displaced-but-retained serving states, newest last (the
+        # rollback store); guarded by its own lock — reload may be
+        # driven concurrently from the route pool and a promotion loop
+        self._retained: (
+            "collections.OrderedDict[str, DeployedEngine]"
+        ) = collections.OrderedDict()
+        self._retained_lock = threading.Lock()
+        # serializes the read-bind-retire sequence: reload may be driven
+        # concurrently from the route pool and a promotion loop, and two
+        # racing swaps reading the same api.deployed would displace one
+        # fresh snapshot without ever retiring (draining/releasing) it
+        self._swap_lock = threading.Lock()
         self.api = QueryAPI(
             deployed,
             self.config,
@@ -1356,31 +1501,119 @@ class EngineServer:
     def shutdown(self) -> None:
         self._http.shutdown()
         self.api.close()
+        # free the retained rollback states' device buffers — tests and
+        # operators cycle many servers per process
+        with self._retained_lock:
+            retained = list(self._retained.values())
+            self._retained.clear()
+        for dep in retained:
+            dep.release(timeout_s=1.0)
 
-    def reload(self) -> None:
-        """Swap in the latest completed instance of the SAME engine
-        (reference MasterActor ReloadServer, CreateServer.scala:322-343).
-        Queries in flight keep the old DeployedEngine snapshot."""
-        try:
-            current = self.api.deployed.engine_instance
+    def retained_versions(self) -> List[str]:
+        """The engine-instance ids of the retained (instant-rollback)
+        serving states, oldest first."""
+        with self._retained_lock:
+            return list(self._retained)
+
+    def swap_deployed(self, fresh: DeployedEngine) -> DeployedEngine:
+        """Atomically swap ``fresh`` in behind the in-flight batch
+        boundary (bind_deployed re-points the per-version metrics +
+        pio_model_info; queries in flight keep the old snapshot) and
+        retire the displaced DeployedEngine into the retained LRU.
+        Returns the displaced engine — the promotion pipeline drains it
+        explicitly; LRU evictees drain + release in the background."""
+        with self._swap_lock:
+            old = self.api.deployed
+            self.api.bind_deployed(fresh)
+            self._retire(old)
+        return old
+
+    def _retire(self, old: DeployedEngine) -> None:
+        evicted: List[DeployedEngine] = []
+        with self._retained_lock:
+            # a bare /reload re-deploys a fresh copy of the same instance
+            # id: the previously retained copy it displaces must still
+            # drain+release, not silently drop to GC with its resident
+            # buffers unaccounted
+            displaced_twin = self._retained.pop(old.engine_instance.id, None)
+            if displaced_twin is not None and displaced_twin is not old:
+                evicted.append(displaced_twin)
+            self._retained[old.engine_instance.id] = old
+            while len(self._retained) > max(0, self.config.retained_states):
+                evicted.append(self._retained.popitem(last=False)[1])
+        for dep in evicted:
+            threading.Thread(
+                target=self._drain_and_release, args=(dep,), daemon=True,
+                name="serving-drain",
+            ).start()
+
+    def _drain_and_release(self, dep: DeployedEngine) -> None:
+        """Background eviction: wait for the last in-flight batch, then
+        free the device-resident serving state. Watched by the
+        ``serving-drain`` heartbeat — a wedged drain degrades /readyz
+        instead of silently leaking HBM."""
+        hb = _health.heartbeat(
+            "serving-drain", deadline_s=self.DRAIN_DEADLINE_S
+        )
+        with hb.busy():
+            drained = dep.drain(self.DRAIN_TIMEOUT_S, on_progress=hb.beat)
+            released = dep.release(timeout_s=1.0)
+        if not (drained and released):
+            logger.warning(
+                "evicted serving state %s did not drain cleanly "
+                "(drained=%s released=%s); buffers free by refcount when "
+                "the straggler batch resolves",
+                dep.engine_instance.id, drained, released,
+            )
+
+    def reload(self, engine_instance_id: Optional[str] = None) -> str:
+        """Swap serving state (reference MasterActor ReloadServer,
+        CreateServer.scala:322-343). With ``engine_instance_id`` the
+        swap is pinned to that exact instance (the promotion / fleet-
+        convergence contract; a retained LRU hit swaps without touching
+        storage); without it, the latest COMPLETED instance of the same
+        engine is resolved — the reference's semantics. Returns the now-
+        serving instance id; raises on failure with the old snapshot
+        still serving (the /reload route turns that into a 500)."""
+        current = self.api.deployed
+        current_id = current.engine_instance.id
+        if engine_instance_id is not None and engine_instance_id == current_id:
+            return current_id  # idempotent: fleet-converge nudges are free
+        fresh: Optional[DeployedEngine] = None
+        if engine_instance_id is not None:
+            with self._retained_lock:
+                fresh = self._retained.pop(engine_instance_id, None)
+        if fresh is None:
+            inst = current.engine_instance
             fresh = DeployedEngine.from_storage(
                 self.engine,
                 self.storage,
-                engine_id=current.engine_id,
-                engine_version=current.engine_version,
-                engine_variant=current.engine_variant,
+                engine_instance_id=engine_instance_id,
+                engine_id=(
+                    inst.engine_id if engine_instance_id is None else None
+                ),
+                engine_version=(
+                    inst.engine_version
+                    if engine_instance_id is None
+                    else None
+                ),
+                engine_variant=(
+                    inst.engine_variant
+                    if engine_instance_id is None
+                    else None
+                ),
                 ctx=self._serving_ctx,
             )
-            # bind_deployed swaps the snapshot AND re-points the
-            # per-version serving metrics + pio_model_info at the fresh
-            # instance (in-flight queries keep recording under the old
-            # version label)
-            self.api.bind_deployed(fresh)
-            logger.info(
-                "reloaded engine instance %s", fresh.engine_instance.id
-            )
-        except Exception:
-            logger.exception("reload failed; keeping current instance")
+        # NOTE: a bare /reload (no pinned id) that resolves "latest" to
+        # the instance already serving still swaps in the fresh copy —
+        # the reference ReloadServer's unconditional re-deploy, and the
+        # residency regression gate in tests/test_retrieval.py. Only
+        # PINNED reloads short-circuit (above): that is what makes the
+        # fleet-convergence nudges free.
+        new_id = fresh.engine_instance.id
+        self.swap_deployed(fresh)
+        logger.info("reloaded engine instance %s", new_id)
+        return new_id
 
 
 def create_server(

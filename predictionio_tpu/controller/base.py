@@ -188,6 +188,16 @@ class BaseAlgorithm(Controller, Generic[PD, M, Q, P]):
         once per algorithm when a DeployedEngine is constructed. Default:
         nothing."""
 
+    def release_serving(self, model: M) -> None:
+        """Undeploy-time inverse of ``prepare_serving`` (no reference
+        analog): free the device-resident serving state a displaced
+        model holds, called by the promotion pipeline's drain→release
+        step only after the model's last in-flight batch resolved
+        (DeployedEngine.release). CONTRACT: a query racing past the
+        release must still be servable — implementations null the
+        device-state fields so predict falls back to the host
+        (training-time) path instead of erroring. Default: nothing."""
+
     # --- query class resolution (reference queryClass via TypeResolver) ---
 
     def query_from_json(self, json_obj: Any) -> Q:

@@ -13,9 +13,18 @@ Configuration (env registry, data/storage/__init__.py):
     PIO_STORAGE_SOURCES_GATEWAY_SECRET=...            # optional
     PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE=GATEWAY  # etc.
 
-Connections are pooled per thread (HTTP/1.1 keep-alive); operations
-retry once on a dropped connection (gateway restart) before failing with
-StorageError, mirroring the reference clients' single-reconnect behavior.
+Connections are pooled per thread (HTTP/1.1 keep-alive). READS retry
+with bounded, jittered exponential backoff (first retry immediate — the
+dropped-keepalive case — then ``_BACKOFF_BASE_S * 2^k`` with full
+jitter, capped): a gateway restart mid-continuous-round (or
+mid-promotion) rides through the restart window instead of aborting the
+round. NON-IDEMPOTENT writes keep fail-fast semantics — they re-send
+only when the request provably never reached the gateway (a send
+failure on a reused keep-alive connection), because replaying an insert
+that may have committed would duplicate it. Retry outcomes are counted
+in ``pio_storage_client_retries_total{outcome}`` (``retried`` per
+attempt, ``recovered`` when a retried call succeeds, ``exhausted`` when
+retries run out).
 """
 
 from __future__ import annotations
@@ -23,10 +32,14 @@ from __future__ import annotations
 import datetime as _dt
 import http.client
 import json
+import random
 import socket
 import threading
+import time
 import urllib.parse
 from typing import Any, Dict, Iterator, List, Optional, Sequence
+
+from predictionio_tpu.utils import metrics as _metrics
 
 from predictionio_tpu.data.event import Event
 from predictionio_tpu.data.storage import base, wire
@@ -63,6 +76,24 @@ _IDEMPOTENT_METHODS = frozenset(
     }
 )
 
+# read-retry policy: attempts beyond the first (props RETRIES overrides),
+# exponential base and cap for the jittered backoff between them. The
+# FIRST retry is immediate — the common case is a dropped idle
+# keep-alive connection, where waiting buys nothing.
+_READ_RETRIES = 4
+_BACKOFF_BASE_S = 0.05
+_BACKOFF_CAP_S = 2.0
+
+
+def _retries_counter() -> "_metrics.Counter":
+    return _metrics.get_registry().counter(
+        "pio_storage_client_retries_total",
+        "Storage-gateway client retries by outcome (retried = one "
+        "re-attempt; recovered = a retried call ultimately succeeded; "
+        "exhausted = retries ran out and the call failed)",
+        labels=("outcome",),
+    )
+
 
 class StorageClient(base.DAOCacheMixin):
     """Connection pool + RPC transport for one gateway URL."""
@@ -79,6 +110,10 @@ class StorageClient(base.DAOCacheMixin):
         self.secret = props.get("SECRET", "")
         timeout = float(props.get("TIMEOUT_S", "60"))  # LEvents.scala:39
         self._timeout = timeout
+        self._read_retries = int(props.get("RETRIES", _READ_RETRIES))
+        self._backoff_cap_s = float(
+            props.get("BACKOFF_CAP_S", _BACKOFF_CAP_S)
+        )
         self._local = threading.local()
         self._init_dao_cache()
 
@@ -134,8 +169,13 @@ class StorageClient(base.DAOCacheMixin):
             headers[_tracing.TRACE_HEADER] = trace.trace_id
             headers[_tracing.PARENT_HEADER] = trace.span_id
         idempotent = method in _IDEMPOTENT_METHODS
+        # reads retry through a restart window with jittered exponential
+        # backoff; non-idempotent calls keep the single safe reconnect
+        # (send provably never reached the gateway)
+        max_attempts = (self._read_retries + 1) if idempotent else 2
         last: Optional[Exception] = None
-        for attempt in (0, 1):  # at most one reconnect
+        retried = False
+        for attempt in range(max_attempts):
             conn, reused = self._conn()
             sent = False
             try:
@@ -151,15 +191,38 @@ class StorageClient(base.DAOCacheMixin):
                 # request — always safe. A failure after the request went
                 # out may have committed server-side, so only idempotent
                 # reads retry (re-sending an insert could duplicate it).
-                if attempt == 0 and ((not sent and reused) or idempotent):
-                    continue
-                break
+                if idempotent:
+                    may_retry = attempt < max_attempts - 1
+                else:
+                    may_retry = attempt == 0 and (not sent and reused)
+                if not may_retry:
+                    # "exhausted" means retries actually ran out — a
+                    # fail-fast write that never retried must not
+                    # inflate the retry-exhaustion signal operators
+                    # alert on
+                    if retried:
+                        _retries_counter().labels(outcome="exhausted").inc()
+                    break
+                retried = True
+                _retries_counter().labels(outcome="retried").inc()
+                if idempotent and attempt > 0:
+                    # first retry immediate (dropped idle keep-alive);
+                    # later ones back off with full jitter so a fleet of
+                    # clients doesn't stampede a restarting gateway
+                    delay = min(
+                        self._backoff_cap_s,
+                        _BACKOFF_BASE_S * (2 ** (attempt - 1)),
+                    )
+                    time.sleep(delay * random.random())
+                continue
             try:
                 out = json.loads(data.decode("utf-8"))
             except ValueError as e:
                 raise StorageError(
                     f"gateway returned non-JSON ({resp.status}): {data[:200]!r}"
                 ) from e
+            if retried:
+                _retries_counter().labels(outcome="recovered").inc()
             if resp.status == 200:
                 return out.get("result")
             if out.get("type") == "PartialBatchError":

@@ -420,6 +420,18 @@ class ECommAlgorithm(BaseAlgorithm):
         model._constraints = cache
         return model
 
+    def release_serving(self, model: ECommModel) -> None:
+        """Free the device-resident serving state of a displaced model
+        (promotion drain→release contract, controller/base.py): the
+        references are nulled FIRST so a straggler query falls back to
+        the host path, then the retriever's buffers drop — freed by
+        refcount once the last holder resolves."""
+        retriever, model._retriever = model._retriever, None
+        model._constraints = None
+        model._scorer = None
+        if retriever is not None:
+            retriever.free()
+
     def warm(self, model: ECommModel) -> None:
         """Pre-compile the serving executables (see BaseAlgorithm.warm):
         the fused retrieval programs for the prepared state (raw-dot for

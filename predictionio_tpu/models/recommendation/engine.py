@@ -521,6 +521,17 @@ class ALSAlgorithm(BaseAlgorithm):
     def batch_predict(self, model: ALSModel, queries) -> List[Tuple[int, PredictedResult]]:
         return model.recommend_many(queries)
 
+    def release_serving(self, model: ALSModel) -> None:
+        """Free a displaced model's device-resident serving state
+        (promotion drain→release contract, controller/base.py): drop
+        the ServingFactors upload — its device buffers free by refcount
+        once the last in-flight batch resolves. A straggler query
+        lazily rebuilds ServingFactors from the host arrays (the
+        ``serving`` property), so racing past a release degrades to a
+        re-upload, never an error."""
+        model._serving = None
+        model._serving_mesh = None
+
     def warm(self, model: ALSModel) -> None:
         """Compile the padded serving executables at deploy (tail-latency
         control; no reference analog — Spark has no JIT cold start).

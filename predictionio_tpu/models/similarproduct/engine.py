@@ -480,6 +480,16 @@ class ALSAlgorithm(BaseAlgorithm):
         )
         return model
 
+    def release_serving(self, model: SPModel) -> None:
+        """Free a displaced model's device-resident serving state
+        (promotion drain→release contract, controller/base.py): null
+        the references first — stragglers fall back to the host cosine
+        path — then drop the retriever's resident buffers."""
+        retriever, model._retriever = model._retriever, None
+        model._scorer = None
+        if retriever is not None:
+            retriever.free()
+
     def warm(self, model: SPModel) -> None:
         """Compile the serving executables before taking traffic (see
         BaseAlgorithm.warm): the fused cosine retrieval programs for a

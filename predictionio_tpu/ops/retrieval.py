@@ -375,6 +375,7 @@ class ItemRetriever:
             # per-(n_local, flags) jitted shard_map stage-1 executables
             self._stage1_cache: Dict[tuple, object] = {}
         self._batches = 0
+        self._freed = False
         self._mask_stamp = time.monotonic()
         _m_mask_age().labels(component=component).set(0.0)
         _m_resident_bytes().labels(component=component).set(
@@ -493,6 +494,11 @@ class ItemRetriever:
         live-candidate count carry ``-inf`` — the k > live-candidates
         edge is the caller filtering those out.
         """
+        if self._freed:
+            raise RuntimeError(
+                "ItemRetriever was freed (release_serving); the owner "
+                "must null its reference before freeing"
+            )
         q = np.atleast_2d(np.asarray(query_rows, np.float32))
         b = q.shape[0]
         if not (0 < n <= self.n_items):
@@ -583,6 +589,23 @@ class ItemRetriever:
             )
             self._stage1_cache[key] = fn
         return fn
+
+    def free(self) -> None:
+        """Drop the device-resident buffers (factors, norms, mask) and
+        the compiled stage cache. Owner contract (the engines'
+        ``release_serving``): null the model's retriever reference FIRST
+        and only call this after the last in-flight batch drained — a
+        subsequent ``topn`` raises rather than computing on half state.
+        The buffers' device memory is freed by refcount: a wedged
+        straggler still holding them keeps them alive until it resolves,
+        so nothing is ever freed underneath a running batch."""
+        self._freed = True
+        self._y_dev = None
+        self._rn_dev = None
+        self._allow_dev = None
+        if self.mesh is not None:
+            self._stage1_cache = {}
+        _m_resident_bytes().labels(component=self.component).set(0.0)
 
     def warm(
         self,

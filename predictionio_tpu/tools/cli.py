@@ -201,6 +201,42 @@ def _train_continuous(
         except ValueError:  # not the main thread (tests)
             break
 
+    promotion = None
+    if getattr(args, "promote_url", None):
+        # close the retrain→serve loop: every trained round runs the
+        # gated swap pipeline against the named serving fleet
+        # (workflow/promotion.py — shadow gate, pinned-id /reload
+        # convergence, worker-side drain, post-swap observation with
+        # automatic rollback)
+        from predictionio_tpu.data.storage import get_storage
+        from predictionio_tpu.workflow.promotion import (
+            FleetTarget,
+            PromotionConfig,
+            PromotionPipeline,
+        )
+
+        promotion = PromotionPipeline(
+            FleetTarget(
+                args.promote_url,
+                workers_per_url=args.promote_workers_per_url,
+            ),
+            PromotionConfig(
+                observe_s=args.promote_observe_s,
+                max_error_rate=args.promote_max_error_rate,
+                drain_timeout_s=args.promote_drain_timeout_s,
+                require_shadow=bool(args.promote_require_shadow),
+            ),
+            storage=get_storage(),
+        )
+        if not (getattr(args, "shadow_queries", 0) or 0):
+            print(
+                "note: promotion without --shadow-queries has no quality "
+                "gate before the swap (only the post-swap observation "
+                "window); pass --shadow-queries N to gate on the shadow "
+                "verdict",
+                file=sys.stderr,
+            )
+
     def on_round(rep) -> None:
         # structured (trace-correlated) status, not stderr print: a
         # continuous daemon's per-round output is operational telemetry
@@ -237,6 +273,19 @@ def _train_continuous(
                 rep.shadow["rank_displacement_mean"],
                 rep.shadow["queries"],
             )
+        if rep.promotion:
+            logger.info(
+                "round %d promotion: %s — candidate %s, fleet serving %s"
+                "%s",
+                rep.round, rep.promotion.get("outcome"),
+                rep.promotion.get("candidate"),
+                rep.promotion.get("serving"),
+                (
+                    f" ({rep.promotion.get('reason')})"
+                    if rep.promotion.get("reason")
+                    else ""
+                ),
+            )
 
     print(
         f"Continuous training every {args.interval:g}s "
@@ -252,6 +301,7 @@ def _train_continuous(
         on_round=on_round,
         shadow_queries=getattr(args, "shadow_queries", 0) or 0,
         shadow_min_jaccard=getattr(args, "shadow_min_jaccard", 0.5),
+        promotion=promotion,
     )
     print(f"Continuous training stopped after {rounds} round(s).")
     return 0
@@ -322,6 +372,7 @@ def cmd_deploy(args) -> int:
         transport=args.transport,
         reuse_port=bool(getattr(args, "reuse_port", False)),
         serving_devices=getattr(args, "serving_device", None),
+        retained_states=int(getattr(args, "retained_states", 1)),
     )
     server = create_server(engine, config)
     print(f"Engine server serving on {args.ip}:{server.port}")
@@ -332,11 +383,12 @@ def cmd_deploy(args) -> int:
 def _deploy_worker_fleet(args, workers: int) -> int:
     """Spawn the SO_REUSEPORT engine-server fleet (the eventserver
     --workers recipe applied to serving): per-worker subprocesses with
-    a device assignment each, shared-storage validation, signal
-    forwarding, and a bind-failure grace check."""
-    import signal
+    a device assignment each, shared-storage validation, and a
+    SUPERVISOR (tools/fleet.py) that restarts crashed workers with
+    capped backoff — surfaced as
+    ``pio_fleet_worker_restarts_total{worker}`` and in ``pio top`` —
+    instead of leaving the fleet silently degraded."""
     import subprocess
-    import time as _time
 
     if args.port == 0:
         print(
@@ -394,6 +446,7 @@ def _deploy_worker_fleet(args, workers: int) -> int:
             "--pipeline-depth", str(args.pipeline_depth),
             "--event-server-ip", args.event_server_ip,
             "--event-server-port", str(args.event_server_port),
+            "--retained-states", str(getattr(args, "retained_states", 1)),
         ]
         if args.engine_instance_id:
             cmd += ["--engine-instance-id", args.engine_instance_id]
@@ -406,52 +459,33 @@ def _deploy_worker_fleet(args, workers: int) -> int:
             cmd += ["--serving-device", devs]
         return cmd
 
-    procs = [subprocess.Popen(worker_cmd(w)) for w in range(workers)]
-    shutdown = {"requested": False}
-
-    def forward(signum, frame):
-        shutdown["requested"] = True
-        for p in procs:
-            p.terminate()
-
-    signal.signal(signal.SIGTERM, forward)
-    signal.signal(signal.SIGINT, forward)
-    # grace check: a worker that failed to bind or to load the model
-    # dies quickly — report a partial fleet instead of printing success
     from predictionio_tpu.api.http import JsonHTTPServer
+    from predictionio_tpu.tools.fleet import run_worker_fleet
 
-    _time.sleep(
-        1.0 + JsonHTTPServer.BIND_RETRIES * JsonHTTPServer.BIND_RETRY_DELAY_S
-    )
-    # workers found dead here failed to START — unless the operator
-    # already SIGTERMed the fleet during the grace window (a short-lived
-    # deploy in a test/bench), which is a clean stop, not a failure
-    dead = [p for p in procs if p.poll() is not None]
-    if dead and not shutdown["requested"]:
-        for p in procs:
-            if p.poll() is None:
-                p.terminate()
-        for p in procs:
-            p.wait()
-        print(
-            f"deploy: {len(dead)}/{workers} workers failed to start "
-            "(see tracebacks above); aborting",
-            file=sys.stderr,
-        )
-        return 1
-    if not shutdown["requested"]:
+    def on_started() -> None:
         print(
             f"Engine server: {workers} workers sharing "
             f"{args.ip}:{args.port} (SO_REUSEPORT, one prepared serving "
-            "state per worker)"
+            "state per worker; crashed workers restart with capped "
+            "backoff)"
         )
-    rc = 0
-    for p in procs:
-        code = p.wait()
-        if shutdown["requested"] and code < 0:
-            # worker killed by the signal we forwarded: a clean stop
-            code = 0
-        rc = code or rc
+
+    rc = run_worker_fleet(
+        lambda w: subprocess.Popen(worker_cmd(w)),
+        workers,
+        fleet_name="deploy",
+        grace_s=(
+            1.0
+            + JsonHTTPServer.BIND_RETRIES * JsonHTTPServer.BIND_RETRY_DELAY_S
+        ),
+        on_started=on_started,
+    )
+    if rc == 1:
+        print(
+            "deploy: workers failed to start (see tracebacks above); "
+            "aborting",
+            file=sys.stderr,
+        )
     return rc
 
 
@@ -1132,6 +1166,43 @@ def build_parser() -> argparse.ArgumentParser:
         help="mean-jaccard floor below which a shadow-scored round's "
         "verdict is 'diverged' (default 0.5)",
     )
+    # zero-downtime promotion (workflow/promotion.py): with --continuous,
+    # every trained round runs the gated swap pipeline against the named
+    # serving fleet — shadow-verdict gate, per-worker /reload pinned to
+    # the candidate engine-instance id, worker-side drain, post-swap
+    # observation window with automatic rollback
+    train.add_argument(
+        "--promote-url", action="append",
+        help="with --continuous: serving-fleet base URL to promote each "
+        "trained round to (repeatable: one per worker port; an "
+        "SO_REUSEPORT fleet sharing one port passes it once plus "
+        "--promote-workers-per-url)",
+    )
+    train.add_argument(
+        "--promote-workers-per-url", type=int, default=1,
+        help="workers behind each --promote-url (drives how many "
+        "consecutive matching status polls count as fleet convergence)",
+    )
+    train.add_argument(
+        "--promote-observe-s", type=float, default=10.0,
+        help="post-swap observation window before a promotion is final; "
+        "regressions inside it roll back to the retained previous "
+        "instance (0 disables observation+rollback)",
+    )
+    train.add_argument(
+        "--promote-max-error-rate", type=float, default=0.05,
+        help="rollback when window 5xx / candidate requests exceeds "
+        "this (default 0.05)",
+    )
+    train.add_argument(
+        "--promote-drain-timeout-s", type=float, default=30.0,
+        help="bounded drain of the displaced instance (default 30)",
+    )
+    train.add_argument(
+        "--promote-require-shadow", action="store_true",
+        help="refuse to promote rounds that produced no shadow sample "
+        "(default: promote — fresh deploys have no capture yet)",
+    )
     train.set_defaults(func=cmd_train)
 
     ev = sub.add_parser("eval", help="run an evaluation")
@@ -1189,6 +1260,13 @@ def build_parser() -> argparse.ArgumentParser:
     deploy.add_argument(
         "--reuse-port", action="store_true",
         help="bind with SO_REUSEPORT (set automatically for workers)",
+    )
+    deploy.add_argument(
+        "--retained-states", type=int, default=1,
+        help="displaced serving states each worker keeps prepared "
+        "(warm, factors resident) after a /reload swap — the promotion "
+        "pipeline's instant-rollback store; evicted states drain and "
+        "free their device buffers (default 1, 0 disables retention)",
     )
     deploy.add_argument(
         "--serving-device",

@@ -1,0 +1,181 @@
+"""Supervised SO_REUSEPORT worker fleets for ``pio deploy --workers``.
+
+The pre-round-13 fleet launcher spawned N worker processes and simply
+waited: a worker that crashed (OOM, a poisoned model load, a device
+fault) left the fleet silently degraded until an operator noticed the
+qps drop. This module owns the supervision loop instead:
+
+- a worker that exits NONZERO outside shutdown is restarted with capped
+  exponential backoff (1s, 2s, 4s, ... up to ``backoff_cap_s``); a
+  worker that then stays alive ``healthy_reset_s`` gets its backoff
+  reset, so a one-off crash recovers fast while a crash-looping worker
+  cannot hot-spin the supervisor;
+- every restart is counted in
+  ``pio_fleet_worker_restarts_total{worker}`` (the supervisor's process
+  registry; ``pio top`` renders the family as its RESTART column) and
+  logged with the exit code;
+- a worker that exits ZERO (a clean /stop undeploy) is intentional and
+  is NOT restarted — when every worker has exited cleanly the
+  supervisor returns 0;
+- startup keeps the pre-existing grace semantics: workers that die
+  within the bind-grace window mean a configuration failure (port held,
+  model missing) and abort the whole fleet rather than restart-looping
+  a doomed command.
+
+The loop is shutdown-aware by construction (stop-event idiom — the
+tools/ while-True lint's sanctioned shape): SIGTERM/SIGINT set the stop
+event, terminate the children, and the supervisor returns.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Callable, List, Optional
+
+from predictionio_tpu.utils import metrics as _metrics
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["run_worker_fleet"]
+
+
+def _restarts_counter() -> "_metrics.Counter":
+    return _metrics.get_registry().counter(
+        "pio_fleet_worker_restarts_total",
+        "Crashed fleet workers restarted by the supervisor, by worker "
+        "slot",
+        labels=("worker",),
+    )
+
+
+def run_worker_fleet(
+    spawn: Callable[[int], "object"],
+    workers: int,
+    *,
+    fleet_name: str = "fleet",
+    grace_s: float = 2.0,
+    poll_s: float = 0.5,
+    backoff_base_s: float = 1.0,
+    backoff_cap_s: float = 30.0,
+    healthy_reset_s: float = 60.0,
+    stop_event: Optional[threading.Event] = None,
+    install_signal_handlers: bool = True,
+    on_started: Optional[Callable[[], None]] = None,
+) -> int:
+    """Spawn ``workers`` processes via ``spawn(slot)`` and supervise
+    them until shutdown. Returns the fleet's exit code (0 on a clean
+    stop, the first nonzero worker code when workers exited on their
+    own uncleanly at shutdown, 1 on a startup failure).
+
+    ``spawn`` must return a ``subprocess.Popen``-compatible object
+    (``poll()``, ``terminate()``, ``wait()``, ``returncode``); tests
+    drive the supervisor with lightweight stand-in processes.
+    """
+    stop = stop_event if stop_event is not None else threading.Event()
+    procs: List[object] = [spawn(w) for w in range(workers)]
+    # per-slot restart state: consecutive crash count + last spawn time
+    consecutive = [0] * workers
+    spawned_at = [time.monotonic()] * workers
+    # a slot whose worker exited CLEANLY stays retired
+    retired = [False] * workers
+
+    def _terminate_all() -> None:
+        for p in procs:
+            if p is not None and p.poll() is None:
+                try:
+                    p.terminate()
+                except OSError:
+                    logger.debug("terminate failed", exc_info=True)
+
+    if install_signal_handlers:
+        import signal
+
+        def forward(signum, frame):
+            stop.set()
+            _terminate_all()
+
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            try:
+                signal.signal(sig, forward)
+            except ValueError:  # not the main thread (tests)
+                break
+
+    # startup grace: a worker dead this early failed to START (bind
+    # conflict, missing model) — abort the fleet, do not restart-loop a
+    # doomed configuration
+    if not stop.wait(grace_s):
+        dead = [p for p in procs if p.poll() is not None]
+        if dead and not stop.is_set():
+            _terminate_all()
+            for p in procs:
+                p.wait()
+            logger.error(
+                "%s: %d/%d workers failed to start; aborting",
+                fleet_name, len(dead), workers,
+            )
+            return 1
+    if stop.is_set():
+        _terminate_all()
+        rc = 0
+        for p in procs:
+            code = p.wait()
+            if code and code > 0:
+                rc = code
+        return rc
+    if on_started is not None:
+        on_started()
+
+    rc = 0
+    # per-slot pending-restart deadlines: backoff is tracked, never
+    # slept inline — a 30s backoff on one crash-looping slot must not
+    # stall crash DETECTION (and restarts) on every other slot
+    restart_at: list = [None] * workers
+    while not stop.is_set():
+        now = time.monotonic()
+        for w, p in enumerate(procs):
+            if retired[w]:
+                continue
+            if restart_at[w] is not None:
+                if now >= restart_at[w]:
+                    restart_at[w] = None
+                    procs[w] = spawn(w)
+                    spawned_at[w] = time.monotonic()
+                    _restarts_counter().labels(worker=str(w)).inc()
+                continue
+            if p.poll() is None:
+                continue
+            code = p.returncode
+            if code == 0:
+                # intentional exit (undeploy /stop): retire the slot
+                logger.info(
+                    "%s: worker %d exited cleanly; not restarting",
+                    fleet_name, w,
+                )
+                retired[w] = True
+                continue
+            if now - spawned_at[w] >= healthy_reset_s:
+                consecutive[w] = 0
+            delay = min(
+                backoff_cap_s, backoff_base_s * (2 ** consecutive[w])
+            )
+            consecutive[w] += 1
+            restart_at[w] = now + delay
+            logger.warning(
+                "%s: worker %d crashed (rc=%s); restart %d in %.1fs",
+                fleet_name, w, code, consecutive[w], delay,
+            )
+        if all(retired):
+            return 0
+        if stop.wait(poll_s):
+            break
+
+    _terminate_all()
+    for w, p in enumerate(procs):
+        code = p.wait()
+        # a worker killed by the signal we forwarded is a clean stop,
+        # not a failure bubbling up as -SIGTERM
+        if code and code > 0:
+            rc = code or rc
+    return rc

@@ -209,6 +209,12 @@ def _row(snap: dict, prev: Optional[dict], elapsed_s: float) -> dict:
     hit = attributed_hit_rate(m)
     if hit is not None:
         row["hit_rate"] = round(hit * 100.0, 1)
+    # fleet-supervisor column (tools/fleet.py): crashed workers the
+    # supervisor restarted — present when the scraped process runs a
+    # supervised `pio deploy --workers` fleet
+    restarts = counter_sum(m, "pio_fleet_worker_restarts_total")
+    if restarts:
+        row["restarts"] = int(restarts)
     stalled = snap.get("ready_detail", {}).get("stalledDaemons") or {}
     if stalled:
         row["stalled"] = ",".join(sorted(stalled))
@@ -231,6 +237,7 @@ _COLUMNS = (
     ("last_delta", "CONV", 9),
     ("resident_mb", "RES_MB", 7),
     ("mask_age_s", "MASKs", 6),
+    ("restarts", "RESTART", 8),
     ("stalled", "STALLED", 20),
 )
 

@@ -92,11 +92,16 @@ class RoundReport:
     # shadow-scoring verdict (workflow/quality.py shadow_score): the
     # candidate instance scored against the previous round's (live)
     # instance on the captured query sample — jaccard/displacement/
-    # score-delta plus the 'comparable'/'diverged' verdict the future
-    # swap pipeline consumes as its refuse-swap signal. None when
+    # score-delta plus the 'comparable'/'diverged' verdict the
+    # promotion pipeline consumes as its refuse-swap gate. None when
     # shadow scoring is disabled, no previous instance exists yet, or
     # the capture ring is empty.
     shadow: Optional[Dict] = None
+    # promotion-pipeline report (workflow/promotion.py): outcome
+    # (promoted/refused/failed/rolled_back), per-stage timings, and the
+    # version the serving target ended up on. None when no pipeline is
+    # wired into the loop.
+    promotion: Optional[Dict] = None
 
 
 def poll_fingerprint(engine_params, storage) -> Optional[tuple]:
@@ -164,6 +169,7 @@ def continuous_train(
     on_round: Optional[Callable[[RoundReport], None]] = None,
     shadow_queries: int = 0,
     shadow_min_jaccard: float = 0.5,
+    promotion=None,
 ) -> int:
     """Run the poll→delta-fold→warm-train→checkpoint loop until
     ``stop_event`` is set (or ``max_rounds`` rounds ran — tests/bench).
@@ -185,7 +191,19 @@ def continuous_train(
     ``comparable`` when the mean jaccard clears ``shadow_min_jaccard``
     — lands in ``RoundReport.shadow`` and the ``pio_shadow_*``
     families. This runs on the training loop only, never the serving
-    path."""
+    path.
+
+    ``promotion`` (a workflow/promotion.PromotionPipeline) closes the
+    retrain→serve loop: every trained round's candidate runs the full
+    gated swap pipeline — the shadow verdict is its HARD gate (diverged
+    ⇒ the swap is refused and the fleet keeps serving the live
+    instance), then prepare/warm off the hot path → atomic swap →
+    bounded drain → post-swap observation with automatic rollback. The
+    report lands in ``RoundReport.promotion``; the loop's notion of the
+    LIVE instance (the shadow baseline) then follows what the serving
+    target actually serves, so a refused or rolled-back round keeps
+    shadow-scoring future candidates against the version still taking
+    traffic."""
     from predictionio_tpu.workflow.context import workflow_context
     from predictionio_tpu.workflow.core_workflow import CoreWorkflow
 
@@ -204,8 +222,20 @@ def continuous_train(
     last_fp: Optional[tuple] = None
     trained_once = False
     # the "live" reference for shadow scoring: the previous trained
-    # round's instance (what a deployed server would be serving now)
+    # round's instance (what a deployed server would be serving now).
+    # With a promotion pipeline wired in, seed it from what the serving
+    # target ACTUALLY serves, so round 1's candidate already shadows
+    # against live traffic's model.
     live_instance_id: Optional[str] = None
+    if promotion is not None:
+        try:
+            live_instance_id = promotion.target.current_version()
+        except Exception:
+            logger.warning(
+                "could not read the serving target's current version; "
+                "shadow gating starts at the first trained round",
+                exc_info=True,
+            )
     # watchdog: a round that wedges (a hung scan, a stuck device call)
     # flips every in-process server's /readyz to 503 once it overruns
     # the deadline — the signal the hot-swap/fleet tier routes on
@@ -266,7 +296,18 @@ def continuous_train(
                     engine, ctx.storage, live_instance_id, instance_id,
                     shadow_queries, shadow_min_jaccard,
                 )
-            if instance_id:
+            if promotion is not None and instance_id:
+                # the gated swap pipeline; promote() never raises on an
+                # ordinary failure (the fleet keeps serving a consistent
+                # version), so the loop survives a refused/failed round
+                # and retries with the NEXT trained candidate
+                report.promotion = promotion.promote(
+                    instance_id, shadow=report.shadow
+                )
+                served = report.promotion.get("serving")
+                if served:
+                    live_instance_id = served
+            elif instance_id:
                 live_instance_id = instance_id
             logger.info(
                 "continuous round %d: %s in %.3fs (%s%s%s)",

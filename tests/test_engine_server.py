@@ -554,6 +554,96 @@ class TestServingSatellites:
 
 
 class TestReloadAndHTTP:
+    @pytest.mark.parametrize("transport", ["async", "threaded"])
+    def test_reload_failure_keeps_serving_and_answers_500(
+        self, mem_storage, transport
+    ):
+        """A /reload whose DeployedEngine.from_storage fails (missing/
+        corrupt instance, store down) must keep serving the old snapshot
+        and answer 500 naming the cause — on BOTH transports."""
+        fe.reset_counters()
+        train_instance(mem_storage)
+        server = EngineServer(
+            make_engine(), ServerConfig(port=0, transport=transport),
+            storage=mem_storage,
+        ).start()
+        try:
+            base = f"http://localhost:{server.port}"
+            v1 = server.api.deployed.engine_instance.id
+            old_snapshot = server.api.deployed
+            import urllib.error
+
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(
+                    f"{base}/reload?engineInstanceId=no-such-instance"
+                )
+            assert ei.value.code == 500
+            payload = json.loads(ei.value.read())
+            # the 500 names the cause AND the instance still serving
+            assert "no-such-instance" in payload["message"]
+            assert v1 in payload["message"]
+            assert server.api.deployed is old_snapshot
+            # serving is unaffected
+            req = urllib.request.Request(
+                f"{base}/queries.json",
+                data=json.dumps({"qx": 4}).encode(),
+                headers={"Content-Type": "application/json"},
+                method="POST",
+            )
+            with urllib.request.urlopen(req) as resp:
+                assert resp.status == 200
+                assert json.loads(resp.read())["qx"] == 4
+        finally:
+            server.shutdown()
+
+    def test_reload_pinned_to_current_instance_is_idempotent(
+        self, mem_storage
+    ):
+        """The fleet-convergence nudge: /reload pinned to the instance
+        already serving answers 200 WITHOUT displacing the snapshot."""
+        fe.reset_counters()
+        train_instance(mem_storage)
+        server = EngineServer(
+            make_engine(), ServerConfig(port=0), storage=mem_storage
+        ).start()
+        try:
+            base = f"http://localhost:{server.port}"
+            v1 = server.api.deployed.engine_instance.id
+            snapshot = server.api.deployed
+            req = urllib.request.Request(
+                f"{base}/reload?engineInstanceId={v1}",
+                data=b"", method="POST",
+            )
+            with urllib.request.urlopen(req) as resp:
+                assert resp.status == 200
+                assert v1 in resp.read().decode()
+            assert server.api.deployed is snapshot
+            assert server.retained_versions() == []
+        finally:
+            server.shutdown()
+
+    def test_reload_pinned_to_older_instance_swaps_back(self, mem_storage):
+        """Pinned reload to a specific (older) instance — the rollback
+        path — swaps to exactly that instance and retains the displaced
+        one."""
+        fe.reset_counters()
+        v1 = train_instance(mem_storage)
+        v2 = train_instance(mem_storage)
+        server = EngineServer(
+            make_engine(), ServerConfig(port=0), storage=mem_storage
+        ).start()
+        try:
+            base = f"http://localhost:{server.port}"
+            assert server.api.deployed.engine_instance.id == v2
+            with urllib.request.urlopen(
+                f"{base}/reload?engineInstanceId={v1}"
+            ) as resp:
+                assert v1 in resp.read().decode()
+            assert server.api.deployed.engine_instance.id == v1
+            assert server.retained_versions() == [v2]
+        finally:
+            server.shutdown()
+
     def test_http_roundtrip_and_reload(self, mem_storage):
         fe.reset_counters()
         train_instance(mem_storage)

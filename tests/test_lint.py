@@ -596,6 +596,90 @@ def test_metric_name_allowlist_is_not_stale():
     )
 
 
+# --- silent exception swallowing in the promotion-critical tiers ---
+#
+# The bug class (round 13's promotion tentpole): an `except ...: pass`
+# in workflow/ or api/ code silently eats the very failures the
+# promotion pipeline exists to surface — a swap that half-happened, a
+# drain that never resolved, a reload that kept serving a corpse. Every
+# handler must either re-raise, return a typed error, or at minimum log
+# (logger.debug(..., exc_info=True) is the sanctioned minimum for
+# expected-teardown paths). Scope: workflow/ and api/ — the tiers a
+# promotion traverses. The allowlist below was reviewed entry by entry
+# (all are connection-teardown paths where the peer is already gone)
+# and is shrink-only.
+
+_EXCEPT_PASS_DIRS = ("workflow", "api")
+
+# (relative path, stripped source line of the `except` statement) pairs
+# reviewed as safe. Shrink-only: delete entries when the code they
+# excuse goes away; new silent swallows must log instead.
+EXCEPT_PASS_ALLOWED = {
+    # loop finished between the closed-check and call_soon_threadsafe —
+    # shutdown teardown, nothing to report
+    ("api/aio_http.py", "except RuntimeError:"),
+    # loop.shutdown_asyncgens during loop teardown; the loop is closing
+    # regardless and the server already logged its lifecycle
+    ("api/aio_http.py", "except Exception:"),
+    # setsockopt(TCP_NODELAY) on a socket the peer may already have
+    # closed — a lost latency optimization, not an error
+    ("api/aio_http.py", "except OSError:"),
+    # peer went away mid-request: normal keep-alive connection death
+    ("api/aio_http.py", "except (ConnectionError, asyncio.IncompleteReadError):"),
+    # writer.wait_closed on an already-dead transport during teardown
+    (
+        "api/aio_http.py",
+        "except (ConnectionError, OSError, asyncio.CancelledError):",
+    ),
+    # awaiting the cancelled writer task during connection teardown
+    ("api/aio_http.py", "except asyncio.CancelledError:"),
+    # close()'s bounded drain of the feedback queue: Empty IS the loop's
+    # exit condition
+    ("api/engine_server.py", "except queue.Empty:"),
+    # the transport cancelled the request (client gone) — the future has
+    # no waiter left to inform
+    ("api/engine_server.py", "except concurrent.futures.InvalidStateError:"),
+}
+
+
+def _except_pass_occurrences():
+    import ast
+
+    found = set()
+    for d in _EXCEPT_PASS_DIRS:
+        for path in sorted((PACKAGE / d).rglob("*.py")):
+            rel = f"{d}/" + path.relative_to(PACKAGE / d).as_posix()
+            source = path.read_text(encoding="utf-8")
+            lines = source.splitlines()
+            for node in ast.walk(ast.parse(source, filename=str(path))):
+                if not isinstance(node, ast.ExceptHandler):
+                    continue
+                if len(node.body) == 1 and isinstance(node.body[0], ast.Pass):
+                    found.add((rel, lines[node.lineno - 1].strip()))
+    return found
+
+
+def test_no_silent_exception_swallows_in_promotion_tiers():
+    found = _except_pass_occurrences()
+    new = found - EXCEPT_PASS_ALLOWED
+    assert not new, (
+        "silent `except ...: pass` under workflow/ or api/ — swallowed "
+        "exceptions are how promotion bugs hide (a half-swapped fleet, "
+        "a drain that never resolves); re-raise, return a typed error, "
+        "or at least logger.debug(..., exc_info=True), or justify an "
+        f"allowlist entry: {sorted(new)}"
+    )
+
+
+def test_except_pass_allowlist_is_not_stale():
+    found = _except_pass_occurrences()
+    stale = EXCEPT_PASS_ALLOWED - found
+    assert not stale, (
+        f"except-pass allowlist entries no longer in the tree: "
+        f"{sorted(stale)}"
+    )
+
+
 def test_no_mutable_module_state_in_segment_tier():
     found = _mutable_module_state_occurrences()
     new = found - MUTABLE_MODULE_STATE_ALLOWED

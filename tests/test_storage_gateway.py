@@ -172,6 +172,58 @@ class TestTransport:
         assert len(list(le.find(1, until_time=just_above))) == 1
         assert len(list(le.find(1, until_time=t0))) == 0
 
+    def test_reads_retry_with_backoff_through_outage(
+        self, gateway, monkeypatch
+    ):
+        """Round-13 satellite: reads ride through a multi-failure outage
+        window (a gateway restart mid-promotion) with bounded jittered
+        backoff instead of the old single reconnect, and the retries are
+        counted in pio_storage_client_retries_total{outcome}."""
+        import http.client as hc
+
+        from predictionio_tpu.data.storage.http import _retries_counter
+
+        s = Storage(gw_config(gateway.port))
+        apps = s.get_meta_data_apps()
+        apps.insert(App(id=0, name="a1"))
+
+        real_getresponse = hc.HTTPConnection.getresponse
+        state = {"fail_remaining": 0}
+
+        def flaky_getresponse(conn):
+            if state["fail_remaining"] > 0:
+                state["fail_remaining"] -= 1
+                raise ConnectionResetError("outage window")
+            return real_getresponse(conn)
+
+        monkeypatch.setattr(
+            hc.HTTPConnection, "getresponse", flaky_getresponse
+        )
+        c = _retries_counter()
+        retried0 = c.labels(outcome="retried").value
+        recovered0 = c.labels(outcome="recovered").value
+        # THREE consecutive transport failures — the pre-round-13 single
+        # reconnect would have raised StorageError here
+        state["fail_remaining"] = 3
+        assert [a.name for a in apps.get_all()] == ["a1"]
+        assert c.labels(outcome="retried").value == retried0 + 3
+        assert c.labels(outcome="recovered").value == recovered0 + 1
+
+    def test_read_retries_exhaust_and_count(self):
+        from predictionio_tpu.data.storage import http as http_mod
+
+        c = http_mod._retries_counter()
+        retried0 = c.labels(outcome="retried").value
+        exhausted0 = c.labels(outcome="exhausted").value
+        s = Storage(gw_config(1))  # nothing listens on port 1
+        with pytest.raises(StorageError, match="unreachable"):
+            s.get_meta_data_apps().get_all()
+        assert (
+            c.labels(outcome="retried").value
+            == retried0 + http_mod._READ_RETRIES
+        )
+        assert c.labels(outcome="exhausted").value == exhausted0 + 1
+
     def test_mutations_do_not_retry_after_send(self, gateway, monkeypatch):
         """A transport failure AFTER an insert went out must not re-send it
         (the gateway may have committed); reads may retry freely."""

@@ -854,3 +854,118 @@ class TestColumnarParquetExport:
         assert events_to_file(
             "vex", str(path), storage=src, format="parquet"
         ) == 2
+
+
+class TestFleetSupervisor:
+    """Round-13 satellite: the `pio deploy --workers` supervisor
+    (tools/fleet.py) restarts crashed workers with capped backoff and
+    counts them in pio_fleet_worker_restarts_total, instead of leaving
+    the fleet degraded."""
+
+    def _run(self, spawn, **kw):
+        import threading
+
+        from predictionio_tpu.tools.fleet import run_worker_fleet
+
+        stop = kw.pop("stop_event", threading.Event())
+        box = {}
+
+        def target():
+            box["rc"] = run_worker_fleet(
+                spawn, kw.pop("workers", 1),
+                stop_event=stop, install_signal_handlers=False,
+                grace_s=kw.pop("grace_s", 0.05),
+                poll_s=0.05, backoff_base_s=0.05, backoff_cap_s=0.2,
+                **kw,
+            )
+
+        import threading as _t
+
+        t = _t.Thread(target=target)
+        t.start()
+        return stop, t, box
+
+    def test_restarts_crashed_worker_and_counts(self):
+        import subprocess
+        import sys
+        import time
+
+        from predictionio_tpu.tools.fleet import _restarts_counter
+
+        spawns = []
+
+        def spawn(w):
+            spawns.append(w)
+            if len(spawns) == 1:
+                # survives the grace window, then crashes
+                cmd = "import time, sys; time.sleep(0.3); sys.exit(3)"
+            else:
+                cmd = "import time; time.sleep(60)"
+            return subprocess.Popen([sys.executable, "-c", cmd])
+
+        before = _restarts_counter().labels(worker="0").value
+        stop, t, box = self._run(spawn)
+        deadline = time.time() + 20
+        while time.time() < deadline and len(spawns) < 2:
+            time.sleep(0.05)
+        try:
+            assert len(spawns) >= 2, "crashed worker was never restarted"
+            assert _restarts_counter().labels(worker="0").value >= before + 1
+        finally:
+            stop.set()
+            t.join(timeout=20)
+        # supervisor shut down cleanly (terminated workers are a clean
+        # stop, not a failure)
+        assert box["rc"] == 0
+
+    def test_startup_failure_aborts_instead_of_restart_looping(self):
+        import subprocess
+        import sys
+
+        spawns = []
+
+        def spawn(w):
+            spawns.append(w)
+            return subprocess.Popen([sys.executable, "-c", "raise SystemExit(2)"])
+
+        stop, t, box = self._run(spawn, grace_s=1.0)
+        t.join(timeout=20)
+        assert box["rc"] == 1
+        # a doomed configuration is not restart-looped
+        assert len(spawns) == 1
+
+    def test_clean_worker_exit_retires_slot(self):
+        import subprocess
+        import sys
+
+        spawns = []
+
+        def spawn(w):
+            spawns.append(w)
+            return subprocess.Popen(
+                [sys.executable, "-c", "import time; time.sleep(0.2)"]
+            )
+
+        stop, t, box = self._run(spawn, grace_s=0.05)
+        t.join(timeout=20)
+        # every worker exited 0 -> the fleet is done, rc 0, no restarts
+        assert box["rc"] == 0
+        assert len(spawns) == 1
+
+    def test_top_renders_restart_column(self):
+        from predictionio_tpu.tools.top import _row, render
+
+        snap = {
+            "url": "http://h:1",
+            "up": True,
+            "ready": True,
+            "health": {"uptimeSec": 1.0},
+            "metrics": {
+                'pio_fleet_worker_restarts_total{worker="0"}': 2.0,
+                'pio_fleet_worker_restarts_total{worker="1"}': 1.0,
+            },
+        }
+        row = _row(snap, None, 0.0)
+        assert row["restarts"] == 3
+        out = render([row])
+        assert "RESTART" in out.splitlines()[0]
