@@ -214,6 +214,12 @@ _SUMMARY_FIELDS = {
         "value", "p99_baseline_ms", "swap_window_s", "qps_under_load",
         "errors", "shadow_refusal_enforced", "rollback_on_regression",
     ),
+    "cluster_ingest": (
+        "value", "events_per_sec_1node", "scaling_4_over_1", "cores",
+        "acked_events_lost", "wire_identical_node_down",
+        "wire_identical_recovered", "model_fingerprint_unchanged",
+        "resynced_events",
+    ),
 }
 
 
@@ -3145,6 +3151,372 @@ def bench_promotion_under_load(device_name):
         storage_mod.set_storage(None)
 
 
+def _spawn_gateway(port, db_path):
+    """One storage-gateway NODE as a separate OS process (sqlite-backed,
+    restartable on the same port + store for the kill sweep)."""
+    import subprocess
+    import sys
+
+    child = (
+        "import sys\n"
+        "from predictionio_tpu.data.storage import Storage\n"
+        "from predictionio_tpu.api.storage_gateway import "
+        "StorageGatewayServer\n"
+        "port, path = int(sys.argv[1]), sys.argv[2]\n"
+        "cfg = {\n"
+        "    'PIO_STORAGE_SOURCES_SQLITE_TYPE': 'sqlite',\n"
+        "    'PIO_STORAGE_SOURCES_SQLITE_PATH': path,\n"
+        "    'PIO_STORAGE_REPOSITORIES_METADATA_NAME': 'meta',\n"
+        "    'PIO_STORAGE_REPOSITORIES_METADATA_SOURCE': 'SQLITE',\n"
+        "    'PIO_STORAGE_REPOSITORIES_EVENTDATA_NAME': 'event',\n"
+        "    'PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE': 'SQLITE',\n"
+        "    'PIO_STORAGE_REPOSITORIES_MODELDATA_NAME': 'model',\n"
+        "    'PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE': 'SQLITE',\n"
+        "}\n"
+        "server = StorageGatewayServer(\n"
+        "    Storage(cfg), ip='127.0.0.1', port=port\n"
+        ")\n"
+        "print('READY', server.port, flush=True)\n"
+        "server.serve_forever()\n"
+    )
+    return subprocess.Popen(
+        [sys.executable, "-c", child, str(port), str(db_path)],
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+
+
+def _free_ports(n):
+    import socket
+
+    socks = [socket.socket() for _ in range(n)]
+    try:
+        for s in socks:
+            s.bind(("127.0.0.1", 0))
+        return [s.getsockname()[1] for s in socks]
+    finally:
+        for s in socks:
+            s.close()
+
+
+def _wait_ready(port, timeout_s=90.0):
+    import urllib.error
+    import urllib.request
+
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout_s:
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/readyz", timeout=2
+            ) as r:
+                if r.status == 200:
+                    return True
+        except (urllib.error.URLError, OSError):
+            pass
+        time.sleep(0.1)
+    return False
+
+
+def _cluster_storage(ports, replicas):
+    from predictionio_tpu.data.storage import Storage
+
+    return Storage(
+        {
+            "PIO_STORAGE_SOURCES_C_TYPE": "cluster",
+            "PIO_STORAGE_SOURCES_C_NODES": ",".join(
+                f"http://127.0.0.1:{p}" for p in ports
+            ),
+            "PIO_STORAGE_SOURCES_C_REPLICAS": str(replicas),
+            "PIO_STORAGE_SOURCES_C_BREAKER_FAILURES": "2",
+            "PIO_STORAGE_SOURCES_C_BREAKER_COOLDOWN_S": "0.2",
+            "PIO_STORAGE_SOURCES_C_TIMEOUT_S": "20",
+            "PIO_STORAGE_REPOSITORIES_METADATA_NAME": "meta",
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "C",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_NAME": "event",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "C",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_NAME": "model",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "C",
+        }
+    )
+
+
+def _cluster_events(n, t_base_ms, users=97, items=53, tag="i"):
+    import datetime as dt
+
+    from predictionio_tpu.data.event import DataMap, Event
+
+    return [
+        Event(
+            event="rate",
+            entity_type="user",
+            entity_id=f"u{j % users}",
+            target_entity_type="item",
+            target_entity_id=f"{tag}{j % items}",
+            properties=DataMap({"rating": float(j % 5 + 1)}),
+            # globally unique, increasing times: the merged wire is then
+            # deterministic, so byte-identity against the single-node
+            # reference is exact, not tie-dependent
+            event_time=dt.datetime.fromtimestamp(
+                (t_base_ms + j) / 1000.0, dt.timezone.utc
+            ),
+        )
+        for j in range(n)
+    ]
+
+
+def _ingest_through_cluster(le, events, workers=4, batch=200):
+    """Threaded insert_batch ingest; returns (acked list of (event,id),
+    wall seconds). PartialBatchError contributes its acked slots only.
+
+    Workers partition by USER (each entity's events ride one worker, in
+    sequence): per-entity arrival order is then deterministic, which is
+    the condition under which a rowid-ordered store scan is
+    byte-comparable to the time-ordered reference — threads racing one
+    user's batches would make per-user commit order (and thus any
+    store's wire) run-dependent."""
+    import threading
+    import zlib
+
+    from predictionio_tpu.data.storage.base import PartialBatchError
+
+    lock = threading.Lock()
+    acked = []
+
+    def worker(w):
+        mine = [
+            ev
+            for ev in events
+            if zlib.crc32(ev.entity_id.encode()) % workers == w
+        ]
+        for s in range(0, len(mine), batch):
+            chunk = mine[s : s + batch]
+            try:
+                ids = le.insert_batch(chunk, 1)
+                failed = frozenset()
+            except PartialBatchError as e:
+                ids, failed = e.event_ids, e.failed_ids
+            with lock:
+                acked.extend(
+                    (ev, eid)
+                    for ev, eid in zip(chunk, ids)
+                    if eid not in failed
+                )
+
+    t0 = time.perf_counter()
+    threads = [
+        threading.Thread(target=worker, args=(w,), daemon=True)
+        for w in range(workers)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return acked, time.perf_counter() - t0
+
+
+def _wire_of(stream):
+    from predictionio_tpu.ops import als as als_mod
+    from predictionio_tpu.ops import streaming as strm
+
+    out = strm._scan_and_pack(
+        stream, als_mod.ALSConfig(rank=8, iterations=2), {}, 2
+    )
+    assert out is not None, "empty scan"
+    return out[0]
+
+
+def _model_fingerprint(wire):
+    import hashlib
+
+    from predictionio_tpu.ops import als as als_mod
+
+    arrays = als_mod.train_from_wire(
+        wire, als_mod.ALSConfig(rank=8, iterations=2, seed=11)
+    )
+    h = hashlib.sha256()
+    h.update(np.asarray(arrays.user_factors).tobytes())
+    h.update(np.asarray(arrays.item_factors).tobytes())
+    return h.hexdigest()
+
+
+def bench_cluster_ingest(device_name):
+    """The round-14 acceptance rig (docs/STORAGE.md): multi-PROCESS
+    gateway fleet behind the cluster routing backend.
+
+    Phase 1 — scaling: threaded ingest through 1 node vs 4 nodes (R=1,
+    sqlite-backed gateway processes). Hard gate: no collapse anywhere,
+    and real scaling (>= 1.8x) when the box has the cores to show it —
+    on a 1-2 core container every gateway process shares the client's
+    core, so the recorded factor is the box's ceiling, not the tier's.
+
+    Phase 2 — node-kill fault sweep (3 nodes, R=2): SIGKILL one gateway
+    mid-ingest. Hard gates: ZERO acked-event loss; the scatter-gather
+    streaming scan's merged wire stays BYTE-identical to a single-node
+    store holding exactly the acked events (node down AND after
+    recovery); the trained-model fingerprint is unchanged; and recovery
+    completes — the restarted node's /readyz returns 200, resync
+    replays its missed rows, and it rejoins the read path non-stale.
+    """
+    import shutil
+    import tempfile
+
+    from predictionio_tpu.data.storage import App
+    from predictionio_tpu.data.storage.memory import MemLEvents
+
+    work = tempfile.mkdtemp(prefix="pio-cluster-bench-")
+    procs = []
+
+    def spawn_fleet(n, subdir):
+        ports = _free_ports(n)
+        fleet = []
+        for i, port in enumerate(ports):
+            path = os.path.join(work, subdir, f"n{i}", "storage.db")
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            p = _spawn_gateway(port, path)
+            procs.append(p)
+            fleet.append((p, port, path))
+        for _, port, _ in fleet:
+            assert _wait_ready(port), f"gateway :{port} never got ready"
+        return fleet
+
+    try:
+        # --- phase 1: 1 -> 4 node ingest scaling (R=1) ---
+        n_events = int(os.environ.get("BENCH_CLUSTER_EVENTS", "6000"))
+        rates = {}
+        for n_nodes in (1, 4):
+            fleet = spawn_fleet(n_nodes, f"scale{n_nodes}")
+            storage = _cluster_storage(
+                [port for _, port, _ in fleet], replicas=1
+            )
+            storage.get_meta_data_apps().insert(App(id=0, name="bench"))
+            le = storage.get_l_events()
+            le.init(1)
+            events = _cluster_events(n_events, 1_760_000_000_000)
+            acked, wall = _ingest_through_cluster(le, events)
+            assert len(acked) == n_events, "events lost with no fault!"
+            rates[n_nodes] = n_events / wall
+            storage._client("C").close()
+            for p, _, _ in fleet:
+                p.kill()
+        cores = os.cpu_count() or 1
+        scaling = rates[4] / rates[1]
+        if cores >= 4:
+            assert scaling >= 1.8, (
+                f"1->4 node scaling {scaling:.2f}x on a {cores}-core box "
+                "— the partitioned tier must scale when the hardware can"
+            )
+        else:
+            # every server process shares the client's core(s): gate
+            # only against collapse, record the box-bound factor
+            assert scaling >= 0.35, (
+                f"1->4 nodes COLLAPSED to {scaling:.2f}x even on a "
+                f"{cores}-core box"
+            )
+
+        # --- phase 2: node-kill fault sweep (3 nodes, R=2) ---
+        fleet = spawn_fleet(3, "kill")
+        storage = _cluster_storage(
+            [port for _, port, _ in fleet], replicas=2
+        )
+        storage.get_meta_data_apps().insert(App(id=0, name="bench"))
+        client = storage._client("C")
+        le = storage.get_l_events()
+        le.init(1)
+        t_base = 1_770_000_000_000
+        pre = _cluster_events(2000, t_base)
+        acked1, _ = _ingest_through_cluster(le, pre)
+        victim_idx = 1
+        victim_proc, victim_port, victim_path = fleet[victim_idx]
+        victim_proc.kill()
+        victim_proc.wait(timeout=30)
+        during = _cluster_events(2000, t_base + 10_000, tag="k")
+        acked2, _ = _ingest_through_cluster(le, during)
+        acked = acked1 + acked2
+        assert len(acked2) == 2000, (
+            f"{2000 - len(acked2)} events failed to ack with one "
+            "replica down — quorum writes must keep acking"
+        )
+        assert client.nodes[victim_idx].stale, (
+            "the killed node missed acked writes and must be stale"
+        )
+
+        # zero acked loss + byte-identical wire while the node is DOWN
+        ref = MemLEvents()
+        ref.init(1)
+        ref.insert_batch(
+            [ev.with_event_id(eid) for ev, eid in acked], 1
+        )
+        w_down = _wire_of(le.stream_columns_native(1))
+        w_ref = _wire_of(ref.stream_columns_native(1))
+        wire_identical_down = bool(
+            np.array_equal(w_down.iw, w_ref.iw)
+            and np.array_equal(w_down.vw, w_ref.vw)
+        )
+        assert wire_identical_down, (
+            "merged wire diverged from the single-node reference with "
+            "one replica killed — acked events were lost or reordered"
+        )
+        visible = {eid for _, eid in acked}
+        scanned = {e.event_id for e in le.find(1)}
+        assert visible <= scanned, (
+            f"{len(visible - scanned)} ACKED events missing from the "
+            "failover scatter read"
+        )
+
+        # unchanged trained-model fingerprint
+        fp_down = _model_fingerprint(w_down)
+        fp_ref = _model_fingerprint(w_ref)
+        assert fp_down == fp_ref, "trained-model fingerprint changed"
+
+        # --- recovery: restart on the same port + store, resync ---
+        p2 = _spawn_gateway(victim_port, victim_path)
+        procs.append(p2)
+        assert _wait_ready(victim_port), "restarted node never ready"
+        report = client.resync()
+        label = client.nodes[victim_idx].label
+        assert "resynced" in report["nodes"].get(label, ""), report
+        assert not client.nodes[victim_idx].stale
+        assert client.nodes[victim_idx].available()
+        w_back = _wire_of(le.stream_columns_native(1))
+        wire_identical_recovered = bool(
+            np.array_equal(w_back.iw, w_ref.iw)
+            and np.array_equal(w_back.vw, w_ref.vw)
+        )
+        assert wire_identical_recovered, (
+            "wire diverged after the node rejoined — resync replayed "
+            "the wrong rows"
+        )
+        client.close()
+        emit(
+            {
+                "metric": "cluster_ingest",
+                "unit": "events/s",
+                "value": round(rates[4], 1),
+                "events_per_sec_1node": round(rates[1], 1),
+                "events_per_sec_4node": round(rates[4], 1),
+                "scaling_4_over_1": round(scaling, 3),
+                "cores": cores,
+                "cpu_bound": cores < 4,
+                "replicas": 2,
+                "acked_during_kill": len(acked2),
+                "acked_events_lost": 0,
+                "wire_identical_node_down": wire_identical_down,
+                "wire_identical_recovered": wire_identical_recovered,
+                "model_fingerprint_unchanged": fp_down == fp_ref,
+                "resynced_events": report["events"],
+                "device": device_name,
+            }
+        )
+    finally:
+        for p in procs:
+            try:
+                p.kill()
+            except OSError:
+                pass
+        shutil.rmtree(work, ignore_errors=True)
+
+
 BENCHES = {
     "recommendation": bench_recommendation,
     "classification": bench_classification,
@@ -3160,6 +3532,7 @@ BENCHES = {
     "delta_train": bench_delta_train,
     "serving_saturation": bench_serving_saturation,
     "promotion_under_load": bench_promotion_under_load,
+    "cluster_ingest": bench_cluster_ingest,
 }
 
 

@@ -15,8 +15,8 @@ descriptors, not threads, and an in-flight request is just a pending
 ``concurrent.futures.Future`` the loop awaits. The request core decides
 the handoff shape via its return value:
 
-  * a ``(status, payload[, content_type])`` tuple — answered inline
-    (fast, non-blocking routes: status pages, plugin listings);
+  * a ``(status, payload[, content_type[, headers]])`` tuple — answered
+    inline (fast, non-blocking routes: status pages, plugin listings);
   * a ``concurrent.futures.Future`` resolving to that tuple — awaited
     without a thread (the engine server's ``QueryAPI.handle_nowait``
     query route, the event server's bounded handler-pool offload);
@@ -468,6 +468,10 @@ class AsyncJsonHTTPServer:
     def _render(result, keep_alive: bool) -> Tuple[bytes, bytes]:
         status, payload = result[0], result[1]
         out_type = result[2] if len(result) > 2 else "application/json"
+        # optional 4th element: extra response headers (e.g. the 503
+        # backpressure path's Retry-After) — same contract as the
+        # threaded transport (api/http.py)
+        extra = result[3] if len(result) > 3 and result[3] else {}
         if out_type == "application/json" and not isinstance(payload, str):
             data = json.dumps(payload).encode("utf-8")
         else:
@@ -475,6 +479,9 @@ class AsyncJsonHTTPServer:
             data = str(payload).encode("utf-8")
         reason = _REASONS.get(status, "Unknown")
         conn_header = "" if keep_alive else "Connection: close\r\n"
+        extra_headers = "".join(
+            f"{k}: {v}\r\n" for k, v in extra.items()
+        )
         # handlers may return a fully-qualified content type (the
         # Prometheus exposition carries its own charset parameter) —
         # only bare types get the default charset appended
@@ -484,7 +491,7 @@ class AsyncJsonHTTPServer:
             f"HTTP/1.1 {status} {reason}\r\n"
             f"Content-Type: {out_type}\r\n"
             f"Content-Length: {len(data)}\r\n"
-            f"{conn_header}\r\n"
+            f"{extra_headers}{conn_header}\r\n"
         ).encode("latin-1")
         return head, data
 

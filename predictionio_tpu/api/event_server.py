@@ -47,7 +47,11 @@ from predictionio_tpu.data.event import (
     parse_iso8601,
 )
 from predictionio_tpu.data.storage import Storage, get_storage
-from predictionio_tpu.data.storage.base import UNSET, PartialBatchError
+from predictionio_tpu.data.storage.base import (
+    UNSET,
+    PartialBatchError,
+    StorageSaturatedError,
+)
 from predictionio_tpu.data.webhooks import (
     ConnectorException,
     to_event,
@@ -133,6 +137,20 @@ class EventServerConfig:
                 f"unknown transport {self.transport!r} "
                 f"(expected one of {TRANSPORTS})"
             )
+
+
+def _saturated(e: StorageSaturatedError) -> Tuple[int, dict, str, dict]:
+    """Deliberate backpressure: the storage write path refused admission
+    (bounded group-commit queue full), so answer 503 + ``Retry-After``
+    instead of parking the handler thread unboundedly. The transport
+    layer counts it in ``pio_http_errors_total{status="503"}``."""
+    retry_s = max(1, int(round(e.retry_after_s)))
+    return (
+        503,
+        {"message": str(e)},
+        "application/json",
+        {"Retry-After": str(retry_s)},
+    )
 
 
 def _message(status: int, message: str) -> Tuple[int, dict]:
@@ -593,6 +611,10 @@ class EventAPI:
                     [e for _, e in pending], app_id, channel_id
                 )
                 failed: frozenset = frozenset()
+            except StorageSaturatedError as e:
+                # NOTHING was admitted: the whole batch is safe to
+                # retry after backoff (unlike PartialBatchError below)
+                return _saturated(e)
             except PartialBatchError as e:
                 # some shard slices committed, others did not — report
                 # per-event outcomes so the client retries ONLY the
@@ -635,7 +657,10 @@ class EventAPI:
             self.plugin_context.run_blockers(app_id, channel_id, event)
         except Exception as e:  # an input blocker rejected the event
             return _message(403, str(e))
-        return self._insert(app_id, channel_id, event)
+        try:
+            return self._insert(app_id, channel_id, event)
+        except StorageSaturatedError as e:
+            return _saturated(e)
 
     def _find_events(self, app_id, channel_id, query) -> Tuple[int, Any]:
         try:
@@ -691,7 +716,10 @@ class EventAPI:
             EventValidationError,
         ) as e:
             return _message(400, str(e))
-        return self._insert(app_id, channel_id, event, route="webhook")
+        try:
+            return self._insert(app_id, channel_id, event, route="webhook")
+        except StorageSaturatedError as e:
+            return _saturated(e)
 
     def _webhook_form(
         self, app_id, channel_id, web, method, form
@@ -707,7 +735,10 @@ class EventAPI:
             event = to_event(connector, form or {})
         except (ConnectorException, EventValidationError) as e:
             return _message(400, str(e))
-        return self._insert(app_id, channel_id, event, route="webhook")
+        try:
+            return self._insert(app_id, channel_id, event, route="webhook")
+        except StorageSaturatedError as e:
+            return _saturated(e)
 
 
 class EventServer:

@@ -203,6 +203,10 @@ class _Handler(BaseHTTPRequestHandler):
         status, payload = result[0], result[1]
         record_http_error(self.server_name, parsed.path, status, trace_id)
         out_type = result[2] if len(result) > 2 else "application/json"
+        # optional 4th element: extra response headers (e.g. the 503
+        # backpressure path's Retry-After); same contract as the async
+        # transport (api/aio_http.py)
+        extra = result[3] if len(result) > 3 and result[3] else {}
         if out_type == "application/json" and not isinstance(payload, str):
             data = json.dumps(payload).encode("utf-8")
         else:
@@ -215,6 +219,8 @@ class _Handler(BaseHTTPRequestHandler):
             out_type = f"{out_type}; charset=utf-8"
         self.send_header("Content-Type", out_type)
         self.send_header("Content-Length", str(len(data)))
+        for k, v in extra.items():
+            self.send_header(k, str(v))
         self.end_headers()
         self.wfile.write(data)
 

@@ -33,7 +33,11 @@ from typing import Any, Dict, Optional
 
 from predictionio_tpu.api.aio_http import TRANSPORTS, make_http_server
 from predictionio_tpu.data.storage import Storage, get_storage
-from predictionio_tpu.data.storage.base import PartialBatchError, StorageError
+from predictionio_tpu.data.storage.base import (
+    PartialBatchError,
+    StorageError,
+    StorageSaturatedError,
+)
 from predictionio_tpu.data.storage import wire
 from predictionio_tpu.utils import health as _health
 from predictionio_tpu.utils import metrics as _metrics
@@ -93,6 +97,9 @@ _LEVENTS_METHODS = frozenset(
         "delete", "find", "aggregate_properties", "insert_columns",
         "insert_columns_v2", "find_columns_native",
         "aggregate_properties_of_entity",
+        # chunked/delta scan surface (cluster tier + remote delta
+        # training): materialized batches + opaque cursor/fingerprint
+        "scan_columns", "scan_columns_delta", "store_fingerprint",
     }
 )
 
@@ -216,6 +223,15 @@ class StorageGatewayCore:
                 "type": "PartialBatchError",
                 "event_ids": list(e.event_ids),
                 "failed_ids": sorted(e.failed_ids),
+            }
+        except StorageSaturatedError as e:
+            # deliberate backpressure, not a backend fault: the typed
+            # refusal crosses the wire so an event server fronted by
+            # this gateway still answers 503 + Retry-After end to end
+            return 503, {
+                "error": str(e),
+                "type": "StorageSaturatedError",
+                "retry_after_s": e.retry_after_s,
             }
         except StorageError as e:
             return 400, {"error": str(e), "type": "StorageError"}
@@ -348,6 +364,12 @@ class StorageGatewayCore:
                 event_names=a.get("event_names"),
             )
             return None if cols is None else col.columnar_to_wire(cols)
+        if method == "store_fingerprint":
+            return wire.opaque_to_wire(
+                le.store_fingerprint(a["app_id"], a.get("channel_id"))
+            )
+        if method in ("scan_columns", "scan_columns_delta"):
+            return self._scan_columns(le, method, a)
         if method == "aggregate_properties_of_entity":
             pm = le.aggregate_properties_of_entity(
                 app_id=a["app_id"],
@@ -359,6 +381,82 @@ class StorageGatewayCore:
             )
             return None if pm is None else wire.property_map_to_wire(pm)
         raise KeyError(f"unknown levents method {method!r}")
+
+    @staticmethod
+    def _scan_columns(le, method: str, a: Dict[str, Any]) -> Any:
+        """Materialized chunked/delta scan for remote consumers: the
+        backend's ``stream_columns_native``/``stream_columns_delta``
+        exhausted into ONE wire payload — packed code/value columns in
+        the stream's shared code space, the post-scan ``names`` array,
+        and the opaque delta cursor + pre-scan fingerprint (tagged
+        codec, wire.opaque_to_wire) that make remote delta training and
+        the cluster tier's per-node cursors possible. ``{"invalid":
+        true}`` = the backend declined the delta (full-repack fallback);
+        a backend with no chunked path at all raises KeyError so old
+        clients keep their find_columns_native fallback."""
+        import numpy as np
+
+        from predictionio_tpu.data.storage import columnar as col
+        from predictionio_tpu.data.storage.base import UNSET
+
+        tet = a.get("target_entity_type", wire.UNSET_WIRE)
+        kwargs = dict(
+            value_spec=col.spec_from_wire(a.get("value_spec")),
+            start_time=wire.opt_dt_from_wire(a.get("start_time")),
+            until_time=wire.opt_dt_from_wire(a.get("until_time")),
+            entity_type=a.get("entity_type"),
+            target_entity_type=UNSET if tet == wire.UNSET_WIRE else tet,
+            event_names=a.get("event_names"),
+        )
+        if a.get("batch_rows"):
+            kwargs["batch_rows"] = int(a["batch_rows"])
+        if method == "scan_columns_delta":
+            stream = le.stream_columns_delta(
+                a["app_id"], a.get("channel_id"),
+                cursor=wire.opaque_from_wire(a["cursor"]), **kwargs,
+            )
+            if stream is None:
+                return {"invalid": True}
+        else:
+            stream = le.stream_columns_native(
+                a["app_id"], a.get("channel_id"), **kwargs
+            )
+            if stream is None:
+                # no chunked path on this backend: the one-batch wrap
+                # (pre-scan fingerprint, no cursor) keeps the RPC total
+                fp = le.store_fingerprint(a["app_id"], a.get("channel_id"))
+                cols = le.find_columns_native(
+                    a["app_id"], a.get("channel_id"), **kwargs
+                )
+                if cols is None:
+                    return {"invalid": True}
+                from predictionio_tpu.data.storage.columnar import (
+                    ColumnarStream,
+                )
+
+                stream = ColumnarStream.from_columnar(cols, fingerprint=fp)
+        e_parts, t_parts, v_parts = [], [], []
+        for e_codes, t_codes, values in stream:
+            e_parts.append(np.asarray(e_codes, np.int64))
+            t_parts.append(np.asarray(t_codes, np.int64))
+            v_parts.append(np.asarray(values, np.float32))
+        names = stream.names  # valid only after exhaustion
+        cat = np.concatenate
+        empty_i = np.empty(0, np.int64)
+        return {
+            "names": [str(n) for n in np.asarray(names)],
+            "e_codes": col.array_to_b64(
+                cat(e_parts) if e_parts else empty_i
+            ),
+            "t_codes": col.array_to_b64(
+                cat(t_parts) if t_parts else empty_i
+            ),
+            "values": col.array_to_b64(
+                cat(v_parts) if v_parts else np.empty(0, np.float32)
+            ),
+            "cursor": wire.opaque_to_wire(stream.cursor),
+            "fingerprint": wire.opaque_to_wire(stream.fingerprint),
+        }
 
     def _call_metadata(self, dao, kind: str, method: str, args: Dict[str, Any]) -> Any:
         a = dict(args)

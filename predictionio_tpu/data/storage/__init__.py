@@ -59,6 +59,10 @@ BUILTIN_BACKENDS: Dict[str, tuple] = {
     # client-server backend: DAOs proxied to a storage gateway service
     # (api/storage_gateway.py) — the HBase/JDBC/Elasticsearch role
     "http": ("predictionio_tpu.data.storage.http", "HTTP"),
+    # partitioned, replicated gateway TIER: entity-hash routing over N
+    # gateway nodes with R-way writes and failover scatter-gather scans
+    # (data/storage/cluster.py) — the HBase-cluster role
+    "cluster": ("predictionio_tpu.data.storage.cluster", "Cluster"),
 }
 
 REPOSITORIES = ("METADATA", "EVENTDATA", "MODELDATA")

@@ -69,6 +69,19 @@ class StorageError(Exception):
     """Backend failure (reference StorageException, Storage.scala:85-105)."""
 
 
+class StorageSaturatedError(StorageError):
+    """The write path is at capacity RIGHT NOW (a bounded group-commit
+    queue refused a unit within its admission window). Distinct from a
+    plain StorageError so frontends can answer deliberate backpressure
+    (503 + ``Retry-After``) instead of parking a handler thread
+    unboundedly behind a wedged or overloaded committer. ``retry_after_s``
+    is the hint frontends surface to clients."""
+
+    def __init__(self, message: str, retry_after_s: float = 1.0):
+        super().__init__(message)
+        self.retry_after_s = float(retry_after_s)
+
+
 class PartialBatchError(StorageError):
     """An ``insert_batch`` where some per-partition slices committed and
     others failed. ``event_ids`` is the full assigned-id list (input

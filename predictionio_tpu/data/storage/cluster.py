@@ -1,0 +1,1729 @@
+"""``cluster`` storage backend — a partitioned, replicated gateway tier.
+
+The reference's production event store is a CLUSTER: HBase regionservers
+each own a slice of the key space, and the MD5-prefixed row key
+(hbase/HBEventsUtil) exists precisely to spread one app's entities
+across regions. This backend plays that role for the gateway tier: N
+storage-gateway nodes (api/storage_gateway.py) each own an entity-hash
+slice of the event space, and this thin client routes every operation by
+the SAME ``crc32(entity_id) % N`` rule the local sqlite shards use
+(data/storage/sqlite.py ``shard_index_for``) — one hash rule from a
+single file's WAL shards to a multi-host fleet.
+
+Configuration (env registry, data/storage/__init__.py)::
+
+    PIO_STORAGE_SOURCES_C_TYPE=cluster
+    PIO_STORAGE_SOURCES_C_NODES=http://n0:7077,http://n1:7077,http://n2:7077
+    PIO_STORAGE_SOURCES_C_REPLICAS=2          # R-way replicated writes
+    PIO_STORAGE_SOURCES_C_WRITE_QUORUM=1      # min acks per slot
+    PIO_STORAGE_SOURCES_C_SECRET=...          # shared gateway secret
+    PIO_STORAGE_SOURCES_C_TIMEOUT_S=10        # per-request deadline
+    PIO_STORAGE_SOURCES_C_BREAKER_FAILURES=3
+    PIO_STORAGE_SOURCES_C_BREAKER_COOLDOWN_S=5
+    PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE=C
+
+Semantics (the operator runbook is docs/STORAGE.md):
+
+- **Writes** (insert / insert_batch / bulk columnar import): events are
+  assigned their ids CLIENT-side, grouped by home slot ``h =
+  crc32(entity_id) % N``, and each slot's slice is written to all R
+  replica nodes ``h, h+1, …, h+R-1 (mod N)``. A slot acks once at least
+  ``WRITE_QUORUM`` replicas committed it; a replica that failed the
+  write while its peers committed is marked STALE (it is missing acked
+  data) and leaves the read path until resync. Per-slot failure
+  attribution is preserved across routing: ids whose slot missed quorum
+  come back in a :class:`PartialBatchError` exactly as a single sqlite
+  store reports per-shard slices, and retrying only those slots is
+  idempotent because the ids were fixed before the first attempt.
+
+- **Reads/scans**: a read plan assigns every slot to one healthy,
+  non-stale replica (primary first). Scatter-gather scans fetch each
+  planned node once, filter its rows to the slots it serves in THIS
+  plan (a node stores R slots' worth of rows — the filter is what keeps
+  replicated rows from double-counting), and feed the per-node batches
+  to the shared counting-sort merge (ops/streaming.py). Because every
+  entity's rows live wholly on its serving node in per-store scan
+  order, the merged wire is BYTE-identical to a single-node store — the
+  invariant every storage tier in this repo has held.
+
+- **Failure handling**: transport failures feed a per-node circuit
+  breaker; a tripped node leaves the plan (scans re-plan mid-flight
+  around a node that dies between planning and fetching), and a
+  half-open probe of the node's ``/readyz`` (PR 7's health endpoint)
+  closes the breaker when it recovers. A recovered node that missed
+  writes is STALE until :meth:`ClusterStorageClient.resync` replays the
+  rows above its event-time high-water mark from a peer replica
+  (explicit-id re-posts — idempotent REPLACE, the delta-cursor
+  contract's destructive-counter machinery then forces the next train
+  round to full-rescan rather than trust a cursor over resynced rows).
+
+- **Delta cursors**: a scan's cursor carries the read plan plus every
+  planned node's own gateway cursor. Deltas fold while the plan is
+  unchanged; any re-plan (node died or recovered between rounds) falls
+  back to one full re-scan — never a silently incomplete delta — and
+  delta folding resumes on the next round under the new plan.
+
+Fault injection rides the ``le.compact_fault`` idiom: ``faults`` maps
+stage names (:data:`FAULT_STAGES`: route_write / quorum_ack /
+node_down_scan / resync) to callables tests and the bench use to kill a
+node at any boundary and assert zero acked-event loss.
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import http.client as _http_client
+import logging
+import threading
+import time
+import zlib
+from typing import Any, Dict, Iterator, List, Optional, Sequence
+
+from predictionio_tpu.data.event import Event, new_event_id
+from predictionio_tpu.data.storage import base
+from predictionio_tpu.data.storage import http as _http
+from predictionio_tpu.data.storage.base import (
+    UNSET,
+    OptFilter,
+    PartialBatchError,
+    StorageError,
+    StorageSaturatedError,
+)
+from predictionio_tpu.utils import metrics as _metrics
+
+logger = logging.getLogger(__name__)
+
+PREFIX = "Cluster"
+
+# the named fault-injection boundaries (le.compact_fault idiom):
+#   route_write    before a batch's slot slices are dispatched to nodes
+#   quorum_ack     after per-replica acks are collected, before the
+#                  quorum decision
+#   node_down_scan when a scan (re-)plans around an unavailable node
+#   resync         before a stale node's catch-up rows are applied
+FAULT_STAGES = ("route_write", "quorum_ack", "node_down_scan", "resync")
+
+
+def _counter(name: str, doc: str, labels=()):
+    return _metrics.get_registry().counter(name, doc, labels=labels)
+
+
+def _gauge(name: str, doc: str, labels=()):
+    return _metrics.get_registry().gauge(name, doc, labels=labels)
+
+
+class _Node:
+    """One gateway node: its http client, DAO handles, and the circuit
+    breaker + staleness state that governs its read/write eligibility."""
+
+    def __init__(
+        self,
+        index: int,
+        url: str,
+        props: Dict[str, str],
+        breaker_failures: int,
+        breaker_cooldown_s: float,
+    ):
+        from predictionio_tpu.data.storage import StorageClientConfig
+
+        self.index = index
+        self.url = url
+        node_props = {"URL": url}
+        for key in ("SECRET", "TIMEOUT_S", "RETRIES", "BACKOFF_CAP_S"):
+            if props.get(key):
+                node_props[key] = props[key]
+        # fail fast into the breaker: a dead node must cost one timeout,
+        # not the read path's full 4-retry backoff ladder, unless the
+        # operator explicitly asked for more
+        node_props.setdefault("RETRIES", "1")
+        self.client = _http.StorageClient(StorageClientConfig(node_props))
+        self.label = f"{self.client.host}:{self.client.port}"
+        self._breaker_failures = max(1, breaker_failures)
+        self._breaker_cooldown_s = max(0.0, breaker_cooldown_s)
+        self._lock = threading.Lock()
+        self._consecutive_failures = 0
+        self._opened_at: Optional[float] = None
+        self.stale = False
+        self._m_up = _gauge(
+            "pio_cluster_node_up",
+            "Cluster node breaker state (1 = in the serving path, "
+            "0 = breaker open)",
+            labels=("node",),
+        ).labels(node=self.label)
+        self._m_stale = _gauge(
+            "pio_cluster_node_stale",
+            "Cluster node staleness (1 = missed acked writes; out of "
+            "the read path until resync)",
+            labels=("node",),
+        ).labels(node=self.label)
+        self._m_up.set(1.0)
+        self._m_stale.set(0.0)
+
+    def le(self, namespace: str) -> "_http.HTTPLEvents":
+        return self.client.dao(_http.HTTPLEvents, namespace)
+
+    def dao(self, cls, namespace: str):
+        return self.client.dao(cls, namespace)
+
+    # --- circuit breaker ---
+
+    def record_failure(self) -> None:
+        with self._lock:
+            self._consecutive_failures += 1
+            if (
+                self._consecutive_failures >= self._breaker_failures
+                and self._opened_at is None
+            ):
+                self._opened_at = time.monotonic()
+                self._m_up.set(0.0)
+                logger.warning(
+                    "cluster node %s breaker OPEN after %d consecutive "
+                    "failures", self.label, self._consecutive_failures,
+                )
+
+    def record_success(self) -> None:
+        with self._lock:
+            self._consecutive_failures = 0
+            if self._opened_at is not None:
+                self._opened_at = None
+                self._m_up.set(1.0)
+                logger.info("cluster node %s breaker CLOSED", self.label)
+
+    def mark_stale(self) -> None:
+        if not self.stale:
+            logger.warning(
+                "cluster node %s marked STALE (missed an acked write); "
+                "out of the read path until resync", self.label,
+            )
+        self.stale = True
+        self._m_stale.set(1.0)
+
+    def clear_stale(self) -> None:
+        self.stale = False
+        self._m_stale.set(0.0)
+
+    def breaker_open(self) -> bool:
+        with self._lock:
+            return self._opened_at is not None
+
+    def available(self) -> bool:
+        """Breaker-gated eligibility. A closed breaker passes without
+        I/O; an open one past its cooldown does a half-open ``/readyz``
+        probe (the PR 7 health endpoint) and closes on 200."""
+        with self._lock:
+            if self._opened_at is None:
+                return True
+            if time.monotonic() - self._opened_at < self._breaker_cooldown_s:
+                return False
+        if self._probe_ready():
+            self.record_success()
+            return True
+        with self._lock:
+            # stay open for another cooldown window
+            self._opened_at = time.monotonic()
+        return False
+
+    def _probe_ready(self) -> bool:
+        conn = None
+        try:
+            conn = _http_client.HTTPConnection(
+                self.client.host, self.client.port,
+                timeout=min(2.0, self.client._timeout),
+            )
+            conn.request("GET", "/readyz")
+            resp = conn.getresponse()
+            resp.read()
+            return resp.status == 200
+        except (OSError, _http_client.HTTPException):
+            return False
+        finally:
+            if conn is not None:
+                conn.close()
+
+    def close(self) -> None:
+        self.client.close()
+
+
+class StorageClient(base.DAOCacheMixin):
+    """Routing client over N gateway nodes (module docstring)."""
+
+    def __init__(self, config=None):
+        self.config = config
+        props = getattr(config, "properties", None) or {}
+        urls = [
+            u.strip()
+            for u in (props.get("NODES") or props.get("URLS") or "").split(",")
+            if u.strip()
+        ]
+        if not urls:
+            raise StorageError(
+                "cluster backend needs PIO_STORAGE_SOURCES_<NAME>_NODES="
+                "url1,url2,... (one storage gateway per node)"
+            )
+        breaker_failures = int(props.get("BREAKER_FAILURES", 3) or 3)
+        breaker_cooldown_s = float(props.get("BREAKER_COOLDOWN_S", 5) or 5)
+        self.nodes: List[_Node] = [
+            _Node(i, url, props, breaker_failures, breaker_cooldown_s)
+            for i, url in enumerate(urls)
+        ]
+        self.n_nodes = len(self.nodes)
+        self.replicas = max(1, min(int(props.get("REPLICAS", 2) or 2), self.n_nodes))
+        self.write_quorum = max(
+            1, min(int(props.get("WRITE_QUORUM", 1) or 1), self.replicas)
+        )
+        self.auto_resync = (props.get("AUTO_RESYNC", "1") or "1") != "0"
+        self._init_dao_cache()
+        # fault-injection hooks (le.compact_fault idiom)
+        self.faults: Dict[str, Any] = {s: None for s in FAULT_STAGES}
+        # (app_id, channel_id) pairs this client has touched — the
+        # resync enumeration set
+        self._known_tables: set = set()
+        self._known_lock = threading.Lock()
+        self._resync_lock = threading.Lock()
+        self._m_writes = _counter(
+            "pio_cluster_writes_total",
+            "Cluster events by write outcome (acked / under_replicated "
+            "= acked with at least one replica missing / failed = "
+            "below write quorum)",
+            labels=("outcome",),
+        )
+        self._m_failovers = _counter(
+            "pio_cluster_failovers_total",
+            "Cluster operations re-planned around an unavailable or "
+            "stale node",
+            labels=("path",),
+        )
+        self._m_resyncs = _counter(
+            "pio_cluster_resyncs_total",
+            "Stale-node resync attempts by outcome",
+            labels=("outcome",),
+        )
+        self._m_resynced = _counter(
+            "pio_cluster_resynced_events_total",
+            "Events replayed onto stale nodes by resync",
+        )
+        self._m_degraded = _counter(
+            "pio_cluster_degraded_reads_total",
+            "Read plans forced to serve a slot from a STALE replica "
+            "(every healthier replica unavailable)",
+        )
+
+    # --- routing ---
+
+    def slot_of(self, entity_id) -> int:
+        """Stable entity→slot hash — the SAME crc32 rule the sqlite
+        shard files use, lifted from intra-file to inter-node."""
+        return zlib.crc32(str(entity_id).encode("utf-8")) % self.n_nodes
+
+    def replicas_of_slot(self, slot: int) -> List[int]:
+        return [
+            (slot + r) % self.n_nodes for r in range(self.replicas)
+        ]
+
+    def fire(self, stage: str) -> None:
+        fault = self.faults.get(stage)
+        if fault is not None:
+            fault()
+
+    def note_table(self, namespace: str, app_id: int, channel_id) -> None:
+        with self._known_lock:
+            self._known_tables.add((namespace, app_id, channel_id))
+
+    def known_tables(self) -> List[tuple]:
+        with self._known_lock:
+            return sorted(
+                self._known_tables, key=lambda t: (t[0], t[1], t[2] or -1)
+            )
+
+    # --- read planning ---
+
+    def read_plan(self, count_failover: bool = True) -> Dict[int, int]:
+        """slot -> node index: primary when eligible, else the first
+        available non-stale replica; a stale replica only when nothing
+        healthier answers (counted as a degraded read)."""
+        if self.auto_resync:
+            self.maybe_resync()
+        plan: Dict[int, int] = {}
+        failed_over = False
+        degraded = False
+        for slot in range(self.n_nodes):
+            chosen = None
+            stale_fallback = None
+            for idx in self.replicas_of_slot(slot):
+                node = self.nodes[idx]
+                if not node.available():
+                    continue
+                if node.stale:
+                    if stale_fallback is None:
+                        stale_fallback = idx
+                    continue
+                chosen = idx
+                break
+            if chosen is None and stale_fallback is not None:
+                chosen = stale_fallback
+                degraded = True
+            if chosen is None:
+                raise StorageError(
+                    f"cluster slot {slot} has no available replica "
+                    f"(nodes {self.replicas_of_slot(slot)} all down)"
+                )
+            if chosen != slot:
+                failed_over = True
+            plan[slot] = chosen
+        if failed_over and count_failover:
+            self._m_failovers.labels(path="scan").inc()
+            self.fire("node_down_scan")
+        if degraded:
+            self._m_degraded.inc()
+        return plan
+
+    def plan_is_degraded(self, plan: Dict[int, int]) -> bool:
+        return any(self.nodes[idx].stale for idx in plan.values())
+
+    # --- resync ---
+
+    def maybe_resync(self) -> None:
+        """Opportunistic resync of recovered stale nodes, off the
+        caller's thread: the replay (peer fetch + re-insert, possibly
+        large) runs on a background worker while reads keep planning
+        around the still-stale node; it rejoins once the replay lands.
+        Non-blocking and single-flight (the lock is held for the
+        worker's lifetime)."""
+        if not any(
+            n.stale and not n.breaker_open() for n in self.nodes
+        ):
+            return
+        if not self._resync_lock.acquire(blocking=False):
+            return
+
+        def run():
+            try:
+                self._resync_locked()
+            except Exception:
+                logger.exception("background cluster resync failed")
+            finally:
+                self._resync_lock.release()
+
+        threading.Thread(
+            target=run, daemon=True, name="cluster-resync"
+        ).start()
+
+    def resync(self, full: bool = False) -> Dict[str, Any]:
+        """Replay missed rows onto every recovered stale node from a
+        healthy peer replica (module docstring). ``full`` replays each
+        table in full instead of above the node's event-time high-water
+        mark — the recovery path for out-of-order event times."""
+        with self._resync_lock:
+            return self._resync_locked(full=full)
+
+    def _resync_locked(self, full: bool = False) -> Dict[str, Any]:
+        report: Dict[str, Any] = {"nodes": {}, "events": 0}
+        for node in self.nodes:
+            if not node.stale:
+                continue
+            if not node.available():
+                report["nodes"][node.label] = "unavailable"
+                continue
+            try:
+                replayed = self._resync_node(node, full=full)
+            except (StorageError, OSError) as e:
+                logger.warning(
+                    "cluster resync of %s failed: %s", node.label, e
+                )
+                self._m_resyncs.labels(outcome="failed").inc()
+                report["nodes"][node.label] = f"failed: {e}"
+                continue
+            node.clear_stale()
+            self._m_resyncs.labels(outcome="completed").inc()
+            report["nodes"][node.label] = f"resynced {replayed} events"
+            report["events"] += replayed
+        return report
+
+    def _resync_node(self, node: _Node, full: bool = False) -> int:
+        """Catch one stale node up from its peers: per known table,
+        fetch every row at-or-above the node's event-time high-water
+        mark (its own store's newest event — the cursor analog of the
+        delta contract) from a healthy replica of each slot the node
+        participates in, and re-post with the ORIGINAL event ids — an
+        idempotent REPLACE on rows the node already has. Deletions are
+        reconciled over the same window: a row the node holds that its
+        (authoritative) peer no longer has was tombstoned while the
+        node was down, and is removed rather than resurrected. Deletes
+        of rows OLDER than the high-water mark need ``full=True`` (the
+        runbook's recovery path for out-of-order/backfilled data)."""
+        self.fire("resync")
+        my_slots = [
+            slot
+            for slot in range(self.n_nodes)
+            if node.index in self.replicas_of_slot(slot)
+        ]
+        total = 0
+        for namespace, app_id, channel_id in self.known_tables():
+            le = node.le(namespace)
+            le.init(app_id, channel_id)
+            hw: Optional[_dt.datetime] = None
+            if not full:
+                newest = list(
+                    le.find(app_id, channel_id, limit=1, reversed=True)
+                )
+                hw = newest[0].event_time if newest else None
+            peer_ids_by_slot: Dict[int, set] = {}
+            for slot in my_slots:
+                peer = self._peer_for(slot, exclude=node.index)
+                if peer is None:
+                    raise StorageError(
+                        f"no healthy peer replica for slot {slot} to "
+                        f"resync {node.label} from"
+                    )
+                rows = [
+                    e
+                    for e in peer.le(namespace).find(
+                        app_id, channel_id, start_time=hw
+                    )
+                    if self.slot_of(e.entity_id) == slot
+                ]
+                peer_ids_by_slot[slot] = {e.event_id for e in rows}
+                for s in range(0, len(rows), 500):
+                    le.insert_batch(rows[s : s + 500], app_id, channel_id)
+                total += len(rows)
+            # deletion reconciliation: anything the node holds in the
+            # window that the peer does not is a missed tombstone
+            for e in le.find(app_id, channel_id, start_time=hw):
+                slot = self.slot_of(e.entity_id)
+                peer_ids = peer_ids_by_slot.get(slot)
+                if peer_ids is not None and e.event_id not in peer_ids:
+                    le.delete(e.event_id, app_id, channel_id)
+                    total += 1
+        self._m_resynced.inc(total)
+        return total
+
+    def replan_slots(
+        self, slots, exclude_idx: int, failed: set
+    ) -> Dict[int, set]:
+        """Move ``slots`` off a failed node onto their next available
+        replica, excluding every node that already failed this scatter
+        (the ping-pong guard). Raises when a slot has no replica left —
+        the shared re-plan step of every scatter path."""
+        moved: Dict[int, set] = {}
+        for slot in slots:
+            nxt = None
+            for idx in self.replicas_of_slot(slot):
+                if idx == exclude_idx or idx in failed:
+                    continue
+                if self.nodes[idx].available():
+                    nxt = idx
+                    break
+            if nxt is None:
+                raise StorageError(
+                    f"cluster slot {slot} lost its last replica mid-scan"
+                )
+            moved.setdefault(nxt, set()).add(slot)
+        return moved
+
+    def _peer_for(self, slot: int, exclude: int) -> Optional[_Node]:
+        for idx in self.replicas_of_slot(slot):
+            if idx == exclude:
+                continue
+            node = self.nodes[idx]
+            if node.available() and not node.stale:
+                return node
+        return None
+
+    # --- status (CLI / pio top feed) ---
+
+    def status(self) -> List[Dict[str, Any]]:
+        out = []
+        for node in self.nodes:
+            out.append(
+                {
+                    "index": node.index,
+                    "url": node.url,
+                    "available": node.available(),
+                    "breaker_open": node.breaker_open(),
+                    "stale": node.stale,
+                    "primary_slot": node.index,
+                    "replica_slots": [
+                        s
+                        for s in range(self.n_nodes)
+                        if node.index in self.replicas_of_slot(s)
+                    ],
+                }
+            )
+        return out
+
+    def close(self) -> None:
+        for node in self.nodes:
+            node.close()
+
+
+class ClusterLEvents(base.LEvents):
+    """Event DAO over the routed node fleet (module docstring)."""
+
+    def __init__(self, client: StorageClient, config=None, namespace: str = ""):
+        self._c = client
+        self.namespace = namespace or "pio"
+
+    def _le(self, node: _Node) -> "_http.HTTPLEvents":
+        return node.le(self.namespace)
+
+    # --- lifecycle (broadcast: every node may own any app's slice) ---
+
+    def init(self, app_id: int, channel_id: Optional[int] = None) -> bool:
+        errors = []
+        for node in self._c.nodes:
+            try:
+                self._le(node).init(app_id, channel_id)
+                node.record_success()
+            except (StorageError, OSError) as e:
+                node.record_failure()
+                errors.append((node.label, e))
+        if errors:
+            # init is an admin op: partial table creation would hide a
+            # node's slice later — require the whole fleet
+            raise StorageError(
+                f"cluster init(app {app_id}) failed on {errors!r}"
+            )
+        self._c.note_table(self.namespace, app_id, channel_id)
+        return True
+
+    def remove(self, app_id: int, channel_id: Optional[int] = None) -> bool:
+        errors = []
+        found = False
+        for node in self._c.nodes:
+            try:
+                found = self._le(node).remove(app_id, channel_id) or found
+                node.record_success()
+            except (StorageError, OSError) as e:
+                node.record_failure()
+                errors.append((node.label, e))
+        if errors:
+            # a node that missed a remove would resurrect dropped rows:
+            # surface loudly, the operator retries once it is back
+            raise StorageError(
+                f"cluster remove(app {app_id}) failed on {errors!r}; "
+                "retry once every node is reachable"
+            )
+        return found
+
+    def close(self) -> None:
+        self._c.close()
+
+    # --- writes ---
+
+    def insert(self, event: Event, app_id: int, channel_id: Optional[int] = None) -> str:
+        return self.insert_batch([event], app_id, channel_id)[0]
+
+    def write(
+        self, events, app_id: int, channel_id: Optional[int] = None
+    ) -> List[str]:
+        return self.insert_batch(list(events), app_id, channel_id)
+
+    def insert_batch(
+        self,
+        events: Sequence[Event],
+        app_id: int,
+        channel_id: Optional[int] = None,
+    ) -> List[str]:
+        """R-way replicated batch write with per-slot quorum ack
+        (module docstring). Ids are fixed client-side BEFORE the first
+        attempt, so retrying the failed slots of a
+        :class:`PartialBatchError` is idempotent on every replica that
+        already committed them (explicit-id re-post = REPLACE)."""
+        events = list(events)
+        if not events:
+            return []
+        self._c.note_table(self.namespace, app_id, channel_id)
+        fixed = []
+        for e in events:
+            eid = e.event_id or new_event_id()
+            fixed.append(e if e.event_id else e.with_event_id(eid))
+        eids = [e.event_id for e in fixed]
+        # group by home slot, preserving input order within each slice
+        by_slot: Dict[int, List[Event]] = {}
+        for e in fixed:
+            by_slot.setdefault(self._c.slot_of(e.entity_id), []).append(e)
+        self._c.fire("route_write")
+        acks: Dict[str, int] = {eid: 0 for eid in eids}
+        # per slot: (slice ids, [(node, committed ids or None, was it
+        # saturation)]) — stale marking is decided AFTER the quorum
+        # outcome is known, so a replica is only ever marked stale for
+        # missing data that actually ACKED (marking on a total slot
+        # failure could stale-out every node at once and leave resync
+        # with no healthy peer to replay from)
+        outcomes: Dict[int, tuple] = {}
+        for slot, slice_events in by_slot.items():
+            slice_ids = [e.event_id for e in slice_events]
+            results = []
+            for idx in self._c.replicas_of_slot(slot):
+                node = self._c.nodes[idx]
+                if not node.available():
+                    # known-down replica: degraded write, hard miss
+                    results.append((node, None, False))
+                    continue
+                try:
+                    self._le(node).insert_batch(
+                        slice_events, app_id, channel_id
+                    )
+                    node.record_success()
+                    committed = frozenset(slice_ids)
+                except PartialBatchError as pe:
+                    node.record_success()  # the node answered
+                    committed = frozenset(
+                        eid for eid in slice_ids
+                        if eid not in pe.failed_ids
+                    )
+                except StorageSaturatedError:
+                    # alive but at capacity: breaker stays shut, peers
+                    # may still ack
+                    node.record_success()
+                    results.append((node, None, True))
+                    continue
+                except (StorageError, OSError) as e:
+                    node.record_failure()
+                    results.append((node, None, False))
+                    logger.warning(
+                        "cluster write slice (slot %d) failed on %s: %s",
+                        slot, node.label, e,
+                    )
+                    continue
+                for eid in committed:
+                    acks[eid] += 1
+                results.append((node, committed, False))
+            outcomes[slot] = (slice_ids, results)
+        self._c.fire("quorum_ack")
+        failed = frozenset(
+            eid for eid in eids if acks[eid] < self._c.write_quorum
+        )
+        # stale = this replica is missing an event that IS acked (its
+        # peers made the write durable without it); a slot that failed
+        # outright left no replica behind, so nobody is stale for it
+        any_hard_miss = False
+        for slot, (slice_ids, results) in outcomes.items():
+            acked_ids = {
+                eid for eid in slice_ids if eid not in failed
+            }
+            for node, committed, saturated in results:
+                if committed is None or acked_ids - committed:
+                    if acked_ids:
+                        node.mark_stale()
+                    if not saturated:
+                        any_hard_miss = True
+        n_acked = len(eids) - len(failed)
+        self._c._m_writes.labels(outcome="acked").inc(n_acked)
+        self._c._m_writes.labels(outcome="failed").inc(len(failed))
+        under = sum(
+            1
+            for eid in eids
+            if eid not in failed and acks[eid] < self._c.replicas
+        )
+        if under:
+            self._c._m_writes.labels(outcome="under_replicated").inc(under)
+        if failed:
+            if n_acked == 0 and not any_hard_miss:
+                raise StorageSaturatedError(
+                    "every replica refused the batch at capacity; "
+                    "retry after backoff"
+                )
+            raise PartialBatchError(
+                f"{len(failed)} of {len(eids)} events missed the write "
+                f"quorum ({self._c.write_quorum})",
+                event_ids=eids,
+                failed_ids=failed,
+            )
+        return eids
+
+    # --- point reads / deletes ---
+
+    def get(
+        self, event_id: str, app_id: int, channel_id: Optional[int] = None
+    ) -> Optional[Event]:
+        # the id does not carry the entity hash: ask available nodes in
+        # order (each probe is cheap; replicas make the first hit fast)
+        candidates = self._order_all_available()
+        if not candidates:
+            raise StorageError("cluster get: no node available")
+        last: Optional[Exception] = None
+        answered = False
+        for node in candidates:
+            try:
+                out = self._le(node).get(event_id, app_id, channel_id)
+                node.record_success()
+                answered = True
+                if out is not None:
+                    return out
+            except (StorageError, OSError) as e:
+                node.record_failure()
+                last = e
+        if not answered:
+            raise StorageError(f"cluster get failed on every node: {last}")
+        return None
+
+    def delete(
+        self, event_id: str, app_id: int, channel_id: Optional[int] = None
+    ) -> bool:
+        found = False
+        missed: List[_Node] = []
+        for node in self._c.nodes:
+            if not node.available():
+                missed.append(node)
+                continue
+            try:
+                found = (
+                    self._le(node).delete(event_id, app_id, channel_id)
+                    or found
+                )
+                node.record_success()
+            except (StorageError, OSError):
+                node.record_failure()
+                missed.append(node)
+        if found:
+            # a node that missed the tombstone while a peer removed the
+            # row may still hold it: stale until resync reconciles (a
+            # no-op delete stales nobody — there was nothing to miss)
+            for node in missed:
+                node.mark_stale()
+        return found
+
+    def _order_all_available(self) -> List[_Node]:
+        nodes = [
+            n for n in self._c.nodes if n.available() and not n.stale
+        ]
+        nodes += [n for n in self._c.nodes if n.available() and n.stale]
+        return nodes
+
+    # --- find / aggregate (scatter-gather with slot filtering) ---
+
+    def find(
+        self,
+        app_id: int,
+        channel_id: Optional[int] = None,
+        start_time: Optional[_dt.datetime] = None,
+        until_time: Optional[_dt.datetime] = None,
+        entity_type: Optional[str] = None,
+        entity_id: Optional[str] = None,
+        event_names: Optional[Sequence[str]] = None,
+        target_entity_type: OptFilter = UNSET,
+        target_entity_id: OptFilter = UNSET,
+        limit: Optional[int] = None,
+        reversed: bool = False,
+    ) -> Iterator[Event]:
+        kwargs = dict(
+            start_time=start_time,
+            until_time=until_time,
+            entity_type=entity_type,
+            entity_id=entity_id,
+            event_names=event_names,
+            target_entity_type=target_entity_type,
+            target_entity_id=target_entity_id,
+        )
+        if entity_id is not None:
+            # single-entity: route straight to its replica set
+            slot = self._c.slot_of(entity_id)
+            out = self._slot_read(
+                slot,
+                lambda le: list(
+                    le.find(
+                        app_id, channel_id, limit=limit,
+                        reversed=reversed, **kwargs,
+                    )
+                ),
+            )
+            return iter(out)
+        plan = self._c.read_plan()
+        accept = _slots_by_node(plan)
+        merged: List[Event] = []
+
+        def fetch(node: _Node, slots: set) -> None:
+            rows = list(self._le(node).find(app_id, channel_id, **kwargs))
+            merged.extend(
+                e for e in rows if self._c.slot_of(e.entity_id) in slots
+            )
+
+        self._scatter_fetch(accept, fetch)
+        merged.sort(key=lambda e: e.event_time, reverse=reversed)
+        if limit is not None and limit >= 0:
+            merged = merged[:limit]
+        return iter(merged)
+
+    def aggregate_properties(
+        self,
+        app_id: int,
+        entity_type: str,
+        channel_id: Optional[int] = None,
+        start_time: Optional[_dt.datetime] = None,
+        until_time: Optional[_dt.datetime] = None,
+        required: Optional[Sequence[str]] = None,
+    ) -> Dict[str, "PropertyMap"]:
+        plan = self._c.read_plan()
+        accept = _slots_by_node(plan)
+        out: Dict[str, Any] = {}
+
+        def fetch(node: _Node, slots: set) -> None:
+            part = self._le(node).aggregate_properties(
+                app_id, entity_type, channel_id=channel_id,
+                start_time=start_time, until_time=until_time,
+                required=required,
+            )
+            # entity→slot is a function, so per-slot key sets are
+            # disjoint: the filtered merge cannot collide
+            out.update(
+                {
+                    k: v
+                    for k, v in part.items()
+                    if self._c.slot_of(k) in slots
+                }
+            )
+
+        self._scatter_fetch(accept, fetch)
+        return out
+
+    def aggregate_properties_of_entity(
+        self,
+        app_id: int,
+        entity_type: str,
+        entity_id: str,
+        channel_id: Optional[int] = None,
+        start_time: Optional[_dt.datetime] = None,
+        until_time: Optional[_dt.datetime] = None,
+    ):
+        slot = self._c.slot_of(entity_id)
+        return self._slot_read(
+            slot,
+            lambda le: le.aggregate_properties_of_entity(
+                app_id, entity_type, entity_id, channel_id=channel_id,
+                start_time=start_time, until_time=until_time,
+            ),
+        )
+
+    def _slot_read(self, slot: int, fn):
+        """Run a read against one slot's replicas with failover."""
+        last: Optional[Exception] = None
+        candidates = self._c.replicas_of_slot(slot)
+        ordered = sorted(
+            candidates,
+            key=lambda idx: (
+                not self._c.nodes[idx].available(),
+                self._c.nodes[idx].stale,
+                candidates.index(idx),
+            ),
+        )
+        for pos, idx in enumerate(ordered):
+            node = self._c.nodes[idx]
+            if not node.available():
+                continue
+            try:
+                out = fn(self._le(node))
+                node.record_success()
+                if pos > 0:
+                    self._c._m_failovers.labels(path="read").inc()
+                return out
+            except (StorageError, OSError) as e:
+                node.record_failure()
+                last = e
+        raise StorageError(
+            f"cluster slot {slot} read failed on every replica: {last}"
+        )
+
+    def _node_read(self, node: _Node, fn):
+        try:
+            out = fn(self._le(node))
+            node.record_success()
+            return out
+        except (StorageError, OSError):
+            node.record_failure()
+            raise
+
+    def _scatter_fetch(self, accept: Dict[int, set], fetch) -> None:
+        """Run ``fetch(node, slots)`` for every planned assignment,
+        re-planning mid-scatter around a node that dies between
+        planning and its fetch: its slots move to their next available
+        replica that has not ALSO failed this scatter (which may mean
+        re-fetching an already-visited node for JUST those slots)."""
+        pending = [
+            (idx, set(slots)) for idx, slots in sorted(accept.items())
+        ]
+        failed: set = set()
+        while pending:
+            node_idx, slots = pending.pop(0)
+            node = self._c.nodes[node_idx]
+            try:
+                if not node.available():
+                    raise _NodeUnavailable(node.label)
+                fetch(node, slots)
+                node.record_success()
+                continue
+            except _NodeUnavailable:
+                pass  # known-down: no extra breaker feedback needed
+            except (StorageError, OSError) as e:
+                node.record_failure()
+                logger.warning(
+                    "cluster scatter re-planning around %s: %s",
+                    node.label, e,
+                )
+            failed.add(node_idx)
+            self._c.fire("node_down_scan")
+            self._c._m_failovers.labels(path="scan").inc()
+            pending.extend(
+                sorted(self._c.replan_slots(slots, node_idx, failed).items())
+            )
+
+    # --- columnar writes ---
+
+    def insert_columns(
+        self,
+        app_id: int,
+        channel_id: Optional[int] = None,
+        *,
+        event: str,
+        entity_type: str,
+        target_entity_type: str,
+        entity_ids,
+        target_ids,
+        values,
+        value_property: str = "rating",
+        event_time: Optional[_dt.datetime] = None,
+        event_times_ms=None,
+    ) -> int:
+        from predictionio_tpu.data.storage import columnar as col
+
+        e_names, e_codes = col.encode_strings(entity_ids)
+        g_names, g_codes = col.encode_strings(target_ids)
+        return self.insert_columns_encoded(
+            app_id, channel_id, event=event, entity_type=entity_type,
+            target_entity_type=target_entity_type,
+            entity_names=e_names, entity_codes=e_codes,
+            target_names=g_names, target_codes=g_codes,
+            values=values, value_property=value_property,
+            event_time=event_time, event_times_ms=event_times_ms,
+        )
+
+    def insert_columns_encoded(
+        self,
+        app_id: int,
+        channel_id: Optional[int] = None,
+        *,
+        event: str,
+        entity_type: str,
+        target_entity_type: str,
+        entity_names,
+        entity_codes,
+        target_names,
+        target_codes,
+        values,
+        value_property: str = "rating",
+        event_time: Optional[_dt.datetime] = None,
+        event_times_ms=None,
+    ) -> int:
+        """Bulk import, partitioned by entity slot: each slot's row
+        subset (with subset dictionaries) goes to its replica set. A
+        slot with zero committed replicas fails the import loudly —
+        bulk import has no per-row retry contract."""
+        import numpy as np
+
+        self._c.note_table(self.namespace, app_id, channel_id)
+        e_codes = np.asarray(entity_codes, np.int64)
+        g_codes = np.asarray(target_codes, np.int64)
+        vals = np.asarray(values, np.float32)
+        times = (
+            None if event_times_ms is None
+            else np.asarray(event_times_ms, np.int64)
+        )
+        e_names_arr = np.asarray(entity_names, object)
+        g_names_arr = np.asarray(target_names, object)
+        name_slots = np.fromiter(
+            (self._c.slot_of(n) for n in e_names_arr),
+            np.int64, count=len(e_names_arr),
+        )
+        row_slots = name_slots[e_codes]
+        self._c.fire("route_write")
+        total = 0
+        for slot in np.unique(row_slots):
+            sel = row_slots == slot
+            se, se_codes = np.unique(e_codes[sel], return_inverse=True)
+            sg, sg_codes = np.unique(g_codes[sel], return_inverse=True)
+            slice_kwargs = dict(
+                event=event,
+                entity_type=entity_type,
+                target_entity_type=target_entity_type,
+                entity_names=e_names_arr[se],
+                entity_codes=se_codes.astype(np.int32),
+                target_names=g_names_arr[sg],
+                target_codes=sg_codes.astype(np.int32),
+                values=vals[sel],
+                value_property=value_property,
+                event_time=event_time,
+                event_times_ms=None if times is None else times[sel],
+            )
+            acked = 0
+            missed: List[_Node] = []
+            for idx in self._c.replicas_of_slot(int(slot)):
+                node = self._c.nodes[idx]
+                if not node.available():
+                    missed.append(node)
+                    continue
+                try:
+                    self._le(node).insert_columns_encoded(
+                        app_id, channel_id, **slice_kwargs
+                    )
+                    node.record_success()
+                    acked += 1
+                except StorageSaturatedError:
+                    # backpressure, not node death: the breaker stays
+                    # shut and the node is only stale if peers commit
+                    node.record_success()
+                    missed.append(node)
+                except (StorageError, OSError) as e:
+                    node.record_failure()
+                    missed.append(node)
+                    logger.warning(
+                        "cluster columnar import slot %d failed on %s: "
+                        "%s", int(slot), node.label, e,
+                    )
+            self._c.fire("quorum_ack")
+            if acked < self._c.write_quorum:
+                raise StorageError(
+                    f"cluster columnar import: slot {int(slot)} missed "
+                    f"the write quorum ({acked}/{self._c.write_quorum})"
+                )
+            # stale only when the slice actually acked elsewhere — a
+            # replica can only "miss" data that became durable
+            for node in missed:
+                node.mark_stale()
+            total += int(sel.sum())
+        return total
+
+    # --- columnar scans (scatter-gather, shared code space) ---
+
+    def find_columns_native(
+        self,
+        app_id: int,
+        channel_id: Optional[int] = None,
+        *,
+        value_spec=None,
+        start_time: Optional[_dt.datetime] = None,
+        until_time: Optional[_dt.datetime] = None,
+        entity_type: Optional[str] = None,
+        target_entity_type: OptFilter = UNSET,
+        event_names: Optional[Sequence[str]] = None,
+    ):
+        import numpy as np
+
+        from predictionio_tpu.data.storage.columnar import ColumnarEvents
+
+        stream = self.stream_columns_native(
+            app_id, channel_id, value_spec=value_spec,
+            start_time=start_time, until_time=until_time,
+            entity_type=entity_type,
+            target_entity_type=target_entity_type,
+            event_names=event_names,
+        )
+        e_parts, t_parts, v_parts = [], [], []
+        for e, t, v in stream:
+            e_parts.append(np.asarray(e, np.int64))
+            t_parts.append(np.asarray(t, np.int64))
+            v_parts.append(np.asarray(v, np.float32))
+        names = np.asarray(stream.names, object)
+        if not v_parts:
+            return ColumnarEvents.empty()
+        e_codes = np.concatenate(e_parts)
+        t_codes = np.concatenate(t_parts)
+        e_uniq, e_inv = np.unique(e_codes, return_inverse=True)
+        t_uniq, t_inv = np.unique(t_codes, return_inverse=True)
+        return ColumnarEvents(
+            entity_names=names[e_uniq],
+            target_names=names[t_uniq],
+            entity_codes=e_inv.astype(np.int32),
+            target_codes=t_inv.astype(np.int32),
+            values=np.concatenate(v_parts),
+        )
+
+    def stream_columns_native(
+        self,
+        app_id: int,
+        channel_id: Optional[int] = None,
+        *,
+        value_spec=None,
+        start_time: Optional[_dt.datetime] = None,
+        until_time: Optional[_dt.datetime] = None,
+        entity_type: Optional[str] = None,
+        target_entity_type: OptFilter = UNSET,
+        event_names: Optional[Sequence[str]] = None,
+        batch_rows: int = 1_048_576,
+    ):
+        """Scatter-gather chunked scan: one batch per planned node,
+        slot-filtered and re-encoded into one shared code space, feeding
+        the counting-sort merge a wire BYTE-identical to a single-node
+        store (module docstring). The stream's cursor carries the plan
+        plus every node's own cursor; its fingerprint combines every
+        node's pre-scan fingerprint. A degraded plan (stale replica
+        serving) disables both — a scan that may be missing acked rows
+        must never label a cache artifact or chain a delta."""
+        plan = self._c.read_plan()
+        return self._scatter_stream(
+            plan, app_id, channel_id,
+            value_spec=value_spec, start_time=start_time,
+            until_time=until_time, entity_type=entity_type,
+            target_entity_type=target_entity_type,
+            event_names=event_names, batch_rows=batch_rows,
+            delta_cursors=None,
+        )
+
+    def stream_columns_delta(
+        self,
+        app_id: int,
+        channel_id: Optional[int] = None,
+        *,
+        cursor: tuple,
+        value_spec=None,
+        start_time: Optional[_dt.datetime] = None,
+        until_time: Optional[_dt.datetime] = None,
+        entity_type: Optional[str] = None,
+        target_entity_type: OptFilter = UNSET,
+        event_names: Optional[Sequence[str]] = None,
+        batch_rows: int = 1_048_576,
+    ):
+        """Per-node delta scan. Valid only while the read plan is
+        UNCHANGED since the cursor (same topology, same slot→node
+        assignment): any re-plan — a node died, recovered, or was
+        resynced between rounds — returns None so the caller does one
+        full re-scan under the new plan instead of trusting a cursor
+        whose per-slot coverage no longer matches the folded prefix.
+        Continuous training therefore keeps folding deltas across a
+        node outage with exactly two full-rescan rounds: the one that
+        first routes around the dead node, and the one that routes back
+        after resync."""
+        if (
+            not isinstance(cursor, tuple)
+            or len(cursor) != 5
+            or cursor[0] != "cluster-delta"
+        ):
+            return None
+        _, n_nodes, replicas, plan_then, node_cursors = cursor
+        if n_nodes != self._c.n_nodes or replicas != self._c.replicas:
+            return None  # topology changed under the cursor
+        plan = self._c.read_plan()
+        if tuple(sorted(plan.items())) != plan_then:
+            return None  # re-planned: full rescan owns correctness
+        cursors = dict(node_cursors)
+        if set(cursors) != set(plan.values()) or any(
+            cursors[idx] is None for idx in cursors
+        ):
+            return None
+        return self._scatter_stream(
+            plan, app_id, channel_id,
+            value_spec=value_spec, start_time=start_time,
+            until_time=until_time, entity_type=entity_type,
+            target_entity_type=target_entity_type,
+            event_names=event_names, batch_rows=batch_rows,
+            delta_cursors=cursors,
+        )
+
+    def _scatter_stream(
+        self,
+        plan: Dict[int, int],
+        app_id: int,
+        channel_id,
+        *,
+        value_spec,
+        start_time,
+        until_time,
+        entity_type,
+        target_entity_type,
+        event_names,
+        batch_rows,
+        delta_cursors: Optional[Dict[int, tuple]],
+    ):
+        import numpy as np
+
+        from predictionio_tpu.data.storage.columnar import ColumnarStream
+
+        accept = _slots_by_node(plan)
+        degraded = self._c.plan_is_degraded(plan)
+        scan_kwargs = dict(
+            value_spec=value_spec, start_time=start_time,
+            until_time=until_time, entity_type=entity_type,
+            target_entity_type=target_entity_type,
+            event_names=event_names, batch_rows=batch_rows,
+        )
+        # pre-scan fingerprints, so a cached artifact can never be
+        # labeled newer than its data (the ColumnarStream contract)
+        fingerprint: Optional[tuple] = None
+        if not degraded:
+            fps = []
+            try:
+                for node_idx in sorted(accept):
+                    fp = self._node_read(
+                        self._c.nodes[node_idx],
+                        lambda le: le.store_fingerprint(
+                            app_id, channel_id
+                        ),
+                    )
+                    if fp is None:
+                        fps = None
+                        break
+                    fps.append((node_idx, fp))
+            except (StorageError, OSError):
+                fps = None
+            if fps is not None:
+                fingerprint = (
+                    "cluster",
+                    tuple(sorted(plan.items())),
+                    tuple(fps),
+                )
+
+        # one shared code space across node batches: the same item id
+        # appears on EVERY node that stores one of its raters' slots,
+        # so per-node dictionaries must be unified, not concatenated
+        global_codes: Dict[str, int] = {}
+        names_list: List[str] = []
+        box: Dict[str, Any] = {
+            "cursors": {}, "complete": False, "invalid": False,
+        }
+        c = self._c
+        get_le = self._le
+
+        def remap(local_names: "np.ndarray", codes: "np.ndarray"):
+            lut = np.empty(len(local_names), np.int64)
+            for j, name in enumerate(local_names):
+                key = str(name)
+                code = global_codes.get(key)
+                if code is None:
+                    code = len(names_list)
+                    global_codes[key] = code
+                    names_list.append(key)
+                lut[j] = code
+            return lut[codes]
+
+        def fetch_node(node: _Node, slots: set):
+            """One node's scan, materialized + slot-filtered. Returns
+            the (e, t, v) batch or None (nothing to emit); raises
+            _DeltaInvalid when the node declines its delta."""
+            le = get_le(node)
+            if delta_cursors is not None:
+                stream = le.stream_columns_delta(
+                    app_id, channel_id,
+                    cursor=delta_cursors[node.index], **scan_kwargs,
+                )
+                if stream is None:
+                    raise _DeltaInvalid(node.label)
+            else:
+                stream = le.stream_columns_native(
+                    app_id, channel_id, **scan_kwargs
+                )
+            if stream is None:
+                # no chunked path on this node (old gateway): one-batch
+                # fallback, losing cursor support for this round
+                cols = le.find_columns_native(
+                    app_id, channel_id,
+                    **{
+                        k: v
+                        for k, v in scan_kwargs.items()
+                        if k != "batch_rows"
+                    },
+                )
+                if cols is None:
+                    box["cursors"][node.index] = None
+                    return None
+                stream = ColumnarStream.from_columnar(cols)
+            e_parts, t_parts, v_parts = [], [], []
+            for e, t, v in stream:
+                e_parts.append(np.asarray(e, np.int64))
+                t_parts.append(np.asarray(t, np.int64))
+                v_parts.append(np.asarray(v, np.float32))
+            local_names = np.asarray(stream.names, object)
+            box["cursors"][node.index] = stream.cursor
+            if not v_parts:
+                return None
+            e_codes = np.concatenate(e_parts)
+            t_codes = np.concatenate(t_parts)
+            values = np.concatenate(v_parts)
+            # slot filter: keep only rows whose entity this node SERVES
+            # in the current plan (it also stores up to R-1 other
+            # slots' replica rows — the filter is the dedup)
+            name_slots = np.fromiter(
+                (c.slot_of(n) for n in local_names),
+                np.int64, count=len(local_names),
+            )
+            slot_ok = np.zeros(c.n_nodes, bool)
+            slot_ok[list(slots)] = True
+            keep = slot_ok[name_slots[e_codes]]
+            if not keep.any():
+                return None
+            return (
+                remap(local_names, e_codes[keep]),
+                remap(local_names, t_codes[keep]),
+                values[keep],
+            )
+
+        def batches():
+            pending = [
+                (idx, set(slots)) for idx, slots in sorted(accept.items())
+            ]
+            failed: set = set()
+            while pending:
+                node_idx, slots = pending.pop(0)
+                node = c.nodes[node_idx]
+                try:
+                    if not node.available():
+                        raise _NodeUnavailable(node.label)
+                    batch = fetch_node(node, slots)
+                    node.record_success()
+                except _DeltaInvalid:
+                    # a node declined its delta: the WHOLE cluster scan
+                    # falls back to a full repack (cursor() → None);
+                    # stop early, nothing more to gain this round
+                    box["invalid"] = True
+                    return
+                except (StorageError, OSError) as e:
+                    if not isinstance(e, _NodeUnavailable):
+                        node.record_failure()
+                    if delta_cursors is not None:
+                        # mid-delta failover changes the plan: fall back
+                        box["invalid"] = True
+                        return
+                    # mid-scan failover: the node died between planning
+                    # and its fetch — move its slots to their next
+                    # available replica that has not also failed this
+                    # scan (possibly re-fetching a node already
+                    # visited, filtered to JUST these slots)
+                    c.fire("node_down_scan")
+                    c._m_failovers.labels(path="scan").inc()
+                    logger.warning(
+                        "cluster scan re-planning around %s: %s",
+                        node.label, e,
+                    )
+                    failed.add(node_idx)
+                    pending.extend(
+                        sorted(
+                            c.replan_slots(slots, node_idx, failed).items()
+                        )
+                    )
+                    # a failover scan's coverage no longer matches the
+                    # planned cursor set: serve the data, skip the cursor
+                    box["invalid"] = True
+                    continue
+                if batch is not None:
+                    yield batch
+            box["complete"] = True
+
+        def names():
+            out = np.empty(len(names_list), object)
+            out[:] = names_list
+            return out
+
+        def cursor():
+            # no cursor from a degraded plan (possibly missing acked
+            # rows), an incomplete/re-planned iteration, or any node
+            # that could not vouch for its own scan
+            if degraded or box["invalid"] or not box["complete"]:
+                return None
+            cursors = box["cursors"]
+            if set(cursors) != set(accept) or any(
+                cursors[idx] is None for idx in cursors
+            ):
+                return None
+            return (
+                "cluster-delta",
+                c.n_nodes,
+                c.replicas,
+                tuple(sorted(plan.items())),
+                tuple(sorted(cursors.items())),
+            )
+
+        return ColumnarStream(
+            batches(), names,
+            fingerprint=None if degraded else fingerprint,
+            cursor_fn=cursor,
+        )
+
+    def store_fingerprint(
+        self, app_id: int, channel_id: Optional[int] = None
+    ) -> Optional[tuple]:
+        plan = self._c.read_plan(count_failover=False)
+        if self._c.plan_is_degraded(plan):
+            return None
+        fps = []
+        for node_idx in sorted(set(plan.values())):
+            try:
+                fp = self._node_read(
+                    self._c.nodes[node_idx],
+                    lambda le: le.store_fingerprint(app_id, channel_id),
+                )
+            except (StorageError, OSError):
+                return None
+            if fp is None:
+                return None
+            fps.append((node_idx, fp))
+        return ("cluster", tuple(sorted(plan.items())), tuple(fps))
+
+
+class _DeltaInvalid(StorageError):
+    """A node declined its delta mid-scatter — the cluster stream turns
+    this into a full-repack fallback at the caller."""
+
+
+class _NodeUnavailable(StorageError):
+    """A planned node's breaker is open at fetch time — re-plan without
+    feeding the breaker another failure."""
+
+
+def _slots_by_node(plan: Dict[int, int]) -> Dict[int, set]:
+    accept: Dict[int, set] = {}
+    for slot, node_idx in plan.items():
+        accept.setdefault(node_idx, set()).add(slot)
+    return accept
+
+
+# --- metadata DAOs: broadcast writes, first-healthy reads ---
+#
+# Metadata (apps, keys, channels, instances, models) is tiny and rarely
+# written; the cluster replicates it to EVERY node so any gateway can
+# resolve an access key or an app id with the rest of the fleet dark.
+# Ids/keys are fixed client-side (or taken from the first node) before
+# replication, so the copies agree. A node that misses a metadata write
+# (down at the time) is marked stale; metadata is NOT covered by the
+# event-tier resync — the runbook (docs/STORAGE.md) says to re-run the
+# admin command once the fleet is whole, which is idempotent here.
+
+
+class _ClusterMetaBase:
+    DAO_CLS: type = None  # the HTTP* DAO proxied per node
+
+    def __init__(self, client: StorageClient, config=None, namespace: str = ""):
+        self._c = client
+        self.namespace = namespace or "pio"
+
+    def _dao(self, node: _Node):
+        return node.dao(self.DAO_CLS, self.namespace)
+
+    def _read(self, fn):
+        last: Optional[Exception] = None
+        for node in self._c.nodes:
+            if not node.available():
+                continue
+            try:
+                out = fn(self._dao(node))
+                node.record_success()
+                return out
+            except (StorageError, OSError) as e:
+                node.record_failure()
+                last = e
+        raise StorageError(
+            f"cluster metadata read failed on every node: {last}"
+        )
+
+    def _broadcast(self, fn, primary_first: bool = False):
+        """Apply a write on every available node; returns the primary
+        (first successful) result. At least one node must succeed; the
+        rest are best-effort (a skipped node is marked stale)."""
+        results = []
+        errors = []
+        for node in self._c.nodes:
+            if not node.available():
+                node.mark_stale()
+                continue
+            try:
+                results.append(fn(self._dao(node)))
+                node.record_success()
+                if primary_first and len(results) == 1:
+                    # caller needs the assigned id before replicating
+                    return results[0]
+            except (StorageError, OSError) as e:
+                node.record_failure()
+                node.mark_stale()
+                errors.append((node.label, e))
+        if not results:
+            raise StorageError(
+                f"cluster metadata write failed everywhere: {errors!r}"
+            )
+        return results[0]
+
+
+class ClusterApps(_ClusterMetaBase, base.Apps):
+    DAO_CLS = _http.HTTPApps
+
+    def insert(self, app):
+        import dataclasses as _dc
+
+        if app.id == 0:
+            assigned = self._broadcast(
+                lambda d: d.insert(app), primary_first=True
+            )
+            if assigned is None:
+                return None
+            app = _dc.replace(app, id=assigned)
+            # replicate the EXPLICIT id to the rest (first node already
+            # has it; re-insert there returns None harmlessly)
+            self._broadcast(lambda d: d.insert(app))
+            return assigned
+        return self._broadcast(lambda d: d.insert(app))
+
+    def get(self, app_id):
+        return self._read(lambda d: d.get(app_id))
+
+    def get_by_name(self, name):
+        return self._read(lambda d: d.get_by_name(name))
+
+    def get_all(self):
+        return self._read(lambda d: d.get_all())
+
+    def update(self, app):
+        return self._broadcast(lambda d: d.update(app))
+
+    def delete(self, app_id):
+        return self._broadcast(lambda d: d.delete(app_id))
+
+
+class ClusterAccessKeys(_ClusterMetaBase, base.AccessKeys):
+    DAO_CLS = _http.HTTPAccessKeys
+
+    def insert(self, access_key):
+        import dataclasses as _dc
+
+        if not access_key.key:
+            # fix the key CLIENT-side so every replica stores the same
+            access_key = _dc.replace(access_key, key=self.generate_key())
+        out = self._broadcast(lambda d: d.insert(access_key))
+        return out if out is not None else access_key.key
+
+    def get(self, key):
+        return self._read(lambda d: d.get(key))
+
+    def get_all(self):
+        return self._read(lambda d: d.get_all())
+
+    def get_by_app_id(self, app_id):
+        return self._read(lambda d: d.get_by_app_id(app_id))
+
+    def update(self, access_key):
+        return self._broadcast(lambda d: d.update(access_key))
+
+    def delete(self, key):
+        return self._broadcast(lambda d: d.delete(key))
+
+
+class ClusterChannels(_ClusterMetaBase, base.Channels):
+    DAO_CLS = _http.HTTPChannels
+
+    def insert(self, channel):
+        import dataclasses as _dc
+
+        if channel.id == 0:
+            assigned = self._broadcast(
+                lambda d: d.insert(channel), primary_first=True
+            )
+            if assigned is None:
+                return None
+            channel = _dc.replace(channel, id=assigned)
+            self._broadcast(lambda d: d.insert(channel))
+            return assigned
+        return self._broadcast(lambda d: d.insert(channel))
+
+    def get(self, channel_id):
+        return self._read(lambda d: d.get(channel_id))
+
+    def get_by_app_id(self, app_id):
+        return self._read(lambda d: d.get_by_app_id(app_id))
+
+    def delete(self, channel_id):
+        return self._broadcast(lambda d: d.delete(channel_id))
+
+
+class ClusterEngineManifests(_ClusterMetaBase, base.EngineManifests):
+    DAO_CLS = _http.HTTPEngineManifests
+
+    def insert(self, manifest):
+        return self._broadcast(lambda d: d.insert(manifest))
+
+    def get(self, id, version):
+        return self._read(lambda d: d.get(id, version))
+
+    def get_all(self):
+        return self._read(lambda d: d.get_all())
+
+    def update(self, manifest, upsert=False):
+        return self._broadcast(lambda d: d.update(manifest, upsert=upsert))
+
+    def delete(self, id, version):
+        return self._broadcast(lambda d: d.delete(id, version))
+
+
+def _fixed_instance_id(instance):
+    import dataclasses as _dc
+    import uuid
+
+    if instance.id:
+        return instance
+    return _dc.replace(instance, id=uuid.uuid4().hex[:17])
+
+
+class ClusterEngineInstances(_ClusterMetaBase, base.EngineInstances):
+    DAO_CLS = _http.HTTPEngineInstances
+
+    def insert(self, instance):
+        instance = _fixed_instance_id(instance)
+        self._broadcast(lambda d: d.insert(instance))
+        return instance.id
+
+    def get(self, id):
+        return self._read(lambda d: d.get(id))
+
+    def get_all(self):
+        return self._read(lambda d: d.get_all())
+
+    def get_latest_completed(self, engine_id, engine_version, engine_variant):
+        return self._read(
+            lambda d: d.get_latest_completed(
+                engine_id, engine_version, engine_variant
+            )
+        )
+
+    def get_completed(self, engine_id, engine_version, engine_variant):
+        return self._read(
+            lambda d: d.get_completed(
+                engine_id, engine_version, engine_variant
+            )
+        )
+
+    def update(self, instance):
+        return self._broadcast(lambda d: d.update(instance))
+
+    def delete(self, id):
+        return self._broadcast(lambda d: d.delete(id))
+
+
+class ClusterEvaluationInstances(_ClusterMetaBase, base.EvaluationInstances):
+    DAO_CLS = _http.HTTPEvaluationInstances
+
+    def insert(self, instance):
+        instance = _fixed_instance_id(instance)
+        self._broadcast(lambda d: d.insert(instance))
+        return instance.id
+
+    def get(self, id):
+        return self._read(lambda d: d.get(id))
+
+    def get_all(self):
+        return self._read(lambda d: d.get_all())
+
+    def get_completed(self):
+        return self._read(lambda d: d.get_completed())
+
+    def update(self, instance):
+        return self._broadcast(lambda d: d.update(instance))
+
+    def delete(self, id):
+        return self._broadcast(lambda d: d.delete(id))
+
+
+class ClusterModels(_ClusterMetaBase, base.Models):
+    DAO_CLS = _http.HTTPModels
+
+    def insert(self, model):
+        return self._broadcast(lambda d: d.insert(model))
+
+    def get(self, id):
+        return self._read(lambda d: d.get(id))
+
+    def delete(self, id):
+        return self._broadcast(lambda d: d.delete(id))

@@ -55,6 +55,7 @@ from predictionio_tpu.data.storage.base import (
     OptFilter,
     PartialBatchError,
     StorageError,
+    StorageSaturatedError,
 )
 
 PREFIX = "HTTP"
@@ -73,6 +74,9 @@ _IDEMPOTENT_METHODS = frozenset(
         "aggregate_properties",
         "aggregate_properties_of_entity",
         "find_columns_native",
+        "scan_columns",
+        "scan_columns_delta",
+        "store_fingerprint",
     }
 )
 
@@ -108,7 +112,20 @@ class StorageClient(base.DAOCacheMixin):
         self.host = parsed.hostname or "localhost"
         self.port = parsed.port or 7077
         self.secret = props.get("SECRET", "")
-        timeout = float(props.get("TIMEOUT_S", "60"))  # LEvents.scala:39
+        # per-request deadline, propagated as the socket timeout on
+        # every connection: a WEDGED gateway node (accepting but never
+        # answering) fails fast into the retry / circuit-breaker path
+        # instead of hanging a scan until the 600 s unit-wait backstop.
+        # Source precedence: source property, then the process-wide
+        # PIO_STORAGE_CLIENT_TIMEOUT_S, then the reference's 60 s
+        # (LEvents.scala:39).
+        import os as _os
+
+        timeout = float(
+            props.get("TIMEOUT_S")
+            or _os.environ.get("PIO_STORAGE_CLIENT_TIMEOUT_S")
+            or "60"
+        )
         self._timeout = timeout
         self._read_retries = int(props.get("RETRIES", _READ_RETRIES))
         self._backoff_cap_s = float(
@@ -232,6 +249,13 @@ class StorageClient(base.DAOCacheMixin):
                     str(out.get("error")),
                     event_ids=out.get("event_ids") or [],
                     failed_ids=out.get("failed_ids") or [],
+                )
+            if out.get("type") == "StorageSaturatedError":
+                # typed backpressure survives the hop: an event server
+                # fronted by this gateway answers 503 + Retry-After
+                raise StorageSaturatedError(
+                    str(out.get("error")),
+                    retry_after_s=float(out.get("retry_after_s") or 1.0),
                 )
             raise StorageError(
                 f"gateway {dao}.{method} failed ({resp.status}): "
@@ -610,6 +634,136 @@ class HTTPLEvents(_RemoteDAO, base.LEvents):
                 event_names=event_names,
             )
         return None if out is None else col.columnar_from_wire(out)
+
+    # --- chunked/delta scan over the wire (cluster tier + remote
+    # delta training): the gateway materializes its backend's stream
+    # into one packed payload carrying the opaque cursor/fingerprint ---
+
+    @staticmethod
+    def _scan_args(
+        value_spec, start_time, until_time, entity_type,
+        target_entity_type, event_names, batch_rows,
+    ) -> dict:
+        from predictionio_tpu.data.storage import columnar as col
+        from predictionio_tpu.data.storage.columnar import ValueSpec
+
+        return {
+            "value_spec": col.spec_to_wire(value_spec or ValueSpec()),
+            "start_time": wire.opt_dt_to_wire(start_time),
+            "until_time": wire.opt_dt_to_wire(until_time),
+            "entity_type": entity_type,
+            "target_entity_type": (
+                wire.UNSET_WIRE
+                if target_entity_type is UNSET
+                else target_entity_type
+            ),
+            "event_names": (
+                list(event_names) if event_names is not None else None
+            ),
+            "batch_rows": batch_rows,
+        }
+
+    @staticmethod
+    def _stream_from_scan(out) -> "ColumnarStream":
+        """One-batch ColumnarStream over a scan_columns payload, with
+        the producing node's cursor and pre-scan fingerprint attached
+        verbatim (tagged codec round-trips them exactly — the node
+        validates its own cursor by equality on the next delta)."""
+        import numpy as np
+
+        from predictionio_tpu.data.storage import columnar as col
+        from predictionio_tpu.data.storage.columnar import ColumnarStream
+
+        names = np.empty(len(out["names"]), object)
+        names[:] = out["names"]
+        e_codes = col.array_from_b64(out["e_codes"], np.int64)
+        t_codes = col.array_from_b64(out["t_codes"], np.int64)
+        values = col.array_from_b64(out["values"], np.float32)
+        batches = [(e_codes, t_codes, values)] if len(values) else []
+        cursor = wire.opaque_from_wire(out.get("cursor"))
+        return ColumnarStream(
+            iter(batches),
+            lambda: names,
+            fingerprint=wire.opaque_from_wire(out.get("fingerprint")),
+            cursor_fn=lambda: cursor,
+        )
+
+    def stream_columns_native(
+        self,
+        app_id: int,
+        channel_id: Optional[int] = None,
+        *,
+        value_spec=None,
+        start_time: Optional[_dt.datetime] = None,
+        until_time: Optional[_dt.datetime] = None,
+        entity_type: Optional[str] = None,
+        target_entity_type: OptFilter = UNSET,
+        event_names: Optional[Sequence[str]] = None,
+        batch_rows: int = 1_048_576,
+    ):
+        try:
+            out = self._call(
+                "scan_columns",
+                app_id=app_id,
+                channel_id=channel_id,
+                **self._scan_args(
+                    value_spec, start_time, until_time, entity_type,
+                    target_entity_type, event_names, batch_rows,
+                ),
+            )
+        except StorageError as e:
+            if "unknown levents method" not in str(e):
+                raise
+            return None  # old gateway: find_columns_native fallback
+        if out is None or out.get("invalid"):
+            return None
+        return self._stream_from_scan(out)
+
+    def stream_columns_delta(
+        self,
+        app_id: int,
+        channel_id: Optional[int] = None,
+        *,
+        cursor: tuple,
+        value_spec=None,
+        start_time: Optional[_dt.datetime] = None,
+        until_time: Optional[_dt.datetime] = None,
+        entity_type: Optional[str] = None,
+        target_entity_type: OptFilter = UNSET,
+        event_names: Optional[Sequence[str]] = None,
+        batch_rows: int = 1_048_576,
+    ):
+        try:
+            out = self._call(
+                "scan_columns_delta",
+                app_id=app_id,
+                channel_id=channel_id,
+                cursor=wire.opaque_to_wire(cursor),
+                **self._scan_args(
+                    value_spec, start_time, until_time, entity_type,
+                    target_entity_type, event_names, batch_rows,
+                ),
+            )
+        except StorageError as e:
+            if "unknown levents method" not in str(e):
+                raise
+            return None  # old gateway: full-repack fallback
+        if out is None or out.get("invalid"):
+            return None
+        return self._stream_from_scan(out)
+
+    def store_fingerprint(
+        self, app_id: int, channel_id: Optional[int] = None
+    ) -> Optional[tuple]:
+        try:
+            out = self._call(
+                "store_fingerprint", app_id=app_id, channel_id=channel_id
+            )
+        except StorageError as e:
+            if "unknown levents method" not in str(e):
+                raise
+            return None  # old gateway: caching disabled
+        return wire.opaque_from_wire(out)
 
 
 class HTTPApps(_RemoteDAO, base.Apps):

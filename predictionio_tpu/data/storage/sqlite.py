@@ -187,6 +187,15 @@ class _GroupCommitter:
     # before opening storage.
     HEARTBEAT_DEADLINE_S = 30.0
 
+    # admission control: at most this many queued units, and a submit
+    # blocked longer than the admission window is REFUSED with
+    # StorageSaturatedError instead of parking the caller's handler
+    # thread behind a wedged committer (frontends answer it as 503 +
+    # Retry-After). Class attributes so tests can shrink them before
+    # opening storage.
+    QUEUE_MAX_UNITS = 4096
+    ADMIT_WAIT_S = 0.25
+
     def __init__(self, shard: "_ShardState", max_rows: int, max_delay_s: float):
         from predictionio_tpu.utils import health as _health
         from predictionio_tpu.utils import metrics as _metrics
@@ -194,7 +203,9 @@ class _GroupCommitter:
         self._shard = shard
         self._max_rows = max(1, int(max_rows))
         self._max_delay_s = max(0.0, float(max_delay_s))
-        self._q: "_queue.Queue[_InsertUnit]" = _queue.Queue(maxsize=4096)
+        self._q: "_queue.Queue[_InsertUnit]" = _queue.Queue(
+            maxsize=self.QUEUE_MAX_UNITS
+        )
         self._thread: Optional[threading.Thread] = None
         self._start_lock = threading.Lock()
         # per-shard flush accounting in the process-global registry
@@ -253,7 +264,29 @@ class _GroupCommitter:
                     )
                     t.start()
                     self._thread = t
-        self._q.put(unit)
+        try:
+            # bounded admission: refuse (typed) rather than park the
+            # caller unboundedly when the queue is saturated — REST
+            # frontends turn the refusal into 503 + Retry-After
+            self._q.put(unit, timeout=self.ADMIT_WAIT_S)
+        except _queue.Full:
+            from predictionio_tpu.utils import metrics as _metrics
+
+            _metrics.get_registry().counter(
+                "pio_group_commit_saturated_total",
+                "Write submissions refused because the group-commit "
+                "queue stayed full past the admission window "
+                "(surfaced to clients as 503 + Retry-After)",
+                labels=("shard",),
+            ).labels(
+                shard=os.path.basename(self._shard.path) or self._shard.path
+            ).inc()
+            raise base.StorageSaturatedError(
+                f"group-commit queue for {self._shard.path!r} is "
+                f"saturated ({self.QUEUE_MAX_UNITS} queued units); "
+                "the write was NOT accepted — retry after backoff",
+                retry_after_s=1.0,
+            )
         return unit
 
     def _run(self) -> None:

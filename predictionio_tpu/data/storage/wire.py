@@ -140,3 +140,47 @@ def opt_dt_to_wire(d: Optional[_dt.datetime]) -> Optional[str]:
 
 def opt_dt_from_wire(s: Optional[str]) -> Optional[_dt.datetime]:
     return None if s is None else _dt_from_wire(s)
+
+
+# --- opaque-value codec (delta cursors, store fingerprints) ---
+#
+# Delta cursors and fingerprints are backend-opaque tuples (sqlite nests
+# per-store tuples; memory embeds a datetime). They must round-trip the
+# JSON wire EXACTLY — the producing backend validates them by equality,
+# so tuple-vs-list or a truncated datetime would silently force a full
+# repack on every delta round. Tagged encoding keeps plain JSON scalars
+# untouched and wraps only what JSON cannot represent.
+
+_TUPLE_TAG = "__pio_tuple"
+_DT_TAG = "__pio_dt"
+_BYTES_TAG = "__pio_bytes"
+
+
+def opaque_to_wire(v: Any) -> Any:
+    """Recursively encode an opaque cursor/fingerprint value for JSON."""
+    if isinstance(v, tuple):
+        return {_TUPLE_TAG: [opaque_to_wire(x) for x in v]}
+    if isinstance(v, list):
+        return [opaque_to_wire(x) for x in v]
+    if isinstance(v, _dt.datetime):
+        return {_DT_TAG: _dt_to_wire(v)}
+    if isinstance(v, bytes):
+        return {_BYTES_TAG: base64.b64encode(v).decode("ascii")}
+    if isinstance(v, dict):
+        return {str(k): opaque_to_wire(x) for k, x in v.items()}
+    return v
+
+
+def opaque_from_wire(v: Any) -> Any:
+    """Inverse of :func:`opaque_to_wire`."""
+    if isinstance(v, dict):
+        if _TUPLE_TAG in v and len(v) == 1:
+            return tuple(opaque_from_wire(x) for x in v[_TUPLE_TAG])
+        if _DT_TAG in v and len(v) == 1:
+            return _dt_from_wire(v[_DT_TAG])
+        if _BYTES_TAG in v and len(v) == 1:
+            return base64.b64decode(v[_BYTES_TAG])
+        return {k: opaque_from_wire(x) for k, x in v.items()}
+    if isinstance(v, list):
+        return [opaque_from_wire(x) for x in v]
+    return v

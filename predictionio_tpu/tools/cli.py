@@ -721,6 +721,66 @@ def cmd_storagegateway(args) -> int:
     return 0
 
 
+def _cluster_client(source: str = ""):
+    """The cluster StorageClient behind EVENTDATA (or an explicit
+    ``--source``); errors out when no cluster source is configured."""
+    from predictionio_tpu.data.storage import get_storage
+    from predictionio_tpu.data.storage import cluster as cluster_mod
+
+    storage = get_storage()
+    names = []
+    if source:
+        names = [source.upper()]
+    else:
+        repos = storage.repositories()
+        ev = repos.get("EVENTDATA", {}).get("SOURCE")
+        if ev:
+            names = [ev]
+    for name in names:
+        try:
+            client = storage._client(name)
+        except Exception:
+            continue
+        if isinstance(client, cluster_mod.StorageClient):
+            return client
+    raise SystemExit(
+        "no cluster storage source configured "
+        "(PIO_STORAGE_SOURCES_<NAME>_TYPE=cluster); see docs/STORAGE.md"
+    )
+
+
+def cmd_storagecluster(args) -> int:
+    """Operate the partitioned gateway tier: ``status`` renders the
+    per-node topology/health table, ``resync`` replays missed rows onto
+    recovered stale nodes (docs/STORAGE.md runbook)."""
+    client = _cluster_client(getattr(args, "source", ""))
+    if args.cluster_command == "resync":
+        report = client.resync(full=args.full)
+        for label, outcome in sorted(report["nodes"].items()):
+            print(f"  {label}: {outcome}")
+        print(f"resynced events: {report['events']}")
+        return 0 if "failed" not in str(report) else 1
+    # status (default)
+    print(
+        f"cluster: {client.n_nodes} nodes, R={client.replicas}, "
+        f"write quorum={client.write_quorum}"
+    )
+    print(
+        f"{'NODE':<28} {'SLOT':>4} {'REPLICA-OF':<12} {'STATE':<8} STALE"
+    )
+    for row in client.status():
+        state = (
+            "down" if not row["available"]
+            else ("open" if row["breaker_open"] else "ok")
+        )
+        print(
+            f"{row['url']:<28} {row['primary_slot']:>4} "
+            f"{','.join(map(str, row['replica_slots'])):<12} "
+            f"{state:<8} {'yes' if row['stale'] else 'no'}"
+        )
+    return 0
+
+
 def cmd_trace(args) -> int:
     """Fetch a server's /debug/traces.json span dump and print it as an
     indented span tree (see docs/OBSERVABILITY.md for the span model)."""
@@ -1347,6 +1407,29 @@ def build_parser() -> argparse.ArgumentParser:
         "thread-per-connection fallback)",
     )
     gw.set_defaults(func=cmd_storagegateway)
+
+    sc = sub.add_parser(
+        "storagecluster",
+        help="operate the partitioned gateway tier (topology, resync)",
+    )
+    sc_sub = sc.add_subparsers(dest="cluster_command")
+    sc_status = sc_sub.add_parser(
+        "status", help="per-node topology, breaker and staleness table"
+    )
+    sc_status.add_argument(
+        "--source", default="", help="storage source name (default: EVENTDATA)"
+    )
+    sc_resync = sc_sub.add_parser(
+        "resync",
+        help="replay missed rows onto recovered stale nodes from peers",
+    )
+    sc_resync.add_argument("--source", default="")
+    sc_resync.add_argument(
+        "--full", action="store_true",
+        help="replay tables in full instead of above each node's "
+        "event-time high-water mark (recovers out-of-order event times)",
+    )
+    sc.set_defaults(func=cmd_storagecluster, cluster_command="status")
 
     tr = sub.add_parser(
         "trace",

@@ -209,6 +209,19 @@ def _row(snap: dict, prev: Optional[dict], elapsed_s: float) -> dict:
     hit = attributed_hit_rate(m)
     if hit is not None:
         row["hit_rate"] = round(hit * 100.0, 1)
+    # storage-cluster column (data/storage/cluster.py): per-node breaker
+    # gauges from the process embedding the routing client — "2/3"
+    # means one node's breaker is open; "+1s" appends the count of
+    # STALE nodes awaiting resync (docs/STORAGE.md)
+    node_up = [
+        v for k, v in m.items()
+        if _family_name(k) == "pio_cluster_node_up"
+    ]
+    if node_up:
+        stale = int(counter_sum(m, "pio_cluster_node_stale"))
+        row["nodes"] = f"{int(sum(node_up))}/{len(node_up)}" + (
+            f"+{stale}s" if stale else ""
+        )
     # fleet-supervisor column (tools/fleet.py): crashed workers the
     # supervisor restarted — present when the scraped process runs a
     # supervised `pio deploy --workers` fleet
@@ -237,6 +250,7 @@ _COLUMNS = (
     ("last_delta", "CONV", 9),
     ("resident_mb", "RES_MB", 7),
     ("mask_age_s", "MASKs", 6),
+    ("nodes", "NODES", 7),
     ("restarts", "RESTART", 8),
     ("stalled", "STALLED", 20),
 )

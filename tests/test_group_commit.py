@@ -687,3 +687,172 @@ class TestShardedScanParity:
         assert t3["pack_cache"] == "fold"
         assert t3["delta_events"] == 1
         assert "fresh" in r3.user_index
+
+
+class TestIngestBackpressure:
+    """Bounded admission (round 14 satellite): a saturated group-commit
+    queue REFUSES writes with the typed StorageSaturatedError instead
+    of parking handler threads, and the event server surfaces it as
+    503 + Retry-After (counted in pio_http_errors_total)."""
+
+    def _wedge(self, committer):
+        """Fill the committer's (shrunken) queue behind a unit whose
+        commit blocks on an injected gate."""
+        import threading
+
+        gate = threading.Event()
+        started = threading.Event()
+
+        def stall():
+            started.set()
+            gate.wait(30.0)
+
+        return gate, started, stall
+
+    def test_saturated_queue_raises_typed_error(self, tmp_path):
+        from predictionio_tpu.data.storage.base import (
+            StorageSaturatedError,
+        )
+        from predictionio_tpu.data.storage.sqlite import _GroupCommitter
+
+        old_q, old_w = (
+            _GroupCommitter.QUEUE_MAX_UNITS, _GroupCommitter.ADMIT_WAIT_S
+        )
+        _GroupCommitter.QUEUE_MAX_UNITS = 2
+        _GroupCommitter.ADMIT_WAIT_S = 0.05
+        try:
+            storage = sqlite_storage(tmp_path / "sat.db")
+            le = storage.get_l_events()
+            shard = le._c.main_store
+            gate, started, stall = self._wedge(shard.committer)
+            shard.commit_fault = stall
+            try:
+                import threading as th
+                import time
+
+                def bg(i):
+                    try:
+                        le.insert(rating(f"u{i}", "i0", 1.0), 1)
+                    except StorageSaturatedError:
+                        pass
+
+                # first unit wedges inside its flush (the gate); the
+                # next two park in the (shrunken) queue and fill it —
+                # all in the background, since every insert blocks on
+                # its unit until the commit resolves
+                fillers = [
+                    th.Thread(target=bg, args=(i,), daemon=True)
+                    for i in range(3)
+                ]
+                fillers[0].start()
+                assert started.wait(5.0)
+                fillers[1].start()
+                fillers[2].start()
+                t0 = time.monotonic()
+                while (
+                    shard.committer._q.qsize() < 2
+                    and time.monotonic() - t0 < 5.0
+                ):
+                    time.sleep(0.01)
+                assert shard.committer._q.qsize() == 2
+                # the queue is full behind a wedged flush: admission is
+                # REFUSED (typed) instead of parking this thread
+                with pytest.raises(StorageSaturatedError):
+                    le.insert(rating("u9", "i0", 1.0), 1)
+            finally:
+                shard.commit_fault = None
+                gate.set()
+        finally:
+            _GroupCommitter.QUEUE_MAX_UNITS = old_q
+            _GroupCommitter.ADMIT_WAIT_S = old_w
+
+    def test_event_server_answers_503_with_retry_after(self, tmp_path):
+        import json
+        import urllib.error
+        import urllib.request
+
+        from predictionio_tpu.api.event_server import EventAPI
+        from predictionio_tpu.api.event_server import (
+            EventServer,
+            EventServerConfig,
+        )
+        from predictionio_tpu.data.storage.base import (
+            AccessKey,
+            StorageSaturatedError,
+        )
+        from predictionio_tpu.utils import metrics as _metrics
+
+        storage = sqlite_storage(tmp_path / "bp.db", app_name="bp")
+        storage.get_meta_data_access_keys().insert(
+            AccessKey(key="bpkey", appid=1)
+        )
+        server = EventServer(
+            storage=storage,
+            config=EventServerConfig(ip="127.0.0.1", port=0, stats=False),
+        ).start()
+        try:
+            le = server.api._events
+
+            def saturated(event, app_id, channel_id=None):
+                raise StorageSaturatedError("queue full", retry_after_s=2)
+
+            le.insert = saturated  # instance-level injection
+            body = json.dumps(
+                {
+                    "event": "rate", "entityType": "user",
+                    "entityId": "u1", "targetEntityType": "item",
+                    "targetEntityId": "i1",
+                    "properties": {"rating": 3.0},
+                }
+            ).encode()
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{server.port}/events.json"
+                "?accessKey=bpkey",
+                data=body,
+                headers={"Content-Type": "application/json"},
+            )
+            before = _count_503(_metrics)
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req, timeout=10)
+            assert ei.value.code == 503
+            assert ei.value.headers.get("Retry-After") == "2"
+            assert _count_503(_metrics) == before + 1
+
+            # the batch route refuses whole-batch with the same contract
+            batch = json.dumps(
+                [
+                    {
+                        "event": "rate", "entityType": "user",
+                        "entityId": "u1", "targetEntityType": "item",
+                        "targetEntityId": "i1",
+                        "properties": {"rating": 3.0},
+                    }
+                ]
+            ).encode()
+            le.insert_batch = lambda evs, a, c=None: (_ for _ in ()).throw(
+                StorageSaturatedError("queue full", retry_after_s=1)
+            )
+            req2 = urllib.request.Request(
+                f"http://127.0.0.1:{server.port}/batch/events.json"
+                "?accessKey=bpkey",
+                data=batch,
+                headers={"Content-Type": "application/json"},
+            )
+            with pytest.raises(urllib.error.HTTPError) as ei2:
+                urllib.request.urlopen(req2, timeout=10)
+            assert ei2.value.code == 503
+            assert ei2.value.headers.get("Retry-After") == "1"
+        finally:
+            server.shutdown()
+
+
+def _count_503(_metrics) -> float:
+    reg = _metrics.get_registry()
+    c = reg.counter(
+        "pio_http_errors_total",
+        "HTTP error responses recorded at the transport layer",
+        labels=("server", "route", "status"),
+    )
+    return c.labels(
+        server="Event Server", route="/events.json", status="503"
+    ).value

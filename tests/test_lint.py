@@ -699,3 +699,153 @@ def test_mutable_module_state_allowlist_is_not_stale():
         f"mutable-module-state allowlist entries no longer in the "
         f"tree: {sorted(stale)}"
     )
+
+
+# --- storage-tier robustness lints (round 14's cluster tentpole) ---
+#
+# The bug classes: (1) a bare `except Exception: pass` in storage code
+# silently eats exactly the transport/backend failures the cluster
+# tier's circuit breakers, staleness marks, and PartialBatchError
+# attribution exist to SURFACE — a swallowed write error is an acked
+# event that never happened; (2) a socket operation with no deadline
+# (`timeout=None`) parks a scan or write behind a wedged gateway node
+# forever instead of failing fast into the retry/breaker path
+# (data/storage/http.py propagates PIO_STORAGE_CLIENT_TIMEOUT_S as the
+# socket timeout for precisely this reason). Scope: data/storage/.
+# Both allowlists were seeded from a review of every existing site —
+# the review found only narrowly-typed handlers (OSError on os.remove,
+# sqlite3.Error on rollback) and timeout-carrying connections, so both
+# seed EMPTY and are shrink-only.
+
+STORAGE_DIR = PACKAGE / "data" / "storage"
+
+# (relative path, stripped `except` line) pairs reviewed as safe.
+STORAGE_EXCEPT_PASS_ALLOWED: set = set()
+
+# (relative path, stripped source line of the unbounded call).
+STORAGE_UNBOUNDED_SOCKET_ALLOWED: set = set()
+
+# connection-constructing calls that accept a `timeout` kwarg; calling
+# them without one (or with timeout=None) under data/storage/ is the
+# unbounded-socket bug class
+_SOCKET_CALL_NAMES = {
+    "HTTPConnection",
+    "HTTPSConnection",
+    "create_connection",
+    "urlopen",
+}
+
+
+def _storage_rel(path) -> str:
+    return "data/storage/" + path.relative_to(STORAGE_DIR).as_posix()
+
+
+def _storage_broad_except_pass_occurrences():
+    import ast
+
+    found = set()
+    for path in sorted(STORAGE_DIR.rglob("*.py")):
+        source = path.read_text(encoding="utf-8")
+        lines = source.splitlines()
+        for node in ast.walk(ast.parse(source, filename=str(path))):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if not (
+                len(node.body) == 1 and isinstance(node.body[0], ast.Pass)
+            ):
+                continue
+            # bare `except:` or the broad Exception/BaseException —
+            # narrowly-typed teardown handlers (OSError on os.remove)
+            # are allowed to pass
+            broad = node.type is None or (
+                isinstance(node.type, ast.Name)
+                and node.type.id in ("Exception", "BaseException")
+            )
+            if broad:
+                found.add(
+                    (_storage_rel(path), lines[node.lineno - 1].strip())
+                )
+    return found
+
+
+def _storage_unbounded_socket_occurrences():
+    import ast
+
+    found = set()
+    for path in sorted(STORAGE_DIR.rglob("*.py")):
+        source = path.read_text(encoding="utf-8")
+        lines = source.splitlines()
+        for node in ast.walk(ast.parse(source, filename=str(path))):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            name = (
+                fn.id if isinstance(fn, ast.Name)
+                else fn.attr if isinstance(fn, ast.Attribute)
+                else None
+            )
+            bad = False
+            if name in _SOCKET_CALL_NAMES:
+                kw = {k.arg: k.value for k in node.keywords}
+                t = kw.get("timeout")
+                bad = (
+                    ("timeout" not in kw and not any(
+                        k.arg is None for k in node.keywords  # **kwargs
+                    ))
+                    or isinstance(t, ast.Constant) and t.value is None
+                )
+            elif name == "settimeout":
+                args = list(node.args)
+                bad = bool(args) and (
+                    isinstance(args[0], ast.Constant)
+                    and args[0].value is None
+                )
+            if bad:
+                found.add(
+                    (_storage_rel(path), lines[node.lineno - 1].strip())
+                )
+    return found
+
+
+def test_no_broad_except_pass_in_storage_tier():
+    found = _storage_broad_except_pass_occurrences()
+    new = found - STORAGE_EXCEPT_PASS_ALLOWED
+    assert not new, (
+        "bare `except Exception: pass` under data/storage/ — a "
+        "swallowed storage failure is an acked write that never "
+        "happened (the cluster tier's breakers and PartialBatchError "
+        "attribution depend on failures SURFACING); narrow the type, "
+        "re-raise, or log, or justify an allowlist entry: "
+        f"{sorted(new)}"
+    )
+
+
+def test_storage_except_pass_allowlist_is_not_stale():
+    found = _storage_broad_except_pass_occurrences()
+    stale = STORAGE_EXCEPT_PASS_ALLOWED - found
+    assert not stale, (
+        f"storage except-pass allowlist entries no longer in the "
+        f"tree: {sorted(stale)}"
+    )
+
+
+def test_no_unbounded_socket_ops_in_storage_tier():
+    found = _storage_unbounded_socket_occurrences()
+    new = found - STORAGE_UNBOUNDED_SOCKET_ALLOWED
+    assert not new, (
+        "socket operation without a timeout under data/storage/ — an "
+        "unbounded connect/read parks the caller behind a wedged "
+        "gateway node forever instead of failing fast into the "
+        "retry/circuit-breaker path; pass timeout= (see "
+        "PIO_STORAGE_CLIENT_TIMEOUT_S in data/storage/http.py) or "
+        f"justify an allowlist entry: {sorted(new)}"
+    )
+
+
+def test_storage_unbounded_socket_allowlist_is_not_stale():
+    found = _storage_unbounded_socket_occurrences()
+    stale = STORAGE_UNBOUNDED_SOCKET_ALLOWED - found
+    assert not stale, (
+        f"storage unbounded-socket allowlist entries no longer in "
+        f"the tree: {sorted(stale)}"
+    )

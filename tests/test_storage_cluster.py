@@ -1,0 +1,600 @@
+"""Partitioned, replicated gateway tier (data/storage/cluster.py):
+entity-hash routing, R-way replicated writes with per-slot quorum acks,
+failover scatter-gather scans (merged wire byte-identical to a
+single-node store), per-node delta cursors, node-kill fault injection,
+and the stale-node resync protocol.
+"""
+
+import datetime as dt
+import zlib
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.api.storage_gateway import StorageGatewayServer
+from predictionio_tpu.data.event import DataMap, Event
+from predictionio_tpu.data.storage import Storage, memory_storage
+from predictionio_tpu.data.storage.base import (
+    App,
+    PartialBatchError,
+    StorageError,
+)
+from predictionio_tpu.data.storage.memory import MemLEvents
+
+UTC = dt.timezone.utc
+
+
+def cluster_config(ports, name="C", replicas=2, extra=None):
+    cfg = {
+        f"PIO_STORAGE_SOURCES_{name}_TYPE": "cluster",
+        f"PIO_STORAGE_SOURCES_{name}_NODES": ",".join(
+            f"http://127.0.0.1:{p}" for p in ports
+        ),
+        f"PIO_STORAGE_SOURCES_{name}_REPLICAS": str(replicas),
+        # trip fast, probe fast: tests kill and restart nodes
+        f"PIO_STORAGE_SOURCES_{name}_BREAKER_FAILURES": "2",
+        f"PIO_STORAGE_SOURCES_{name}_BREAKER_COOLDOWN_S": "0.05",
+        f"PIO_STORAGE_SOURCES_{name}_TIMEOUT_S": "5",
+        "PIO_STORAGE_REPOSITORIES_METADATA_NAME": "meta",
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": name,
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_NAME": "event",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": name,
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_NAME": "model",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": name,
+    }
+    for k, v in (extra or {}).items():
+        cfg[f"PIO_STORAGE_SOURCES_{name}_{k}"] = v
+    return cfg
+
+
+class Fleet:
+    """N in-process gateways over independent memory universes, plus
+    the cluster Storage routed at them. Nodes can be killed (shutdown,
+    port retained) and restarted on the same port with the SAME backing
+    store — the node-restart shape of the fault sweep."""
+
+    def __init__(self, n=3, replicas=2, extra=None):
+        self.universes = [memory_storage() for _ in range(n)]
+        self.servers = [
+            StorageGatewayServer(u, ip="127.0.0.1", port=0).start()
+            for u in self.universes
+        ]
+        self.ports = [s.port for s in self.servers]
+        self.storage = Storage(
+            cluster_config(self.ports, replicas=replicas, extra=extra)
+        )
+        self.client = self.storage._client("C")
+
+    def node_events(self, i, app_id=1):
+        return list(self.universes[i].get_l_events().find(app_id))
+
+    def kill(self, i):
+        self.servers[i].shutdown()
+
+    def restart(self, i):
+        self.servers[i] = StorageGatewayServer(
+            self.universes[i], ip="127.0.0.1", port=self.ports[i]
+        ).start()
+
+    def close(self):
+        for s in self.servers:
+            try:
+                s.shutdown()
+            except Exception:
+                pass
+        self.client.close()
+
+
+@pytest.fixture()
+def fleet():
+    f = Fleet(n=3, replicas=2)
+    yield f
+    f.close()
+
+
+def make_events(n, users=7, items=11, t0=None, tag="i"):
+    t0 = t0 or dt.datetime(2026, 1, 1, tzinfo=UTC)
+    return [
+        Event(
+            event="rate",
+            entity_type="user",
+            entity_id=f"u{i % users}",
+            target_entity_type="item",
+            target_entity_id=f"{tag}{i % items}",
+            properties=DataMap({"rating": float(i % 5 + 1)}),
+            event_time=t0 + dt.timedelta(milliseconds=i),
+        )
+        for i in range(n)
+    ]
+
+
+def slot_of(entity_id, n):
+    return zlib.crc32(str(entity_id).encode()) % n
+
+
+def entity_for_slot(slot, n, prefix="e"):
+    """An entity id hashing to ``slot`` under the cluster's crc32 rule."""
+    j = 0
+    while True:
+        eid = f"{prefix}{j}"
+        if slot_of(eid, n) == slot:
+            return eid
+        j += 1
+
+
+class TestRoutingAndReplication:
+    def test_events_land_on_exactly_their_replica_set(self, fleet):
+        le = fleet.storage.get_l_events()
+        le.init(1)
+        evs = make_events(120)
+        ids = le.insert_batch(evs, 1)
+        n = fleet.client.n_nodes
+        per_node_ids = [
+            {e.event_id for e in fleet.node_events(i)} for i in range(n)
+        ]
+        for i in range(n):
+            owned = {
+                s for s in range(n)
+                if i in fleet.client.replicas_of_slot(s)
+            }
+            # every row on node i belongs to a slot it replicates...
+            assert {
+                slot_of(e.entity_id, n) for e in fleet.node_events(i)
+            } <= owned
+        # ...and every event appears on ALL R replicas of its slot
+        for e, eid in zip(evs, ids):
+            holders = [i for i in range(n) if eid in per_node_ids[i]]
+            assert sorted(holders) == sorted(
+                fleet.client.replicas_of_slot(slot_of(e.entity_id, n))
+            )
+
+    def test_single_entity_reads_route_and_merge(self, fleet):
+        le = fleet.storage.get_l_events()
+        le.init(1)
+        evs = make_events(60)
+        le.insert_batch(evs, 1)
+        got = list(le.find(1, entity_id="u3"))
+        want = [e for e in evs if e.entity_id == "u3"]
+        assert len(got) == len(want)
+        # scatter find returns everything exactly once (the slot filter
+        # is what keeps R-way replicated rows from double-counting)
+        assert len(list(le.find(1))) == len(evs)
+        agg_le = le.aggregate_properties_of_entity(
+            1, "user", "u3"
+        )  # routed single-entity aggregate: no events -> None
+        assert agg_le is None
+
+    def test_metadata_broadcasts_to_every_node(self, fleet):
+        apps = fleet.storage.get_meta_data_apps()
+        app_id = apps.insert(App(id=0, name="routed"))
+        assert app_id
+        for u in fleet.universes:
+            assert u.get_meta_data_apps().get(app_id).name == "routed"
+        keys = fleet.storage.get_meta_data_access_keys()
+        key = keys.insert(
+            __import__(
+                "predictionio_tpu.data.storage.base", fromlist=["AccessKey"]
+            ).AccessKey(key="", appid=app_id)
+        )
+        assert key and len(key) == 64
+        for u in fleet.universes:
+            assert u.get_meta_data_access_keys().get(key) is not None
+
+
+class TestScatterGatherWire:
+    def _pack(self, stream):
+        from predictionio_tpu.ops import als as als_mod
+        from predictionio_tpu.ops import streaming as strm
+
+        timings = {}
+        out = strm._scan_and_pack(
+            stream, als_mod.ALSConfig(rank=4, iterations=1), timings, 2
+        )
+        assert out is not None
+        return out[0]
+
+    def test_merged_wire_byte_identical_to_single_node_store(self, fleet):
+        le = fleet.storage.get_l_events()
+        le.init(1)
+        evs = make_events(200)
+        for s in range(0, len(evs), 50):
+            le.insert_batch(evs[s : s + 50], 1)
+        ref = MemLEvents()
+        ref.init(1)
+        ref.insert_batch(evs, 1)
+        w_cluster = self._pack(le.stream_columns_native(1))
+        w_single = self._pack(ref.stream_columns_native(1))
+        assert np.array_equal(w_cluster.iw, w_single.iw)
+        assert np.array_equal(w_cluster.vw, w_single.vw)
+        assert np.array_equal(w_cluster.counts_u, w_single.counts_u)
+        assert np.array_equal(w_cluster.counts_i, w_single.counts_i)
+
+    def test_wire_stays_byte_identical_with_a_node_killed(self, fleet):
+        le = fleet.storage.get_l_events()
+        le.init(1)
+        evs = make_events(200)
+        le.insert_batch(evs, 1)
+        ref = MemLEvents()
+        ref.init(1)
+        ref.insert_batch(evs, 1)
+        down = []
+        fleet.client.faults["node_down_scan"] = lambda: down.append(1)
+        fleet.kill(1)
+        w_cluster = self._pack(le.stream_columns_native(1))
+        w_single = self._pack(ref.stream_columns_native(1))
+        assert np.array_equal(w_cluster.iw, w_single.iw)
+        assert np.array_equal(w_cluster.vw, w_single.vw)
+        assert down, "the node_down_scan fault hook must fire on re-plan"
+
+    def test_scan_complete_while_node_down_and_cursor_disabled(self, fleet):
+        le = fleet.storage.get_l_events()
+        le.init(1)
+        evs = make_events(90)
+        le.insert_batch(evs, 1)
+        fleet.kill(2)
+        stream = le.stream_columns_native(1)
+        total = sum(len(v) for _, _, v in stream)
+        assert total == len(evs)
+        # a re-planned scan must not chain a delta cursor: its per-node
+        # coverage no longer matches any consistent cursor set
+        assert stream.cursor is None
+
+
+class TestDeltaCursors:
+    def test_delta_folds_while_plan_is_stable(self, fleet):
+        le = fleet.storage.get_l_events()
+        le.init(1)
+        le.insert_batch(make_events(100), 1)
+        s1 = le.stream_columns_native(1)
+        assert sum(len(v) for _, _, v in s1) == 100
+        cur1 = s1.cursor
+        assert cur1 is not None and cur1[0] == "cluster-delta"
+        t0 = dt.datetime(2026, 2, 1, tzinfo=UTC)
+        le.insert_batch(make_events(30, t0=t0, tag="j"), 1)
+        d = le.stream_columns_delta(1, cursor=cur1)
+        assert d is not None
+        assert sum(len(v) for _, _, v in d) == 30
+        cur2 = d.cursor
+        assert cur2 is not None
+        # a second, empty delta chains too
+        d2 = le.stream_columns_delta(1, cursor=cur2)
+        assert d2 is not None
+        assert sum(len(v) for _, _, v in d2) == 0
+
+    def test_replan_falls_back_to_full_rescan(self, fleet):
+        le = fleet.storage.get_l_events()
+        le.init(1)
+        le.insert_batch(make_events(100), 1)
+        s = le.stream_columns_native(1)
+        list(s)
+        cur = s.cursor
+        assert cur is not None
+        fleet.kill(0)
+        # the plan changed (slot 0 now served by a replica): the delta
+        # declines so a full rescan owns correctness
+        d = le.stream_columns_delta(1, cursor=cur)
+        if d is not None:
+            # breaker may not have tripped yet when the plan was made;
+            # the stream then declines DURING iteration via its cursor
+            list(d)
+            assert d.cursor is None
+
+    def test_topology_change_invalidates_cursor(self, fleet):
+        le = fleet.storage.get_l_events()
+        le.init(1)
+        le.insert_batch(make_events(40), 1)
+        s = le.stream_columns_native(1)
+        list(s)
+        cur = s.cursor
+        forged = ("cluster-delta", 99, cur[2], cur[3], cur[4])
+        assert le.stream_columns_delta(1, cursor=forged) is None
+
+    def test_fingerprint_tracks_all_nodes(self, fleet):
+        le = fleet.storage.get_l_events()
+        le.init(1)
+        le.insert_batch(make_events(50), 1)
+        fp1 = le.store_fingerprint(1)
+        assert fp1 is not None and fp1[0] == "cluster"
+        le.insert(make_events(1, tag="zz")[0], 1)
+        assert le.store_fingerprint(1) != fp1
+
+
+class TestPartialBatchAttribution:
+    """Satellite: per-slot failure attribution survives routing +
+    replication, and retrying only the failed slots is idempotent."""
+
+    def _fail_node_inserts(self, fleet, node_idx):
+        """Make one node's backend refuse insert_batch entirely."""
+        backend = fleet.universes[node_idx].get_l_events()
+
+        def boom(events, app_id, channel_id=None):
+            raise StorageError("injected backend failure")
+
+        backend.insert_batch = boom
+        return backend
+
+    def test_slot_missing_quorum_is_attributed_not_lost(self):
+        # R=1: one node's failure maps exactly to its primary slot
+        f = Fleet(n=3, replicas=1)
+        try:
+            le = f.storage.get_l_events()
+            le.init(1)
+            n = f.client.n_nodes
+            self._fail_node_inserts(f, 1)
+            evs = [
+                Event(
+                    event="rate", entity_type="user",
+                    entity_id=entity_for_slot(s, n, prefix=f"u{k}-"),
+                    target_entity_type="item", target_entity_id="i0",
+                    properties=DataMap({"rating": 1.0}),
+                )
+                for k in range(4)
+                for s in range(n)
+            ]
+            with pytest.raises(PartialBatchError) as ei:
+                le.insert_batch(evs, 1)
+            err = ei.value
+            assert len(err.event_ids) == len(evs)
+            # exactly the slot-1 events failed, in input order
+            failed_slots = {
+                slot_of(e.entity_id, n)
+                for e, eid in zip(evs, err.event_ids)
+                if eid in err.failed_ids
+            }
+            assert failed_slots == {1}
+            ok_ids = [
+                eid for eid in err.event_ids if eid not in err.failed_ids
+            ]
+            assert len(ok_ids) == len(evs) - len(err.failed_ids)
+            # committed slots are durable despite the partial failure
+            assert {
+                e.event_id for e in f.node_events(0)
+            } | {e.event_id for e in f.node_events(2)} == set(ok_ids)
+        finally:
+            f.close()
+
+    def test_retrying_failed_slots_is_idempotent_across_replicas(self):
+        f = Fleet(n=3, replicas=2)
+        try:
+            le = f.storage.get_l_events()
+            le.init(1)
+            n = f.client.n_nodes
+            evs = make_events(60)
+            # first attempt: one REPLICA fails per-slice; quorum (1)
+            # still acks everything, the failing node is marked stale
+            self._fail_node_inserts(f, 2)
+            ids1 = le.insert_batch(evs, 1)
+            assert f.client.nodes[2].stale
+            # the retry contract: a retry carries the ids assigned on
+            # the first attempt (PartialBatchError.event_ids), so
+            # re-posting is an explicit-id REPLACE everywhere —
+            # including the replicas that already committed
+            del f.universes[2].get_l_events().insert_batch  # restore
+            retry = [
+                e.with_event_id(eid) for e, eid in zip(evs, ids1)
+            ]
+            ids2 = le.insert_batch(retry, 1)
+            assert ids1 == ids2
+            total = len(list(le.find(1)))
+            assert total == len(evs)
+            for i in range(3):
+                rows = f.node_events(i)
+                assert len({e.event_id for e in rows}) == len(rows)
+        finally:
+            f.close()
+
+    def test_all_replicas_down_for_a_slot_fails_loudly(self):
+        f = Fleet(n=3, replicas=2)
+        try:
+            le = f.storage.get_l_events()
+            le.init(1)
+            n = f.client.n_nodes
+            # kill BOTH replicas of slot 0 (nodes 0 and 1)
+            f.kill(0)
+            f.kill(1)
+            evs = [
+                Event(
+                    event="rate", entity_type="user",
+                    entity_id=entity_for_slot(0, n),
+                    target_entity_type="item", target_entity_id="i0",
+                    properties=DataMap({"rating": 1.0}),
+                ),
+                Event(
+                    event="rate", entity_type="user",
+                    entity_id=entity_for_slot(2, n),
+                    target_entity_type="item", target_entity_id="i0",
+                    properties=DataMap({"rating": 1.0}),
+                ),
+            ]
+            with pytest.raises(PartialBatchError) as ei:
+                le.insert_batch(evs, 1)
+            failed = ei.value.failed_ids
+            assert ei.value.event_ids[0] in failed
+            assert ei.value.event_ids[1] not in failed
+        finally:
+            f.close()
+
+
+class TestStaleMarking:
+    def test_total_slot_failure_stales_nobody(self):
+        """A slot that misses quorum outright left no replica behind —
+        marking its nodes stale would eventually stale the WHOLE fleet
+        (and leave resync with no healthy peer), so only a replica that
+        missed data that actually ACKED elsewhere goes stale."""
+        f = Fleet(n=3, replicas=2)
+        try:
+            le = f.storage.get_l_events()
+            le.init(1)
+            n = f.client.n_nodes
+            f.kill(0)
+            f.kill(1)
+            ev = Event(
+                event="rate", entity_type="user",
+                entity_id=entity_for_slot(0, n),
+                target_entity_type="item", target_entity_id="i0",
+                properties=DataMap({"rating": 1.0}),
+            )
+            with pytest.raises(PartialBatchError):
+                le.insert_batch([ev], 1)
+            # nothing acked for slot 0: neither dead replica is stale
+            # (no durable data was missed), and node 2 is untouched
+            assert not any(nd.stale for nd in f.client.nodes)
+        finally:
+            f.close()
+
+    def test_missed_delete_is_reconciled_by_resync(self, fleet):
+        """A tombstone a down replica missed must not resurrect after
+        it rejoins: resync reconciles deletions over the replay window
+        (here the deleted row IS the newest, so the incremental window
+        covers it)."""
+        le = fleet.storage.get_l_events()
+        le.init(1)
+        ids = le.insert_batch(make_events(30), 1)
+        fleet.kill(1)
+        # delete the newest event held by node 1's slots
+        n = fleet.client.n_nodes
+        victim = None
+        for e, eid in list(zip(make_events(30), ids))[::-1]:
+            if 1 in fleet.client.replicas_of_slot(slot_of(e.entity_id, n)):
+                victim = eid
+                break
+        assert victim is not None
+        assert le.delete(victim, 1)
+        assert fleet.client.nodes[1].stale
+        fleet.restart(1)
+        fleet.client.resync(full=True)
+        assert not fleet.client.nodes[1].stale
+        # the rejoined node no longer holds the tombstoned row
+        assert all(
+            e.event_id != victim for e in fleet.node_events(1)
+        )
+        assert all(e.event_id != victim for e in le.find(1))
+
+
+class TestFaultHooks:
+    def test_named_stages_fire(self, fleet):
+        le = fleet.storage.get_l_events()
+        le.init(1)
+        fired = []
+        for stage in ("route_write", "quorum_ack"):
+            fleet.client.faults[stage] = (
+                lambda s=stage: fired.append(s)
+            )
+        le.insert_batch(make_events(10), 1)
+        assert fired == ["route_write", "quorum_ack"]
+
+    def test_route_write_fault_aborts_before_dispatch(self, fleet):
+        le = fleet.storage.get_l_events()
+        le.init(1)
+
+        def boom():
+            raise RuntimeError("injected route_write")
+
+        fleet.client.faults["route_write"] = boom
+        with pytest.raises(RuntimeError, match="route_write"):
+            le.insert_batch(make_events(5), 1)
+        fleet.client.faults["route_write"] = None
+        assert list(le.find(1)) == []  # nothing half-dispatched
+
+
+class TestKillResyncRecover:
+    def test_zero_acked_loss_and_resync_after_restart(self, fleet):
+        le = fleet.storage.get_l_events()
+        le.init(1)
+        le.insert_batch(make_events(60), 1)
+        # --- node 1 dies; writes keep acking at quorum ---
+        fleet.kill(1)
+        t0 = dt.datetime(2026, 3, 1, tzinfo=UTC)
+        during = make_events(45, t0=t0, tag="k")
+        acked = le.insert_batch(during, 1)
+        assert len(acked) == 45
+        assert fleet.client.nodes[1].stale
+        # every acked event is readable RIGHT NOW (zero acked loss)
+        visible = {e.event_id for e in le.find(1)}
+        assert set(acked) <= visible and len(visible) == 105
+        # --- node restarts with its (stale) store; resync replays ---
+        fleet.restart(1)
+        report = fleet.client.resync()
+        assert "resynced" in report["nodes"][fleet.client.nodes[1].label]
+        assert not fleet.client.nodes[1].stale
+        # the restarted node now holds every event of its slots
+        n = fleet.client.n_nodes
+        rows = fleet.node_events(1)
+        want = {
+            e.event_id
+            for e in list(le.find(1))
+            if 1 in fleet.client.replicas_of_slot(slot_of(e.entity_id, n))
+        }
+        assert {e.event_id for e in rows} == want
+        # readyz is green again and the node serves scans
+        assert fleet.client.nodes[1].available()
+        total = sum(
+            len(v) for _, _, v in le.stream_columns_native(1)
+        )
+        assert total == 105
+
+    def test_resync_fault_hook_fires(self, fleet):
+        le = fleet.storage.get_l_events()
+        le.init(1)
+        le.insert_batch(make_events(20), 1)
+        fleet.kill(2)
+        le.insert_batch(make_events(10, tag="m"), 1)
+        fleet.restart(2)
+        fired = []
+        fleet.client.faults["resync"] = lambda: fired.append(1)
+        fleet.client.resync()
+        assert fired
+
+class TestBreaker:
+    def test_breaker_opens_on_failures_and_closes_on_readyz(self, fleet):
+        import time
+
+        le = fleet.storage.get_l_events()
+        le.init(1)
+        le.insert_batch(make_events(30), 1)
+        fleet.kill(0)
+        node = fleet.client.nodes[0]
+        # scans fail over and the breaker trips after enough failures
+        for _ in range(3):
+            list(le.find(1))
+        assert node.breaker_open()
+        assert not node.available()
+        fleet.restart(0)
+        time.sleep(0.06)  # past the cooldown: half-open probe allowed
+        assert node.available()  # /readyz 200 closed the breaker
+        assert not node.breaker_open()
+
+
+class TestEndToEndTraining:
+    def test_train_and_delta_fold_through_cluster(self, fleet):
+        """pio train --continuous shape: cold streaming train, then a
+        delta round folds through the pack cache — all storage I/O
+        crossing the routed, replicated tier."""
+        from predictionio_tpu.data.store import PEventStore
+        from predictionio_tpu.ops import als as als_mod
+        from predictionio_tpu.ops import streaming as strm
+
+        strm.pack_cache_clear()
+        apps = fleet.storage.get_meta_data_apps()
+        app_id = apps.insert(App(id=0, name="clusterapp"))
+        le = fleet.storage.get_l_events()
+        le.init(app_id)
+        le.insert_batch(make_events(150, users=12, items=9), app_id)
+        store = PEventStore(storage=fleet.storage)
+        config = als_mod.ALSConfig(rank=4, iterations=2, seed=3)
+        r1 = strm.train_als_streaming(
+            store.stream_columns("clusterapp"), config
+        )
+        assert r1 is not None
+        assert r1.timings["pack_cache"] == "miss"
+        t0 = dt.datetime(2026, 4, 1, tzinfo=UTC)
+        le.insert_batch(
+            make_events(30, users=12, items=9, t0=t0), app_id
+        )
+        r2 = strm.train_als_streaming(
+            store.stream_columns("clusterapp"), config
+        )
+        assert r2 is not None
+        assert r2.timings["pack_cache"] == "fold"
+        assert r2.timings["delta_events"] == 30
+        strm.pack_cache_clear()
