@@ -612,22 +612,36 @@ class EventAPI:
                 )
                 failed: frozenset = frozenset()
             except StorageSaturatedError as e:
-                # NOTHING was admitted: the whole batch is safe to
-                # retry after backoff (unlike PartialBatchError below)
+                # NOTHING was admitted (the storage layer only raises
+                # this when no slice was enqueued): the whole batch is
+                # safe to retry after backoff (unlike PartialBatchError
+                # below)
                 return _saturated(e)
             except PartialBatchError as e:
                 # some shard slices committed, others did not — report
                 # per-event outcomes so the client retries ONLY the
                 # failed slots (a blanket 500 would make it re-post the
-                # committed slice under fresh ids)
+                # committed slice under fresh ids). retry_after_s marks
+                # the failures as capacity refusals: those slots answer
+                # 503 (retry after backoff), not 500
                 event_ids, failed = e.event_ids, e.failed_ids
-            committed = []
-            for (slot, event), event_id in zip(pending, event_ids):
-                if event_id in failed:
-                    results[slot] = {
+                if e.retry_after_s is not None:
+                    failed_result = {
+                        "status": 503,
+                        "message": (
+                            "storage saturated; retry this event after "
+                            f"~{max(1, int(round(e.retry_after_s)))}s"
+                        ),
+                    }
+                else:
+                    failed_result = {
                         "status": 500,
                         "message": "event failed to commit; retry this event",
                     }
+            committed = []
+            for (slot, event), event_id in zip(pending, event_ids):
+                if event_id in failed:
+                    results[slot] = dict(failed_result)
                     continue
                 results[slot] = {"status": 201, "eventId": event_id}
                 self._m_ingested.labels(route="batch").inc()

@@ -223,6 +223,7 @@ class StorageGatewayCore:
                 "type": "PartialBatchError",
                 "event_ids": list(e.event_ids),
                 "failed_ids": sorted(e.failed_ids),
+                "retry_after_s": e.retry_after_s,
             }
         except StorageSaturatedError as e:
             # deliberate backpressure, not a backend fault: the typed

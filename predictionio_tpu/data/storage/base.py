@@ -87,12 +87,25 @@ class PartialBatchError(StorageError):
     others failed. ``event_ids`` is the full assigned-id list (input
     order); ``failed_ids`` the subset whose slice did NOT commit — so a
     caller (the batch REST route) can report per-event outcomes instead
-    of disavowing the whole batch after part of it is durable."""
+    of disavowing the whole batch after part of it is durable.
+    ``retry_after_s``, when set, marks the failures as capacity refusals
+    (the :class:`StorageSaturatedError` case scoped to a slice): the
+    failed slots are retryable after backoff, and frontends answer them
+    503 instead of 500."""
 
-    def __init__(self, message: str, event_ids, failed_ids):
+    def __init__(
+        self,
+        message: str,
+        event_ids,
+        failed_ids,
+        retry_after_s: Optional[float] = None,
+    ):
         super().__init__(message)
         self.event_ids = list(event_ids)
         self.failed_ids = frozenset(failed_ids)
+        self.retry_after_s = (
+            None if retry_after_s is None else float(retry_after_s)
+        )
 
 
 class LEvents(abc.ABC):

@@ -499,26 +499,42 @@ class StorageClient(base.DAOCacheMixin):
 
     def replan_slots(
         self, slots, exclude_idx: int, failed: set
-    ) -> Dict[int, set]:
+    ) -> "tuple[Dict[int, set], bool]":
         """Move ``slots`` off a failed node onto their next available
-        replica, excluding every node that already failed this scatter
-        (the ping-pong guard). Raises when a slot has no replica left —
-        the shared re-plan step of every scatter path."""
+        NON-STALE replica, excluding every node that already failed this
+        scatter (the ping-pong guard); a stale replica (missing acked
+        rows) is a last resort only. Returns ``(moved, used_stale)`` —
+        ``used_stale`` tells the caller some slot is now served by a
+        replica that may be incomplete, so the scan must not label a
+        cache artifact or chain a delta. Raises when a slot has no
+        replica left — the shared re-plan step of every scatter path."""
         moved: Dict[int, set] = {}
+        used_stale = False
         for slot in slots:
             nxt = None
+            stale_fallback = None
             for idx in self.replicas_of_slot(slot):
                 if idx == exclude_idx or idx in failed:
                     continue
-                if self.nodes[idx].available():
-                    nxt = idx
-                    break
+                if not self.nodes[idx].available():
+                    continue
+                if self.nodes[idx].stale:
+                    if stale_fallback is None:
+                        stale_fallback = idx
+                    continue
+                nxt = idx
+                break
+            if nxt is None and stale_fallback is not None:
+                nxt = stale_fallback
+                used_stale = True
             if nxt is None:
                 raise StorageError(
                     f"cluster slot {slot} lost its last replica mid-scan"
                 )
             moved.setdefault(nxt, set()).add(slot)
-        return moved
+        if used_stale:
+            self._m_degraded.inc()
+        return moved, used_stale
 
     def _peer_for(self, slot: int, exclude: int) -> Optional[_Node]:
         for idx in self.replicas_of_slot(slot):
@@ -651,6 +667,10 @@ class ClusterLEvents(base.LEvents):
         # failure could stale-out every node at once and leave resync
         # with no healthy peer to replay from)
         outcomes: Dict[int, tuple] = {}
+        # largest backoff hint any replica attached to a capacity
+        # refusal — propagated outward so clients honor the actual
+        # saturated store's window, not a made-up one
+        retry_hint: Optional[float] = None
         for slot, slice_events in by_slot.items():
             slice_ids = [e.event_id for e in slice_events]
             results = []
@@ -660,6 +680,7 @@ class ClusterLEvents(base.LEvents):
                     # known-down replica: degraded write, hard miss
                     results.append((node, None, False))
                     continue
+                saturated = False
                 try:
                     self._le(node).insert_batch(
                         slice_events, app_id, channel_id
@@ -672,10 +693,21 @@ class ClusterLEvents(base.LEvents):
                         eid for eid in slice_ids
                         if eid not in pe.failed_ids
                     )
-                except StorageSaturatedError:
+                    # a capacity-attributed partial slice is
+                    # saturation, not node death: keep the backoff
+                    # contract intact through the routing layer
+                    if pe.retry_after_s is not None:
+                        saturated = True
+                        retry_hint = max(
+                            retry_hint or 0.0, pe.retry_after_s
+                        )
+                except StorageSaturatedError as se:
                     # alive but at capacity: breaker stays shut, peers
                     # may still ack
                     node.record_success()
+                    retry_hint = max(
+                        retry_hint or 0.0, se.retry_after_s
+                    )
                     results.append((node, None, True))
                     continue
                 except (StorageError, OSError) as e:
@@ -688,7 +720,7 @@ class ClusterLEvents(base.LEvents):
                     continue
                 for eid in committed:
                     acks[eid] += 1
-                results.append((node, committed, False))
+                results.append((node, committed, saturated))
             outcomes[slot] = (slice_ids, results)
         self._c.fire("quorum_ack")
         failed = frozenset(
@@ -719,16 +751,31 @@ class ClusterLEvents(base.LEvents):
         if under:
             self._c._m_writes.labels(outcome="under_replicated").inc(under)
         if failed:
-            if n_acked == 0 and not any_hard_miss:
+            # whole-batch saturation may only be claimed when NO
+            # replica committed anything: a below-quorum commit is
+            # still durable somewhere, and a caller retrying "the whole
+            # batch" with fresh auto ids would duplicate those rows
+            any_commit = any(
+                committed
+                for _, results in outcomes.values()
+                for _, committed, _ in results
+            )
+            if n_acked == 0 and not any_hard_miss and not any_commit:
                 raise StorageSaturatedError(
                     "every replica refused the batch at capacity; "
-                    "retry after backoff"
+                    "retry after backoff",
+                    retry_after_s=retry_hint or 1.0,
                 )
             raise PartialBatchError(
                 f"{len(failed)} of {len(eids)} events missed the write "
                 f"quorum ({self._c.write_quorum})",
                 event_ids=eids,
                 failed_ids=failed,
+                # all-saturation failures are retryable after backoff,
+                # honoring the saturated replicas' own hint
+                retry_after_s=(
+                    (retry_hint or 1.0) if not any_hard_miss else None
+                ),
             )
         return eids
 
@@ -743,19 +790,67 @@ class ClusterLEvents(base.LEvents):
         if not candidates:
             raise StorageError("cluster get: no node available")
         last: Optional[Exception] = None
-        answered = False
+        answered: set = set()  # node indices that answered (non-stale)
+        stale_hit: Optional[Event] = None
         for node in candidates:
             try:
                 out = self._le(node).get(event_id, app_id, channel_id)
                 node.record_success()
-                answered = True
-                if out is not None:
-                    return out
+                if not node.stale:
+                    answered.add(node.index)
+                    if out is not None:
+                        return out
+                elif out is not None and stale_hit is None:
+                    # a STALE replica's positive answer may be a row
+                    # whose tombstone it missed: judged below against
+                    # the healthy replicas of its slot, never returned
+                    # outright (serving it could resurrect a delete)
+                    stale_hit = out
             except (StorageError, OSError) as e:
                 node.record_failure()
                 last = e
         if not answered:
             raise StorageError(f"cluster get failed on every node: {last}")
+        # an acked row lives on >= WRITE_QUORUM replicas of its slot,
+        # so once R - W + 1 of them deny it no quorum-committed copy
+        # can be hiding (pigeonhole) — the shared bar for both
+        # judgments below
+        need = self._c.replicas - self._c.write_quorum + 1
+        if stale_hit is not None:
+            slot = self._c.slot_of(stale_hit.entity_id)
+            got = sum(
+                1
+                for idx in self._c.replicas_of_slot(slot)
+                if idx in answered
+            )
+            if got >= need:
+                # enough healthy replicas deny the row: the stale copy
+                # is a missed tombstone (or never acked) — not found
+                return None
+            raise StorageError(
+                f"cluster get({event_id}): found only on a stale "
+                f"replica with {got}/{need} healthy replicas of slot "
+                f"{slot} answering — cannot tell a missed tombstone "
+                "from an under-replicated row until resync completes"
+            )
+        # "not found" is only definitive when, for EVERY slot the event
+        # could live in, enough of the slot's replicas answered that any
+        # quorum-sized committed set must intersect them — otherwise the
+        # row may exist on an unreachable (or stale) replica, and
+        # unavailability must not masquerade as nonexistence
+        for slot in range(self._c.n_nodes):
+            got = sum(
+                1
+                for idx in self._c.replicas_of_slot(slot)
+                if idx in answered
+            )
+            if got < need:
+                raise StorageError(
+                    f"cluster get({event_id}): not found on answering "
+                    f"nodes, but only {got}/{need} required replicas of "
+                    f"slot {slot} answered — the event may exist on an "
+                    f"unreachable replica: {last}"
+                )
         return None
 
     def delete(
@@ -763,25 +858,47 @@ class ClusterLEvents(base.LEvents):
     ) -> bool:
         found = False
         missed: List[_Node] = []
+        deleters: List[int] = []  # node indices that held + removed it
         for node in self._c.nodes:
             if not node.available():
                 missed.append(node)
                 continue
             try:
-                found = (
-                    self._le(node).delete(event_id, app_id, channel_id)
-                    or found
-                )
+                if self._le(node).delete(event_id, app_id, channel_id):
+                    found = True
+                    deleters.append(node.index)
                 node.record_success()
             except (StorageError, OSError):
                 node.record_failure()
                 missed.append(node)
         if found:
-            # a node that missed the tombstone while a peer removed the
-            # row may still hold it: stale until resync reconciles (a
-            # no-op delete stales nobody — there was nothing to miss)
+            # a replica that missed the tombstone while a peer removed
+            # the row may still hold it: stale until resync reconciles
+            # (a no-op delete stales nobody — there was nothing to
+            # miss). The id carries no entity hash, but every node
+            # that held the row is a replica of its (unknown) slot, so
+            # intersecting the deleters' candidate-slot windows pins
+            # the row's replica set with zero extra round trips — a
+            # tombstone miss then stales only nodes that could
+            # actually hold the row (exact once every live replica
+            # answered), not the whole fleet. An empty intersection
+            # (impossible for slot-routed rows) falls back to staling
+            # every missed node rather than risk resurrecting it.
+            cand: Optional[set] = None
+            for j in deleters:
+                window = {
+                    (j - r) % self._c.n_nodes
+                    for r in range(self._c.replicas)
+                }
+                cand = window if cand is None else (cand & window)
+            eligible = {
+                idx
+                for s in (cand or set())
+                for idx in self._c.replicas_of_slot(s)
+            }
             for node in missed:
-                node.mark_stale()
+                if not eligible or node.index in eligible:
+                    node.mark_stale()
         return found
 
     def _order_all_available(self) -> List[_Node]:
@@ -963,9 +1080,8 @@ class ClusterLEvents(base.LEvents):
             failed.add(node_idx)
             self._c.fire("node_down_scan")
             self._c._m_failovers.labels(path="scan").inc()
-            pending.extend(
-                sorted(self._c.replan_slots(slots, node_idx, failed).items())
-            )
+            moved, _ = self._c.replan_slots(slots, node_idx, failed)
+            pending.extend(sorted(moved.items()))
 
     # --- columnar writes ---
 
@@ -1279,6 +1395,9 @@ class ClusterLEvents(base.LEvents):
         box: Dict[str, Any] = {
             "cursors": {}, "complete": False, "invalid": False,
         }
+        # filled with the ColumnarStream below, so batches() can strip
+        # its fingerprint if a mid-scan failover degrades coverage
+        holder: Dict[str, Any] = {}
         c = self._c
         get_le = self._le
 
@@ -1393,14 +1512,21 @@ class ClusterLEvents(base.LEvents):
                         node.label, e,
                     )
                     failed.add(node_idx)
-                    pending.extend(
-                        sorted(
-                            c.replan_slots(slots, node_idx, failed).items()
-                        )
+                    moved, used_stale = c.replan_slots(
+                        slots, node_idx, failed
                     )
+                    pending.extend(sorted(moved.items()))
                     # a failover scan's coverage no longer matches the
                     # planned cursor set: serve the data, skip the cursor
                     box["invalid"] = True
+                    if used_stale:
+                        # slots now served by a STALE replica: the scan
+                        # may be missing acked rows, so the pre-scan
+                        # fingerprint must not survive to label a cache
+                        # artifact as complete
+                        stream = holder.get("stream")
+                        if stream is not None:
+                            stream.fingerprint = None
                     continue
                 if batch is not None:
                     yield batch
@@ -1430,11 +1556,13 @@ class ClusterLEvents(base.LEvents):
                 tuple(sorted(cursors.items())),
             )
 
-        return ColumnarStream(
+        out = ColumnarStream(
             batches(), names,
             fingerprint=None if degraded else fingerprint,
             cursor_fn=cursor,
         )
+        holder["stream"] = out
+        return out
 
     def store_fingerprint(
         self, app_id: int, channel_id: Optional[int] = None

@@ -245,10 +245,12 @@ class StorageClient(base.DAOCacheMixin):
             if out.get("type") == "PartialBatchError":
                 # reconstruct the typed error so the event server's
                 # per-event retry contract survives the gateway hop
+                retry_s = out.get("retry_after_s")
                 raise PartialBatchError(
                     str(out.get("error")),
                     event_ids=out.get("event_ids") or [],
                     failed_ids=out.get("failed_ids") or [],
+                    retry_after_s=None if retry_s is None else float(retry_s),
                 )
             if out.get("type") == "StorageSaturatedError":
                 # typed backpressure survives the hop: an event server

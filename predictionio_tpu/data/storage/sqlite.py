@@ -1051,17 +1051,39 @@ class SQLiteLEvents(base.LEvents):
         sql = self._INSERT_SQL.format(t=t)
         chunk = self._c.gc_rows
         units: list = []  # (unit, [eids])
+        # bounded admission can refuse a LATER unit after earlier units
+        # of this same batch were enqueued (and will commit). A bare
+        # StorageSaturatedError here would tell the caller "nothing was
+        # admitted — retry the whole batch", and a retry of auto-id
+        # events would re-insert the committed slices under fresh ids.
+        # So the refusal is only propagated as-is when NO unit made it
+        # into a queue; otherwise the refused/unsubmitted slices join
+        # the PartialBatchError's failed set (marked retryable-after-
+        # backoff) after the enqueued units resolve.
+        unsubmitted: list = []  # eids of slices never enqueued
+        admit_error: Optional[base.StorageSaturatedError] = None
         for k, pairs in by_shard.items():
             shard = self._c.event_shards[k]
             self._ensure_shard_table(shard, t)
             for s in range(0, len(pairs), chunk):
                 part = pairs[s : s + chunk]
-                units.append(
-                    (
-                        shard.submit_rows(sql, [row for row, _ in part]),
-                        [eid for _, eid in part],
+                if admit_error is not None:
+                    unsubmitted.extend(eid for _, eid in part)
+                    continue
+                try:
+                    units.append(
+                        (
+                            shard.submit_rows(
+                                sql, [row for row, _ in part]
+                            ),
+                            [eid for _, eid in part],
+                        )
                     )
-                )
+                except base.StorageSaturatedError as e:
+                    admit_error = e
+                    unsubmitted.extend(eid for _, eid in part)
+        if admit_error is not None and not units:
+            raise admit_error  # truly nothing admitted: batch-retry safe
         failed: list = []
         first_error: Optional[BaseException] = None
         for unit, unit_eids in units:
@@ -1071,21 +1093,32 @@ class SQLiteLEvents(base.LEvents):
                 failed.extend(unit_eids)
                 if first_error is None:
                     first_error = e
+        failed.extend(unsubmitted)
         # scrub explicit ids only where the REPLACEMENT actually landed
         # (a failed unit must keep the old copy — see _scrub_duplicate_ids)
         failed_set = set(failed)
         self._scrub_duplicate_ids(
             t, [(eid, keep) for eid, keep in explicit if eid not in failed_set]
         )
-        if first_error is not None:
+        if first_error is not None or admit_error is not None:
+            err = first_error if first_error is not None else admit_error
             if len(failed) == len(eids):
-                raise first_error  # nothing landed: plain error
+                raise err  # nothing landed: plain error
             raise PartialBatchError(
                 f"{len(failed)}/{len(eids)} batch events failed to "
-                f"commit: {first_error}",
+                f"commit: {err}",
                 event_ids=eids,
                 failed_ids=failed,
-            ) from first_error
+                # the backoff hint marks EVERY failed slot as a
+                # capacity refusal, so it is only attached when no
+                # unit failed hard — a mixed batch must not label
+                # commit failures as 503-retryable saturation
+                retry_after_s=(
+                    admit_error.retry_after_s
+                    if admit_error is not None and first_error is None
+                    else None
+                ),
+            ) from err
         return eids
 
     @staticmethod

@@ -845,6 +845,161 @@ class TestIngestBackpressure:
         finally:
             server.shutdown()
 
+    def test_mid_batch_saturation_is_partial_not_whole_batch_refusal(
+        self, tmp_path
+    ):
+        """Admission refusing a LATER unit after earlier units of the
+        same batch were enqueued (and will commit) must come back as a
+        PartialBatchError naming exactly the refused slices: a bare
+        StorageSaturatedError would tell the client "nothing was
+        admitted — retry the whole batch", and the retry would
+        re-insert the committed slice under fresh auto ids."""
+        import threading as th
+        import time
+
+        from predictionio_tpu.data.storage.base import PartialBatchError
+        from predictionio_tpu.data.storage.sqlite import _GroupCommitter
+        from predictionio_tpu.utils import metrics as _metrics
+
+        old_q, old_w = (
+            _GroupCommitter.QUEUE_MAX_UNITS, _GroupCommitter.ADMIT_WAIT_S
+        )
+        _GroupCommitter.QUEUE_MAX_UNITS = 1
+        _GroupCommitter.ADMIT_WAIT_S = 0.05
+        try:
+            storage = sqlite_storage(tmp_path / "mid.db")
+            le = storage.get_l_events()
+            le._c.gc_rows = 2  # the 4-event batch splits into 2 units
+            shard = le._c.main_store
+            sat = _metrics.get_registry().counter(
+                "pio_group_commit_saturated_total",
+                "Write submissions refused because the group-commit "
+                "queue stayed full past the admission window "
+                "(surfaced to clients as 503 + Retry-After)",
+                labels=("shard",),
+            ).labels(shard="mid.db")
+            refused_before = sat.value
+            gate, started, stall = self._wedge(shard.committer)
+            shard.commit_fault = stall
+            outcome = {}
+            try:
+                filler = th.Thread(
+                    target=lambda: le.insert(
+                        rating("u-fill", "i0", 1.0), 1
+                    ),
+                    daemon=True,
+                )
+                filler.start()
+                assert started.wait(5.0)  # flush wedged; queue empty
+
+                batch = [rating(f"u{i}", "i0", 1.0) for i in range(4)]
+
+                def run():
+                    try:
+                        outcome["ids"] = le.insert_batch(batch, 1)
+                    except Exception as e:  # captured for the main thread
+                        outcome["error"] = e
+
+                worker = th.Thread(target=run, daemon=True)
+                worker.start()
+                # unit 1 takes the queue's only slot...
+                t0 = time.monotonic()
+                while (
+                    shard.committer._q.qsize() < 1
+                    and time.monotonic() - t0 < 5.0
+                ):
+                    time.sleep(0.01)
+                assert shard.committer._q.qsize() == 1
+                # ...and unit 2 is REFUSED before the wedge lifts, so
+                # the admitted unit cannot sneak back into the queue
+                t0 = time.monotonic()
+                while (
+                    sat.value <= refused_before
+                    and time.monotonic() - t0 < 5.0
+                ):
+                    time.sleep(0.01)
+                assert sat.value > refused_before
+            finally:
+                shard.commit_fault = None
+                gate.set()
+            worker.join(15.0)
+            filler.join(15.0)
+            err = outcome.get("error")
+            assert isinstance(err, PartialBatchError), (
+                f"expected PartialBatchError, got {outcome!r}"
+            )
+            assert err.retry_after_s is not None
+            assert len(err.event_ids) == 4
+            # exactly the refused second slice failed...
+            assert set(err.failed_ids) == set(err.event_ids[2:])
+            # ...and the first slice is DURABLE: a whole-batch retry
+            # would have duplicated it under fresh ids
+            for eid in err.event_ids[:2]:
+                assert le.get(eid, 1) is not None
+            for eid in err.event_ids[2:]:
+                assert le.get(eid, 1) is None
+        finally:
+            _GroupCommitter.QUEUE_MAX_UNITS = old_q
+            _GroupCommitter.ADMIT_WAIT_S = old_w
+
+    def test_batch_route_answers_503_per_saturated_slot(self, tmp_path):
+        """A PartialBatchError whose failures are capacity refusals
+        (retry_after_s set) maps the failed slots to per-event 503s —
+        retryable after backoff — while committed slots still 201."""
+        import json
+        import urllib.request
+
+        from predictionio_tpu.api.event_server import (
+            EventServer,
+            EventServerConfig,
+        )
+        from predictionio_tpu.data.storage.base import (
+            AccessKey,
+            PartialBatchError,
+        )
+
+        storage = sqlite_storage(tmp_path / "slot503.db", app_name="s5")
+        storage.get_meta_data_access_keys().insert(
+            AccessKey(key="s5key", appid=1)
+        )
+        server = EventServer(
+            storage=storage,
+            config=EventServerConfig(ip="127.0.0.1", port=0, stats=False),
+        ).start()
+        try:
+            le = server.api._events
+
+            def partial(events, app_id, channel_id=None):
+                raise PartialBatchError(
+                    "1/2 batch events failed to commit: queue full",
+                    event_ids=["ok-1", "sat-2"],
+                    failed_ids=["sat-2"],
+                    retry_after_s=2.0,
+                )
+
+            le.insert_batch = partial  # instance-level injection
+            item = {
+                "event": "rate", "entityType": "user",
+                "entityId": "u1", "targetEntityType": "item",
+                "targetEntityId": "i1",
+                "properties": {"rating": 3.0},
+            }
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{server.port}/batch/events.json"
+                "?accessKey=s5key",
+                data=json.dumps([item, item]).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                assert resp.status == 200
+                results = json.loads(resp.read().decode())
+            assert results[0]["status"] == 201
+            assert results[0]["eventId"] == "ok-1"
+            assert results[1]["status"] == 503
+            assert "retry" in results[1]["message"]
+        finally:
+            server.shutdown()
+
 
 def _count_503(_metrics) -> float:
     reg = _metrics.get_registry()
@@ -856,3 +1011,56 @@ def _count_503(_metrics) -> float:
     return c.labels(
         server="Event Server", route="/events.json", status="503"
     ).value
+
+
+class TestMixedBatchFailureAttribution:
+    def test_mixed_hard_and_saturation_failures_drop_backoff_hint(
+        self, tmp_path
+    ):
+        """retry_after_s on a PartialBatchError marks EVERY failed slot
+        as a capacity refusal, so a batch that ALSO had a hard commit
+        failure must not carry it — otherwise the event server answers
+        hard-failed slots 503 "storage saturated" and a cluster replica
+        receiving the error suppresses its own hard-miss accounting."""
+        from predictionio_tpu.data.storage.base import (
+            PartialBatchError,
+            StorageError,
+            StorageSaturatedError,
+        )
+
+        storage = sqlite_storage(tmp_path / "mixed.db")
+        le = storage.get_l_events()
+        le._c.gc_rows = 2  # the 6-event batch splits into 3 units
+        shard = le._c.main_store
+        orig = shard.submit_rows
+
+        class FailUnit:
+            def wait(self, timeout=None):
+                raise StorageError("injected commit failure")
+
+        calls = {"n": 0}
+
+        def fake(sql, rows):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                return orig(sql, rows)  # unit 1 commits for real
+            if calls["n"] == 2:
+                return FailUnit()  # unit 2 fails HARD
+            raise StorageSaturatedError(  # unit 3 refused at capacity
+                "injected: queue full", retry_after_s=1.0
+            )
+
+        shard.submit_rows = fake
+        try:
+            batch = [rating(f"u{i}", "i0", 1.0) for i in range(6)]
+            with pytest.raises(PartialBatchError) as ei:
+                le.insert_batch(batch, 1)
+        finally:
+            shard.submit_rows = orig
+        err = ei.value
+        # units 2 (hard) and 3 (refused) failed; unit 1 committed
+        assert set(err.failed_ids) == set(err.event_ids[2:])
+        for eid in err.event_ids[:2]:
+            assert le.get(eid, 1) is not None
+        # the mixed batch carries NO backoff hint
+        assert err.retry_after_s is None

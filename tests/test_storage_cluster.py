@@ -598,3 +598,182 @@ class TestEndToEndTraining:
         assert r2.timings["pack_cache"] == "fold"
         assert r2.timings["delta_events"] == 30
         strm.pack_cache_clear()
+
+
+class TestDegradedFailoverSemantics:
+    """Review fixes: mid-scan failover prefers healthy replicas, a
+    forced stale fallback strips the stream fingerprint, point reads
+    never convert unavailability into "not found", tombstone misses
+    stale only the row's replica set, and a below-quorum commit is
+    attributed per-slot instead of claimed as whole-batch saturation."""
+
+    def test_replan_prefers_non_stale_replica(self):
+        f = Fleet(n=3, replicas=3)
+        try:
+            # node 1 is the next replica in slot order but STALE: the
+            # re-plan must reach past it to healthy node 2
+            f.client.nodes[1].mark_stale()
+            moved, used_stale = f.client.replan_slots([0], 0, {0})
+            assert moved == {2: {0}}
+            assert not used_stale
+            # with every healthier replica gone, the stale one is a
+            # last resort — and the caller is told so
+            f.client.nodes[2].mark_stale()
+            moved, used_stale = f.client.replan_slots([0], 0, {0})
+            assert moved == {1: {0}}
+            assert used_stale
+        finally:
+            f.close()
+
+    def test_failover_onto_stale_replica_strips_fingerprint(self, fleet):
+        le = fleet.storage.get_l_events()
+        le.init(1)
+        evs = make_events(80)
+        le.insert_batch(evs, 1)
+        fleet.client.auto_resync = False
+        # node 2 carries the STALE label (its store is actually
+        # complete — only the label matters here): the healthy plan
+        # routes slot 2 to node 0 and still carries a fingerprint
+        fleet.client.nodes[2].mark_stale()
+        stream = le.stream_columns_native(1)
+        assert stream.fingerprint is not None
+        # node 1 dies between planning and fetching; slot 1's only
+        # remaining replica is the stale node 2. The data still merges
+        # (this stale store happens to be whole) but the scan can no
+        # longer vouch for completeness: neither the cursor NOR the
+        # pre-scan fingerprint may survive to label a cache artifact
+        fleet.kill(1)
+        total = sum(len(v) for _, _, v in stream)
+        assert total == len(evs)
+        assert stream.cursor is None
+        assert stream.fingerprint is None
+
+    def test_get_raises_when_replica_coverage_incomplete(self, fleet):
+        le = fleet.storage.get_l_events()
+        le.init(1)
+        ids = le.insert_batch(make_events(30), 1)
+        # healthy fleet: a definitive miss is a clean None
+        assert le.get("no-such-event", 1) is None
+        # with a node down, an id missing from the answering nodes may
+        # still exist on the dead one (R=2, quorum=1: a row can live on
+        # any single replica) — unavailability must surface as an
+        # error, never as "does not exist"
+        fleet.kill(2)
+        with pytest.raises(StorageError):
+            le.get("no-such-event", 1)
+        # found rows still resolve through the live replicas
+        assert le.get(ids[0], 1) is not None
+
+    def test_tombstone_miss_stales_only_the_replica_set(self, fleet):
+        le = fleet.storage.get_l_events()
+        le.init(1)
+        n = fleet.client.n_nodes
+        ev = Event(
+            event="rate", entity_type="user",
+            entity_id=entity_for_slot(0, n),
+            target_entity_type="item", target_entity_id="i0",
+            properties=DataMap({"rating": 1.0}),
+        )
+        (eid,) = le.insert_batch([ev], 1)
+        # node 2 is NOT a replica of slot 0 ({0, 1}): its death during
+        # the delete must not drag it into a resync it does not need
+        fleet.kill(2)
+        assert le.delete(eid, 1)
+        assert not any(nd.stale for nd in fleet.client.nodes)
+
+    def test_below_quorum_commit_is_partial_not_whole_batch_saturation(self):
+        from predictionio_tpu.data.storage.base import (
+            StorageSaturatedError,
+        )
+
+        f = Fleet(n=3, replicas=2, extra={"WRITE_QUORUM": "2"})
+        try:
+            le = f.storage.get_l_events()
+            le.init(1)
+            n = f.client.n_nodes
+            backend = f.universes[1].get_l_events()
+
+            def full(events, app_id, channel_id=None):
+                raise StorageSaturatedError("injected: queue full")
+
+            backend.insert_batch = full
+            ev = Event(
+                event="rate", entity_type="user",
+                entity_id=entity_for_slot(0, n),
+                target_entity_type="item", target_entity_id="i0",
+                properties=DataMap({"rating": 1.0}),
+            )
+            # node 0 commits, node 1 refuses at capacity: below quorum
+            # but durable SOMEWHERE — claiming whole-batch saturation
+            # would invite a full retry that duplicates the committed
+            # copy under a fresh auto id
+            with pytest.raises(PartialBatchError) as ei:
+                le.insert_batch([ev], 1)
+            assert set(ei.value.failed_ids) == set(ei.value.event_ids)
+            # all-saturation failures are marked retryable-after-backoff
+            assert ei.value.retry_after_s is not None
+            assert {e.event_id for e in f.node_events(0)} == set(
+                ei.value.event_ids
+            )
+        finally:
+            f.close()
+
+    def test_replica_capacity_partial_keeps_backoff_hint(self):
+        """A replica answering its slice with a capacity-attributed
+        PartialBatchError (retry_after_s set) is saturation, not node
+        death: the outer error must stay retryable and carry the
+        saturated replica's OWN backoff hint, so clients back off
+        instead of hammering the store with per-slot 500-retries."""
+        f = Fleet(n=3, replicas=2, extra={"WRITE_QUORUM": "2"})
+        try:
+            le = f.storage.get_l_events()
+            le.init(1)
+            n = f.client.n_nodes
+            backend = f.universes[1].get_l_events()
+
+            def sat_partial(events, app_id, channel_id=None):
+                ids = [e.event_id for e in events]
+                raise PartialBatchError(
+                    "injected: slice refused at capacity",
+                    event_ids=ids, failed_ids=ids, retry_after_s=2.5,
+                )
+
+            backend.insert_batch = sat_partial
+            ev = Event(
+                event="rate", entity_type="user",
+                entity_id=entity_for_slot(0, n),
+                target_entity_type="item", target_entity_id="i0",
+                properties=DataMap({"rating": 1.0}),
+            )
+            with pytest.raises(PartialBatchError) as ei:
+                le.insert_batch([ev], 1)
+            assert ei.value.retry_after_s == 2.5
+        finally:
+            f.close()
+
+    def test_get_never_serves_a_stale_replicas_ghost_row(self):
+        """A row found ONLY on a stale replica may be a tombstone the
+        replica missed: get() must not serve it outright. With too few
+        healthy replicas answering to adjudicate (R=2, quorum=1), the
+        ambiguity surfaces as StorageError — never as the ghost row."""
+        f = Fleet(n=3, replicas=2)
+        try:
+            le = f.storage.get_l_events()
+            le.init(1)
+            n = f.client.n_nodes
+            f.client.auto_resync = False
+            ev = Event(
+                event="rate", entity_type="user",
+                entity_id=entity_for_slot(0, n),
+                target_entity_type="item", target_entity_id="i0",
+                properties=DataMap({"rating": 1.0}),
+            )
+            (eid,) = le.insert_batch([ev], 1)  # replicas {0, 1}
+            # simulate node 1 missing the tombstone: the row vanishes
+            # from node 0's backend while node 1 (stale) still holds it
+            f.universes[0].get_l_events().delete(eid, 1)
+            f.client.nodes[1].mark_stale()
+            with pytest.raises(StorageError, match="stale"):
+                le.get(eid, 1)
+        finally:
+            f.close()
