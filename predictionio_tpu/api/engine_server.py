@@ -1099,11 +1099,10 @@ class QueryAPI:
             return (
                 401, {"message": "Invalid accessKey."}, "application/json"
             )
-        return (
-            200,
-            {"spans": _tracing.dump(query.get("traceId") or None)},
-            "application/json",
-        )
+        from predictionio_tpu.api.http import traces_payload
+
+        status, payload = traces_payload(query)
+        return status, payload, "application/json"
 
     def _debug_predictions(self, query: Dict[str, str]) -> Tuple[int, Any, str]:
         """The capture-ring dump. The payload is directly persistable as

@@ -363,7 +363,9 @@ class EventAPI:
             auth, err = self._authenticate(query)
             if err:
                 return err
-            return 200, {"spans": _tracing.dump(query.get("traceId") or None)}
+            from predictionio_tpu.api.http import traces_payload
+
+            return traces_payload(query)
 
         if parts[0] == "plugins" and len(parts) >= 3 and method == "GET":
             auth, err = self._authenticate(query)

@@ -82,6 +82,31 @@ def record_http_error(
         )
 
 
+def traces_payload(query) -> Tuple[int, dict]:
+    """The shared ``GET /debug/traces.json`` body builder (all three
+    servers route here after their own auth gate). Supports the full
+    dump, a ``traceId`` filter, and the incremental ``since=<seq>``
+    cursor: the response always carries ``seq`` — the process's span
+    high-water mark — which a consumer (the telemetry collector) feeds
+    back as the next ``since`` so it never re-downloads the ring."""
+    from predictionio_tpu.utils import tracing as _tracing
+
+    q = query or {}
+    trace_id = q.get("traceId") or None
+    raw_since = q.get("since")
+    if raw_since in (None, ""):
+        return 200, {
+            "spans": _tracing.dump(trace_id),
+            "seq": _tracing.high_water(),
+        }
+    try:
+        since = int(raw_since)
+    except (TypeError, ValueError):
+        return 400, {"message": f"invalid since cursor {raw_since!r}"}
+    spans, hwm = _tracing.dump_since(since, trace_id=trace_id)
+    return 200, {"spans": spans, "seq": hwm}
+
+
 def accepts_headers(fn: Callable) -> bool:
     """Whether a request core takes the optional ``headers`` kwarg (the
     lower-cased request-header dict both transports can supply). Probed

@@ -1,0 +1,95 @@
+"""Per-process observability sideband listener.
+
+An SO_REUSEPORT fleet shares ONE serving port — the kernel routes each
+accepted connection to an arbitrary worker, so a scrape of the shared
+port samples a random member instead of enumerating the fleet. Exact
+federation (utils/telemetry.py) needs every process individually
+addressable. This sideband is the answer: a tiny second HTTP listener
+per worker serving ONLY the observability surface —
+
+- ``GET /metrics``      — the process-global registry,
+- ``GET /healthz``      — liveness,
+- ``GET /readyz``       — the process's daemon-stall verdict,
+- ``GET /debug/traces.json`` — the span ring, with the incremental
+  ``?since=<seq>`` cursor (gated by ``access_key`` when configured).
+
+``pio deploy --metrics-port P`` / ``pio eventserver --metrics-port P``
+start one beside the main server; the fleet supervisor
+(``pio deploy --workers N --collector-url …``) assigns each worker its
+own sideband port and registers those URLs with the local collector.
+The sideband refuses non-loopback binds without an access key — it
+exposes the same information class as the main servers' gated debug
+routes.
+"""
+
+from __future__ import annotations
+
+import logging
+import secrets as _secrets
+
+from predictionio_tpu.api.aio_http import make_http_server
+from predictionio_tpu.api.http import traces_payload
+from predictionio_tpu.utils import health as _health
+from predictionio_tpu.utils import metrics as _metrics
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["ObservabilitySideband"]
+
+_LOOPBACK_IPS = ("localhost", "127.0.0.1", "::1")
+
+
+class ObservabilitySideband:
+    """The sideband server. Handlers are allocation-light and touch no
+    storage, so they run inline on the event loop — a scrape can never
+    park behind the main server's handler pool."""
+
+    def __init__(
+        self,
+        ip: str = "localhost",
+        port: int = 0,
+        access_key: str = "",
+        server_name: str = "Sideband",
+    ):
+        if not access_key and ip not in _LOOPBACK_IPS:
+            raise ValueError(
+                f"refusing to bind sideband on {ip!r} without an access "
+                "key: the span dump carries entity ids and timings"
+            )
+        self.access_key = access_key
+        self._http = make_http_server(
+            self._handle, ip, port, server_name, transport="async"
+        )
+
+    def _handle(self, method, path, query, body, form=None, headers=None):
+        if method != "GET":
+            return 405, {"message": "Method not allowed."}
+        if path == "/healthz":
+            return 200, _health.liveness()
+        if path == "/readyz":
+            ok, payload = _health.readiness()
+            return (200 if ok else 503), payload
+        if path == "/metrics":
+            return (
+                200,
+                _metrics.get_registry().render(),
+                _metrics.render_content_type(),
+            )
+        if path == "/debug/traces.json":
+            if self.access_key and not _secrets.compare_digest(
+                (query or {}).get("accessKey", ""), self.access_key
+            ):
+                return 401, {"message": "Invalid accessKey."}
+            return traces_payload(query)
+        return 404, {"message": f"unknown route {method} {path}"}
+
+    @property
+    def port(self) -> int:
+        return self._http.port
+
+    def start(self) -> "ObservabilitySideband":
+        self._http.start()
+        return self
+
+    def shutdown(self) -> None:
+        self._http.shutdown()

@@ -176,9 +176,9 @@ class StorageGatewayCore:
                 (query or {}).get("secret", ""), self.secret
             ):
                 return 401, {"error": "invalid or missing secret"}
-            return 200, {
-                "spans": _tracing.dump((query or {}).get("traceId") or None)
-            }
+            from predictionio_tpu.api.http import traces_payload
+
+            return traces_payload(query)
         if path != "/rpc" or method != "POST":
             return 404, {"error": f"unknown route {method} {path}"}
         try:

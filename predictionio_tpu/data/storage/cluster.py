@@ -144,6 +144,9 @@ class _Node:
         self._consecutive_failures = 0
         self._opened_at: Optional[float] = None
         self.stale = False
+        # when this node was marked STALE (monotonic), for the age
+        # gauge; None while healthy
+        self.stale_since: Optional[float] = None
         self._m_up = _gauge(
             "pio_cluster_node_up",
             "Cluster node breaker state (1 = in the serving path, "
@@ -156,8 +159,28 @@ class _Node:
             "the read path until resync)",
             labels=("node",),
         ).labels(node=self.label)
+        # staleness observability (PR 14 follow-up): how LONG a replica
+        # has been out of the read path, and how far behind its resync
+        # source it was last measured — the two numbers an operator
+        # needs to decide between waiting out an auto-resync and paging
+        self._m_stale_age = _gauge(
+            "pio_cluster_stale_age_seconds",
+            "Seconds since this node was marked STALE (0 = healthy); "
+            "refreshed on every read-planning pass and status read",
+            labels=("node",),
+        ).labels(node=self.label)
+        self._m_resync_lag = _gauge(
+            "pio_cluster_resync_lag_seconds",
+            "Event-time gap between a stale node's high-water mark and "
+            "its resync source peer, measured at the last resync "
+            "attempt (0 = caught up)",
+            labels=("node",),
+        ).labels(node=self.label)
+        self.resync_lag_s = 0.0
         self._m_up.set(1.0)
         self._m_stale.set(0.0)
+        self._m_stale_age.set(0.0)
+        self._m_resync_lag.set(0.0)
 
     def le(self, namespace: str) -> "_http.HTTPLEvents":
         return self.client.dao(_http.HTTPLEvents, namespace)
@@ -195,12 +218,38 @@ class _Node:
                 "cluster node %s marked STALE (missed an acked write); "
                 "out of the read path until resync", self.label,
             )
+            self.stale_since = time.monotonic()
         self.stale = True
         self._m_stale.set(1.0)
+        self._m_stale_age.set(0.0)
 
     def clear_stale(self) -> None:
         self.stale = False
+        self.stale_since = None
+        self.resync_lag_s = 0.0
         self._m_stale.set(0.0)
+        self._m_stale_age.set(0.0)
+        self._m_resync_lag.set(0.0)
+
+    def stale_age_s(self) -> float:
+        """Seconds this node has been STALE (0 while healthy); refreshes
+        the ``pio_cluster_stale_age_seconds`` gauge as a side effect, so
+        any read-planning pass or status read keeps the exported age
+        current for scrapers."""
+        age = (
+            0.0
+            if self.stale_since is None
+            else max(0.0, time.monotonic() - self.stale_since)
+        )
+        self._m_stale_age.set(age)
+        return age
+
+    def note_resync_lag(self, lag_s: float) -> None:
+        """Record the event-time gap to the resync source measured at
+        the latest resync attempt (kept visible across a FAILED replay
+        so an operator sees how far behind the node still is)."""
+        self.resync_lag_s = max(0.0, float(lag_s))
+        self._m_resync_lag.set(self.resync_lag_s)
 
     def breaker_open(self) -> bool:
         with self._lock:
@@ -343,6 +392,10 @@ class StorageClient(base.DAOCacheMixin):
         healthier answers (counted as a degraded read)."""
         if self.auto_resync:
             self.maybe_resync()
+        for node in self.nodes:
+            # keep the exported stale-age current on every planning
+            # pass (a float store per node — off any hot loop)
+            node.stale_age_s()
         plan: Dict[int, int] = {}
         failed_over = False
         degraded = False
@@ -452,6 +505,7 @@ class StorageClient(base.DAOCacheMixin):
         of rows OLDER than the high-water mark need ``full=True`` (the
         runbook's recovery path for out-of-order/backfilled data)."""
         self.fire("resync")
+        node.note_resync_lag(0.0)  # re-measured below, max across slots
         my_slots = [
             slot
             for slot in range(self.n_nodes)
@@ -483,6 +537,21 @@ class StorageClient(base.DAOCacheMixin):
                     if self.slot_of(e.entity_id) == slot
                 ]
                 peer_ids_by_slot[slot] = {e.event_id for e in rows}
+                if rows:
+                    # the observability gap this gauge closes: how far
+                    # (in EVENT time) the stale node trails its resync
+                    # source — recorded before the replay so a failed
+                    # attempt still leaves the measured lag visible
+                    times = [
+                        e.event_time for e in rows
+                        if e.event_time is not None
+                    ]
+                    if times:
+                        base = hw if hw is not None else min(times)
+                        lag = (max(times) - base).total_seconds()
+                        node.note_resync_lag(
+                            max(node.resync_lag_s, lag)
+                        )
                 for s in range(0, len(rows), 500):
                     le.insert_batch(rows[s : s + 500], app_id, channel_id)
                 total += len(rows)
@@ -557,6 +626,8 @@ class StorageClient(base.DAOCacheMixin):
                     "available": node.available(),
                     "breaker_open": node.breaker_open(),
                     "stale": node.stale,
+                    "stale_age_s": node.stale_age_s(),
+                    "resync_lag_s": node.resync_lag_s,
                     "primary_slot": node.index,
                     "replica_slots": [
                         s

@@ -225,6 +225,9 @@ def _train_continuous(
                 max_error_rate=args.promote_max_error_rate,
                 drain_timeout_s=args.promote_drain_timeout_s,
                 require_shadow=bool(args.promote_require_shadow),
+                collector_url=(
+                    getattr(args, "promote_collector_url", None) or None
+                ),
             ),
             storage=get_storage(),
         )
@@ -375,9 +378,41 @@ def cmd_deploy(args) -> int:
         retained_states=int(getattr(args, "retained_states", 1)),
     )
     server = create_server(engine, config)
+    _maybe_start_sideband(args, access_key=args.accesskey or "")
     print(f"Engine server serving on {args.ip}:{server.port}")
     server.serve_forever()
     return 0
+
+
+def _maybe_start_sideband(args, access_key: str = ""):
+    """Start the per-process observability sideband when --metrics-port
+    was given (api/sideband.py): the individually-scrapable address an
+    SO_REUSEPORT worker needs for exact fleet federation."""
+    port = int(getattr(args, "metrics_port", 0) or 0)
+    if not port:
+        return None
+    from predictionio_tpu.api.sideband import ObservabilitySideband
+
+    try:
+        sideband = ObservabilitySideband(
+            ip=args.ip, port=port, access_key=access_key
+        ).start()
+    except ValueError as e:
+        raise CommandError(str(e)) from e
+    print(f"Observability sideband on {args.ip}:{sideband.port}")
+    return sideband
+
+
+def _free_port(ip: str) -> int:
+    import socket
+
+    host = "127.0.0.1" if ip == "localhost" else ip
+    # bind with the ip's OWN address family — AF_INET against "::1"
+    # would abort a deploy on the loopback the sideband supports
+    family = socket.getaddrinfo(host, None)[0][0]
+    with socket.socket(family) as s:
+        s.bind((host, 0))
+        return s.getsockname()[1]
 
 
 def _deploy_worker_fleet(args, workers: int) -> int:
@@ -434,6 +469,17 @@ def _deploy_worker_fleet(args, workers: int) -> int:
         ]
         return ",".join(mine)
 
+    # exact fleet federation: with a collector to register with (or an
+    # explicit --metrics-port base), every worker gets its OWN sideband
+    # observability port — the shared SO_REUSEPORT serving port routes a
+    # scrape to an arbitrary worker, so it cannot enumerate the fleet
+    collector_url = getattr(args, "collector_url", None)
+    sideband_ports: list = []
+    if collector_url or getattr(args, "metrics_port", 0):
+        base = int(getattr(args, "metrics_port", 0) or 0)
+        for w in range(workers):
+            sideband_ports.append(base + w if base else _free_port(args.ip))
+
     def worker_cmd(w: int) -> list:
         cmd = [
             sys.executable, "-m", "predictionio_tpu.tools.cli",
@@ -454,6 +500,8 @@ def _deploy_worker_fleet(args, workers: int) -> int:
             cmd += ["--feedback"]
         if args.accesskey:
             cmd += ["--accesskey", args.accesskey]
+        if sideband_ports:
+            cmd += ["--metrics-port", str(sideband_ports[w])]
         devs = worker_devices(w)
         if devs is not None:
             cmd += ["--serving-device", devs]
@@ -479,6 +527,10 @@ def _deploy_worker_fleet(args, workers: int) -> int:
             + JsonHTTPServer.BIND_RETRIES * JsonHTTPServer.BIND_RETRY_DELAY_S
         ),
         on_started=on_started,
+        collector_url=collector_url,
+        worker_urls=[
+            f"http://{args.ip}:{p}" for p in sideband_ports
+        ] if collector_url else None,
     )
     if rc == 1:
         print(
@@ -564,6 +616,21 @@ def cmd_eventserver(args) -> int:
                     if (w > 0 or getattr(args, "no_compact", False))
                     else []
                 )
+                # per-worker sideband ports (base + slot): each worker
+                # individually scrapable for exact fleet federation
+                + (
+                    ["--metrics-port", str(args.metrics_port + w)]
+                    + (
+                        [
+                            "--metrics-access-key",
+                            args.metrics_access_key,
+                        ]
+                        if getattr(args, "metrics_access_key", "")
+                        else []
+                    )
+                    if getattr(args, "metrics_port", 0)
+                    else []
+                )
             )
             for w in range(workers)
         ]
@@ -622,6 +689,9 @@ def cmd_eventserver(args) -> int:
             transport=args.transport,
             compact=not getattr(args, "no_compact", False),
         )
+    )
+    _maybe_start_sideband(
+        args, access_key=getattr(args, "metrics_access_key", "") or ""
     )
     print(f"Event server serving on {args.ip}:{server.port}")
     server.serve_forever()
@@ -766,24 +836,38 @@ def cmd_storagecluster(args) -> int:
         f"write quorum={client.write_quorum}"
     )
     print(
-        f"{'NODE':<28} {'SLOT':>4} {'REPLICA-OF':<12} {'STATE':<8} STALE"
+        f"{'NODE':<28} {'SLOT':>4} {'REPLICA-OF':<12} {'STATE':<8} "
+        f"{'STALE':<6} {'AGE':>8} {'LAG':>8}"
     )
     for row in client.status():
         state = (
             "down" if not row["available"]
             else ("open" if row["breaker_open"] else "ok")
         )
+        # AGE = wall seconds out of the read path; LAG = the event-time
+        # gap to the resync source measured at the last resync attempt
+        age = f"{row['stale_age_s']:.0f}s" if row["stale"] else "-"
+        lag = (
+            f"{row['resync_lag_s']:.0f}s"
+            if row["stale"] and row["resync_lag_s"]
+            else "-"
+        )
         print(
             f"{row['url']:<28} {row['primary_slot']:>4} "
             f"{','.join(map(str, row['replica_slots'])):<12} "
-            f"{state:<8} {'yes' if row['stale'] else 'no'}"
+            f"{state:<8} {'yes' if row['stale'] else 'no':<6} "
+            f"{age:>8} {lag:>8}"
         )
     return 0
 
 
 def cmd_trace(args) -> int:
-    """Fetch a server's /debug/traces.json span dump and print it as an
-    indented span tree (see docs/OBSERVABILITY.md for the span model)."""
+    """Fetch a span dump and print it as an indented span tree (see
+    docs/OBSERVABILITY.md for the span model). ``--url`` reads ONE
+    server's /debug/traces.json ring; ``--collector`` reads a telemetry
+    collector's /api/traces.json — the fleet's spans STITCHED across
+    processes by trace id, each annotated with the process it was
+    pulled from."""
     import json as _json
     import urllib.parse as _up
     import urllib.request as _ur
@@ -793,11 +877,15 @@ def cmd_trace(args) -> int:
     params = {}
     if args.trace_id:
         params["traceId"] = args.trace_id
-    if args.access_key:
-        params["accessKey"] = args.access_key
-    if args.secret:
-        params["secret"] = args.secret
-    url = args.url.rstrip("/") + "/debug/traces.json"
+    collector = getattr(args, "collector", None)
+    if collector:
+        url = collector.rstrip("/") + "/api/traces.json"
+    else:
+        if args.access_key:
+            params["accessKey"] = args.access_key
+        if args.secret:
+            params["secret"] = args.secret
+        url = args.url.rstrip("/") + "/debug/traces.json"
     if params:
         url += "?" + _up.urlencode(params)
     try:
@@ -813,6 +901,19 @@ def cmd_trace(args) -> int:
     if args.json:
         print(_json.dumps(spans, indent=2))
         return 0
+    if collector:
+        # a stitched tree spans processes: show each span's origin
+        spans = [
+            {
+                **s,
+                "name": (
+                    f"{s['name']} [{s['instance']}]"
+                    if s.get("instance")
+                    else s["name"]
+                ),
+            }
+            for s in spans
+        ]
     # group by trace so unrelated requests don't interleave
     by_trace: dict = {}
     for s in spans:
@@ -821,6 +922,63 @@ def cmd_trace(args) -> int:
         print(f"trace {trace_id} ({len(group)} span(s)):")
         tree = format_trace(group)
         print("\n".join("  " + line for line in tree.splitlines()))
+    return 0
+
+
+def cmd_collector(args) -> int:
+    """``pio collector``: the fleet telemetry collector daemon
+    (tools/collector.py + utils/telemetry.py) — federated /metrics,
+    /api/fleet.json, cross-process /api/traces.json, and the SLO
+    burn-rate /api/alerts.json over the registered targets."""
+    from predictionio_tpu.tools.collector import CollectorServer
+    from predictionio_tpu.utils.telemetry import Collector, load_slos
+
+    targets = list(args.targets or [])
+    if args.targets_file:
+        try:
+            with open(args.targets_file, "r", encoding="utf-8") as f:
+                for line in f:
+                    line = line.strip()
+                    if line and not line.startswith("#"):
+                        targets.append(line)
+        except OSError as e:
+            raise CommandError(f"collector: {e}") from e
+    slos = None
+    if args.slo_file:
+        try:
+            slos = load_slos(args.slo_file)
+        except (OSError, ValueError) as e:
+            raise CommandError(f"collector: bad --slo-file: {e}") from e
+    try:
+        collector = Collector(
+            targets,
+            poll_interval_s=args.interval,
+            retention=args.retention,
+            slos=slos,
+            access_key=args.access_key or "",
+            secret=args.secret or "",
+        )
+        server = CollectorServer(
+            collector,
+            ip=args.ip,
+            port=args.port,
+            admin_secret=args.admin_secret or "",
+            transport=args.transport,
+        )
+    except ValueError as e:
+        raise CommandError(f"collector: {e}") from e
+    collector.start()
+    server.start()
+    print(
+        f"Telemetry collector serving on {args.ip}:{server.port} "
+        f"({len(collector.target_urls())} target(s), "
+        f"poll every {args.interval:g}s, "
+        f"{len(collector.slos)} SLO(s))"
+    )
+    try:
+        server.serve_forever()
+    finally:
+        collector.stop()
     return 0
 
 
@@ -880,14 +1038,22 @@ def cmd_replay(args) -> int:
 
 
 def cmd_top(args) -> int:
-    """Live fleet console over /metrics + /healthz + /readyz
-    (tools/top.py): one row per server URL, refreshed every --interval
-    seconds; --once prints a single frame (scripting/tests)."""
+    """Live fleet console (tools/top.py): one row per server URL,
+    refreshed every --interval seconds; --once prints a single frame
+    (scripting/tests). With ``--collector URL`` the whole fleet renders
+    from ONE endpoint — the collector's /api/fleet.json — instead of
+    per-server scrapes."""
     import signal
     import threading
 
     from predictionio_tpu.tools.top import run_top
 
+    collector = getattr(args, "collector", None)
+    if not collector and not args.url:
+        print(
+            "top: pass --url (repeatable) or --collector", file=sys.stderr
+        )
+        return 2
     stop = threading.Event()
 
     def _request_stop(signum, frame):
@@ -900,10 +1066,11 @@ def cmd_top(args) -> int:
             except ValueError:  # not the main thread (tests)
                 break
     return run_top(
-        args.url,
+        args.url or [],
         interval_s=args.interval,
         iterations=1 if args.once else None,
         stop_event=stop,
+        collector=collector,
     )
 
 
@@ -1263,6 +1430,15 @@ def build_parser() -> argparse.ArgumentParser:
         help="refuse to promote rounds that produced no shadow sample "
         "(default: promote — fresh deploys have no capture yet)",
     )
+    train.add_argument(
+        "--promote-collector-url",
+        help="telemetry collector base URL (pio collector): the "
+        "post-swap observation window reads the FLEET-wide federated "
+        "/metrics from it — error rate and hit rate across every "
+        "worker and the event server — instead of one process's "
+        "counters; size --promote-observe-s to at least two collector "
+        "poll intervals",
+    )
     train.set_defaults(func=cmd_train)
 
     ev = sub.add_parser("eval", help="run an evaluation")
@@ -1335,6 +1511,21 @@ def build_parser() -> argparse.ArgumentParser:
         "'0,1'; with --workers the list is dealt round-robin across "
         "workers (default: auto round-robin over all visible devices)",
     )
+    deploy.add_argument(
+        "--metrics-port", type=int, default=0,
+        help="also serve this process's /metrics + /healthz + /readyz + "
+        "/debug/traces.json on a dedicated sideband port — the "
+        "individually-scrapable address an SO_REUSEPORT worker needs "
+        "for exact fleet federation (0 disables; with --workers the "
+        "supervisor assigns one per worker automatically when "
+        "--collector-url is set)",
+    )
+    deploy.add_argument(
+        "--collector-url",
+        help="with --workers: telemetry collector base URL "
+        "(pio collector) to auto-register every worker's sideband "
+        "/metrics address with",
+    )
     deploy.set_defaults(func=cmd_deploy)
 
     undeploy = sub.add_parser("undeploy", help="stop a deployed server")
@@ -1365,6 +1556,19 @@ def build_parser() -> argparse.ArgumentParser:
         "--no-compact", action="store_true",
         help="disable the background segment compactor (cold event "
         "ranges stay in the row store; see 'pio compact')",
+    )
+    es.add_argument(
+        "--metrics-port", type=int, default=0,
+        help="also serve this process's observability surface on a "
+        "dedicated sideband port (api/sideband.py) — the "
+        "individually-scrapable address an SO_REUSEPORT worker needs "
+        "for exact fleet federation (0 disables)",
+    )
+    es.add_argument(
+        "--metrics-access-key", default="",
+        help="access key gating the sideband's /debug/traces.json "
+        "(required for a non-loopback --ip — the span dump carries "
+        "entity ids and timings)",
     )
     es.set_defaults(func=cmd_eventserver)
 
@@ -1451,6 +1655,12 @@ def build_parser() -> argparse.ArgumentParser:
     tr.add_argument(
         "--json", action="store_true", help="raw span JSON, not the tree"
     )
+    tr.add_argument(
+        "--collector", default="",
+        help="telemetry collector base URL: read the fleet's STITCHED "
+        "cross-process spans from its /api/traces.json instead of one "
+        "server's ring (each span shows the process it came from)",
+    )
     tr.set_defaults(func=cmd_trace)
 
     rp = sub.add_parser(
@@ -1493,9 +1703,15 @@ def build_parser() -> argparse.ArgumentParser:
         help="live console over a fleet's /metrics + /healthz + /readyz",
     )
     top.add_argument(
-        "--url", action="append", required=True,
+        "--url", action="append",
         help="server base URL (repeatable: one row per server — event "
         "servers, engine servers, storage gateways, any mix)",
+    )
+    top.add_argument(
+        "--collector", default="",
+        help="telemetry collector base URL: render the WHOLE fleet "
+        "from its /api/fleet.json (one endpoint, SLO alert footer) "
+        "instead of per-server scrapes",
     )
     top.add_argument(
         "--interval", type=float, default=2.0,
@@ -1506,6 +1722,58 @@ def build_parser() -> argparse.ArgumentParser:
         help="print one frame and exit (scripting)",
     )
     top.set_defaults(func=cmd_top)
+
+    col = sub.add_parser(
+        "collector",
+        help="fleet telemetry collector: federated /metrics, "
+        "cross-process trace stitching, SLO burn-rate alerts",
+    )
+    col.add_argument("--ip", default="localhost")
+    col.add_argument("--port", type=int, default=7078)
+    col.add_argument(
+        "--targets", action="append", default=None,
+        help="fleet process base URL to poll (repeatable); every "
+        "worker needs its OWN address — give SO_REUSEPORT workers "
+        "sideband ports via --metrics-port / --collector-url",
+    )
+    col.add_argument(
+        "--targets-file",
+        help="file of target URLs, one per line (# comments allowed)",
+    )
+    col.add_argument(
+        "--interval", type=float, default=2.0,
+        help="seconds between poll sweeps (default 2)",
+    )
+    col.add_argument(
+        "--retention", type=int, default=360,
+        help="exposition snapshots retained per target (default 360 ≈ "
+        "12 min at the default interval; size to cover the slowest "
+        "SLO window for full-fidelity slow burns)",
+    )
+    col.add_argument(
+        "--slo-file",
+        help="JSON list of SLO declarations (utils/telemetry.SLODef "
+        "fields; default: the stock serving-availability / "
+        "serving-latency / ingest-errors SLOs)",
+    )
+    col.add_argument(
+        "--access-key", default="",
+        help="access key forwarded on span pulls (event/engine servers "
+        "gate /debug/traces.json behind it)",
+    )
+    col.add_argument(
+        "--secret", default="",
+        help="shared secret forwarded on span pulls (storage gateways)",
+    )
+    col.add_argument(
+        "--admin-secret", default="",
+        help="gate POST /api/targets registration (required for "
+        "non-loopback --ip)",
+    )
+    col.add_argument(
+        "--transport", choices=("async", "threaded"), default="async",
+    )
+    col.set_defaults(func=cmd_collector)
 
     admin = sub.add_parser("adminserver", help="start the admin server")
     admin.add_argument("--ip", default="localhost")

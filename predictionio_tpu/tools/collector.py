@@ -1,0 +1,179 @@
+"""``pio collector`` — the fleet telemetry collector daemon.
+
+The HTTP face of :class:`utils.telemetry.Collector`: one standalone
+process polls every fleet member's existing public endpoints
+(``/metrics``, ``/healthz``, ``/readyz``, ``/debug/traces.json``) and
+serves the merged operator view:
+
+- ``GET  /metrics``          — FEDERATED fleet exposition (counters and
+  histogram buckets summed exactly across targets, gauges per-instance
+  via an added ``instance`` label) plus the collector's own families;
+- ``GET  /api/fleet.json``   — per-target and fleet-level rates and
+  p50/p99-over-time computed from snapshot deltas (``?window=S``);
+- ``GET  /api/traces.json``  — cross-process stitched spans
+  (``?traceId=…&limit=N``), rendered by ``pio trace --collector``;
+- ``GET  /api/alerts.json``  — the SLO burn-rate report and firing
+  alerts;
+- ``GET  /api/targets.json`` / ``POST /api/targets`` (``{"url": …}``)
+  — the target registry; ``tools/fleet.py`` auto-registers its workers
+  here;
+- ``GET  /healthz`` / ``GET /readyz`` — the collector's own health
+  (ready = the poll loop scraped something recently and is not
+  stalled).
+
+Binding a non-loopback interface without ``--admin-secret`` refuses
+(the gateway's posture): the stitched span dump aggregates every fleet
+member's gated debug surface.
+"""
+
+from __future__ import annotations
+
+import hmac
+import json
+import logging
+from typing import Optional
+
+from predictionio_tpu.api.aio_http import TRANSPORTS, make_http_server
+from predictionio_tpu.utils import health as _health
+from predictionio_tpu.utils import metrics as _metrics
+from predictionio_tpu.utils.telemetry import Collector
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["CollectorServer", "DEFAULT_PORT"]
+
+DEFAULT_PORT = 7078  # beside the storage gateway's 7077
+
+_LOOPBACK_IPS = ("localhost", "127.0.0.1", "::1")
+
+
+class CollectorServer:
+    """The collector's HTTP frontend. Handlers are pure reads of the
+    Collector's in-memory state (no storage, no network), so they run
+    inline on the event loop like the sideband's."""
+
+    def __init__(
+        self,
+        collector: Collector,
+        ip: str = "localhost",
+        port: int = DEFAULT_PORT,
+        admin_secret: str = "",
+        transport: str = "async",
+    ):
+        if not admin_secret and ip not in _LOOPBACK_IPS:
+            raise ValueError(
+                f"refusing to bind the collector on {ip!r} without "
+                "--admin-secret: the stitched span dump aggregates every "
+                "fleet member's gated debug surface"
+            )
+        if transport not in TRANSPORTS:
+            raise ValueError(
+                f"unknown transport {transport!r} "
+                f"(expected one of {TRANSPORTS})"
+            )
+        self.collector = collector
+        self.admin_secret = admin_secret
+        self._http = make_http_server(
+            self._handle, ip, port, "Collector", transport=transport
+        )
+        # ready = the poll loop delivered a scrape recently; the margin
+        # covers one slow sweep over a fleet with a dead member
+        self._ready_probe = _health.TTLProbe("poll", self._probe_poll)
+
+    def _probe_poll(self) -> None:
+        age = self.collector.last_poll_age_s()
+        budget = max(10.0, 3.0 * self.collector.poll_interval_s)
+        if not self.collector.target_urls():
+            return  # an empty registry is idle, not broken
+        if age is None:
+            raise RuntimeError("no target scraped yet")
+        if age > budget:
+            raise RuntimeError(
+                f"newest scrape is {age:.1f}s old (budget {budget:.1f}s)"
+            )
+
+    def _authorized(self, query, payload: Optional[dict] = None) -> bool:
+        if not self.admin_secret:
+            return True
+        given = (query or {}).get("secret", "")
+        if not given and payload:
+            given = str(payload.get("secret") or "")
+        return hmac.compare_digest(given, self.admin_secret)
+
+    def _handle(self, method, path, query, body, form=None, headers=None):
+        c = self.collector
+        if path == "/healthz" and method == "GET":
+            return 200, _health.liveness()
+        if path == "/readyz" and method == "GET":
+            ok, payload = _health.readiness((self._ready_probe,))
+            return (200 if ok else 503), payload
+        if path == "/metrics" and method == "GET":
+            return 200, self._render_metrics(), _metrics.render_content_type()
+        if path == "/api/fleet.json" and method == "GET":
+            try:
+                window_s = float((query or {}).get("window", 60.0))
+            except (TypeError, ValueError):
+                return 400, {"message": "invalid window"}
+            return 200, c.fleet_json(window_s=window_s)
+        if path == "/api/traces.json" and method == "GET":
+            q = query or {}
+            try:
+                limit = int(q.get("limit", 4096))
+            except (TypeError, ValueError):
+                return 400, {"message": "invalid limit"}
+            return 200, c.traces_json(q.get("traceId") or None, limit)
+        if path == "/api/alerts.json" and method == "GET":
+            return 200, c.alerts_json()
+        if path == "/api/targets.json" and method == "GET":
+            return 200, {"targets": c.target_urls()}
+        if path == "/api/targets" and method == "POST":
+            try:
+                payload = json.loads((body or b"{}").decode("utf-8"))
+            except (ValueError, UnicodeDecodeError) as e:
+                return 400, {"message": f"invalid JSON body: {e}"}
+            if not isinstance(payload, dict):
+                return 400, {"message": "body must be a JSON object"}
+            if not self._authorized(query, payload):
+                return 401, {"message": "invalid or missing secret"}
+            url = str(payload.get("url") or "")
+            if not url:
+                return 400, {"message": "missing url"}
+            try:
+                if payload.get("remove"):
+                    removed = c.remove_target(url)
+                    return 200, {
+                        "removed": removed, "targets": c.target_urls()
+                    }
+                added = c.add_target(url)
+            except ValueError as e:
+                return 400, {"message": str(e)}
+            return 200, {"added": added, "targets": c.target_urls()}
+        return 404, {"message": f"unknown route {method} {path}"}
+
+    def _render_metrics(self) -> str:
+        """Federated fleet families first, then this process's OWN
+        families (``pio_collector_*``, the SLO gauges, heartbeats) that
+        federation did not already cover — one HELP/TYPE per family
+        name, so the output stays valid exposition even when an
+        operator registers the collector as its own target."""
+        federated = self.collector.federated_families()
+        lines = [self.collector.render_federated().rstrip("\n")]
+        for fam in _metrics.get_registry().families():
+            if fam.name in federated:
+                continue
+            lines.extend(fam.render())
+        return "\n".join(line for line in lines if line) + "\n"
+
+    @property
+    def port(self) -> int:
+        return self._http.port
+
+    def start(self) -> "CollectorServer":
+        self._http.start()
+        return self
+
+    def serve_forever(self) -> None:
+        self._http.serve_forever()
+
+    def shutdown(self) -> None:
+        self._http.shutdown()

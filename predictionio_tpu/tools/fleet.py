@@ -29,16 +29,57 @@ event, terminate the children, and the supervisor returns.
 
 from __future__ import annotations
 
+import json
 import logging
 import threading
 import time
-from typing import Callable, List, Optional
+import urllib.request
+from typing import Callable, List, Optional, Sequence
 
 from predictionio_tpu.utils import metrics as _metrics
 
 logger = logging.getLogger(__name__)
 
-__all__ = ["run_worker_fleet"]
+__all__ = ["register_fleet_targets", "run_worker_fleet"]
+
+
+def register_fleet_targets(
+    collector_url: str,
+    worker_urls: Sequence[str],
+    timeout_s: float = 5.0,
+    admin_secret: str = "",
+) -> int:
+    """Register every worker's scrape address with a local telemetry
+    collector (``POST /api/targets`` — idempotent, so supervisor
+    restarts re-register harmlessly). Returns how many registrations
+    succeeded; failures log and never fail the fleet — a collector
+    being down is an observability gap, not a serving outage."""
+    ok = 0
+    base = collector_url.rstrip("/")
+    for url in worker_urls:
+        payload: dict = {"url": url}
+        if admin_secret:
+            payload["secret"] = admin_secret
+        req = urllib.request.Request(
+            base + "/api/targets",
+            data=json.dumps(payload).encode("utf-8"),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=timeout_s):
+                ok += 1
+        except Exception as e:
+            logger.warning(
+                "collector registration of %s with %s failed: %s",
+                url, collector_url, e,
+            )
+    if ok:
+        logger.info(
+            "registered %d/%d worker(s) with collector %s",
+            ok, len(worker_urls), collector_url,
+        )
+    return ok
 
 
 def _restarts_counter() -> "_metrics.Counter":
@@ -63,6 +104,8 @@ def run_worker_fleet(
     stop_event: Optional[threading.Event] = None,
     install_signal_handlers: bool = True,
     on_started: Optional[Callable[[], None]] = None,
+    collector_url: Optional[str] = None,
+    worker_urls: Optional[Sequence[str]] = None,
 ) -> int:
     """Spawn ``workers`` processes via ``spawn(slot)`` and supervise
     them until shutdown. Returns the fleet's exit code (0 on a clean
@@ -126,6 +169,11 @@ def run_worker_fleet(
         return rc
     if on_started is not None:
         on_started()
+    if collector_url and worker_urls:
+        # auto-register each worker's sideband scrape address with the
+        # local telemetry collector (idempotent; failure is logged, not
+        # fatal — see register_fleet_targets)
+        register_fleet_targets(collector_url, worker_urls)
 
     rc = 0
     # per-slot pending-restart deadlines: backoff is tracked, never

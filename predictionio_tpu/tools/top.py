@@ -18,27 +18,19 @@ renders as ``down`` instead of killing the console.
 from __future__ import annotations
 
 import json
-import re
 import threading
 import urllib.error
 import urllib.request
 from typing import Dict, List, Optional
 
 from predictionio_tpu.utils.metrics import (
+    counter_sum,
+    gauge_max,
+    histogram_quantile_from_samples as histogram_quantile,
     parse_exposition,
-    quantile_from_buckets,
+    sample_family_name as _family_name,
+    sample_label_value as _label_value,
 )
-
-_LE_RE = re.compile(r'le="([^"]+)"')
-
-
-def _family_name(sample_key: str) -> str:
-    return sample_key.split("{", 1)[0]
-
-
-def _label_value(sample_key: str, label: str) -> Optional[str]:
-    m = re.search(rf'{label}="([^"]*)"', sample_key)
-    return m.group(1) if m else None
 
 
 def active_model_version(samples: Dict[str, float]) -> Optional[str]:
@@ -68,46 +60,6 @@ def attributed_hit_rate(samples: Dict[str, float]) -> Optional[float]:
             missed += value
     denom = converted + missed
     return (converted / denom) if denom else None
-
-
-def counter_sum(samples: Dict[str, float], family: str) -> float:
-    """Sum a counter family across its label sets."""
-    total = 0.0
-    for key, value in samples.items():
-        if _family_name(key) == family:
-            total += value
-    return total
-
-
-def gauge_max(samples: Dict[str, float], family: str) -> Optional[float]:
-    vals = [v for k, v in samples.items() if _family_name(k) == family]
-    return max(vals) if vals else None
-
-
-def histogram_quantile(
-    samples: Dict[str, float], family: str, q: float
-) -> Optional[float]:
-    """Quantile from the exposition's cumulative ``_bucket`` samples,
-    summed across label sets (bounds are fixed per family, so cumulative
-    vectors add — the SO_REUSEPORT merge property)."""
-    by_le: Dict[float, float] = {}
-    for key, value in samples.items():
-        if _family_name(key) != f"{family}_bucket":
-            continue
-        m = _LE_RE.search(key)
-        if not m:
-            continue
-        le = m.group(1)
-        bound = float("inf") if le == "+Inf" else float(le)
-        by_le[bound] = by_le.get(bound, 0.0) + value
-    if not by_le:
-        return None
-    bounds = sorted(b for b in by_le if b != float("inf"))
-    cum = [by_le[b] for b in bounds] + [by_le.get(float("inf"), 0.0)]
-    counts = [int(c - (cum[i - 1] if i else 0.0)) for i, c in enumerate(cum)]
-    if sum(counts) <= 0:
-        return None
-    return quantile_from_buckets(bounds, counts, q)
 
 
 def fetch_server(base_url: str, timeout: float = 5.0) -> dict:
@@ -219,9 +171,17 @@ def _row(snap: dict, prev: Optional[dict], elapsed_s: float) -> dict:
     ]
     if node_up:
         stale = int(counter_sum(m, "pio_cluster_node_stale"))
-        row["nodes"] = f"{int(sum(node_up))}/{len(node_up)}" + (
-            f"+{stale}s" if stale else ""
-        )
+        detail = ""
+        if stale:
+            # how long the worst replica has been out of the read path,
+            # and its measured event-time lag to the resync source —
+            # "+1s(34s/12s)" = 1 stale node, stale 34s, 12s behind
+            age = gauge_max(m, "pio_cluster_stale_age_seconds") or 0.0
+            lag = gauge_max(m, "pio_cluster_resync_lag_seconds") or 0.0
+            detail = f"+{stale}s({age:.0f}s" + (
+                f"/{lag:.0f}s)" if lag else ")"
+            )
+        row["nodes"] = f"{int(sum(node_up))}/{len(node_up)}" + detail
     # fleet-supervisor column (tools/fleet.py): crashed workers the
     # supervisor restarted — present when the scraped process runs a
     # supervised `pio deploy --workers` fleet
@@ -280,6 +240,76 @@ def render(rows: List[dict]) -> str:
     return "\n".join(lines)
 
 
+def fetch_fleet(collector_url: str, timeout: float = 5.0) -> dict:
+    """One /api/fleet.json snapshot from a telemetry collector
+    (utils/telemetry.py); degrades to ``{"error": …}`` so the console
+    keeps rendering when the collector is down."""
+    url = collector_url.rstrip("/") + "/api/fleet.json"
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return json.loads(r.read().decode("utf-8"))
+    except Exception as e:
+        return {"error": str(e), "targets": []}
+
+
+def _row_from_fleet(t: dict) -> dict:
+    """Map one fleet.json target entry onto the console's columns —
+    rates and windowed quantiles come from the collector's retention
+    ring, so the console needs no scrape-to-scrape diffing of its own."""
+    if not t.get("up"):
+        row = {"url": t.get("url", "?"), "live": "DOWN", "ready": "-"}
+        return row
+    row = {
+        "url": t["url"],
+        "live": "ok",
+        "ready": (
+            "ok" if t.get("ready")
+            else ("503" if t.get("ready") is False else "-")
+        ),
+        "uptime_s": t.get("uptime_s"),
+        "requests": t.get("requests"),
+        "rate": t.get("rate"),
+        "errors": t.get("errors"),
+    }
+    # prefer the windowed (over-time) quantiles; lifetime as fallback
+    p50 = t.get("window_p50_ms", t.get("p50_ms"))
+    if p50 is not None:
+        row["p50_ms"] = p50
+        row["p99_ms"] = t.get("window_p99_ms", t.get("p99_ms"))
+    return row
+
+
+def render_fleet(fleet: dict) -> str:
+    """A collector-fed frame: the per-target table plus an SLO footer
+    (burn rates per window; firing alerts called out)."""
+    lines = [render([_row_from_fleet(t) for t in fleet.get("targets", [])])]
+    if fleet.get("error"):
+        lines.append(f"collector unreachable: {fleet['error']}")
+    f = fleet.get("fleet") or {}
+    if f:
+        parts = [f"fleet: {f.get('up', 0)}/{f.get('targets', 0)} up"]
+        if f.get("rate") is not None:
+            parts.append(f"{f['rate']:.1f} req/s")
+        if f.get("window_p99_ms") is not None:
+            parts.append(f"window p99 {f['window_p99_ms']:.2f}ms")
+        elif f.get("p99_ms") is not None:
+            parts.append(f"p99 {f['p99_ms']:.2f}ms")
+        lines.append("  ".join(parts))
+    slos = fleet.get("slos") or []
+    if slos:
+        rendered = []
+        for s in slos:
+            w = s.get("windows", {})
+            fast = (w.get("fast") or {}).get("burn_rate")
+            slow = (w.get("slow") or {}).get("burn_rate")
+            tag = " FIRING" if s.get("firing") else ""
+            rendered.append(
+                f"{s['slo']} burn fast={fast} slow={slow}{tag}"
+            )
+        lines.append("slo: " + "; ".join(rendered))
+    return "\n".join(lines)
+
+
 def run_top(
     urls: List[str],
     interval_s: float = 2.0,
@@ -287,11 +317,14 @@ def run_top(
     stop_event: Optional[threading.Event] = None,
     out=None,
     clear: bool = True,
+    collector: Optional[str] = None,
 ) -> int:
     """The console loop: scrape, diff against the previous scrape for
     rates, render. ``iterations=1`` is the scriptable one-shot
     (``pio top --once``); interactive runs clear the screen per frame
-    and stop on the event (wired to SIGINT/SIGTERM by the CLI)."""
+    and stop on the event (wired to SIGINT/SIGTERM by the CLI). With
+    ``collector`` set, the whole fleet renders from that collector's
+    /api/fleet.json instead of per-server scrapes."""
     import sys
     import time
 
@@ -301,22 +334,26 @@ def run_top(
     prev_t: Optional[float] = None
     n = 0
     while not stop.is_set():
-        snaps = [fetch_server(u) for u in urls]
-        # rates use the MEASURED time between scrape cycles, not the
-        # nominal interval: slow scrapes (a DOWN member eating its
-        # connect timeout) must not inflate every other server's REQ/S
-        now = time.monotonic()
-        elapsed_s = (now - prev_t) if prev_t is not None else 0.0
-        prev_t = now
-        rows = [
-            _row(s, prev.get(s["url"]), elapsed_s) for s in snaps
-        ]
-        frame = render(rows)
+        if collector:
+            frame = render_fleet(fetch_fleet(collector))
+        else:
+            snaps = [fetch_server(u) for u in urls]
+            # rates use the MEASURED time between scrape cycles, not the
+            # nominal interval: slow scrapes (a DOWN member eating its
+            # connect timeout) must not inflate every other server's
+            # REQ/S
+            now = time.monotonic()
+            elapsed_s = (now - prev_t) if prev_t is not None else 0.0
+            prev_t = now
+            rows = [
+                _row(s, prev.get(s["url"]), elapsed_s) for s in snaps
+            ]
+            frame = render(rows)
+            prev = {s["url"]: s for s in snaps}
         if clear and iterations != 1:
             out.write("\x1b[2J\x1b[H")
         out.write(frame + "\n")
         out.flush()
-        prev = {s["url"]: s for s in snaps}
         n += 1
         if iterations is not None and n >= iterations:
             break

@@ -54,6 +54,13 @@ __all__ = [
     "quantile_from_buckets",
     "merge_snapshots",
     "parse_exposition",
+    "parse_exposition_families",
+    "parse_labels",
+    "sample_family_name",
+    "sample_label_value",
+    "counter_sum",
+    "gauge_max",
+    "histogram_quantile_from_samples",
     "render_content_type",
     "LATENCY_BUCKETS_S",
     "BATCH_SIZE_BUCKETS",
@@ -599,6 +606,173 @@ def parse_exposition(text: str) -> Dict[str, float]:
         except ValueError:
             continue
     return out
+
+
+# --- exposition analysis helpers (fleet consumers) ---
+#
+# Shared by `pio top`, the telemetry collector (utils/telemetry.py), and
+# bench.py: everything a scrape CONSUMER needs to turn raw exposition
+# text back into typed samples, per-family sums, and reconstructed
+# quantiles. Kept here (not in tools/) because the collector tier is
+# library code.
+
+_LABEL_PAIR_RE = re.compile(
+    r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:\\.|[^"\\])*)"'
+)
+
+_UNESCAPE = {"\\\\": "\\", '\\"': '"', "\\n": "\n"}
+
+
+def _unescape_label_value(v: str) -> str:
+    out: List[str] = []
+    i = 0
+    while i < len(v):
+        pair = v[i : i + 2]
+        if pair in _UNESCAPE:
+            out.append(_UNESCAPE[pair])
+            i += 2
+        else:
+            out.append(v[i])
+            i += 1
+    return "".join(out)
+
+
+def sample_family_name(sample_key: str) -> str:
+    """``pio_foo_total{a="b"}`` → ``pio_foo_total``."""
+    return sample_key.split("{", 1)[0]
+
+
+def sample_label_value(sample_key: str, label: str) -> Optional[str]:
+    """One label's (still-escaped) value from a rendered sample key."""
+    m = re.search(rf'{label}="((?:\\.|[^"\\])*)"', sample_key)
+    return m.group(1) if m else None
+
+
+def parse_labels(sample_key: str) -> Tuple[Tuple[str, str], ...]:
+    """The label set of a rendered sample key as ordered (name, value)
+    pairs, with exposition escapes undone — the representation the
+    federation layer merges and re-renders on."""
+    if "{" not in sample_key:
+        return ()
+    body = sample_key.split("{", 1)[1].rsplit("}", 1)[0]
+    return tuple(
+        (name, _unescape_label_value(value))
+        for name, value in _LABEL_PAIR_RE.findall(body)
+    )
+
+
+def parse_exposition_families(text: str) -> "Dict[str, dict]":
+    """Parse Prometheus text into typed families::
+
+        {family: {"kind": "counter"|"gauge"|"histogram"|"untyped",
+                  "help": str,
+                  "samples": [(sample_name, labels, value), ...]}}
+
+    ``sample_name`` keeps histogram suffixes (``_bucket``/``_sum``/
+    ``_count``) and ``labels`` is the ordered, unescaped pair tuple from
+    :func:`parse_labels`. This is the typed complement of
+    :func:`parse_exposition` — the federation layer needs the TYPE line
+    to know whether samples sum (counters, histogram buckets) or keep
+    per-instance identity (gauges)."""
+    families: Dict[str, dict] = {}
+
+    def family_for(sample_name: str) -> dict:
+        # histogram samples carry suffixes; map them onto their family
+        base = sample_name
+        for suffix in ("_bucket", "_sum", "_count"):
+            if sample_name.endswith(suffix):
+                candidate = sample_name[: -len(suffix)]
+                if candidate in families:
+                    base = candidate
+                    break
+        fam = families.get(base)
+        if fam is None:
+            fam = families[base] = {
+                "kind": "untyped", "help": "", "samples": [],
+            }
+        return fam
+
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("# HELP "):
+            parts = line.split(" ", 3)
+            if len(parts) >= 3:
+                fam = families.setdefault(
+                    parts[2], {"kind": "untyped", "help": "", "samples": []}
+                )
+                fam["help"] = parts[3] if len(parts) > 3 else ""
+            continue
+        if line.startswith("# TYPE "):
+            parts = line.split(" ", 3)
+            if len(parts) >= 4:
+                fam = families.setdefault(
+                    parts[2], {"kind": "untyped", "help": "", "samples": []}
+                )
+                fam["kind"] = parts[3]
+            continue
+        if line.startswith("#"):
+            continue
+        idx = line.rfind(" ")
+        if idx <= 0:
+            continue
+        key, raw_value = line[:idx].strip(), line[idx + 1 :]
+        try:
+            value = float(raw_value)
+        except ValueError:
+            continue
+        sample_name = sample_family_name(key)
+        family_for(sample_name)["samples"].append(
+            (sample_name, parse_labels(key), value)
+        )
+    return families
+
+
+def counter_sum(samples: Dict[str, float], family: str) -> float:
+    """Sum a counter family across its label sets (flat
+    :func:`parse_exposition` samples)."""
+    total = 0.0
+    for key, value in samples.items():
+        if sample_family_name(key) == family:
+            total += value
+    return total
+
+
+def gauge_max(samples: Dict[str, float], family: str) -> Optional[float]:
+    vals = [
+        v for k, v in samples.items() if sample_family_name(k) == family
+    ]
+    return max(vals) if vals else None
+
+
+_LE_RE = re.compile(r'le="([^"]+)"')
+
+
+def histogram_quantile_from_samples(
+    samples: Dict[str, float], family: str, q: float
+) -> Optional[float]:
+    """Quantile from the exposition's cumulative ``_bucket`` samples,
+    summed across label sets (bounds are fixed per family, so cumulative
+    vectors add — the SO_REUSEPORT merge property)."""
+    by_le: Dict[float, float] = {}
+    for key, value in samples.items():
+        if sample_family_name(key) != f"{family}_bucket":
+            continue
+        m = _LE_RE.search(key)
+        if not m:
+            continue
+        le = m.group(1)
+        bound = float("inf") if le == "+Inf" else float(le)
+        by_le[bound] = by_le.get(bound, 0.0) + value
+    if not by_le:
+        return None
+    bounds = sorted(b for b in by_le if b != float("inf"))
+    cum = [by_le[b] for b in bounds] + [by_le.get(float("inf"), 0.0)]
+    counts = [int(c - (cum[i - 1] if i else 0.0)) for i, c in enumerate(cum)]
+    if sum(counts) <= 0:
+        return None
+    return quantile_from_buckets(bounds, counts, q)
 
 
 # THE process-global registry (one per worker process; an SO_REUSEPORT

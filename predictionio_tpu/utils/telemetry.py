@@ -1,0 +1,992 @@
+"""Fleet-wide telemetry plane: metrics federation, trace stitching, SLOs.
+
+PRs 13–14 turned the system into a real fleet — SO_REUSEPORT serving
+workers under a supervisor, N replicated storage-gateway nodes, a
+continuous retrain→swap loop — but observability stayed per-process:
+spans lived in each process's bounded ring, ``/metrics`` had to be
+scraped and merged by hand per target, nothing retained history, and
+the promotion observation window judged rollback from the one process
+it could see. This module is the collector tier that closes the gap
+(the multi-host serving deployment shape of the ALX paper,
+arXiv:2112.02194, is what it targets; the reference delegated all of
+this to external dashboards):
+
+- **Federated metrics.** The :class:`Collector` polls every fleet
+  process's existing ``/metrics`` endpoint into a bounded per-target
+  ring of timestamped exposition snapshots, and merges the LATEST
+  snapshots exactly: counters and cumulative histogram bucket vectors
+  SUM across targets (PR 6's fixed-bucket invariant — a fleet's merged
+  p99 equals a single combined worker's), while gauges keep per-target
+  identity via an added ``instance`` label so `pio_host_rss_bytes`
+  from three workers never falsely sums into one number.
+- **Cross-process trace stitching.** Spans are pulled INCREMENTALLY
+  from every target's ring (``/debug/traces.json?since=<seq>``, the
+  per-process span-sequence cursor in utils/tracing.py) and joined by
+  trace id into one tree — the ``X-PIO-Trace-Id``/``X-PIO-Parent-Span``
+  chain already crosses http→batch→predict→feedback→ingest→gateway→
+  committer, so one user request finally renders as ONE end-to-end
+  trace across the engine worker, the event server, and the cluster
+  node that committed the write.
+- **SLO burn-rate engine.** Declarative SLOs (serving availability,
+  serving latency, ingest error rate) are evaluated over fast/slow
+  windows from the retention ring — the standard multiwindow
+  burn-rate method: ``burn = bad_fraction / error_budget``; an alert
+  fires only when BOTH windows burn above threshold (fast-only =
+  blips, slow-only = a fire that already ended). Exposed as
+  ``pio_slo_burn_rate{slo,window}`` + ``pio_slo_alert{slo}`` and the
+  collector's ``/api/alerts.json``; the PR 13 promotion observation
+  window can consult the collector (``--promote-collector-url``) for
+  the FLEET-wide post-swap error rate instead of one process's
+  counters.
+
+The HTTP daemon wrapping this class lives in ``tools/collector.py``
+(``pio collector``); this module is transport-free so tests and the
+promotion pipeline can drive a Collector in-process.
+
+Like utils/metrics.py and utils/tracing.py, the collector records its
+own operational families (``pio_collector_*``) into the process-global
+registry — a collector is itself a scrapable fleet member.
+"""
+
+from __future__ import annotations
+
+import collections
+import concurrent.futures
+import dataclasses
+import json
+import logging
+import math
+import threading
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from predictionio_tpu.utils import health as _health
+from predictionio_tpu.utils import metrics as _metrics
+
+logger = logging.getLogger(__name__)
+
+__all__ = [
+    "Collector",
+    "SLODef",
+    "default_slos",
+    "load_slos",
+    "DEFAULT_POLL_INTERVAL_S",
+    "DEFAULT_RETENTION",
+    "DEFAULT_SPAN_RETENTION",
+]
+
+# snapshots kept per target: at the default 2 s poll interval this is
+# ~12 minutes of history — enough for a 5-minute fast window plus slack;
+# size the ring to cover the SLOW window for full-fidelity slow burns
+# (docs/OBSERVABILITY.md's sizing table)
+DEFAULT_RETENTION = 360
+DEFAULT_POLL_INTERVAL_S = 2.0
+# stitched spans kept collector-wide (each target's own ring holds 4096)
+DEFAULT_SPAN_RETENTION = 32768
+
+# the collector's poll loop heartbeat: a wedged scrape sweep (every
+# target timing out serially) must degrade the collector's /readyz
+COLLECTOR_DEADLINE_S = 120.0
+
+
+# --- SLO declarations ---
+
+SLO_KINDS = ("availability", "latency", "ingest_error_rate")
+
+
+@dataclasses.dataclass(frozen=True)
+class SLODef:
+    """One declarative SLO evaluated from the retention ring.
+
+    ``objective`` is the GOOD fraction target (0.999 = "99.9% of
+    serving requests succeed"); the error budget is ``1 - objective``
+    and ``burn_rate = bad_fraction / error_budget`` — burn 1.0 spends
+    the budget exactly at the objective's natural rate, burn 14.4 (the
+    classic fast-page threshold) exhausts a 30-day budget in ~2 days.
+
+    Kinds:
+
+    - ``availability``: bad = engine-server 5xx ∕ (serving requests
+      + those 5xx), from ``pio_http_errors_total`` and
+      ``pio_serving_requests_total`` window deltas;
+    - ``latency``: bad = serving requests slower than
+      ``latency_threshold_s``, from ``pio_serving_latency_seconds``
+      bucket deltas (the threshold is clamped UP to the nearest fixed
+      bucket bound — a threshold past the largest finite bound clamps
+      DOWN to it — so the fraction is exact, never interpolated; the
+      default 0.2048 IS a bound of the fixed ladder, so the declared
+      and enforced thresholds coincide);
+    - ``ingest_error_rate``: bad = event-server 5xx ∕ (ingested events
+      + those 5xx).
+    """
+
+    name: str
+    kind: str
+    objective: float = 0.999
+    # a bound of LATENCY_BUCKETS_S (1e-4 x 2^11), so the enforced
+    # threshold equals the declared one with no clamping surprise
+    latency_threshold_s: float = 0.2048
+    fast_window_s: float = 300.0
+    slow_window_s: float = 3600.0
+    burn_threshold: float = 14.4
+
+    def __post_init__(self):
+        if self.kind not in SLO_KINDS:
+            raise ValueError(
+                f"unknown SLO kind {self.kind!r} (expected one of "
+                f"{SLO_KINDS})"
+            )
+        if not (0.0 < self.objective < 1.0):
+            raise ValueError("SLO objective must be in (0, 1)")
+        if self.fast_window_s <= 0 or self.slow_window_s < self.fast_window_s:
+            raise ValueError("need 0 < fast_window_s <= slow_window_s")
+
+
+def default_slos() -> Tuple[SLODef, ...]:
+    """The stock fleet SLOs (docs/OBSERVABILITY.md documents each)."""
+    return (
+        SLODef(name="serving-availability", kind="availability",
+               objective=0.999),
+        SLODef(name="serving-latency", kind="latency", objective=0.99,
+               latency_threshold_s=0.2048),
+        SLODef(name="ingest-errors", kind="ingest_error_rate",
+               objective=0.999),
+    )
+
+
+def load_slos(path: str) -> Tuple[SLODef, ...]:
+    """Load SLO declarations from a JSON file: a list of objects whose
+    keys are :class:`SLODef` fields (``name`` and ``kind`` required)."""
+    with open(path, "r", encoding="utf-8") as f:
+        raw = json.load(f)
+    if not isinstance(raw, list):
+        raise ValueError("SLO file must hold a JSON list of objects")
+    out = []
+    valid = {f.name for f in dataclasses.fields(SLODef)}
+    for i, item in enumerate(raw):
+        if not isinstance(item, dict):
+            raise ValueError(f"SLO entry {i} is not an object")
+        unknown = set(item) - valid
+        if unknown:
+            raise ValueError(
+                f"SLO entry {i} has unknown keys {sorted(unknown)}"
+            )
+        out.append(SLODef(**item))
+    return tuple(out)
+
+
+# --- per-target state ---
+
+
+def _instance_label(url: str) -> str:
+    """``http://host:7070`` → ``host:7070`` — the ``instance`` label
+    value federated gauges carry (mirrors Prometheus's convention)."""
+    parsed = urllib.parse.urlsplit(url if "//" in url else f"//{url}")
+    label = parsed.netloc or url
+    if parsed.path and parsed.path != "/":
+        label += parsed.path
+    return label
+
+
+class _TargetState:
+    """One fleet process under observation: its snapshot ring, span
+    cursor, and last health/readiness verdicts. All mutation happens on
+    the collector's poll thread; readers take the collector lock."""
+
+    def __init__(self, url: str, retention: int):
+        self.url = url.rstrip("/")
+        self.instance = _instance_label(self.url)
+        # (wall-clock seconds, flat parse_exposition samples)
+        self.ring: "collections.deque" = collections.deque(maxlen=retention)
+        # typed families of the NEWEST snapshot only (federation input)
+        self.families: Optional[Dict[str, dict]] = None
+        self.span_cursor = 0
+        self.up = False
+        self.ready: Optional[bool] = None
+        self.last_error: Optional[str] = None
+        self.last_scrape_s: Optional[float] = None
+        self.health: Optional[dict] = None
+
+    def sample_at(self, cutoff: float) -> Optional[Tuple[float, Dict]]:
+        """Newest ring entry at-or-before ``cutoff`` (else the oldest
+        entry — a short ring degrades to "since retention began")."""
+        chosen = None
+        for entry in self.ring:
+            if entry[0] <= cutoff:
+                chosen = entry
+            else:
+                break
+        if chosen is None and self.ring:
+            chosen = self.ring[0]
+        return chosen
+
+    def latest(self) -> Optional[Tuple[float, Dict]]:
+        return self.ring[-1] if self.ring else None
+
+
+def _delta_samples(
+    newer: Dict[str, float], older: Dict[str, float]
+) -> Dict[str, float]:
+    """Per-sample counter deltas, clamped at 0 (a process restart resets
+    its counters; a negative delta must read as "fresh start", never as
+    negative traffic)."""
+    return {
+        key: max(0.0, value - older.get(key, 0.0))
+        for key, value in newer.items()
+    }
+
+
+# --- the collector ---
+
+
+class Collector:
+    """Poll a fleet's existing public endpoints; serve the merged view.
+
+    ``targets`` are base URLs (event servers, engine workers, storage
+    gateways, cluster nodes — any process exposing ``/metrics``).
+    ``access_key``/``secret`` are forwarded on the span pull
+    (``/debug/traces.json`` is gated per server: accessKey on the event
+    and engine servers, the shared secret on gateways); metrics and
+    health endpoints are unauthenticated by design. All state is
+    instance-scoped — tests run several collectors in one process.
+    """
+
+    def __init__(
+        self,
+        targets: Sequence[str] = (),
+        *,
+        poll_interval_s: float = DEFAULT_POLL_INTERVAL_S,
+        retention: int = DEFAULT_RETENTION,
+        span_retention: int = DEFAULT_SPAN_RETENTION,
+        slos: Optional[Sequence[SLODef]] = None,
+        access_key: str = "",
+        secret: str = "",
+        timeout_s: float = 5.0,
+    ):
+        self.poll_interval_s = float(poll_interval_s)
+        self.retention = max(2, int(retention))
+        self.span_retention = max(1, int(span_retention))
+        self.slos: Tuple[SLODef, ...] = tuple(
+            default_slos() if slos is None else slos
+        )
+        names = [s.name for s in self.slos]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate SLO names in {names}")
+        # the multiwindow blip filter is void when the ring cannot
+        # cover the slow window (both windows then degrade to "since
+        # retention began" and measure roughly the same span) — warn
+        # loudly instead of silently paging on transients
+        slowest = max((s.slow_window_s for s in self.slos), default=0.0)
+        covered = self.retention * self.poll_interval_s
+        if slowest and covered < slowest:
+            logger.warning(
+                "collector retention (%d snapshots x %.1fs = %.0fs) "
+                "does not cover the slowest SLO window (%.0fs): slow "
+                "burns degrade toward the fast window and the "
+                "multiwindow alert filter loses its blip suppression; "
+                "raise retention to at least %d",
+                self.retention, self.poll_interval_s, covered, slowest,
+                math.ceil(slowest / self.poll_interval_s),
+            )
+        self.access_key = access_key
+        self.secret = secret
+        self.timeout_s = float(timeout_s)
+        self._lock = threading.RLock()
+        self._targets: "Dict[str, _TargetState]" = {}
+        # stitched spans, fleet-wide: a bounded deque + a dedup key set
+        # ((instance, traceId, spanId) — span seqs reset on process
+        # restart, span ids do not collide within a trace)
+        self._spans: "collections.deque" = collections.deque()
+        self._span_keys: set = set()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._last_alerts: List[dict] = []
+        self._last_slo_report: List[dict] = []
+        reg = _metrics.get_registry()
+        self._m_scrapes = reg.counter(
+            "pio_collector_scrapes_total",
+            "Collector target scrapes by outcome (ok / error = the "
+            "/metrics fetch failed; the target renders DOWN)",
+            labels=("target", "outcome"),
+        )
+        self._m_scrape_seconds = reg.histogram(
+            "pio_collector_scrape_seconds",
+            "Wall clock of one full target scrape (metrics + health + "
+            "incremental span pull)",
+            buckets=_metrics.LATENCY_BUCKETS_S,
+        )
+        self._m_targets = reg.gauge(
+            "pio_collector_targets",
+            "Fleet targets registered with this collector",
+        )
+        self._m_spans = reg.counter(
+            "pio_collector_spans_total",
+            "Spans pulled incrementally from fleet targets",
+            labels=("target",),
+        )
+        self._m_burn = reg.gauge(
+            "pio_slo_burn_rate",
+            "SLO error-budget burn rate per evaluation window "
+            "(bad_fraction / error_budget; 1.0 = burning exactly at "
+            "the objective's natural rate)",
+            labels=("slo", "window"),
+        )
+        self._m_alert = reg.gauge(
+            "pio_slo_alert",
+            "1 while an SLO's fast AND slow windows both burn above "
+            "its threshold (the multiwindow page condition)",
+            labels=("slo",),
+        )
+        for url in targets:
+            self.add_target(url)
+
+    # -- target registry --
+
+    def add_target(self, url: str) -> bool:
+        """Register one fleet process (idempotent — re-registration by
+        a restarted supervisor is a no-op). Returns True when new."""
+        url = (url or "").rstrip("/")
+        if not url:
+            raise ValueError("empty target URL")
+        if "://" not in url:
+            url = "http://" + url
+        with self._lock:
+            if url in self._targets:
+                return False
+            self._targets[url] = _TargetState(url, self.retention)
+            self._m_targets.set(float(len(self._targets)))
+        logger.info("collector: registered target %s", url)
+        return True
+
+    def remove_target(self, url: str) -> bool:
+        url = (url or "").rstrip("/")
+        if "://" not in url:
+            url = "http://" + url
+        with self._lock:
+            removed = self._targets.pop(url, None) is not None
+            self._m_targets.set(float(len(self._targets)))
+        return removed
+
+    def target_urls(self) -> List[str]:
+        with self._lock:
+            return sorted(self._targets)
+
+    def _states(self) -> List[_TargetState]:
+        with self._lock:
+            return [self._targets[u] for u in sorted(self._targets)]
+
+    # -- polling --
+
+    def _fetch(self, url: str) -> bytes:
+        with urllib.request.urlopen(url, timeout=self.timeout_s) as resp:
+            return resp.read()
+
+    def _poll_target(self, state: _TargetState) -> None:
+        t0 = time.perf_counter()
+        now = time.time()
+        try:
+            text = self._fetch(state.url + "/metrics").decode("utf-8")
+        except Exception as e:
+            with self._lock:
+                state.up = False
+                state.ready = None
+                state.last_error = f"{type(e).__name__}: {e}"
+            self._m_scrapes.labels(
+                target=state.instance, outcome="error"
+            ).inc()
+            return
+        samples = _metrics.parse_exposition(text)
+        families = _metrics.parse_exposition_families(text)
+        health: Optional[dict] = None
+        ready: Optional[bool] = None
+        try:
+            health = json.loads(
+                self._fetch(state.url + "/healthz").decode("utf-8")
+            )
+        except Exception:
+            logger.debug(
+                "collector: healthz fetch from %s failed", state.url,
+                exc_info=True,
+            )
+        try:
+            self._fetch(state.url + "/readyz")
+            ready = True
+        except urllib.error.HTTPError:
+            ready = False
+        except Exception:
+            ready = None
+        # restart detection the span-sequence comparison alone cannot
+        # provide: a restarted process that recorded MORE spans than
+        # the cursor before our next poll presents a high-water mark
+        # ABOVE it, silently hiding its early spans. Uptime going
+        # backwards is unambiguous — drop the cursor so the pull below
+        # starts from scratch (the stitched-store dedup absorbs any
+        # overlap).
+        with self._lock:
+            prev_uptime = (state.health or {}).get("uptimeSec")
+        new_uptime = (health or {}).get("uptimeSec")
+        if (
+            isinstance(prev_uptime, (int, float))
+            and isinstance(new_uptime, (int, float))
+            and new_uptime < prev_uptime
+            and state.span_cursor
+        ):
+            logger.info(
+                "collector: %s restarted (uptime %.1fs -> %.1fs); span "
+                "cursor reset", state.url, prev_uptime, new_uptime,
+            )
+            with self._lock:
+                state.span_cursor = 0
+        spans, hwm = self._pull_spans(state)
+        with self._lock:
+            state.ring.append((now, samples))
+            state.families = families
+            state.up = True
+            state.ready = ready
+            state.health = health
+            state.last_error = None
+            state.last_scrape_s = now
+            if hwm is not None:
+                state.span_cursor = hwm
+            for span in spans:
+                key = (
+                    state.instance,
+                    span.get("traceId"),
+                    span.get("spanId"),
+                )
+                if key in self._span_keys:
+                    continue
+                self._span_keys.add(key)
+                entry = dict(span)
+                entry["instance"] = state.instance
+                self._spans.append(entry)
+            while len(self._spans) > self.span_retention:
+                evicted = self._spans.popleft()
+                self._span_keys.discard((
+                    evicted.get("instance"),
+                    evicted.get("traceId"),
+                    evicted.get("spanId"),
+                ))
+        if spans:
+            self._m_spans.labels(target=state.instance).inc(len(spans))
+        self._m_scrapes.labels(target=state.instance, outcome="ok").inc()
+        self._m_scrape_seconds.observe(time.perf_counter() - t0)
+
+    def _pull_spans(
+        self, state: _TargetState
+    ) -> "Tuple[List[dict], Optional[int]]":
+        """Incremental span pull: only spans past the target's cursor
+        come over the wire. A target whose dump is auth-gated (and no
+        key/secret was configured) or that predates the cursor protocol
+        simply contributes no spans — metrics federation is unaffected."""
+        params: Dict[str, str] = {"since": str(state.span_cursor)}
+        if self.access_key:
+            params["accessKey"] = self.access_key
+        if self.secret:
+            params["secret"] = self.secret
+        def fetch(since: str):
+            q = dict(params)
+            q["since"] = since
+            url = (
+                state.url
+                + "/debug/traces.json?"
+                + urllib.parse.urlencode(q)
+            )
+            return json.loads(self._fetch(url).decode("utf-8"))
+
+        try:
+            payload = fetch(str(state.span_cursor))
+            seq = payload.get("seq")
+            if isinstance(seq, int) and seq < state.span_cursor:
+                # the process restarted (its span sequence reset under
+                # our cursor): re-pull the whole ring NOW — waiting for
+                # the next poll would drop every span below the stale
+                # cursor (the dedup key set absorbs any overlap)
+                logger.info(
+                    "collector: %s span sequence reset (%d -> %d); "
+                    "re-pulling from scratch", state.url,
+                    state.span_cursor, seq,
+                )
+                payload = fetch("0")
+                seq = payload.get("seq")
+        except Exception:
+            logger.debug(
+                "collector: span pull from %s failed", state.url,
+                exc_info=True,
+            )
+            return [], None
+        spans = payload.get("spans") or []
+        if not isinstance(seq, int):
+            # a pre-cursor server answered with a full dump and no
+            # high-water mark: take the spans, keep the cursor at 0
+            # (the dedup key set absorbs the re-downloads)
+            return list(spans), None
+        return list(spans), seq
+
+    def poll_once(self) -> dict:
+        """One scrape sweep over every registered target — CONCURRENT,
+        so a few dead targets eating connect timeouts cannot stall
+        scrape freshness (and the SLO windows' snapshot spacing) for
+        the healthy ones — then an SLO evaluation pass. Returns a
+        summary (the CLI logs it)."""
+        states = self._states()
+        if len(states) > 1:
+            with concurrent.futures.ThreadPoolExecutor(
+                max_workers=min(8, len(states)),
+                thread_name_prefix="collector-scrape",
+            ) as pool:
+                list(pool.map(self._poll_target, states))
+        elif states:
+            self._poll_target(states[0])
+        report = self.evaluate_slos()
+        with self._lock:
+            up = sum(1 for s in states if s.up)
+        return {
+            "targets": len(states),
+            "up": up,
+            "alerts": sum(1 for r in report if r["firing"]),
+        }
+
+    def run(self, stop_event: Optional[threading.Event] = None) -> None:
+        """The poll loop (stop-event idiom; ``pio collector`` wires
+        SIGINT/SIGTERM to the event)."""
+        stop = stop_event if stop_event is not None else self._stop
+        hb = _health.heartbeat(
+            "telemetry-collector", deadline_s=COLLECTOR_DEADLINE_S
+        )
+        while not stop.is_set():
+            with hb.busy():
+                try:
+                    self.poll_once()
+                except Exception:
+                    logger.exception("collector poll sweep failed")
+            if stop.wait(self.poll_interval_s):
+                break
+
+    def start(self) -> "Collector":
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self.run, name="telemetry-collector", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+
+    def last_poll_age_s(self) -> Optional[float]:
+        """Seconds since the newest successful target scrape (the
+        collector server's readiness probe)."""
+        with self._lock:
+            newest = max(
+                (
+                    s.last_scrape_s
+                    for s in self._targets.values()
+                    if s.last_scrape_s is not None
+                ),
+                default=None,
+            )
+        return None if newest is None else max(0.0, time.time() - newest)
+
+    # -- federation --
+
+    def federated_families(self) -> Dict[str, dict]:
+        """Merge every target's NEWEST typed snapshot exactly:
+
+        - counters and histogram samples (cumulative ``_bucket`` /
+          ``_sum`` / ``_count``) SUM across targets per identical label
+          set — fixed bucket bounds are what make the histogram sum a
+          true union (PR 6's invariant, now applied fleet-wide);
+        - gauges (and untyped samples) gain an ``instance`` label and
+          are NEVER summed — three workers' RSS gauges stay three
+          samples.
+
+        Returns ``{family: {"kind", "help", "rows": {(sample_name,
+        labels): value}}}``; render with :meth:`render_federated`.
+        """
+        merged: Dict[str, dict] = {}
+        for state in self._states():
+            with self._lock:
+                families = state.families
+                instance = state.instance
+            if not families:
+                continue
+            for name, fam in families.items():
+                out = merged.setdefault(
+                    name,
+                    {"kind": fam["kind"], "help": fam["help"], "rows": {}},
+                )
+                if out["kind"] == "untyped" and fam["kind"] != "untyped":
+                    out["kind"] = fam["kind"]
+                if not out["help"]:
+                    out["help"] = fam["help"]
+                summing = fam["kind"] in ("counter", "histogram")
+                for sample_name, labels, value in fam["samples"]:
+                    if summing:
+                        key = (sample_name, labels)
+                        out["rows"][key] = out["rows"].get(key, 0.0) + value
+                    else:
+                        key = (
+                            sample_name,
+                            labels + (("instance", instance),),
+                        )
+                        out["rows"][key] = value
+        return merged
+
+    def render_federated(self) -> str:
+        """The fleet-level ``GET /metrics`` body: the federated families
+        as Prometheus text 0.0.4. Samples render deterministically
+        (histogram buckets in ascending ``le`` order inside each label
+        set; everything else sorted by label values), so two renders of
+        the same snapshots are byte-identical."""
+        lines: List[str] = []
+        merged = self.federated_families()
+        for name in sorted(merged):
+            fam = merged[name]
+            if fam["help"]:
+                lines.append(f"# HELP {name} {fam['help']}")
+            lines.append(f"# TYPE {name} {fam['kind']}")
+            lines.extend(self._render_rows(fam["rows"]))
+        return "\n".join(lines) + "\n"
+
+    @staticmethod
+    def _render_rows(rows: Dict[tuple, float]) -> List[str]:
+        def le_rank(labels: tuple) -> tuple:
+            # order histogram bucket lines by ascending bound, +Inf last
+            le = dict(labels).get("le")
+            if le is None:
+                return (0, 0.0)
+            return (1, math.inf if le == "+Inf" else float(le))
+
+        def sort_key(item):
+            (sample_name, labels), _ = item
+            others = tuple(
+                (k, v) for k, v in labels if k != "le"
+            )
+            return (sample_name, others, le_rank(labels))
+
+        out = []
+        for (sample_name, labels), value in sorted(
+            rows.items(), key=sort_key
+        ):
+            label_str = ""
+            if labels:
+                pairs = ",".join(
+                    f'{k}="{_metrics._escape_label_value(v)}"'
+                    for k, v in labels
+                )
+                label_str = "{" + pairs + "}"
+            out.append(f"{sample_name}{label_str} {_metrics._fmt(value)}")
+        return out
+
+    # -- the fleet view (/api/fleet.json) --
+
+    _WORK_COUNTERS = (
+        "pio_serving_requests_total",
+        "pio_events_ingested_total",
+        "pio_gateway_rpc_total",
+    )
+
+    def _windowed(
+        self, state: _TargetState, window_s: float
+    ) -> Optional[Tuple[float, Dict[str, float]]]:
+        """(actual window seconds, counter deltas) for one target, or
+        None without at least two snapshots."""
+        with self._lock:
+            latest = state.latest()
+            if latest is None:
+                return None
+            base = state.sample_at(latest[0] - window_s)
+        if base is None or base[0] >= latest[0]:
+            return None
+        return latest[0] - base[0], _delta_samples(latest[1], base[1])
+
+    def _target_row(self, state: _TargetState, window_s: float) -> dict:
+        with self._lock:
+            row: dict = {
+                "url": state.url,
+                "instance": state.instance,
+                "up": state.up,
+                "ready": state.ready,
+            }
+            if state.last_error:
+                row["error"] = state.last_error
+            latest = state.latest()
+            health = state.health
+        if health and "uptimeSec" in health:
+            row["uptime_s"] = health["uptimeSec"]
+        if latest is None:
+            return row
+        samples = latest[1]
+        work = sum(
+            _metrics.counter_sum(samples, c) for c in self._WORK_COUNTERS
+        )
+        row["requests"] = int(work)
+        p50 = _metrics.histogram_quantile_from_samples(
+            samples, "pio_serving_latency_seconds", 0.5
+        )
+        if p50 is not None:
+            row["p50_ms"] = p50 * 1e3
+            row["p99_ms"] = (
+                _metrics.histogram_quantile_from_samples(
+                    samples, "pio_serving_latency_seconds", 0.99
+                )
+                or 0.0
+            ) * 1e3
+        errors = _metrics.counter_sum(samples, "pio_http_errors_total")
+        if errors:
+            row["errors"] = int(errors)
+        windowed = self._windowed(state, window_s)
+        if windowed is not None:
+            span_s, delta = windowed
+            row["window_s"] = round(span_s, 3)
+            window_work = sum(
+                _metrics.counter_sum(delta, c) for c in self._WORK_COUNTERS
+            )
+            row["rate"] = window_work / span_s
+            wp50 = _metrics.histogram_quantile_from_samples(
+                delta, "pio_serving_latency_seconds", 0.5
+            )
+            if wp50 is not None:
+                row["window_p50_ms"] = wp50 * 1e3
+                row["window_p99_ms"] = (
+                    _metrics.histogram_quantile_from_samples(
+                        delta, "pio_serving_latency_seconds", 0.99
+                    )
+                    or 0.0
+                ) * 1e3
+        return row
+
+    def fleet_json(self, window_s: float = 60.0) -> dict:
+        """The ``/api/fleet.json`` payload: one row per target (rates
+        and windowed p50/p99 computed from snapshot DELTAS over
+        ``window_s``), a fleet-level aggregate over the union of the
+        latest snapshots, and the current SLO report."""
+        states = self._states()
+        rows = [self._target_row(s, window_s) for s in states]
+        fleet: dict = {
+            "targets": len(rows),
+            "up": sum(1 for r in rows if r.get("up")),
+            "rate": sum(r.get("rate", 0.0) for r in rows),
+            "requests": sum(r.get("requests", 0) for r in rows),
+        }
+        union: Dict[str, float] = {}
+        union_window: Dict[str, float] = {}
+        for state in states:
+            with self._lock:
+                latest = state.latest()
+            if latest is None:
+                continue
+            for key, value in latest[1].items():
+                union[key] = union.get(key, 0.0) + value
+            windowed = self._windowed(state, window_s)
+            if windowed is None:
+                continue
+            for key, value in windowed[1].items():
+                union_window[key] = union_window.get(key, 0.0) + value
+        p50 = _metrics.histogram_quantile_from_samples(
+            union, "pio_serving_latency_seconds", 0.5
+        )
+        if p50 is not None:
+            fleet["p50_ms"] = p50 * 1e3
+            fleet["p99_ms"] = (
+                _metrics.histogram_quantile_from_samples(
+                    union, "pio_serving_latency_seconds", 0.99
+                )
+                or 0.0
+            ) * 1e3
+        wp99 = _metrics.histogram_quantile_from_samples(
+            union_window, "pio_serving_latency_seconds", 0.99
+        )
+        if wp99 is not None:
+            fleet["window_p99_ms"] = wp99 * 1e3
+        return {
+            "ts": time.time(),
+            "window_s": window_s,
+            "targets": rows,
+            "fleet": fleet,
+            "slos": self.slo_report(),
+            "alerts": self.alerts(),
+        }
+
+    # -- trace stitching (/api/traces.json) --
+
+    def stitched_spans(
+        self,
+        trace_id: Optional[str] = None,
+        limit: int = DEFAULT_SPAN_RETENTION,
+    ) -> List[dict]:
+        """The fleet's spans joined across targets (each annotated with
+        the ``instance`` it was pulled from), sorted by start time so
+        ``tracing.format_trace`` renders one coherent tree per trace."""
+        with self._lock:
+            spans = list(self._spans)
+        if trace_id:
+            spans = [s for s in spans if s.get("traceId") == trace_id]
+        spans.sort(key=lambda s: s.get("startMs", 0.0))
+        return spans[-limit:]
+
+    def traces_json(
+        self, trace_id: Optional[str] = None, limit: int = 4096
+    ) -> dict:
+        spans = self.stitched_spans(trace_id, limit)
+        processes = sorted({s["instance"] for s in spans})
+        return {
+            "spans": spans,
+            "traces": len({s.get("traceId") for s in spans}),
+            "instances": processes,
+        }
+
+    # -- the SLO burn-rate engine --
+
+    def _fleet_window_delta(self, window_s: float) -> Tuple[float, Dict]:
+        """Union of per-target counter deltas over ``window_s`` (each
+        target diffed against ITS OWN ring, so scrape-phase offsets
+        between targets never manufacture deltas). Returns the widest
+        actual window span seen."""
+        union: Dict[str, float] = {}
+        actual = 0.0
+        for state in self._states():
+            windowed = self._windowed(state, window_s)
+            if windowed is None:
+                continue
+            span_s, delta = windowed
+            actual = max(actual, span_s)
+            for key, value in delta.items():
+                union[key] = union.get(key, 0.0) + value
+        return actual, union
+
+    @staticmethod
+    def _errors_5xx(delta: Dict[str, float], server_substr: str) -> float:
+        total = 0.0
+        for key, value in delta.items():
+            if _metrics.sample_family_name(key) != "pio_http_errors_total":
+                continue
+            status = _metrics.sample_label_value(key, "status") or ""
+            server = _metrics.sample_label_value(key, "server") or ""
+            if status.startswith("5") and server_substr in server:
+                total += value
+        return total
+
+    @staticmethod
+    def _latency_bad_fraction(
+        delta: Dict[str, float], threshold_s: float
+    ) -> Optional[float]:
+        by_le: Dict[float, float] = {}
+        for key, value in delta.items():
+            if (
+                _metrics.sample_family_name(key)
+                != "pio_serving_latency_seconds_bucket"
+            ):
+                continue
+            le = _metrics.sample_label_value(key, "le")
+            if le is None:
+                continue
+            bound = math.inf if le == "+Inf" else float(le)
+            by_le[bound] = by_le.get(bound, 0.0) + value
+        if not by_le:
+            return None
+        total = by_le.get(math.inf, max(by_le.values()))
+        if total <= 0:
+            return None
+        # clamp the threshold UP to the nearest fixed bucket bound: the
+        # cumulative count there is exact (never interpolated). A
+        # threshold PAST the largest finite bound clamps DOWN to it —
+        # "good" must not collapse to zero and page on all traffic just
+        # because the declared threshold overshot the ladder.
+        finite = sorted(b for b in by_le if b != math.inf)
+        if not finite:
+            return None
+        good_bound = next(
+            (b for b in finite if b >= threshold_s), finite[-1]
+        )
+        good = by_le[good_bound]
+        return max(0.0, (total - good) / total)
+
+    def _bad_fraction(
+        self, slo: SLODef, delta: Dict[str, float]
+    ) -> Optional[float]:
+        if slo.kind == "availability":
+            good = _metrics.counter_sum(delta, "pio_serving_requests_total")
+            bad = self._errors_5xx(delta, "Engine")
+            denom = good + bad
+            return (bad / denom) if denom > 0 else None
+        if slo.kind == "latency":
+            return self._latency_bad_fraction(
+                delta, slo.latency_threshold_s
+            )
+        if slo.kind == "ingest_error_rate":
+            good = _metrics.counter_sum(delta, "pio_events_ingested_total")
+            bad = self._errors_5xx(delta, "Event")
+            denom = good + bad
+            return (bad / denom) if denom > 0 else None
+        return None
+
+    def evaluate_slos(self) -> List[dict]:
+        """Evaluate every SLO over its fast and slow windows, set the
+        ``pio_slo_burn_rate{slo,window}`` / ``pio_slo_alert{slo}``
+        gauges, and cache the report for ``/api/alerts.json``. Windows
+        without enough retention (or without any matching traffic)
+        report burn 0 and never fire — an empty fleet is not an outage."""
+        report: List[dict] = []
+        deltas: Dict[float, Tuple[float, Dict]] = {}
+        for slo in self.slos:
+            windows: Dict[str, dict] = {}
+            firing = True
+            for label, window_s in (
+                ("fast", slo.fast_window_s),
+                ("slow", slo.slow_window_s),
+            ):
+                if window_s not in deltas:
+                    deltas[window_s] = self._fleet_window_delta(window_s)
+                actual_s, delta = deltas[window_s]
+                frac = self._bad_fraction(slo, delta)
+                budget = 1.0 - slo.objective
+                burn = (frac / budget) if frac is not None else 0.0
+                windows[label] = {
+                    "window_s": window_s,
+                    "actual_window_s": round(actual_s, 3),
+                    "bad_fraction": frac,
+                    "burn_rate": round(burn, 6),
+                }
+                self._m_burn.labels(slo=slo.name, window=label).set(burn)
+                if frac is None or burn < slo.burn_threshold:
+                    firing = False
+            self._m_alert.labels(slo=slo.name).set(1.0 if firing else 0.0)
+            report.append(
+                {
+                    "slo": slo.name,
+                    "kind": slo.kind,
+                    "objective": slo.objective,
+                    "burn_threshold": slo.burn_threshold,
+                    "windows": windows,
+                    "firing": firing,
+                }
+            )
+        with self._lock:
+            self._last_slo_report = report
+            self._last_alerts = [r for r in report if r["firing"]]
+        return report
+
+    def slo_report(self) -> List[dict]:
+        with self._lock:
+            return list(self._last_slo_report)
+
+    def alerts(self) -> List[dict]:
+        with self._lock:
+            return list(self._last_alerts)
+
+    def alerts_json(self) -> dict:
+        return {
+            "ts": time.time(),
+            "slos": self.slo_report(),
+            "alerts": self.alerts(),
+        }

@@ -48,6 +48,8 @@ __all__ = [
     "record_span",
     "span",
     "dump",
+    "dump_since",
+    "high_water",
     "clear",
     "format_trace",
 ]
@@ -128,6 +130,14 @@ def use(ctx: Optional[TraceContext]) -> Iterator[None]:
 
 _SPANS: "collections.deque" = collections.deque(maxlen=MAX_SPANS)
 _SPANS_LOCK = threading.Lock()
+# monotonic per-process span sequence: every recorded span gets the next
+# value as its ``seq`` field, so a remote consumer (the telemetry
+# collector, utils/telemetry.py) can pull the ring INCREMENTALLY with
+# ``?since=<seq>`` instead of re-downloading all 4096 spans per poll.
+# The counter never resets within a process; a fresh process starts at 0
+# (the collector treats a high-water mark BELOW its cursor as a restart
+# and re-pulls from scratch).
+_SEQ = [0]
 
 
 def record_span(
@@ -156,6 +166,8 @@ def record_span(
     if attrs:
         entry["attrs"] = attrs
     with _SPANS_LOCK:
+        _SEQ[0] += 1
+        entry["seq"] = _SEQ[0]
         _SPANS.append(entry)
     return sid
 
@@ -208,9 +220,38 @@ def dump(
     return spans[-limit:]
 
 
+def high_water() -> int:
+    """The newest recorded span's sequence number (0 before any span)."""
+    with _SPANS_LOCK:
+        return _SEQ[0]
+
+
+def dump_since(
+    since: int,
+    limit: int = MAX_SPANS,
+    trace_id: Optional[str] = None,
+) -> "tuple[List[dict], int]":
+    """Incremental dump: ``(spans with seq > since, high-water mark)``.
+
+    The cursor contract behind ``/debug/traces.json?since=<seq>``: a
+    consumer feeds back the returned high-water mark on its next pull
+    and only ever downloads new spans. ``since=0`` is the full ring
+    (same content as :func:`dump`), and the high-water mark advances
+    even when the matching spans were already evicted — the consumer's
+    cursor never sticks behind a burst."""
+    with _SPANS_LOCK:
+        hwm = _SEQ[0]
+        spans = [s for s in _SPANS if s["seq"] > since]
+    if trace_id:
+        trace_id = _sanitize(trace_id)
+        spans = [s for s in spans if s["traceId"] == trace_id]
+    return spans[-limit:], hwm
+
+
 def clear() -> None:
     with _SPANS_LOCK:
         _SPANS.clear()
+        _SEQ[0] = 0
 
 
 def format_trace(spans: List[dict]) -> str:

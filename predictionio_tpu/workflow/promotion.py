@@ -161,6 +161,16 @@ class PromotionConfig:
     # refuse rounds that produced no shadow sample at all (default:
     # promote — the first rounds of a fresh deploy have no capture yet)
     require_shadow: bool = False
+    # telemetry collector base URL (pio collector): when set, the
+    # observation window reads the FLEET-wide federated /metrics from
+    # the collector — 5xx and per-version request/attribution counters
+    # summed across every worker AND the event server — instead of the
+    # one process the target can see. Size observe_s to at least two
+    # collector poll intervals so the window spans a fresh scrape; an
+    # unreachable collector falls back to the target's own sample (and
+    # logs), never fails the promotion.
+    collector_url: Optional[str] = None
+    collector_timeout_s: float = 5.0
 
 
 # --- observation: the per-version serving/quality/error sample ---
@@ -248,6 +258,23 @@ def _sample_delta(after: Dict[str, Any], before: Dict[str, Any]) -> Dict[str, An
         if d:
             out["attributed"][k] = d
     return out
+
+
+def _collector_observation(url: str, timeout_s: float) -> Dict[str, Any]:
+    """The observation sample folded from a telemetry collector's
+    FEDERATED ``/metrics`` (utils/telemetry.py): counters there are
+    already summed across every fleet target, so the standard scrape
+    fold sees the whole fleet's error/request/attribution deltas in one
+    read — the cross-process view the per-process targets structurally
+    cannot provide."""
+    from predictionio_tpu.utils.metrics import parse_exposition
+
+    with urllib.request.urlopen(
+        url.rstrip("/") + "/metrics", timeout=timeout_s
+    ) as resp:
+        return _scraped_observation(
+            parse_exposition(resp.read().decode("utf-8"))
+        )
 
 
 def _hit_rate(attributed: Dict, version: str) -> Optional[float]:
@@ -564,16 +591,57 @@ class PromotionPipeline:
         self, candidate: str, previous: str, hb
     ) -> Optional[str]:
         """The post-swap observation window. Returns a rollback reason,
-        or None when the candidate held up."""
+        or None when the candidate held up.
+
+        The sample SOURCE is pinned for the whole window: when a
+        collector is configured and its first (``before``) fetch
+        succeeds, the ``after`` sample MUST come from the collector
+        too — mixing a fleet-wide ``before`` with a single-process
+        ``after`` (or vice versa) produces garbage deltas that can
+        promote a bad candidate or roll back a healthy one. A collector
+        that dies mid-window makes the window INCONCLUSIVE (no
+        rollback, logged) rather than silently judged against the
+        wrong denominator; a collector that is already unreachable at
+        window start degrades to the target's own sample for BOTH
+        sides."""
         cfg = self.config
         if cfg.observe_s <= 0:
             return None
-        before = self.target.observe_sample()
+        use_collector = bool(cfg.collector_url)
+        if use_collector:
+            try:
+                before = _collector_observation(
+                    cfg.collector_url, cfg.collector_timeout_s
+                )
+            except Exception:
+                logger.warning(
+                    "collector %s unreachable at observation start; the "
+                    "window falls back to the target's own sample",
+                    cfg.collector_url, exc_info=True,
+                )
+                use_collector = False
+        if not use_collector:
+            before = self.target.observe_sample()
         end = time.monotonic() + cfg.observe_s
         while time.monotonic() < end:
             hb.beat()
             time.sleep(min(cfg.observe_poll_s, max(0.0, end - time.monotonic())))
-        after = self.target.observe_sample()
+        if use_collector:
+            try:
+                after = _collector_observation(
+                    cfg.collector_url, cfg.collector_timeout_s
+                )
+            except Exception:
+                logger.warning(
+                    "collector %s died mid-observation; the window is "
+                    "inconclusive (no rollback) — a target-sample "
+                    "'after' would be judged against a fleet-wide "
+                    "'before'",
+                    cfg.collector_url, exc_info=True,
+                )
+                return None
+        else:
+            after = self.target.observe_sample()
         window = _sample_delta(after, before)
         cand_requests = window["requests"].get(candidate, 0.0)
         errors = window["errors_5xx"]

@@ -596,6 +596,92 @@ def test_metric_name_allowlist_is_not_stale():
     )
 
 
+# --- docs drift: every registered family is cataloged ---
+#
+# The bug class (round 15's telemetry tentpole): a family registered in
+# code but absent from docs/OBSERVABILITY.md's catalog is invisible to
+# the operators the whole observability tier exists for — dashboards,
+# SLOs, and the runbooks reference the catalog, not the source. This
+# lint walks every registry registration with a literal name —
+# ``reg.counter(...)``/``gauge``/``histogram`` AND the thin wrapper
+# idiom (``_counter(...)``/``_gauge(...)``, data/storage/cluster.py) —
+# and fails any family name that does not appear in the catalog file.
+# The allowlist is seeded EMPTY (the strays this lint found were
+# documented when it landed) and is shrink-only.
+
+_DOCS_CATALOG = PACKAGE.parent / "docs" / "OBSERVABILITY.md"
+
+# (relative path, family name) pairs excused from the catalog.
+METRIC_DOCS_ALLOWED: set = set()
+
+
+def _registered_family_names():
+    import ast
+
+    found = set()
+    for path in sorted(PACKAGE.rglob("*.py")):
+        rel = path.relative_to(PACKAGE).as_posix()
+        if rel == "utils/metrics.py":
+            continue  # the registry itself (docstrings, generic helpers)
+        tree = ast.parse(
+            path.read_text(encoding="utf-8"), filename=str(path)
+        )
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            name = (
+                fn.attr if isinstance(fn, ast.Attribute)
+                else fn.id if isinstance(fn, ast.Name)
+                else None
+            )
+            # reg.counter(...) and the _counter(...) wrapper idiom both
+            # resolve to a registration; lstrip covers the wrappers
+            if name is None or name.lstrip("_") not in _METRIC_KINDS:
+                continue
+            if not (
+                node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)
+            ):
+                continue  # dynamic names are out of scope for the lint
+            family = node.args[0].value
+            if family.startswith("pio_"):
+                found.add((rel, family))
+    return found
+
+
+def test_every_registered_metric_family_is_documented():
+    catalog = _DOCS_CATALOG.read_text(encoding="utf-8")
+    found = _registered_family_names()
+    missing = {
+        (rel, family)
+        for rel, family in found
+        if family not in catalog and (rel, family) not in METRIC_DOCS_ALLOWED
+    }
+    assert not missing, (
+        "metric family registered in code but absent from "
+        "docs/OBSERVABILITY.md's catalog — the catalog is the operator "
+        "contract; document the family (family name, type, labels, "
+        "meaning) or justify a METRIC_DOCS_ALLOWED entry: "
+        f"{sorted(missing)}"
+    )
+
+
+def test_metric_docs_allowlist_is_not_stale():
+    found = _registered_family_names()
+    catalog = _DOCS_CATALOG.read_text(encoding="utf-8")
+    stale = {
+        entry
+        for entry in METRIC_DOCS_ALLOWED
+        if entry not in found or entry[1] in catalog
+    }
+    assert not stale, (
+        "metric-docs allowlist entries no longer needed (family gone "
+        f"or now documented): {sorted(stale)}"
+    )
+
+
 # --- silent exception swallowing in the promotion-critical tiers ---
 #
 # The bug class (round 13's promotion tentpole): an `except ...: pass`

@@ -1,0 +1,1079 @@
+"""Fleet telemetry plane (ISSUE 14): collector federation, cross-process
+trace stitching, SLO burn rates, and the incremental span-pull cursor.
+
+The acceptance spine:
+
+- the collector's fleet-merged p99 is byte-for-byte the p99 of the
+  offline union of raw per-worker scrapes, asserted against a REAL
+  2-worker SO_REUSEPORT event-server fleet (subprocesses, so each
+  worker has its own process-global registry);
+- gauges federate with an ``instance`` label and never falsely sum;
+- one traced request renders as ONE stitched tree containing spans
+  from ≥2 distinct PROCESSES (event server → the gateway process that
+  committed the write);
+- the ``?since=<seq>`` cursor means the collector never re-downloads a
+  span ring;
+- SLO burn rates fire on the multiwindow condition and feed
+  ``/api/alerts.json``;
+- the promotion observation window consumes the collector's federated
+  /metrics when ``PromotionConfig.collector_url`` is set.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from predictionio_tpu.utils import metrics as m
+from predictionio_tpu.utils import tracing as tr
+from predictionio_tpu.utils.telemetry import (
+    Collector,
+    SLODef,
+    default_slos,
+    load_slos,
+)
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def wait_http(url, timeout=60):
+    deadline = time.time() + timeout
+    last = None
+    while time.time() < deadline:
+        try:
+            with urllib.request.urlopen(url, timeout=5) as resp:
+                return resp.read()
+        except (urllib.error.URLError, ConnectionError) as e:
+            last = e
+            time.sleep(0.25)
+    raise TimeoutError(f"{url}: {last}")
+
+
+def get_json(url, timeout=10):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return json.loads(resp.read().decode("utf-8"))
+
+
+def _demo_exposition() -> str:
+    """One synthetic worker exposition with all three kinds."""
+    reg = m.MetricsRegistry()
+    reg.counter("pio_demo_requests_total", "req", labels=("route",)).labels(
+        route="/q"
+    ).inc(7)
+    h = reg.histogram(
+        "pio_demo_latency_seconds", "lat", buckets=m.LATENCY_BUCKETS_S
+    )
+    for v in (0.0005, 0.002, 0.002, 0.3):
+        h.observe(v)
+    reg.gauge("pio_demo_rss_bytes", "rss").set(111.0)
+    return reg.render()
+
+
+def _inject_snapshot(col: Collector, url: str, text: str, t=None):
+    """Feed one exposition snapshot into a collector target without a
+    network — the synthetic-federation test harness."""
+    state = col._targets[url.rstrip("/")]
+    state.ring.append((time.time() if t is None else t, m.parse_exposition(text)))
+    state.families = m.parse_exposition_families(text)
+    state.up = True
+    state.ready = True
+
+
+class TestTypedExpositionParser:
+    def test_kinds_and_label_escapes_round_trip(self):
+        reg = m.MetricsRegistry()
+        reg.counter("pio_x_total", "x", labels=("route",)).labels(
+            route='a "b"\nc\\d'
+        ).inc(3)
+        reg.histogram("pio_y_seconds", "y", buckets=(0.1, 1.0)).observe(0.5)
+        reg.gauge("pio_z_bytes", "z").set(9)
+        fams = m.parse_exposition_families(reg.render())
+        assert fams["pio_x_total"]["kind"] == "counter"
+        assert fams["pio_y_seconds"]["kind"] == "histogram"
+        assert fams["pio_z_bytes"]["kind"] == "gauge"
+        # escaped label value comes back byte-identical to the original
+        (_, labels, value) = fams["pio_x_total"]["samples"][0]
+        assert labels == (("route", 'a "b"\nc\\d'),)
+        assert value == 3.0
+        # histogram suffix samples map onto the family
+        names = {s[0] for s in fams["pio_y_seconds"]["samples"]}
+        assert names == {
+            "pio_y_seconds_bucket", "pio_y_seconds_sum",
+            "pio_y_seconds_count",
+        }
+
+    def test_flat_helpers(self):
+        reg = m.MetricsRegistry()
+        c = reg.counter("pio_w_total", "w", labels=("k",))
+        c.labels(k="a").inc(2)
+        c.labels(k="b").inc(5)
+        reg.gauge("pio_g", "g", labels=("k",)).labels(k="a").set(4)
+        h = reg.histogram("pio_h_seconds", "h", buckets=m.LATENCY_BUCKETS_S)
+        for v in (0.001,) * 50 + (0.2,) * 50:
+            h.observe(v)
+        samples = m.parse_exposition(reg.render())
+        assert m.counter_sum(samples, "pio_w_total") == 7.0
+        assert m.gauge_max(samples, "pio_g") == 4.0
+        q = m.histogram_quantile_from_samples(samples, "pio_h_seconds", 0.99)
+        assert q == pytest.approx(h.quantile(0.99))
+
+
+class TestFederation:
+    def _collector_two_workers(self, texts):
+        col = Collector([], poll_interval_s=0.1)
+        for i, text in enumerate(texts):
+            url = f"http://w{i}:90{i}"
+            col.add_target(url)
+            _inject_snapshot(col, url, text)
+        return col
+
+    def test_counters_and_histograms_sum_gauges_keep_instance(self):
+        text = _demo_exposition()
+        col = self._collector_two_workers([text, text])
+        fed = m.parse_exposition(col.render_federated())
+        assert m.counter_sum(fed, "pio_demo_requests_total") == 14.0
+        gauges = {
+            k: v for k, v in fed.items()
+            if m.sample_family_name(k) == "pio_demo_rss_bytes"
+        }
+        # two samples, both the per-worker value — NEVER 222
+        assert len(gauges) == 2
+        assert all(v == 111.0 for v in gauges.values())
+        assert all('instance="' in k for k in gauges)
+        instances = {
+            m.sample_label_value(k, "instance") for k in gauges
+        }
+        assert len(instances) == 2
+
+    def test_merged_p99_equals_offline_union(self):
+        """PR 6's invariant through the federation layer: the merged
+        histogram quantile equals quantile_from_buckets over the union
+        of the raw scrapes, to the last byte of the float repr."""
+        reg1, reg2 = m.MetricsRegistry(), m.MetricsRegistry()
+        import random
+
+        rng = random.Random(7)
+        for reg, n in ((reg1, 300), (reg2, 700)):
+            h = reg.histogram(
+                "pio_demo_latency_seconds", "lat",
+                buckets=m.LATENCY_BUCKETS_S,
+            )
+            for _ in range(n):
+                h.observe(rng.lognormvariate(-6, 1.5))
+        t1, t2 = reg1.render(), reg2.render()
+        col = self._collector_two_workers([t1, t2])
+        fed = m.parse_exposition(col.render_federated())
+        union = {}
+        for text in (t1, t2):
+            for k, v in m.parse_exposition(text).items():
+                union[k] = union.get(k, 0.0) + v
+        for q in (0.5, 0.9, 0.99):
+            offline = m.histogram_quantile_from_samples(
+                union, "pio_demo_latency_seconds", q
+            )
+            merged = m.histogram_quantile_from_samples(
+                fed, "pio_demo_latency_seconds", q
+            )
+            assert repr(offline) == repr(merged)
+        # and equals the in-process merge_snapshots estimate
+        snap = m.merge_snapshots([
+            reg1._families["pio_demo_latency_seconds"].snapshot(),
+            reg2._families["pio_demo_latency_seconds"].snapshot(),
+        ])
+        assert m.histogram_quantile_from_samples(
+            fed, "pio_demo_latency_seconds", 0.99
+        ) == pytest.approx(snap.quantile(0.99))
+
+    def test_render_is_deterministic_and_reparsable(self):
+        text = _demo_exposition()
+        col = self._collector_two_workers([text, text])
+        a, b = col.render_federated(), col.render_federated()
+        assert a == b
+        fams = m.parse_exposition_families(a)
+        assert fams["pio_demo_requests_total"]["kind"] == "counter"
+        assert fams["pio_demo_latency_seconds"]["kind"] == "histogram"
+        assert fams["pio_demo_rss_bytes"]["kind"] == "gauge"
+
+    def test_fleet_json_rates_from_snapshot_deltas(self):
+        col = Collector([], poll_interval_s=0.1)
+        url = "http://w0:900"
+        col.add_target(url)
+        reg = m.MetricsRegistry()
+        c = reg.counter("pio_serving_requests_total", "r", labels=("version",))
+        h = reg.histogram(
+            "pio_serving_latency_seconds", "l", buckets=m.LATENCY_BUCKETS_S
+        )
+        c.labels(version="v1").inc(100)
+        h.observe(0.001)
+        now = time.time()
+        _inject_snapshot(col, url, reg.render(), t=now - 10.0)
+        c.labels(version="v1").inc(50)
+        for _ in range(100):
+            h.observe(0.004)
+        _inject_snapshot(col, url, reg.render(), t=now)
+        fleet = col.fleet_json(window_s=60.0)
+        row = fleet["targets"][0]
+        # 50 new requests over the 10 s between snapshots
+        assert row["rate"] == pytest.approx(5.0, rel=0.01)
+        assert row["requests"] == 150
+        # the windowed p99 reflects only the delta's 4 ms observations
+        # (0.004 lands in the 3.2→6.4 ms bucket, index 6 of the fixed
+        # log ladder; one slot per finite bound + the +Inf slot)
+        delta_counts = [0] * (len(m.LATENCY_BUCKETS_S) + 1)
+        delta_counts[6] = 100
+        assert row["window_p99_ms"] == pytest.approx(
+            m.quantile_from_buckets(
+                m.LATENCY_BUCKETS_S, delta_counts, 0.99
+            ) * 1e3,
+            rel=0.01,
+        )
+        assert fleet["fleet"]["rate"] == pytest.approx(5.0, rel=0.01)
+
+
+class TestSpanCursor:
+    def test_dump_since_and_high_water(self):
+        tr.clear()
+        tr.record_span("a", "t1")
+        tr.record_span("b", "t1")
+        tr.record_span("c", "t2")
+        spans, hwm = tr.dump_since(0)
+        assert hwm == 3 and [s["seq"] for s in spans] == [1, 2, 3]
+        spans, hwm = tr.dump_since(2)
+        assert hwm == 3 and [s["name"] for s in spans] == ["c"]
+        spans, _ = tr.dump_since(0, trace_id="t1")
+        assert {s["name"] for s in spans} == {"a", "b"}
+        spans, hwm = tr.dump_since(3)
+        assert spans == [] and hwm == 3
+
+    def test_high_water_advances_past_eviction(self):
+        tr.clear()
+        for i in range(tr.MAX_SPANS + 10):
+            tr.record_span(f"s{i}", "t")
+        spans, hwm = tr.dump_since(0)
+        assert hwm == tr.MAX_SPANS + 10
+        assert len(spans) == tr.MAX_SPANS
+        # the oldest surviving span is past the evicted prefix
+        assert spans[0]["seq"] == 11
+
+    def test_traces_payload_since_contract(self):
+        from predictionio_tpu.api.http import traces_payload
+
+        tr.clear()
+        tr.record_span("a", "t1")
+        status, payload = traces_payload({})
+        assert status == 200 and payload["seq"] == 1
+        status, payload = traces_payload({"since": "1"})
+        assert status == 200 and payload["spans"] == []
+        tr.record_span("b", "t1")
+        status, payload = traces_payload({"since": "1"})
+        assert status == 200
+        assert [s["name"] for s in payload["spans"]] == ["b"]
+        assert payload["seq"] == 2
+        status, payload = traces_payload({"since": "bogus"})
+        assert status == 400
+
+    def test_event_server_endpoint_supports_since(self, mem_storage):
+        from predictionio_tpu.api.event_server import EventAPI
+        from predictionio_tpu.data.storage.base import AccessKey, App
+
+        tr.clear()
+        app_id = mem_storage.get_meta_data_apps().insert(App(id=0, name="t"))
+        mem_storage.get_meta_data_access_keys().insert(
+            AccessKey(key="k", appid=app_id, events=())
+        )
+        mem_storage.get_l_events().init(app_id)
+        api = EventAPI(storage=mem_storage)
+        status, body = api.handle(
+            "POST", "/events.json", {"accessKey": "k"},
+            json.dumps(
+                {"event": "buy", "entityType": "user", "entityId": "u1"}
+            ).encode(),
+            headers={"x-pio-trace-id": "t-cursor"},
+        )
+        assert status == 201, body
+        status, payload = api.handle(
+            "GET", "/debug/traces.json", {"accessKey": "k", "since": "0"}
+        )
+        assert status == 200 and payload["seq"] >= 2
+        hwm = payload["seq"]
+        status, payload = api.handle(
+            "GET", "/debug/traces.json",
+            {"accessKey": "k", "since": str(hwm)},
+        )
+        assert status == 200 and payload["spans"] == []
+
+
+class TestCollectorPolling:
+    def test_poll_sideband_target_and_incremental_pull(self):
+        from predictionio_tpu.api.sideband import ObservabilitySideband
+
+        tr.clear()
+        m.get_registry().counter("pio_poll_demo_total", "d").inc(3)
+        tr.record_span("one", "trace-p1")
+        sb = ObservabilitySideband(port=0).start()
+        col = Collector(
+            [f"http://127.0.0.1:{sb.port}"], poll_interval_s=0.1
+        )
+        try:
+            col.poll_once()
+            url = col.target_urls()[0]
+            state = col._targets[url]
+            assert state.up and state.ready
+            assert state.span_cursor >= 1
+            first_cursor = state.span_cursor
+            assert len(col.stitched_spans()) >= 1
+            n_before = len(col.stitched_spans())
+            # nothing new: the cursor holds, no spans re-downloaded
+            col.poll_once()
+            assert len(col.stitched_spans()) == n_before
+            tr.record_span("two", "trace-p1")
+            col.poll_once()
+            assert state.span_cursor == first_cursor + 1
+            assert (
+                len([
+                    s for s in col.stitched_spans()
+                    if s["traceId"] == "trace-p1"
+                ])
+                == 2
+            )
+            fed = m.parse_exposition(col.render_federated())
+            assert m.counter_sum(fed, "pio_poll_demo_total") >= 3.0
+        finally:
+            sb.shutdown()
+
+    def test_down_target_degrades(self):
+        col = Collector(
+            [f"http://127.0.0.1:{free_port()}"], poll_interval_s=0.1,
+            timeout_s=0.5,
+        )
+        summary = col.poll_once()
+        assert summary == {"targets": 1, "up": 0, "alerts": 0}
+        state = col._targets[col.target_urls()[0]]
+        assert state.up is False and state.last_error
+        row = col.fleet_json()["targets"][0]
+        assert row["up"] is False
+
+    def test_span_sequence_reset_is_handled(self):
+        from predictionio_tpu.api.sideband import ObservabilitySideband
+
+        tr.clear()
+        for i in range(5):
+            tr.record_span(f"s{i}", "trace-r1")
+        sb = ObservabilitySideband(port=0).start()
+        col = Collector(
+            [f"http://127.0.0.1:{sb.port}"], poll_interval_s=0.1
+        )
+        try:
+            col.poll_once()
+            state = col._targets[col.target_urls()[0]]
+            assert state.span_cursor == 5
+            # "restart": the ring and sequence reset under the cursor
+            tr.clear()
+            tr.record_span("fresh", "trace-r2")
+            col.poll_once()
+            assert state.span_cursor == 1
+            names = {s["name"] for s in col.stitched_spans()}
+            assert "fresh" in names
+        finally:
+            sb.shutdown()
+
+
+class TestCollectorServer:
+    def test_routes_and_target_registration(self):
+        from predictionio_tpu.tools.collector import CollectorServer
+
+        col = Collector([], poll_interval_s=0.1)
+        srv = CollectorServer(col, port=0).start()
+        base = f"http://127.0.0.1:{srv.port}"
+        try:
+            # empty registry: ready (idle, not broken)
+            with urllib.request.urlopen(base + "/readyz", timeout=5) as r:
+                assert r.status == 200
+            out = get_json(base + "/api/targets.json")
+            assert out == {"targets": []}
+            req = urllib.request.Request(
+                base + "/api/targets",
+                data=json.dumps({"url": "http://127.0.0.1:9"}).encode(),
+                headers={"Content-Type": "application/json"},
+                method="POST",
+            )
+            out = get_json(req)
+            assert out["added"] is True
+            # idempotent re-registration
+            req = urllib.request.Request(
+                base + "/api/targets",
+                data=json.dumps({"url": "http://127.0.0.1:9"}).encode(),
+                headers={"Content-Type": "application/json"},
+                method="POST",
+            )
+            out = get_json(req)
+            assert out["added"] is False and len(out["targets"]) == 1
+            alerts = get_json(base + "/api/alerts.json")
+            assert {s["slo"] for s in alerts["slos"]} == set()
+            col.evaluate_slos()
+            alerts = get_json(base + "/api/alerts.json")
+            assert {s["slo"] for s in alerts["slos"]} == {
+                "serving-availability", "serving-latency", "ingest-errors",
+            }
+            # registered-but-never-scraped flips readiness (past the
+            # readiness probe's 1 s TTL cache)
+            time.sleep(1.1)
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(base + "/readyz", timeout=5)
+            assert e.value.code == 503
+            # federated /metrics includes the collector's own families
+            text = wait_http(base + "/metrics").decode()
+            assert "pio_collector_targets 1" in text
+        finally:
+            srv.shutdown()
+
+    def test_admin_secret_gates_registration(self):
+        from predictionio_tpu.tools.collector import CollectorServer
+
+        col = Collector([], poll_interval_s=0.1)
+        srv = CollectorServer(col, port=0, admin_secret="s3").start()
+        base = f"http://127.0.0.1:{srv.port}"
+        try:
+            req = urllib.request.Request(
+                base + "/api/targets",
+                data=json.dumps({"url": "http://x:1"}).encode(),
+                headers={"Content-Type": "application/json"},
+                method="POST",
+            )
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(req, timeout=5)
+            assert e.value.code == 401
+            req = urllib.request.Request(
+                base + "/api/targets",
+                data=json.dumps(
+                    {"url": "http://x:1", "secret": "s3"}
+                ).encode(),
+                headers={"Content-Type": "application/json"},
+                method="POST",
+            )
+            assert get_json(req)["added"] is True
+        finally:
+            srv.shutdown()
+
+    def test_non_loopback_requires_admin_secret(self):
+        from predictionio_tpu.tools.collector import CollectorServer
+
+        with pytest.raises(ValueError):
+            CollectorServer(Collector([]), ip="0.0.0.0", port=0)
+
+    def test_sideband_non_loopback_requires_key(self):
+        from predictionio_tpu.api.sideband import ObservabilitySideband
+
+        with pytest.raises(ValueError):
+            ObservabilitySideband(ip="0.0.0.0", port=0)
+
+
+class TestSLOEngine:
+    def _snap(self, requests, errors_5xx, ingested=0, ingest_5xx=0):
+        reg = m.MetricsRegistry()
+        reg.counter(
+            "pio_serving_requests_total", "r", labels=("version",)
+        ).labels(version="v1").inc(requests)
+        if errors_5xx:
+            reg.counter(
+                "pio_http_errors_total", "e",
+                labels=("server", "route", "status"),
+            ).labels(
+                server="EngineServer", route="/queries.json", status="500"
+            ).inc(errors_5xx)
+        if ingested:
+            reg.counter(
+                "pio_events_ingested_total", "i", labels=("route",)
+            ).labels(route="single").inc(ingested)
+        if ingest_5xx:
+            reg.counter(
+                "pio_http_errors_total", "e",
+                labels=("server", "route", "status"),
+            ).labels(
+                server="EventServer", route="/events.json", status="503"
+            ).inc(ingest_5xx)
+        return reg.render()
+
+    def test_availability_burn_fires_on_both_windows(self):
+        col = Collector([], poll_interval_s=0.1)
+        url = "http://w:1"
+        col.add_target(url)
+        now = time.time()
+        _inject_snapshot(col, url, self._snap(1000, 0), t=now - 30)
+        _inject_snapshot(col, url, self._snap(2000, 50), t=now)
+        report = col.evaluate_slos()
+        avail = next(r for r in report if r["slo"] == "serving-availability")
+        # bad fraction 50/1050 ≈ 0.0476, budget 0.001 → burn ≈ 47.6
+        assert avail["windows"]["fast"]["burn_rate"] == pytest.approx(
+            (50 / 1050) / 0.001, rel=1e-3
+        )
+        assert avail["firing"] is True
+        assert col.alerts() and col.alerts()[0]["slo"] == "serving-availability"
+        # the gauges are exported
+        text = m.get_registry().render()
+        assert 'pio_slo_burn_rate{slo="serving-availability",window="fast"}' in text
+        assert 'pio_slo_alert{slo="serving-availability"} 1' in text
+
+    def test_no_traffic_means_no_alert(self):
+        col = Collector([], poll_interval_s=0.1)
+        url = "http://w:1"
+        col.add_target(url)
+        now = time.time()
+        _inject_snapshot(col, url, self._snap(100, 0), t=now - 30)
+        _inject_snapshot(col, url, self._snap(100, 0), t=now)
+        report = col.evaluate_slos()
+        assert all(not r["firing"] for r in report)
+        avail = next(r for r in report if r["slo"] == "serving-availability")
+        assert avail["windows"]["fast"]["burn_rate"] == 0.0
+
+    def test_ingest_error_rate_kind(self):
+        col = Collector([], poll_interval_s=0.1)
+        url = "http://w:1"
+        col.add_target(url)
+        now = time.time()
+        _inject_snapshot(
+            col, url, self._snap(0, 0, ingested=1000, ingest_5xx=0),
+            t=now - 30,
+        )
+        _inject_snapshot(
+            col, url, self._snap(0, 0, ingested=1900, ingest_5xx=100),
+            t=now,
+        )
+        report = col.evaluate_slos()
+        ing = next(r for r in report if r["slo"] == "ingest-errors")
+        assert ing["windows"]["fast"]["bad_fraction"] == pytest.approx(
+            100 / 1000.0
+        )
+        assert ing["firing"] is True
+
+    def test_latency_kind_exact_bucket_fraction(self):
+        reg = m.MetricsRegistry()
+        h = reg.histogram(
+            "pio_serving_latency_seconds", "l", labels=("version",),
+            buckets=m.LATENCY_BUCKETS_S,
+        )
+        child = h.labels(version="v1")
+        t0 = reg.render()
+        for _ in range(90):
+            child.observe(0.001)
+        for _ in range(10):
+            child.observe(2.0)  # past the 0.25-ish threshold bound
+        t1 = reg.render()
+        col = Collector(
+            [], poll_interval_s=0.1,
+            slos=(SLODef(
+                name="lat", kind="latency", objective=0.95,
+                latency_threshold_s=0.25,
+            ),),
+        )
+        url = "http://w:1"
+        col.add_target(url)
+        now = time.time()
+        _inject_snapshot(col, url, t0, t=now - 30)
+        _inject_snapshot(col, url, t1, t=now)
+        report = col.evaluate_slos()
+        lat = report[0]
+        # threshold 0.25 clamps up to the 0.4096 bound; the 2.0s tail
+        # is 10 of 100 observations → bad fraction exactly 0.1
+        assert lat["windows"]["fast"]["bad_fraction"] == pytest.approx(0.1)
+        assert lat["windows"]["fast"]["burn_rate"] == pytest.approx(
+            0.1 / 0.05
+        )
+
+    def test_slo_declarations_validate(self, tmp_path):
+        with pytest.raises(ValueError):
+            SLODef(name="x", kind="nope")
+        with pytest.raises(ValueError):
+            SLODef(name="x", kind="latency", objective=1.5)
+        with pytest.raises(ValueError):
+            Collector([], slos=(
+                SLODef(name="dup", kind="latency"),
+                SLODef(name="dup", kind="availability"),
+            ))
+        path = tmp_path / "slos.json"
+        path.write_text(json.dumps([
+            {"name": "a", "kind": "availability", "objective": 0.99},
+            {"name": "b", "kind": "latency", "latency_threshold_s": 0.1},
+        ]))
+        slos = load_slos(str(path))
+        assert [s.name for s in slos] == ["a", "b"]
+        path.write_text(json.dumps([{"name": "a", "kind": "availability",
+                                     "bogus_key": 1}]))
+        with pytest.raises(ValueError):
+            load_slos(str(path))
+        assert len(default_slos()) == 3
+
+
+class TestPromotionCollectorObservation:
+    class _StubTarget:
+        """Minimal promotion target: swap succeeds instantly; its OWN
+        observation sample never shows errors — only the collector's
+        fleet-wide view can trigger the rollback."""
+
+        def __init__(self):
+            self.version = "v1"
+            self.rolled_back = False
+
+        def current_version(self):
+            return self.version
+
+        def prepare(self, instance_id):
+            return instance_id
+
+        def swap(self, prepared):
+            previous, self.version = self.version, prepared
+            return previous
+
+        def drain(self, displaced, timeout_s, hb):
+            return True
+
+        def rollback(self, displaced, previous_version):
+            self.rolled_back = True
+            self.version = previous_version
+
+        def discard(self, prepared):
+            return None
+
+        def observe_sample(self):
+            from predictionio_tpu.workflow.promotion import _empty_sample
+
+            return _empty_sample()
+
+    def _metrics_stub_server(self, bodies):
+        """A tiny /metrics server that walks through ``bodies`` (last
+        one repeats) — the collector stand-in."""
+        from predictionio_tpu.api.aio_http import make_http_server
+
+        calls = {"n": 0}
+
+        def handler(method, path, query, body, form=None, headers=None):
+            if path != "/metrics":
+                return 404, {"message": "?"}
+            i = min(calls["n"], len(bodies) - 1)
+            calls["n"] += 1
+            return 200, bodies[i], m.render_content_type()
+
+        return make_http_server(
+            handler, "127.0.0.1", 0, "StubCollector", transport="async"
+        )
+
+    def _exposition(self, requests, errors):
+        reg = m.MetricsRegistry()
+        reg.counter(
+            "pio_serving_requests_total", "r", labels=("version",)
+        ).labels(version="v2").inc(requests)
+        if errors:
+            reg.counter(
+                "pio_http_errors_total", "e",
+                labels=("server", "route", "status"),
+            ).labels(
+                server="EngineServer", route="/queries.json", status="500"
+            ).inc(errors)
+        return reg.render()
+
+    def test_fleet_wide_errors_roll_back(self):
+        from predictionio_tpu.workflow.promotion import (
+            PromotionConfig,
+            PromotionPipeline,
+        )
+
+        stub = self._metrics_stub_server(
+            [self._exposition(100, 0), self._exposition(200, 50)]
+        )
+        stub.start()
+        try:
+            target = self._StubTarget()
+            pipeline = PromotionPipeline(
+                target,
+                PromotionConfig(
+                    observe_s=0.2,
+                    observe_poll_s=0.05,
+                    max_error_rate=0.05,
+                    collector_url=f"http://127.0.0.1:{stub.port}",
+                ),
+            )
+            report = pipeline.promote("v2")
+            assert report["outcome"] == "rolled_back", report
+            assert target.rolled_back and target.version == "v1"
+            assert "error rate" in report["reason"]
+        finally:
+            stub.shutdown()
+
+    def test_unreachable_collector_falls_back_to_target(self):
+        from predictionio_tpu.workflow.promotion import (
+            PromotionConfig,
+            PromotionPipeline,
+        )
+
+        target = self._StubTarget()
+        pipeline = PromotionPipeline(
+            target,
+            PromotionConfig(
+                observe_s=0.1,
+                observe_poll_s=0.05,
+                collector_url=f"http://127.0.0.1:{free_port()}",
+                collector_timeout_s=0.3,
+            ),
+        )
+        report = pipeline.promote("v2")
+        # the target's own (clean) sample governs: promoted, no rollback
+        assert report["outcome"] == "promoted", report
+        assert not target.rolled_back
+
+
+class TestClusterStalenessObservability:
+    def _client(self):
+        from predictionio_tpu.data.storage import StorageClientConfig
+        from predictionio_tpu.data.storage.cluster import StorageClient
+
+        return StorageClient(StorageClientConfig({
+            "NODES": "http://127.0.0.1:1,http://127.0.0.1:2",
+            "REPLICAS": "2",
+        }))
+
+    def test_stale_age_tracks_and_clears(self):
+        client = self._client()
+        node = client.nodes[0]
+        assert node.stale_age_s() == 0.0
+        node.mark_stale()
+        time.sleep(0.05)
+        rows = client.status()
+        assert rows[0]["stale"] is True
+        assert rows[0]["stale_age_s"] >= 0.05
+        # exported gauge follows the refresh
+        text = m.get_registry().render()
+        assert "pio_cluster_stale_age_seconds" in text
+        node.note_resync_lag(12.5)
+        assert client.status()[0]["resync_lag_s"] == 12.5
+        node.clear_stale()
+        rows = client.status()
+        assert rows[0]["stale_age_s"] == 0.0
+        assert rows[0]["resync_lag_s"] == 0.0
+
+
+class TestTopCollectorMode:
+    def test_render_fleet_rows_and_slo_footer(self):
+        from predictionio_tpu.tools.top import render_fleet
+
+        frame = render_fleet({
+            "targets": [
+                {"url": "http://a:1", "up": True, "ready": True,
+                 "requests": 10, "rate": 2.5, "window_p50_ms": 1.0,
+                 "window_p99_ms": 3.0},
+                {"url": "http://b:2", "up": False},
+            ],
+            "fleet": {"targets": 2, "up": 1, "rate": 2.5,
+                      "window_p99_ms": 3.0},
+            "slos": [
+                {"slo": "serving-availability", "firing": True,
+                 "windows": {"fast": {"burn_rate": 20.0},
+                             "slow": {"burn_rate": 16.0}}},
+            ],
+        })
+        assert "http://a:1" in frame and "DOWN" in frame
+        assert "fleet: 1/2 up" in frame
+        assert "FIRING" in frame
+
+    def test_run_top_collector_one_frame(self):
+        import io
+
+        from predictionio_tpu.tools.collector import CollectorServer
+        from predictionio_tpu.tools.top import run_top
+
+        col = Collector([], poll_interval_s=0.1)
+        srv = CollectorServer(col, port=0).start()
+        try:
+            out = io.StringIO()
+            rc = run_top(
+                [], iterations=1, out=out, clear=False,
+                collector=f"http://127.0.0.1:{srv.port}",
+            )
+            assert rc == 0
+            assert "SERVER" in out.getvalue()
+        finally:
+            srv.shutdown()
+
+
+def _sqlite_env(tmp_path):
+    env = {
+        **os.environ,
+        "PYTHONPATH": _REPO,
+        "JAX_PLATFORMS": "cpu",
+        "PIO_FS_BASEDIR": str(tmp_path / "fs"),
+        "PIO_STORAGE_SOURCES_SQLITE_TYPE": "sqlite",
+        "PIO_STORAGE_SOURCES_SQLITE_PATH": str(tmp_path / "events.db"),
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "SQLITE",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "SQLITE",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "SQLITE",
+    }
+    env.pop("XLA_FLAGS", None)
+    return env
+
+
+class TestFleetExactAggregation:
+    """The acceptance satellite: a REAL 2-worker SO_REUSEPORT event
+    server fleet (subprocesses — each worker its own process-global
+    registry), each worker individually scrapable via its sideband
+    --metrics-port; the collector's merged histograms must equal the
+    offline union of the raw per-worker scrapes EXACTLY."""
+
+    def test_collector_merge_equals_offline_union(self, tmp_path):
+        if not hasattr(socket, "SO_REUSEPORT"):
+            pytest.skip("platform without SO_REUSEPORT")
+        env = _sqlite_env(tmp_path)
+        # seed the shared store with an app + access key in-process
+        from predictionio_tpu.data.storage import Storage
+        from predictionio_tpu.data.storage.base import AccessKey, App
+
+        storage = Storage({
+            "PIO_STORAGE_SOURCES_SQLITE_TYPE": "sqlite",
+            "PIO_STORAGE_SOURCES_SQLITE_PATH": str(tmp_path / "events.db"),
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "SQLITE",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "SQLITE",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "SQLITE",
+        })
+        app_id = storage.get_meta_data_apps().insert(App(id=0, name="f"))
+        storage.get_meta_data_access_keys().insert(
+            AccessKey(key="fk", appid=app_id, events=())
+        )
+        storage.get_l_events().init(app_id)
+
+        port = free_port()
+        side = [free_port(), free_port()]
+        procs = [
+            subprocess.Popen(
+                [
+                    sys.executable, "-m", "predictionio_tpu.tools.cli",
+                    "eventserver", "--port", str(port), "--reuse-port",
+                    "--no-compact", "--metrics-port", str(side[w]),
+                ],
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True, env=env,
+            )
+            for w in range(2)
+        ]
+        col = None
+        try:
+            for sp in side:
+                wait_http(f"http://127.0.0.1:{sp}/healthz", timeout=90)
+            wait_http(f"http://127.0.0.1:{port}/")
+
+            def post_events(n, tag):
+                import http.client
+
+                conn = http.client.HTTPConnection("127.0.0.1", port)
+                for j in range(n):
+                    conn.request(
+                        "POST", "/events.json?accessKey=fk",
+                        json.dumps({
+                            "event": "rate",
+                            "entityType": "user",
+                            "entityId": f"{tag}-{j}",
+                            "targetEntityType": "item",
+                            "targetEntityId": f"i{j % 7}",
+                            "properties": {"rating": 4.0},
+                        }),
+                        {"Content-Type": "application/json"},
+                    )
+                    r = conn.getresponse()
+                    r.read()
+                    assert r.status == 201
+                conn.close()
+
+            threads = [
+                threading.Thread(target=post_events, args=(40, f"c{i}"))
+                for i in range(4)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=120)
+            time.sleep(1.0)  # let the last group-commit flush land
+
+            raw = [
+                wait_http(f"http://127.0.0.1:{sp}/metrics").decode()
+                for sp in side
+            ]
+            # both workers took traffic in the raw scrapes OR at least
+            # the union accounts for every accepted event
+            union: dict = {}
+            for text in raw:
+                for k, v in m.parse_exposition(text).items():
+                    union[k] = union.get(k, 0.0) + v
+            assert m.counter_sum(
+                union, "pio_events_ingested_total"
+            ) == 160.0
+
+            col = Collector(
+                [f"http://127.0.0.1:{sp}" for sp in side],
+                poll_interval_s=0.2,
+            )
+            col.poll_once()
+            fed = m.parse_exposition(col.render_federated())
+            # counters: federated == offline union, event for event
+            assert m.counter_sum(fed, "pio_events_ingested_total") == 160.0
+            # THE invariant: merged quantiles byte-for-byte equal to
+            # quantile_from_buckets over the union of the raw scrapes
+            fam = "pio_group_commit_flush_seconds"
+            for q in (0.5, 0.9, 0.99):
+                offline = m.histogram_quantile_from_samples(union, fam, q)
+                merged = m.histogram_quantile_from_samples(fed, fam, q)
+                assert offline is not None
+                assert repr(offline) == repr(merged), (q, offline, merged)
+            # and the raw cumulative bucket vectors sum exactly
+            for key, value in union.items():
+                if m.sample_family_name(key) != f"{fam}_bucket":
+                    continue
+                le = m.sample_label_value(key, "le")
+                shard = m.sample_label_value(key, "shard")
+                fed_total = sum(
+                    v for k, v in fed.items()
+                    if m.sample_family_name(k) == f"{fam}_bucket"
+                    and m.sample_label_value(k, "le") == le
+                    and m.sample_label_value(k, "shard") == shard
+                )
+                assert fed_total == value, key
+            # gauges: per-instance identity, never summed — each
+            # worker's event-loop lag stays its own sample (the gauge
+            # moves between scrapes, so the assertion is structural:
+            # two instance-labeled samples, and NO un-instanced sample
+            # that could be a cross-worker sum)
+            lag_samples = {
+                k: v for k, v in fed.items()
+                if m.sample_family_name(k) == "pio_eventloop_lag_seconds"
+            }
+            instances = {
+                m.sample_label_value(k, "instance") for k in lag_samples
+            }
+            assert len(instances) == 2 and None not in instances, (
+                lag_samples
+            )
+        finally:
+            for p in procs:
+                p.terminate()
+            for p in procs:
+                try:
+                    p.communicate(timeout=30)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+
+
+class TestCrossProcessStitching:
+    """Acceptance: one traced request's stitched tree holds spans from
+    ≥2 distinct PROCESSES — the event server (this process) and the
+    gateway subprocess whose committer flushed the write — joined by
+    the collector."""
+
+    def test_ingest_trace_stitches_event_server_and_gateway(self, tmp_path):
+        from predictionio_tpu.api.event_server import EventAPI
+        from predictionio_tpu.api.sideband import ObservabilitySideband
+        from predictionio_tpu.data.storage import Storage
+        from predictionio_tpu.data.storage.base import AccessKey, App
+        from predictionio_tpu.utils.tracing import format_trace
+
+        tr.clear()
+        env = _sqlite_env(tmp_path)
+        gw_port = free_port()
+        gw = subprocess.Popen(
+            [
+                sys.executable, "-m", "predictionio_tpu.tools.cli",
+                "storagegateway", "--port", str(gw_port),
+            ],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, env=env,
+        )
+        sb = None
+        try:
+            wait_http(f"http://127.0.0.1:{gw_port}/healthz", timeout=90)
+            name = "GW"
+            storage = Storage({
+                f"PIO_STORAGE_SOURCES_{name}_TYPE": "http",
+                f"PIO_STORAGE_SOURCES_{name}_URL": (
+                    f"http://127.0.0.1:{gw_port}"
+                ),
+                "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": name,
+                "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": name,
+                "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": name,
+            })
+            app_id = storage.get_meta_data_apps().insert(
+                App(id=0, name="st")
+            )
+            storage.get_meta_data_access_keys().insert(
+                AccessKey(key="sk", appid=app_id, events=())
+            )
+            storage.get_l_events().init(app_id)
+            # this process (the "event server") is scraped via its own
+            # sideband, exactly like a fleet worker would be
+            sb = ObservabilitySideband(port=0).start()
+            status, body = EventAPI(storage=storage).handle(
+                "POST", "/events.json", {"accessKey": "sk"},
+                json.dumps({
+                    "event": "buy", "entityType": "user", "entityId": "u1",
+                }).encode(),
+                headers={"x-pio-trace-id": "stitch-1"},
+            )
+            assert status == 201, body
+
+            col = Collector(
+                [
+                    f"http://127.0.0.1:{sb.port}",
+                    f"http://127.0.0.1:{gw_port}",
+                ],
+                poll_interval_s=0.2,
+            )
+            deadline = time.time() + 30
+            spans = []
+            while time.time() < deadline:
+                col.poll_once()
+                spans = col.stitched_spans(trace_id="stitch-1")
+                if len({s["instance"] for s in spans}) >= 2:
+                    break
+                time.sleep(0.2)
+            names = {s["name"] for s in spans}
+            assert "http:POST /events.json" in names
+            assert "insert" in names
+            assert "rpc:levents.insert" in names, names
+            assert "group-commit-flush" in names
+            # ≥2 distinct processes in ONE stitched trace
+            by_name = {s["name"]: s for s in spans}
+            assert (
+                by_name["insert"]["instance"]
+                != by_name["rpc:levents.insert"]["instance"]
+            )
+            # the cross-process parent link survived stitching: the
+            # gateway's rpc span chains under this process's insert span
+            assert (
+                by_name["rpc:levents.insert"]["parentId"]
+                == by_name["insert"]["spanId"]
+            )
+            # and the gateway's committer flush chains under the rpc
+            assert (
+                by_name["group-commit-flush"]["parentId"]
+                == by_name["rpc:levents.insert"]["spanId"]
+            )
+            # the whole chain renders as ONE indented tree (no orphan
+            # roots besides the http entry)
+            tree = format_trace(spans)
+            assert tree.splitlines()[0].startswith("http:POST /events.json")
+            assert "      group-commit-flush" in tree
+        finally:
+            if sb is not None:
+                sb.shutdown()
+            gw.terminate()
+            try:
+                gw.communicate(timeout=30)
+            except subprocess.TimeoutExpired:
+                gw.kill()
