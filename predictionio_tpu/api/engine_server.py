@@ -55,6 +55,8 @@ from predictionio_tpu.api.engine_plugins import (
 from predictionio_tpu.api.aio_http import TRANSPORTS, make_http_server
 from predictionio_tpu.controller.engine import Engine, EngineParams
 from predictionio_tpu.data.storage import Storage, get_storage
+from predictionio_tpu.utils import compilation_cache as _cc
+from predictionio_tpu.utils import device_ledger as _ledger
 from predictionio_tpu.utils import health as _health
 from predictionio_tpu.utils import metrics as _metrics
 from predictionio_tpu.utils import tracing as _tracing
@@ -199,6 +201,7 @@ class DeployedEngine:
         engine_params: EngineParams,
         engine_instance,
         models: List[Any],
+        ledger_scope: Optional["_ledger.LedgerScope"] = None,
     ):
         self.engine = engine
         self.engine_params = engine_params
@@ -209,6 +212,15 @@ class DeployedEngine:
             raise ValueError(
                 f"{len(self.models)} models for {len(self.algorithms)} algorithms"
             )
+        # HBM residency ledger scope: device buffers registered during
+        # this instance's prepare/warm are grouped under its engine-
+        # instance id, so release() can assert THEY reached zero — even
+        # with a same-version twin resident (the bare-/reload case).
+        # from_storage hands in the scope that already covers
+        # prepare_deploy; direct construction gets a fresh one.
+        self._ledger_scope = ledger_scope or _ledger.get_ledger().scope(
+            str(getattr(engine_instance, "id", None) or "unknown")
+        )
         # compile serving executables before taking traffic (cold compiles
         # cost seconds and would land on the first unlucky requests);
         # persist them so the NEXT deploy of this engine skips the
@@ -218,8 +230,9 @@ class DeployedEngine:
         )
 
         ensure_compilation_cache()
-        for algo, model in zip(self.algorithms, self.models):
-            algo.warm(model)
+        with self._ledger_scope.activate():
+            for algo, model in zip(self.algorithms, self.models):
+                algo.warm(model)
         # in-flight batch accounting: the promotion pipeline's drain
         # stage waits on this before freeing the displaced instance's
         # device-resident serving state (release_serving). The condition
@@ -283,14 +296,21 @@ class DeployedEngine:
                 f"no persisted models for engine instance {instance.id!r}"
             )
         persisted = loads_model(blob.models)
-        models = engine.prepare_deploy(
-            ctx,
-            engine_params,
-            instance.id,
-            persisted,
-            workflow_params or WorkflowParams(),
+        # the ledger scope opens BEFORE prepare_deploy: prepare_serving
+        # parks the resident factors/masks on device in there, and those
+        # registrations must carry this instance's owner label
+        scope = _ledger.get_ledger().scope(str(instance.id))
+        with scope.activate():
+            models = engine.prepare_deploy(
+                ctx,
+                engine_params,
+                instance.id,
+                persisted,
+                workflow_params or WorkflowParams(),
+            )
+        return cls(
+            engine, engine_params, instance, models, ledger_scope=scope
         )
-        return cls(engine, engine_params, instance, models)
 
     # --- the serving pipeline over one coalesced batch ---
 
@@ -379,7 +399,21 @@ class DeployedEngine:
                     logger.exception(
                         "release_serving failed for %s", type(algo).__name__
                     )
+        # the monitored release invariant (the PR 13 leak class): every
+        # device buffer this instance registered during prepare/warm
+        # must be back to zero now — nonzero counts in
+        # pio_device_ledger_leaks_total and logs, instead of silently
+        # pinning HBM until the process dies. (A straggler that raced
+        # past the swap rebuilds serving state OUTSIDE this scope — the
+        # transient shows up as component bytes and drift, never as a
+        # false leak here.)
+        self._ledger_scope.check_released()
         return True
+
+    def ledger_bytes(self) -> int:
+        """Device bytes currently registered under this instance's
+        ledger scope (tests + status detail)."""
+        return self._ledger_scope.bytes()
 
 
 class _BatchingExecutor:
@@ -588,9 +622,22 @@ class _BatchingExecutor:
     def _serve_and_release(self, dep: DeployedEngine, items) -> None:
         t0 = time.time()
         outcomes: List[tuple] = []
+        # the batch runs under a serving compile_site (any executable
+        # compile inside is a COLD compile: counted per site, span-
+        # recorded, and drained below onto the predict span) and under
+        # the first traced item's ambient trace, so a compile span
+        # chains into the request's trace tree
+        batch_trace = next(
+            (t[0] for _, _, _, t in items if t is not None), None
+        )
+        compile_events: List[dict] = []
         try:
-            with self._hb.busy():
-                self._serve_isolating(dep, items, outcomes)
+            with self._hb.busy(), _cc.compile_site("serving"), \
+                    _tracing.use(batch_trace):
+                try:
+                    self._serve_isolating(dep, items, outcomes)
+                finally:
+                    compile_events = _cc.drain_compile_events()
         finally:
             self._inflight.release()
             t1 = time.time()
@@ -601,10 +648,13 @@ class _BatchingExecutor:
                 # predict: the device serve_batch call (incl. bisect
                 # retries); batch: queue wait + serve, the executor's
                 # whole share of the request
+                predict_attrs: Dict[str, Any] = {"batch_size": len(items)}
+                if compile_events:
+                    predict_attrs["cold_compiles"] = compile_events
                 _tracing.record_span(
                     "predict", trace.trace_id, parent_id=batch_span_id,
                     start_s=t0, duration_s=t1 - t0,
-                    attrs={"batch_size": len(items)},
+                    attrs=predict_attrs,
                 )
                 _tracing.record_span(
                     "batch", trace.trace_id, span_id=batch_span_id,
@@ -1025,6 +1075,17 @@ class QueryAPI:
         if path == "/status.json" and method == "GET":
             return 200, self._status_json(), "application/json"
         if path == "/metrics" and method == "GET":
+            # refresh the pull-style device gauges on the way out: the
+            # ledger-vs-memory_stats drift and the persistent
+            # executable-cache size are point-in-time reads (cheap; a
+            # handful of stat calls), so scrape time is the right time
+            try:
+                _ledger.get_ledger().reconcile()
+                _cc.persistent_cache_stats()
+            except Exception:
+                logger.debug(
+                    "device-gauge refresh failed", exc_info=True
+                )
             return (
                 200,
                 _metrics.get_registry().render(),
@@ -1032,6 +1093,8 @@ class QueryAPI:
             )
         if path == "/debug/traces.json" and method == "GET":
             return self._debug_traces(query)
+        if path == "/debug/profile":
+            return self._debug_profile(method, query)
         if path == "/debug/predictions.json" and method == "GET":
             return self._debug_predictions(query)
         if path == "/queries.json" and method == "POST":
@@ -1102,6 +1165,36 @@ class QueryAPI:
         from predictionio_tpu.api.http import traces_payload
 
         status, payload = traces_payload(query)
+        return status, payload, "application/json"
+
+    def _debug_profile(
+        self, method: str, query: Dict[str, str]
+    ) -> Tuple[int, Any, str]:
+        """On-demand profiler capture (utils/profiling.profile_route):
+        ``POST ?seconds=N`` runs one bounded jax.profiler capture and
+        returns the zipped trace base64-encoded; ``GET`` is status.
+        Device timelines expose workload structure, so the endpoint —
+        like /debug/predictions.json — REQUIRES a configured access
+        key. Under the async transport this runs on the route pool, so
+        a capture never blocks the event loop or the serving hot path."""
+        if not self.config.access_key:
+            return (
+                403,
+                {
+                    "message": "profile capture requires a configured "
+                    "access key (deploy with --accesskey)."
+                },
+                "application/json",
+            )
+        from predictionio_tpu.utils.profiling import profile_route
+
+        status, payload = profile_route(
+            method,
+            query,
+            secrets.compare_digest(
+                query.get("accessKey", ""), self.config.access_key
+            ),
+        )
         return status, payload, "application/json"
 
     def _debug_predictions(self, query: Dict[str, str]) -> Tuple[int, Any, str]:
@@ -1379,6 +1472,12 @@ class QueryAPI:
             # promotion-pipeline outcomes (workflow/promotion.py): the
             # in-process view of pio_promotion_total
             "promotion": promotion_stats(),
+            # HBM residency ledger detail: per-device, per-component
+            # registered bytes (the `pio top` detail view's source)
+            "deviceLedger": {
+                "totalBytes": _ledger.get_ledger().total_bytes(),
+                "breakdown": _ledger.get_ledger().breakdown(),
+            },
             # daily self-check (reference CreateServer.scala:253-260)
             "upgradeStatus": upgrade_status,
             "upgradeLastChecked": upgrade_checked,
@@ -1500,13 +1599,18 @@ class EngineServer:
     def shutdown(self) -> None:
         self._http.shutdown()
         self.api.close()
-        # free the retained rollback states' device buffers — tests and
-        # operators cycle many servers per process
+        # free the retained rollback states' device buffers AND the
+        # actively deployed instance's — tests and operators cycle many
+        # servers per process, and a down server keeping factors
+        # resident is exactly the residency the device ledger flags.
+        # The active release waits out in-flight batches (bounded);
+        # release() itself asserts the ledger invariant.
         with self._retained_lock:
             retained = list(self._retained.values())
             self._retained.clear()
         for dep in retained:
             dep.release(timeout_s=1.0)
+        self.api.deployed.release(timeout_s=1.0)
 
     def retained_versions(self) -> List[str]:
         """The engine-instance ids of the retained (instant-rollback)

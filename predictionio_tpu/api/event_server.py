@@ -367,6 +367,21 @@ class EventAPI:
 
             return traces_payload(query)
 
+        if path == "/debug/profile":
+            # on-demand profiler capture (utils/profiling.profile_route)
+            # — device timelines expose workload structure, so it is
+            # gated exactly like the data routes. A POST blocks for its
+            # whole capture window, which is safe on BOTH transports:
+            # async offloads every route to the bounded handler pool
+            # (the capture parks one worker, same as a slow scan), and
+            # threaded blocks its per-connection thread.
+            auth, err = self._authenticate(query)
+            if err:
+                return err
+            from predictionio_tpu.utils.profiling import profile_route
+
+            return profile_route(method, query, True)
+
         if parts[0] == "plugins" and len(parts) >= 3 and method == "GET":
             auth, err = self._authenticate(query)
             if err:

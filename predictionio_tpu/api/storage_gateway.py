@@ -179,6 +179,19 @@ class StorageGatewayCore:
             from predictionio_tpu.api.http import traces_payload
 
             return traces_payload(query)
+        if path == "/debug/profile":
+            # on-demand profiler capture, gated by the same shared
+            # secret as the span dump (utils/profiling.profile_route)
+            from predictionio_tpu.utils.profiling import profile_route
+
+            return profile_route(
+                method,
+                query,
+                not self.secret
+                or hmac.compare_digest(
+                    (query or {}).get("secret", ""), self.secret
+                ),
+            )
         if path != "/rpc" or method != "POST":
             return 404, {"error": f"unknown route {method} {path}"}
         try:

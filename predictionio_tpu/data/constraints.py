@@ -16,7 +16,12 @@ OUT-OF-BAND refresh:
 - Refreshes that CHANGE the set notify ``on_change`` listeners — the
   retrieval tier (ops/retrieval.py) subscribes to rebuild its resident
   on-device candidacy mask, which is what "refreshed out-of-band on
-  constraint-entity change" means end to end.
+  constraint-entity change" means end to end. The mask's device
+  residency is accounted in the HBM ledger under the retriever's
+  ``<component>-mask`` entry (utils/device_ledger.py): every
+  constraint-driven re-upload re-``set``s that entry, so
+  ``pio_device_ledger_bytes`` tracks the mask through its whole
+  refresh lifecycle.
 - Every read outcome is counted in
   ``pio_constraint_cache_total{outcome=hit|miss|error}`` (miss = an
   actual store read, inline or background; error = the store raised and

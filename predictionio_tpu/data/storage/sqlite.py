@@ -333,11 +333,16 @@ class _GroupCommitter:
 
     def _commit_batch(self, batch: list) -> None:
         from predictionio_tpu.utils import tracing as _tracing
+        from predictionio_tpu.utils.compilation_cache import compile_site
 
         t0 = _time.perf_counter()
         t0_wall = _time.time()
         shard = self._shard
-        with self._hb.busy(), shard.lock:
+        # the flush is a latency-critical site: an executable compile
+        # in here (nothing should compile during an ingest flush, which
+        # is exactly why one must be loudly attributable) counts in
+        # pio_cold_compiles_total{site="ingest"}
+        with self._hb.busy(), compile_site("ingest"), shard.lock:
             try:
                 for u in batch:
                     shard.conn.executemany(u.sql, u.rows)

@@ -387,10 +387,12 @@ class ALSModel:
             return unknown
         max_num = max(n for _, _, n in known)
         # pad the top-k width to a power of two (min 16) so varying query
-        # `num`s share O(log) compiled executables instead of one each
-        max_num = min(
-            max(16, 1 << (max_num - 1).bit_length()), len(self.item_index)
-        )
+        # `num`s share O(log) compiled executables instead of one each —
+        # the shared ladder rule, which also records the ladder's padding
+        # waste in pio_padding_waste_ratio{site="retrieval_topk"}
+        from predictionio_tpu.ops.retrieval import pow2_topk_width
+
+        max_num = pow2_topk_width(max_num, len(self.item_index))
         scores, idx = self.serving.topn_by_user(
             [u for _, u, _ in known], max_num
         )

@@ -51,6 +51,8 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from predictionio_tpu.parallel.mesh import pad_to_multiple
+from predictionio_tpu.utils import compilation_cache as _cc
+from predictionio_tpu.utils import device_ledger as _dl
 from predictionio_tpu.utils import metrics as _metrics
 
 logger = logging.getLogger(__name__)
@@ -1408,6 +1410,22 @@ def train_from_wire(
     )
     if timings is not None:
         timings["padded_slots"] = wire.padded_slots
+    # geometry-bucket padding waste: each rating occupies one slot on
+    # each side's segment grid; everything else is padding the bucketed
+    # executables bought (pio_padding_waste_ratio{site="als_pack"})
+    slots = wire.padded_slots
+    if slots:
+        nnz = int(wire.counts_u.sum())
+        _metrics.get_registry().gauge(
+            "pio_padding_waste_ratio",
+            "Fraction of a padded dimension that is padding (0 = no "
+            "waste): serving batch rows, top-k ladder width, ALS "
+            "geometry-bucket slots — the compile-sharing cost the "
+            "capacity planning reads",
+            labels=("site",),
+        ).labels(site="als_pack").set(
+            max(0.0, (slots - 2 * nnz) / slots)
+        )
     return _train_packed(
         user_pack, item_pack, *factor_state,
         config=config, mesh=None, axis="data",
@@ -1595,6 +1613,12 @@ def _record_compile(outcome: str, busy_s: float = 0.0) -> None:
         "ALS iteration-executable compile events by outcome",
         labels=("outcome",),
     ).labels(outcome=outcome).inc()
+    if outcome in ("warmed", "inline"):
+        # the geometry-bucket ladder reports into the shared
+        # executable-cache accounting (cold-site attribution included:
+        # an inline compile under a serving/ingest compile_site counts
+        # in pio_cold_compiles_total)
+        _cc.record_executable_compile("als-geometry", busy_s)
     if busy_s:
         reg.counter(
             "pio_als_compile_seconds_total",
@@ -1704,6 +1728,20 @@ def _train_packed(
     rep_sharding = NamedSharding(mesh, P()) if mesh is not None else None
     row_sharded = P(axis) if mesh is not None else P()
     row_sharding = NamedSharding(mesh, row_sharded) if mesh is not None else None
+
+    # HBM residency ledger: the live factor state is resident for the
+    # whole fused loop. The Anchor ties the entry to this frame, so an
+    # exception mid-train still zeroes it; the explicit close below
+    # fires on the normal path right after the factors come home.
+    _ledger_anchor = _dl.Anchor()
+    _fs_label, _fs_bytes, _fs_members = _dl.device_footprint(X, Y)
+    _ledger_handle = _dl.get_ledger().register(
+        component="train-factors",
+        nbytes=_fs_bytes,
+        device=_fs_label,
+        anchor=_ledger_anchor,
+        members=_fs_members,
+    )
 
     def run_iters(X, Y, n_iters: int):
         return _run_iterations(
@@ -1870,6 +1908,7 @@ def _train_packed(
         else:
             X_host, Y_host = _fetch_global(X), _fetch_global(Y)
         sweep_rows = _fetch_telemetry(tel_parts) if config.sweep_telemetry else None
+    _ledger_handle.close()
     if sweep_rows is not None and len(sweep_rows):
         _record_sweep_telemetry(
             sweep_rows,
@@ -1981,6 +2020,11 @@ def _topn_packed_chain(factors_q, Y, n, n_iters):
     return jax.lax.fori_loop(0, n_iters, body, init)
 
 
+# serving top-k executable keys this process already compiled (the
+# _topn_packed jit caches are process-global, so the seen-set is too)
+_TOPK_SEEN: set = set()
+
+
 class ServingFactors:
     """Device-resident factors for the serving hot path.
 
@@ -2022,6 +2066,24 @@ class ServingFactors:
                 np.asarray(item_factors, np.float32), rep
             )
         self.n_items = self._if_dev.shape[0]
+        # HBM residency ledger: the replicated serving upload — the
+        # footprint counts every per-device COPY (physical bytes), and
+        # the member map attributes each copy to its device for drift
+        # reconciliation. No explicit free path exists (release_serving
+        # just drops the reference and the buffers free by refcount),
+        # so the anchor finalizer IS the close — the ledger entry
+        # zeroes when the last reference (including a straggler
+        # batch's) resolves.
+        label, nbytes, members = _dl.device_footprint(
+            self._uf_dev, self._if_dev
+        )
+        self._ledger = _dl.get_ledger().register(
+            component="serving-factors",
+            nbytes=nbytes,
+            device=label,
+            anchor=self,
+            members=members,
+        )
 
     def topn_by_rows(self, user_rows: np.ndarray, n: int):
         """Top-N for explicit query factor rows [B, k]."""
@@ -2044,17 +2106,26 @@ class ServingFactors:
         from predictionio_tpu.ops.similarity import pad_rows_pow2
 
         q = pad_rows_pow2(user_rows, 8)
+        # executable-cache accounting for the serving top-k ladder: the
+        # jit cache is keyed by (padded batch, catalog shape, n); a new
+        # key is a compile — cold if it lands inside a serving batch
+        exec_key = (
+            q.shape, self._if_dev.shape, n, self.mesh is None,
+        )
         if self.mesh is None:
             q_dev = jax.device_put(q)
-            return _topn_packed(q_dev, self._if_dev, n)
+            with _cc.track_compile("serving-topk", _TOPK_SEEN, exec_key):
+                return _topn_packed(q_dev, self._if_dev, n)
         # shard_batch further pads so the batch divides the mesh axis
         # (a no-op for power-of-two axes), then places row-sharded
         from predictionio_tpu.parallel.mesh import shard_batch
 
         q_dev, _ = shard_batch(self.mesh, q, self._axis)
-        return _topn_packed_sharded(
-            q_dev, self._if_dev, n, NamedSharding(self.mesh, P(self._axis))
-        )
+        with _cc.track_compile("serving-topk", _TOPK_SEEN, exec_key):
+            return _topn_packed_sharded(
+                q_dev, self._if_dev, n,
+                NamedSharding(self.mesh, P(self._axis)),
+            )
 
     def warm(self, n: int = 16, max_batch: int = 128) -> None:
         """Compile every padded-batch-size executable the serving path can

@@ -45,6 +45,22 @@ Metrics (utils/metrics.py conventions, visible in ``pio top``):
 host sync), ``pio_retrieval_mask_refresh_total{component,outcome}``,
 ``pio_retrieval_mask_age_seconds{component}``, and
 ``pio_retrieval_resident_bytes{component}``.
+
+Device-observability round: the resident factors/norms and the
+candidacy mask register in the HBM residency ledger
+(``pio_device_ledger_bytes{device,component,owner}``,
+utils/device_ledger.py) — component ``<component>`` for factors+norms,
+``<component>-mask`` for the constraint-fed mask; executable compiles
+(the fused single-device program and the per-shard stage-1 ladder)
+report through utils/compilation_cache.py's executable-cache
+accounting, so one compiling inside a live serving batch is counted in
+``pio_cold_compiles_total{site="serving"}`` and annotated on the
+serving trace. Sampled batches also record padding waste
+(``pio_padding_waste_ratio{site}``) and cross-shard skew
+(``pio_retrieval_shard_skew{kind}`` — candidate-count and final-result
+imbalance over the mesh, the stage-1 load-imbalance proxy: per-shard
+scoring work is shape-uniform, so imbalance shows up in candidate
+survival, not FLOPs).
 """
 
 from __future__ import annotations
@@ -62,6 +78,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from predictionio_tpu.ops.similarity import pad_rows_pow2, pow2_at_least
 from predictionio_tpu.parallel.mesh import pad_to_multiple
+from predictionio_tpu.utils import compilation_cache as _cc
+from predictionio_tpu.utils import device_ledger as _ledger
 from predictionio_tpu.utils import metrics as _metrics
 
 logger = logging.getLogger(__name__)
@@ -69,6 +87,12 @@ logger = logging.getLogger(__name__)
 # how often the sharded path takes the host sync that splits shard-topk
 # vs merge timing (see ItemRetriever.topn)
 _SPLIT_SAMPLE_EVERY = 16
+
+# executable keys this process already compiled on the SHARED
+# single-device fused-program jit cache (executable-cache accounting:
+# the cache is process-global, so the seen-set must be too — a second
+# retriever with identical shapes hits jit's cache, not a compile)
+_FUSED_SEEN: set = set()
 
 
 def _reciprocal_norms(factors: np.ndarray) -> np.ndarray:
@@ -121,8 +145,15 @@ def unpack_topn(packed: np.ndarray, n: int) -> Tuple[np.ndarray, np.ndarray]:
 def pow2_topk_width(max_num: int, n_items: int) -> int:
     """The top-k width to request for a batch whose largest query wants
     ``max_num`` results: a power of two (min 16) so varying ``num``s
-    share O(log) compiled executables, clamped to the catalog."""
-    return min(max(16, pow2_at_least(max_num)), n_items)
+    share O(log) compiled executables, clamped to the catalog. Records
+    the ladder's padding waste (requested vs padded width) in
+    ``pio_padding_waste_ratio{site="retrieval_topk"}``."""
+    w = min(max(16, pow2_at_least(max_num)), n_items)
+    if w > 0:
+        _m_padding_waste().labels(site="retrieval_topk").set(
+            (w - min(max_num, w)) / w
+        )
+    return w
 
 
 def trimmed_results(
@@ -302,6 +333,36 @@ def _m_resident_bytes():
     )
 
 
+def _m_padding_waste():
+    return _metrics.get_registry().gauge(
+        "pio_padding_waste_ratio",
+        "Fraction of a padded dimension that is padding (0 = no waste): "
+        "serving batch rows, top-k ladder width, ALS geometry-bucket "
+        "slots — the compile-sharing cost the capacity planning reads",
+        labels=("site",),
+    )
+
+
+def _m_shard_skew():
+    return _metrics.get_registry().gauge(
+        "pio_retrieval_shard_skew",
+        "Cross-shard retrieval imbalance on sampled batches: "
+        "max-shard / mean-shard of live stage-1 candidates "
+        "(kind=candidates) and of final top-n contributions "
+        "(kind=results); 1.0 = perfectly even",
+        labels=("kind",),
+    )
+
+
+def _m_shard_candidates():
+    return _metrics.get_registry().gauge(
+        "pio_retrieval_shard_candidates",
+        "Live stage-1 candidates contributed per shard on the most "
+        "recent sampled batch",
+        labels=("shard",),
+    )
+
+
 class ItemRetriever:
     """Device-resident top-N retrieval over one item-factor matrix.
 
@@ -376,10 +437,41 @@ class ItemRetriever:
             self._stage1_cache: Dict[tuple, object] = {}
         self._batches = 0
         self._freed = False
+        # per-(n_local, flags, shapes) executables this instance already
+        # compiled (executable-cache accounting for the stage-1 ladder;
+        # the jit cache behind it is per-instance via self._stage1_cache)
+        self._exec_seen: set = set()
         self._mask_stamp = time.monotonic()
         _m_mask_age().labels(component=component).set(0.0)
         _m_resident_bytes().labels(component=component).set(
             padded.nbytes + rn.nbytes + self._valid.nbytes
+        )
+        # HBM residency ledger: factors+norms under the component name,
+        # the constraint-fed candidacy mask under <component>-mask (its
+        # lifecycle differs — re-uploaded on constraint change). The
+        # per-device footprint maps attribute each shard's bytes to its
+        # own device for drift reconciliation; the anchor finalizers
+        # are the refcount backstop and free() closes explicitly on the
+        # drain/release path.
+        f_label, f_bytes, f_members = _ledger.device_footprint(
+            self._y_dev, self._rn_dev
+        )
+        self._ledger_factors = _ledger.get_ledger().register(
+            component=component,
+            nbytes=f_bytes,
+            device=f_label,
+            anchor=self,
+            members=f_members,
+        )
+        m_label, m_bytes, m_members = _ledger.device_footprint(
+            self._allow_dev
+        )
+        self._ledger_mask = _ledger.get_ledger().register(
+            component=f"{component}-mask",
+            nbytes=m_bytes,
+            device=m_label,
+            anchor=self,
+            members=m_members,
         )
         logger.info(
             "ItemRetriever[%s]: %d items (rank %d) resident %s",
@@ -423,6 +515,8 @@ class ItemRetriever:
                 allow, NamedSharding(self.mesh, P(self._axis))
             )
         self._excluded_ids = idx
+        _, m_bytes, m_members = _ledger.device_footprint(self._allow_dev)
+        self._ledger_mask.set(m_bytes, members=m_members)
         _m_mask_refresh().labels(
             component=self.component, outcome="refreshed"
         ).inc()
@@ -514,17 +608,29 @@ class ItemRetriever:
             list(include or []) + [None] * (b_pad - b), b_pad
         )
         _m_mask_age().labels(component=self.component).set(self.mask_age_s)
+        _m_padding_waste().labels(site="retrieval_batch").set(
+            (b_pad - b) / b_pad
+        )
         if self.mesh is None:
             t0 = time.perf_counter()
             dev = self._device
             put = lambda a: (
                 jax.device_put(a, dev) if dev is not None else jnp.asarray(a)
             )
-            packed = _fused_topn_single(
-                put(qp), self._y_dev, self._rn_dev, self._allow_dev,
-                put(excl), put(incl), put(has_incl),
+            # executable-cache accounting: the fused program's jit cache
+            # is keyed by shapes + statics; a NEW key here is a compile
+            # (cold if it happens under a serving compile_site)
+            exec_key = (
+                self._n_pad, self.rank, b_pad,
+                excl.shape[1], incl.shape[1],
                 n, positive_only, normalize,
             )
+            with _cc.track_compile("retrieval-fused", _FUSED_SEEN, exec_key):
+                packed = _fused_topn_single(
+                    put(qp), self._y_dev, self._rn_dev, self._allow_dev,
+                    put(excl), put(incl), put(has_incl),
+                    n, positive_only, normalize,
+                )
             host = np.asarray(packed)[:b]
             _m_shard_seconds().observe(time.perf_counter() - t0)
             return unpack_topn(host, n)
@@ -543,11 +649,16 @@ class ItemRetriever:
         # run barrier-free and record nothing in these families
         self._batches += 1
         split = self._batches % _SPLIT_SAMPLE_EVERY == 1
-        t0 = time.perf_counter()
-        cand = stage1(
-            q_dev, self._y_dev, self._rn_dev, self._allow_dev,
-            excl_dev, incl_dev, has_dev,
+        exec_key = (
+            n_local, positive_only, normalize, b_pad,
+            excl.shape[1], incl.shape[1],
         )
+        t0 = time.perf_counter()
+        with _cc.track_compile("retrieval-stage1", self._exec_seen, exec_key):
+            cand = stage1(
+                q_dev, self._y_dev, self._rn_dev, self._allow_dev,
+                excl_dev, incl_dev, has_dev,
+            )
         if split:
             jax.block_until_ready(cand)
             t1 = time.perf_counter()
@@ -556,7 +667,40 @@ class ItemRetriever:
         host = np.asarray(packed)[:b]
         if split:
             _m_merge_seconds().observe(time.perf_counter() - t1)
+            # sampled skew: the candidate buffer is already synced (the
+            # split's block_until_ready), so the extra fetch costs one
+            # host copy on 1/_SPLIT_SAMPLE_EVERY batches only
+            self._record_skew(np.asarray(cand)[:b], host, n, n_local)
         return unpack_topn(host, n)
+
+    def _record_skew(
+        self, cand: np.ndarray, host: np.ndarray, n: int, n_local: int
+    ) -> None:
+        """Cross-shard imbalance from one sampled batch: live stage-1
+        candidates per shard, and which shard each final top-n row came
+        from. Uniform shapes make per-shard FLOPs equal, so imbalance —
+        the thing that stretches the merge's critical path — shows up
+        here, not in timers."""
+        S = self._n_shards
+        if S <= 1 or not len(cand):
+            return
+        arr = cand.reshape(cand.shape[0], S, 2, n_local)
+        live = (arr[:, :, 0, :] > -np.inf).sum(axis=(0, 2)).astype(float)
+        g = _m_shard_candidates()
+        for s in range(S):
+            g.labels(shard=str(s)).set(float(live[s]))
+        if live.mean() > 0:
+            _m_shard_skew().labels(kind="candidates").set(
+                float(live.max() / live.mean())
+            )
+        idx = np.ascontiguousarray(host[:, n:]).view(np.int32)
+        scores = host[:, :n]
+        owners = idx[scores > -np.inf] // (self._n_pad // S)
+        counts = np.bincount(owners, minlength=S).astype(float)
+        if counts.mean() > 0:
+            _m_shard_skew().labels(kind="results").set(
+                float(counts.max() / counts.mean())
+            )
 
     def _stage1(self, n_local: int, positive_only: bool, normalize: bool):
         key = (n_local, positive_only, normalize)
@@ -606,6 +750,8 @@ class ItemRetriever:
         if self.mesh is not None:
             self._stage1_cache = {}
         _m_resident_bytes().labels(component=self.component).set(0.0)
+        self._ledger_factors.close()
+        self._ledger_mask.close()
 
     def warm(
         self,

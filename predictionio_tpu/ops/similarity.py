@@ -88,6 +88,18 @@ class SimilarityScorer:
             self._dev = jax.device_put(jnp.asarray(self.normed))
         else:
             self._dev, _ = shard_batch(mesh, self.normed, axis)
+        # HBM residency ledger: released by refcount (no explicit free
+        # path), so the anchor finalizer is the close
+        from predictionio_tpu.utils import device_ledger as _ledger
+
+        label, nbytes, members = _ledger.device_footprint(self._dev)
+        self._ledger = _ledger.get_ledger().register(
+            component="similarity-factors",
+            nbytes=nbytes,
+            device=label,
+            anchor=self,
+            members=members,
+        )
 
     @property
     def n(self) -> int:

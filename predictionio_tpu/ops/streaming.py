@@ -78,6 +78,25 @@ class _PackEntry:
     # event-sized buffers beyond the wire the cache already held.
     cursor: Optional[tuple] = None  # storage delta cursor, None: no delta
     arrays: Optional["_als.ALSModelArrays"] = None  # factors of this wire
+    # HBM residency ledger entry (device="host": cached wires are host
+    # RAM, but they are long-lived residency the capacity view must see)
+    ledger: Optional[object] = None
+
+    def resident_bytes(self) -> int:
+        wire = self.wire
+        total = (
+            wire.iw.nbytes
+            + wire.vw.nbytes
+            + wire.counts_u.nbytes
+            + wire.counts_i.nbytes
+            + sum(int(a.nbytes) for a in wire.aux.values())
+        )
+        if self.arrays is not None:
+            total += (
+                self.arrays.user_factors.nbytes
+                + self.arrays.item_factors.nbytes
+            )
+        return int(total)
 
 
 _PACK_CACHE: "OrderedDict[tuple, _PackEntry]" = OrderedDict()
@@ -105,7 +124,11 @@ def pack_cache_clear() -> None:
     delta-training checkpoint rides in the same entry), and reset the
     hit/miss/fold counters."""
     with _PACK_CACHE_LOCK:
+        evicted = list(_PACK_CACHE.values())
         _PACK_CACHE.clear()
+    for entry in evicted:
+        if entry.ledger is not None:
+            entry.ledger.close()
     _cache_counter().reset()
 
 
@@ -182,11 +205,25 @@ def _cache_put(
         stream.fingerprint if fingerprint is None else fingerprint,
         wire, user_index, item_index, cursor=cursor,
     )
+    from predictionio_tpu.utils import device_ledger as _ledger
+
+    entry.ledger = _ledger.get_ledger().register(
+        component="pack-cache",
+        nbytes=entry.resident_bytes(),
+        device=_ledger.HOST_DEVICE,
+        anchor=entry,
+    )
+    evicted = []
     with _PACK_CACHE_LOCK:
+        displaced = _PACK_CACHE.pop(key, None)
+        if displaced is not None:
+            evicted.append(displaced)
         _PACK_CACHE[key] = entry
-        _PACK_CACHE.move_to_end(key)
         while len(_PACK_CACHE) > PACK_CACHE_MAX_ENTRIES:
-            _PACK_CACHE.popitem(last=False)
+            evicted.append(_PACK_CACHE.popitem(last=False)[1])
+    for old in evicted:
+        if old.ledger is not None:
+            old.ledger.close()
     return entry
 
 
@@ -832,6 +869,22 @@ def train_als_streaming(
     # ship (async) first, then factor-state init: the RNG + small
     # factor/regularizer puts run while the wire chunks are in flight
     device_wire = _ship_wire(wire, n_chunks=ship_chunks)
+    # HBM residency ledger: the staged wire is device-resident from
+    # ship until the device pack consumes it; the Anchor backstops an
+    # exception path, the explicit close below the normal one
+    from predictionio_tpu.utils import device_ledger as _ledger
+
+    _staging_anchor = _ledger.Anchor()
+    _st_label, _st_bytes, _st_members = _ledger.device_footprint(
+        device_wire[0], device_wire[1], *device_wire[2].values()
+    )
+    staging = _ledger.get_ledger().register(
+        component="stream-staging",
+        nbytes=_st_bytes,
+        device=_st_label,
+        anchor=_staging_anchor,
+        members=_st_members,
+    )
     factor_state = _als.init_factor_state_single(
         wire.counts_u, wire.counts_i, wire.n_users, wire.n_items,
         train_config,
@@ -859,12 +912,15 @@ def train_als_streaming(
         compile_wait=compile_wait,
         factor_state=factor_state,
     )
+    staging.close()
     if cache_entry is not None:
         # the trained factors ride the entry so the NEXT delta round can
         # warm-start; plain attribute store under the cache lock (the
         # entry may already have been evicted — harmless)
         with _PACK_CACHE_LOCK:
             cache_entry.arrays = arrays
+        if cache_entry.ledger is not None and not cache_entry.ledger.closed:
+            cache_entry.ledger.set(cache_entry.resident_bytes())
     timings["stream_wall_s"] = time.perf_counter() - t_start
     if timer is not None:
         _attribute_phases(timer, timings)

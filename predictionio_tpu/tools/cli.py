@@ -925,6 +925,63 @@ def cmd_trace(args) -> int:
     return 0
 
 
+def cmd_profile(args) -> int:
+    """``pio profile``: drive one bounded on-demand jax.profiler capture
+    on a running server's gated ``POST /debug/profile`` endpoint
+    (``--collector`` relays through a telemetry collector's
+    ``POST /api/profile`` instead) and write the returned trace archive
+    to a zip — TensorBoard's profile plugin or Perfetto loads it."""
+    import base64 as _b64
+    import json as _json
+    import urllib.parse as _up
+    import urllib.request as _ur
+
+    collector = getattr(args, "collector", None)
+    timeout = float(args.seconds) + 60.0
+    try:
+        if collector:
+            body = {"target": args.url, "seconds": args.seconds}
+            if args.secret:
+                body["secret"] = args.secret
+            req = _ur.Request(
+                collector.rstrip("/") + "/api/profile",
+                data=_json.dumps(body).encode("utf-8"),
+                headers={"Content-Type": "application/json"},
+                method="POST",
+            )
+        else:
+            params = {"seconds": str(args.seconds)}
+            if args.access_key:
+                params["accessKey"] = args.access_key
+            if args.secret:
+                params["secret"] = args.secret
+            req = _ur.Request(
+                args.url.rstrip("/")
+                + "/debug/profile?"
+                + _up.urlencode(params),
+                data=b"",
+                method="POST",
+            )
+        with _ur.urlopen(req, timeout=timeout) as resp:
+            payload = _json.loads(resp.read().decode("utf-8"))
+    except Exception as e:
+        print(f"profile: capture failed: {e}", file=sys.stderr)
+        return 1
+    archive = payload.get("archive_b64")
+    if not archive:
+        print(f"profile: no archive in response: {payload}", file=sys.stderr)
+        return 1
+    data = _b64.b64decode(archive)
+    with open(args.out, "wb") as f:
+        f.write(data)
+    print(
+        f"profile: wrote {len(data)} bytes "
+        f"({len(payload.get('files', []))} trace files, "
+        f"{payload.get('seconds')}s capture) to {args.out}"
+    )
+    return 0
+
+
 def cmd_collector(args) -> int:
     """``pio collector``: the fleet telemetry collector daemon
     (tools/collector.py + utils/telemetry.py) — federated /metrics,
@@ -1357,7 +1414,10 @@ def build_parser() -> argparse.ArgumentParser:
     train.add_argument("--stop-after-read", action="store_true")
     train.add_argument("--stop-after-prepare", action="store_true")
     train.add_argument(
-        "--profile-dir", help="write a jax.profiler trace to this directory"
+        "--profile-dir",
+        help="write a jax.profiler trace of the device loop to this "
+        "directory (same capture machinery and trace layout as the "
+        "servers' POST /debug/profile / `pio profile`)",
     )
     # multi-host training over DCN: run the same command on every host
     # with its own --host-rank (the spark-submit --num-executors analog)
@@ -1662,6 +1722,42 @@ def build_parser() -> argparse.ArgumentParser:
         "server's ring (each span shows the process it came from)",
     )
     tr.set_defaults(func=cmd_trace)
+
+    pf = sub.add_parser(
+        "profile",
+        help="capture an on-demand jax.profiler trace from a running "
+        "server (POST /debug/profile) and save the archive",
+    )
+    pf.add_argument(
+        "--url", default="http://localhost:8000",
+        help="server base URL (engine server :8000, event server :7070, "
+        "storage gateway :7077); with --collector, the TARGET the "
+        "collector should capture",
+    )
+    pf.add_argument(
+        "--seconds", type=float, default=2.0,
+        help="capture window (bounded server-side at 120s)",
+    )
+    pf.add_argument(
+        "--out", default="profile.zip",
+        help="where to write the zipped trace archive",
+    )
+    pf.add_argument(
+        "--access-key", default="",
+        help="access key (event/engine server gating)",
+    )
+    pf.add_argument(
+        "--secret", default="",
+        help="shared secret (storage gateway; collector admin secret "
+        "with --collector)",
+    )
+    pf.add_argument(
+        "--collector", default="",
+        help="telemetry collector base URL: relay the capture through "
+        "its POST /api/profile (the collector forwards its own "
+        "credentials to the target)",
+    )
+    pf.set_defaults(func=cmd_profile)
 
     rp = sub.add_parser(
         "replay",

@@ -28,6 +28,7 @@ member's gated debug surface.
 
 from __future__ import annotations
 
+import concurrent.futures
 import hmac
 import json
 import logging
@@ -73,6 +74,12 @@ class CollectorServer:
             )
         self.collector = collector
         self.admin_secret = admin_secret
+        self._transport = transport
+        # profile captures block for their whole window; one worker
+        # serializes them (jax.profiler cannot run two anyway)
+        self._profile_pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="collector-profile"
+        )
         self._http = make_http_server(
             self._handle, ip, port, "Collector", transport=transport
         )
@@ -124,6 +131,39 @@ class CollectorServer:
             return 200, c.traces_json(q.get("traceId") or None, limit)
         if path == "/api/alerts.json" and method == "GET":
             return 200, c.alerts_json()
+        if path == "/api/profile" and method == "POST":
+            # trigger + fetch one bounded profiler capture on a fleet
+            # target (the target's own secret gating still applies —
+            # the collector forwards its configured credentials).
+            # Admin-gated: the archive is a device timeline of the
+            # target's workload.
+            try:
+                payload = json.loads((body or b"{}").decode("utf-8"))
+            except (ValueError, UnicodeDecodeError) as e:
+                return 400, {"message": f"invalid JSON body: {e}"}
+            if not isinstance(payload, dict):
+                return 400, {"message": "body must be a JSON object"}
+            if not self._authorized(query, payload):
+                return 401, {"message": "invalid or missing secret"}
+            target = str(payload.get("target") or "")
+            if target not in c.target_urls():
+                return 400, {
+                    "message": "target must be a registered collector "
+                    f"target (have {c.target_urls()})"
+                }
+            try:
+                seconds = float(payload.get("seconds", 2.0))
+            except (TypeError, ValueError):
+                return 400, {"message": "invalid seconds"}
+            # a capture blocks for its whole window — off the event
+            # loop (the async transport awaits the returned future;
+            # the threaded transport's per-connection thread may block)
+            fut = self._profile_pool.submit(
+                self._do_capture, target, seconds
+            )
+            if self._transport == "async":
+                return fut
+            return fut.result()
         if path == "/api/targets.json" and method == "GET":
             return 200, {"targets": c.target_urls()}
         if path == "/api/targets" and method == "POST":
@@ -149,6 +189,15 @@ class CollectorServer:
                 return 400, {"message": str(e)}
             return 200, {"added": added, "targets": c.target_urls()}
         return 404, {"message": f"unknown route {method} {path}"}
+
+    def _do_capture(self, target: str, seconds: float):
+        try:
+            return 200, self.collector.capture_profile(target, seconds)
+        except Exception as e:
+            logger.warning(
+                "profile capture on %s failed", target, exc_info=True
+            )
+            return 502, {"message": f"capture on {target} failed: {e}"}
 
     def _render_metrics(self) -> str:
         """Federated fleet families first, then this process's OWN
@@ -177,3 +226,5 @@ class CollectorServer:
 
     def shutdown(self) -> None:
         self._http.shutdown()
+        # wait=False: an in-flight capture must not wedge teardown
+        self._profile_pool.shutdown(wait=False)

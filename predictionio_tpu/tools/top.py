@@ -149,6 +149,21 @@ def _row(snap: dict, prev: Optional[dict], elapsed_s: float) -> dict:
     mask_age = gauge_max(m, "pio_retrieval_mask_age_seconds")
     if mask_age is not None:
         row["mask_age_s"] = mask_age
+    # device-plane columns (utils/device_ledger.py + the efficiency
+    # gauges): total registered HBM residency, ledger-vs-memory_stats
+    # drift, worst padding-waste site, and cross-shard retrieval skew
+    hbm = counter_sum(m, "pio_device_ledger_bytes")
+    if hbm:
+        row["hbm_mb"] = hbm / 2**20
+    drift = gauge_max(m, "pio_device_ledger_drift_bytes")
+    if drift:
+        row["drift_mb"] = drift / 2**20
+    pad = gauge_max(m, "pio_padding_waste_ratio")
+    if pad is not None:
+        row["pad"] = round(pad, 3)
+    skew = gauge_max(m, "pio_retrieval_shard_skew")
+    if skew is not None:
+        row["skew"] = round(skew, 2)
     # model-quality columns: the actively served version(s) and the
     # online attributed hit rate (converted / attributed, across the
     # fleet's feedback join) — an engine server shows VERSION, an event
@@ -209,6 +224,9 @@ _COLUMNS = (
     ("rounds", "ROUNDS", 7),
     ("last_delta", "CONV", 9),
     ("resident_mb", "RES_MB", 7),
+    ("hbm_mb", "HBM_MB", 7),
+    ("pad", "PAD", 6),
+    ("skew", "SKEW", 5),
     ("mask_age_s", "MASKs", 6),
     ("nodes", "NODES", 7),
     ("restarts", "RESTART", 8),
@@ -276,6 +294,10 @@ def _row_from_fleet(t: dict) -> dict:
     if p50 is not None:
         row["p50_ms"] = p50
         row["p99_ms"] = t.get("window_p99_ms", t.get("p99_ms"))
+    # device-plane columns federated by the collector
+    for key in ("hbm_mb", "pad", "skew", "drift_mb"):
+        if t.get(key) is not None:
+            row[key] = t[key]
     return row
 
 
@@ -295,6 +317,14 @@ def render_fleet(fleet: dict) -> str:
         elif f.get("p99_ms") is not None:
             parts.append(f"p99 {f['p99_ms']:.2f}ms")
         lines.append("  ".join(parts))
+    ledger = fleet.get("ledger") or {}
+    if ledger:
+        line = f"ledger: {ledger.get('hbm_mb', 0.0):.3g} MB resident"
+        if ledger.get("max_drift_mb") is not None:
+            line += f"  max drift {ledger['max_drift_mb']:.3g} MB"
+        if ledger.get("drift_alert"):
+            line += "  DRIFT ALERT"
+        lines.append(line)
     slos = fleet.get("slos") or []
     if slos:
         rendered = []

@@ -1,0 +1,491 @@
+"""HBM residency ledger: exact per-component device-memory accounting.
+
+The capacity items on the ROADMAP (10M-item quantized catalogs, the
+device-resident incremental pack) all hinge on knowing EXACTLY what is
+parked in HBM and by whom — the ALX serving recipe (arXiv:2112.02194)
+keeps factors resident between queries, and the approximate-MF work
+(arXiv:1808.03843) makes bytes-per-item the scaling ceiling. Before
+this module, resident bytes were tracked only for the retriever
+(``pio_retrieval_resident_bytes``); every other residency — retained-LRU
+prepared serving states, replicated ServingFactors uploads, live train
+factor state, the pack cache's host wires — was invisible, which is how
+the PR 13 leak class (a displaced instance whose buffers never freed)
+could only be found by reading code.
+
+Every component that parks buffers on device registers its allocations
+through this process-global ledger:
+
+- :meth:`DeviceLedger.register` returns a :class:`LedgerHandle` the
+  component updates (``set``/``add``) and closes when the buffers are
+  released. Passing ``anchor=obj`` arms a ``weakref.finalize`` so a
+  component dropped without an explicit close still zeroes its entry
+  when the owning object is collected (refcount-freed buffers stay
+  truthful).
+- Exposed as ``pio_device_ledger_bytes{device,component,owner}``:
+  ``device`` is the jax device (or span) the bytes live on — ``host``
+  for host-RAM residency like the pack cache — and ``owner`` is the
+  engine-instance id when the allocation happened under a
+  :class:`LedgerScope` (the DeployedEngine lifecycle), ``-`` otherwise.
+- :meth:`DeviceLedger.reconcile` diffs the ledger's per-device totals
+  against ``device.memory_stats()`` into
+  ``pio_device_ledger_drift_bytes{device}`` — untracked growth (a leak)
+  is itself a metric. Backends without memory stats (XLA CPU) skip the
+  drift gauge unless a probe is injected (tests).
+- :meth:`LedgerScope.check_released` is the promotion pipeline's
+  monitored invariant: after a displaced instance's ``release()``, its
+  scope's bytes must be zero; a nonzero remainder increments
+  ``pio_device_ledger_leaks_total{component}`` and logs — the PR 13
+  leak class, now a metric instead of an archaeology project.
+
+Like utils/metrics.py and utils/tracing.py, this module is a sanctioned
+home for module-level observability state (the single process-global
+ledger); tests/test_lint.py's device-residency lint polices that new
+long-lived device placements under ops/ and api/ register here.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import logging
+import threading
+import weakref
+from typing import Callable, Dict, Iterator, List, Optional, Tuple
+
+from predictionio_tpu.utils import metrics as _metrics
+
+logger = logging.getLogger(__name__)
+
+__all__ = [
+    "DeviceLedger",
+    "LedgerHandle",
+    "LedgerScope",
+    "get_ledger",
+    "device_label_of",
+    "HOST_DEVICE",
+    "UNOWNED",
+]
+
+# the ledger's label for host-RAM residency (the pack cache's wires):
+# excluded from drift reconciliation, which only matches jax devices
+HOST_DEVICE = "host"
+# the owner label of allocations made outside any LedgerScope
+UNOWNED = "-"
+
+
+def _m_bytes() -> "_metrics.Gauge":
+    return _metrics.get_registry().gauge(
+        "pio_device_ledger_bytes",
+        "Bytes of long-lived buffers registered in the HBM residency "
+        "ledger, by device, component, and owning engine-instance "
+        "('-' = unowned)",
+        labels=("device", "component", "owner"),
+    )
+
+
+def _m_drift() -> "_metrics.Gauge":
+    return _metrics.get_registry().gauge(
+        "pio_device_ledger_drift_bytes",
+        "device.memory_stats() bytes_in_use minus the ledger's total "
+        "for that device — sustained positive drift is untracked "
+        "residency (a leak); unavailable on backends without memory "
+        "stats",
+        labels=("device",),
+    )
+
+
+def _m_leaks() -> "_metrics.Counter":
+    return _metrics.get_registry().counter(
+        "pio_device_ledger_leaks_total",
+        "Release-invariant violations: a displaced instance whose "
+        "ledger bytes were still nonzero after release_serving ran "
+        "(the PR 13 leak class, per component)",
+        labels=("component",),
+    )
+
+
+def device_label_of(x) -> str:
+    """The ledger device label for a jax array: its device's string, or
+    ``<first device>x<N>`` for an array sharded over N devices. Host
+    numpy (or anything without ``.devices()``) labels :data:`HOST_DEVICE`.
+    """
+    devices = getattr(x, "devices", None)
+    if devices is None:
+        return HOST_DEVICE
+    try:
+        labels = sorted(str(d) for d in devices())
+    except Exception:  # a freed/donated buffer — best-effort label
+        return "unknown"
+    if not labels:
+        return "unknown"
+    if len(labels) == 1:
+        return labels[0]
+    return f"{labels[0]}x{len(labels)}"
+
+
+def device_footprint(*arrays) -> "Tuple[str, int, Dict[str, int]]":
+    """``(label, total_physical_bytes, per-device bytes)`` for a set of
+    jax arrays, computed from their addressable shards — so a
+    row-SHARDED matrix attributes each shard's bytes to its own device
+    and a REPLICATED one counts every per-device copy (``.nbytes``
+    alone is the logical size: one copy). The per-device map is what
+    :meth:`DeviceLedger.reconcile` diffs against each device's
+    ``memory_stats()``; without it, mesh deployments would show the
+    whole resident set as false drift. Host numpy contributes under
+    :data:`HOST_DEVICE`."""
+    members: Dict[str, int] = {}
+    for x in arrays:
+        shards = getattr(x, "addressable_shards", None)
+        counted = False
+        if shards is not None:
+            try:
+                for sh in shards:
+                    lbl = str(sh.device)
+                    members[lbl] = members.get(lbl, 0) + int(
+                        sh.data.nbytes
+                    )
+                counted = True
+            except Exception:  # exotic array types — fall through
+                logger.debug("shard walk failed", exc_info=True)
+        if not counted:
+            lbl = device_label_of(x)
+            members[lbl] = members.get(lbl, 0) + int(
+                getattr(x, "nbytes", 0) or 0
+            )
+    total = sum(members.values())
+    if not members:
+        return HOST_DEVICE, 0, {}
+    if len(members) == 1:
+        return next(iter(members)), total, members
+    first = sorted(members)[0]
+    return f"{first}x{len(members)}", total, members
+
+
+class Anchor:
+    """A throwaway weakref-able object: ``register(anchor=Anchor())``
+    held in a local ties a handle's lifetime to the enclosing frame —
+    the handle closes when the frame exits (including by exception)
+    without try/finally plumbing through a long function body."""
+
+    __slots__ = ("__weakref__",)
+
+
+class LedgerHandle:
+    """One component's live residency entry. Thread-safe via the owning
+    ledger's lock; ``close()`` is idempotent (explicit close and the
+    ``anchor`` finalizer may both fire)."""
+
+    __slots__ = (
+        "_ledger", "component", "device", "owner", "_nbytes", "_closed",
+        "_members", "__weakref__",
+    )
+
+    def __init__(self, ledger: "DeviceLedger", component: str, device: str,
+                 owner: str, nbytes: int,
+                 members: Optional[Dict[str, int]] = None):
+        self._ledger = ledger
+        self.component = component
+        self.device = device
+        self.owner = owner
+        self._nbytes = int(max(0, nbytes))
+        # physical bytes per individual device (reconcile's view of a
+        # sharded/replicated entry); a plain registration is all on its
+        # one device label
+        self._members: Dict[str, int] = (
+            dict(members) if members else {self.device: self._nbytes}
+        )
+        self._closed = False
+
+    @property
+    def nbytes(self) -> int:
+        return 0 if self._closed else self._nbytes
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    def set(
+        self, nbytes: int, members: Optional[Dict[str, int]] = None
+    ) -> None:
+        """Replace this entry's byte count (a mask re-upload, a
+        resize). Without an explicit per-device ``members`` map the old
+        one rescales proportionally — right for a same-layout
+        re-upload; pass a fresh :func:`device_footprint` map when the
+        sharding changed."""
+        self._ledger._update(self, int(max(0, nbytes)), members)
+
+    def add(self, nbytes: int) -> None:
+        self._ledger._update(self, self._nbytes + int(nbytes), None)
+
+    def close(self) -> None:
+        """Zero and retire the entry (the buffers were released — or
+        will free by refcount; the ledger records registered residency
+        INTENT, so a straggler batch still holding freed-by-owner
+        buffers reads as drift, not as ledger bytes)."""
+        self._ledger._close(self)
+
+
+class LedgerScope:
+    """Groups the handles registered during one owner's lifecycle (a
+    DeployedEngine's prepare/warm) so release can assert THEM — and only
+    them — reached zero, even when a same-version twin is also resident.
+    The scope ``label`` (the engine-instance id) becomes the handles'
+    ``owner`` gauge label."""
+
+    def __init__(self, ledger: "DeviceLedger", label: str):
+        self._ledger = ledger
+        self.label = str(label or UNOWNED)
+        self._handles: List[LedgerHandle] = []
+        self._lock = threading.Lock()
+
+    def _adopt(self, handle: LedgerHandle) -> None:
+        with self._lock:
+            self._handles.append(handle)
+
+    @contextlib.contextmanager
+    def activate(self) -> Iterator["LedgerScope"]:
+        """Bind this scope as the ambient registration target: handles
+        registered inside the block join the scope and carry its label
+        as their ``owner``."""
+        token = _ACTIVE_SCOPE.set(self)
+        try:
+            yield self
+        finally:
+            _ACTIVE_SCOPE.reset(token)
+
+    def bytes(self) -> int:
+        with self._lock:
+            return sum(h.nbytes for h in self._handles)
+
+    def check_released(self) -> int:
+        """The release invariant: returns the bytes still registered
+        under this scope (0 = clean). Nonzero increments
+        ``pio_device_ledger_leaks_total`` per leaking component and
+        logs — the displaced instance did not free everything it
+        registered."""
+        with self._lock:
+            open_handles = [h for h in self._handles if h.nbytes > 0]
+        leaked = sum(h.nbytes for h in open_handles)
+        if leaked:
+            leaks = _m_leaks()
+            for h in open_handles:
+                leaks.labels(component=h.component).inc()
+            logger.warning(
+                "device-ledger release invariant violated for owner %s: "
+                "%d bytes still registered (%s)",
+                self.label, leaked,
+                ", ".join(
+                    f"{h.component}@{h.device}={h.nbytes}"
+                    for h in open_handles
+                ),
+            )
+        return leaked
+
+
+_ACTIVE_SCOPE: "contextvars.ContextVar[Optional[LedgerScope]]" = (
+    contextvars.ContextVar("pio_ledger_scope", default=None)
+)
+
+
+class DeviceLedger:
+    """The process-global residency registry. All mutation funnels
+    through the instance lock; gauge children are re-summed per
+    (device, component, owner) key on every mutation — entries are few
+    (one per resident component instance), so this is far off any hot
+    path."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._handles: "Dict[LedgerHandle, None]" = {}
+
+    # -- registration --
+
+    def register(
+        self,
+        component: str,
+        nbytes: int = 0,
+        device: str = HOST_DEVICE,
+        anchor=None,
+        members: Optional[Dict[str, int]] = None,
+    ) -> LedgerHandle:
+        """Register one component's residency. ``device`` is the label
+        from :func:`device_label_of` (or :data:`HOST_DEVICE`);
+        ``members`` is the per-device physical-byte map from
+        :func:`device_footprint` — REQUIRED for correct drift
+        reconciliation of sharded/replicated entries (omitted, all
+        bytes attribute to ``device``). The ambient
+        :class:`LedgerScope` (if any) adopts the handle and stamps its
+        ``owner``. ``anchor`` arms a finalizer that closes the handle
+        when the object is collected — the backstop for refcount-freed
+        device state that never saw an explicit close."""
+        scope = _ACTIVE_SCOPE.get()
+        owner = scope.label if scope is not None else UNOWNED
+        handle = LedgerHandle(
+            self, component, str(device), owner, nbytes, members=members
+        )
+        with self._lock:
+            self._handles[handle] = None
+        if scope is not None:
+            scope._adopt(handle)
+        self._publish(handle.device, handle.component, handle.owner)
+        if anchor is not None:
+            weakref.finalize(anchor, handle.close)
+        return handle
+
+    def scope(self, label: str) -> LedgerScope:
+        return LedgerScope(self, label)
+
+    # -- handle callbacks --
+
+    def _update(
+        self,
+        handle: LedgerHandle,
+        nbytes: int,
+        members: Optional[Dict[str, int]] = None,
+    ) -> None:
+        with self._lock:
+            if handle._closed:
+                return
+            if members is not None:
+                handle._members = dict(members)
+            else:
+                old_total = sum(handle._members.values())
+                if old_total > 0:
+                    handle._members = {
+                        k: int(round(v * nbytes / old_total))
+                        for k, v in handle._members.items()
+                    }
+                else:
+                    handle._members = {handle.device: nbytes}
+            handle._nbytes = nbytes
+        self._publish(handle.device, handle.component, handle.owner)
+
+    def _close(self, handle: LedgerHandle) -> None:
+        with self._lock:
+            if handle._closed:
+                return
+            handle._closed = True
+            self._handles.pop(handle, None)
+        self._publish(handle.device, handle.component, handle.owner)
+
+    def _publish(self, device: str, component: str, owner: str) -> None:
+        with self._lock:
+            total = sum(
+                h._nbytes
+                for h in self._handles
+                if not h._closed
+                and h.device == device
+                and h.component == component
+                and h.owner == owner
+            )
+        _m_bytes().labels(
+            device=device, component=component, owner=owner
+        ).set(float(total))
+
+    # -- queries --
+
+    def _live(self) -> List[LedgerHandle]:
+        with self._lock:
+            return [h for h in self._handles if not h._closed]
+
+    def total_bytes(
+        self,
+        component: Optional[str] = None,
+        device: Optional[str] = None,
+        owner: Optional[str] = None,
+    ) -> int:
+        return sum(
+            h.nbytes
+            for h in self._live()
+            if (component is None or h.component == component)
+            and (device is None or h.device == device)
+            and (owner is None or h.owner == owner)
+        )
+
+    def owner_bytes(self, owner: str) -> int:
+        return self.total_bytes(owner=owner)
+
+    def breakdown(self) -> Dict[str, Dict[str, int]]:
+        """``{device: {component: bytes}}`` — the detail view `pio top`
+        and the collector's fleet.json render."""
+        out: Dict[str, Dict[str, int]] = {}
+        for h in self._live():
+            per = out.setdefault(h.device, {})
+            per[h.component] = per.get(h.component, 0) + h.nbytes
+        return out
+
+    # -- drift reconciliation --
+
+    def reconcile(
+        self, probe: Optional[Callable] = None
+    ) -> Dict[str, dict]:
+        """Diff the ledger against the devices' own accounting.
+
+        ``probe(device) -> Optional[int]`` returns bytes-in-use for one
+        jax device (None = unavailable); the default reads
+        ``device.memory_stats()``. Devices without stats (XLA CPU)
+        contribute no drift sample. The ledger side of each diff is the
+        sum of the handles' PER-DEVICE member maps
+        (:func:`device_footprint`), so a sharded/replicated entry
+        attributes each device's actual shard/copy bytes to that
+        device — a healthy mesh deployment reconciles to ~zero drift
+        instead of flagging its whole resident set. Entries on labels
+        that match no local device (``host``) are reported under their
+        own label with ``in_use=None``. Sets
+        ``pio_device_ledger_drift_bytes{device}`` per probed device and
+        returns ``{device: {"ledger", "in_use", "drift"}}``."""
+        if probe is None:
+            probe = _default_probe
+        per_device: Dict[str, int] = {}
+        for h in self._live():
+            with self._lock:
+                members = dict(h._members)
+            for lbl, b in members.items():
+                per_device[lbl] = per_device.get(lbl, 0) + b
+        report: Dict[str, dict] = {}
+        try:
+            import jax
+
+            devices = list(jax.local_devices())
+        except Exception:  # jax unavailable/broken: ledger-only view
+            devices = []
+        probed = set()
+        for dev in devices:
+            label = str(dev)
+            probed.add(label)
+            try:
+                in_use = probe(dev)
+            except Exception:
+                in_use = None
+            ledger = per_device.get(label, 0)
+            entry: dict = {"ledger": ledger, "in_use": in_use}
+            if in_use is not None:
+                drift = int(in_use) - ledger
+                entry["drift"] = drift
+                _m_drift().labels(device=label).set(float(drift))
+            else:
+                entry["drift"] = None
+            report[label] = entry
+        for label, ledger in per_device.items():
+            if label not in probed:
+                report[label] = {
+                    "ledger": ledger, "in_use": None, "drift": None,
+                }
+        return report
+
+
+def _default_probe(device) -> Optional[int]:
+    stats = getattr(device, "memory_stats", lambda: None)()
+    if not stats:
+        return None
+    value = stats.get("bytes_in_use")
+    return int(value) if value is not None else None
+
+
+# THE process-global ledger (one per worker process, like the metrics
+# registry it records into).
+LEDGER = DeviceLedger()
+
+
+def get_ledger() -> DeviceLedger:
+    return LEDGER

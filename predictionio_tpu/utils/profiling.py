@@ -8,7 +8,11 @@ module provides both:
 - ``PhaseTimer``: named wall-clock phases with nesting, collected per
   workflow run and queryable/printable for run summaries;
 - ``trace(dir)``: context manager around ``jax.profiler.trace`` emitting
-  a TensorBoard-loadable device trace when a profile dir is set.
+  a TensorBoard-loadable device trace when a profile dir is set;
+- :class:`ProfileCapture` + :func:`profile_route`: the on-demand,
+  secret-gated ``POST /debug/profile?seconds=N`` capture every server
+  exposes (``pio profile`` drives it) — same session machinery as
+  ``trace``, so CLI- and HTTP-triggered captures are layout-identical.
 """
 
 from __future__ import annotations
@@ -16,6 +20,8 @@ from __future__ import annotations
 import contextlib
 import dataclasses
 import logging
+import os
+import threading
 import time
 from typing import Dict, Iterator, List, Optional
 
@@ -141,15 +147,232 @@ class PhaseTimer:
         return "\n".join(lines)
 
 
+# --- on-demand profiler capture (the device-observability round) ---
+#
+# One capture machinery for BOTH entry points: `pio train --profile-dir`
+# (the trace() context manager below, driven by workflow_params'
+# profile_dir) and the secret-gated `POST /debug/profile?seconds=N`
+# endpoint every server exposes. Both funnel through _profiler_session,
+# so a CLI-launched capture and an HTTP-triggered one produce IDENTICAL
+# trace layouts (jax's plugins/profile/<run>/ tree) — before this
+# round, the HTTP path simply did not exist and the jax.profiler hook
+# only fired when a train run was launched with --profile-dir.
+
+# serializes jax.profiler sessions process-wide: jax refuses nested /
+# concurrent traces, so a training --profile-dir capture and an HTTP
+# capture must take turns
+_SESSION_LOCK = threading.Lock()
+
+
 @contextlib.contextmanager
-def trace(profile_dir: Optional[str]) -> Iterator[None]:
-    """jax.profiler.trace around a block when profile_dir is set; no-op
-    otherwise. View with TensorBoard's profile plugin or Perfetto."""
-    if not profile_dir:
-        yield
-        return
+def _session_body(profile_dir: str) -> Iterator[None]:
+    """The jax.profiler session itself — callers MUST hold
+    :data:`_SESSION_LOCK` (``_profiler_session`` blocks for it; the
+    HTTP capture acquires it non-blockingly so a busy profiler answers
+    409 instead of parking a route-pool worker)."""
     import jax
 
+    os.makedirs(profile_dir, exist_ok=True)
     logger.info("writing jax profiler trace to %s", profile_dir)
     with jax.profiler.trace(profile_dir):
         yield
+
+
+@contextlib.contextmanager
+def _profiler_session(profile_dir: str) -> Iterator[None]:
+    """THE code path that touches jax.profiler: makedirs + trace,
+    serialized on the process-wide session lock."""
+    with _SESSION_LOCK:
+        with _session_body(profile_dir):
+            yield
+
+
+@contextlib.contextmanager
+def trace(profile_dir: Optional[str]) -> Iterator[None]:
+    """jax.profiler.trace around a block when profile_dir is set; no-op
+    otherwise. View with TensorBoard's profile plugin or Perfetto.
+    (The context-manager API over the shared capture machinery — the
+    HTTP ``/debug/profile`` endpoint rides the same session path.)"""
+    if not profile_dir:
+        yield
+        return
+    with _profiler_session(profile_dir):
+        yield
+
+
+def _m_captures() -> object:
+    from predictionio_tpu.utils import metrics as _metrics
+
+    return _metrics.get_registry().counter(
+        "pio_profile_captures_total",
+        "On-demand profiler captures by outcome (ok / busy = a capture "
+        "or --profile-dir session was already running / error)",
+        labels=("outcome",),
+    )
+
+
+class ProfileCapture:
+    """Bounded on-demand capture driver behind ``POST /debug/profile``.
+
+    One capture at a time (jax.profiler cannot nest); the capture runs
+    INLINE in the calling route-pool thread for ``seconds`` (clamped to
+    :attr:`MAX_SECONDS`), zips the produced trace tree, and returns the
+    archive base64-encoded in the JSON response (the HTTP adapters
+    render JSON/str payloads only — no binary framing needed). The
+    spool directory is capped: only the newest :attr:`MAX_SPOOLED`
+    capture trees are kept on disk."""
+
+    MAX_SECONDS = 120.0
+    MAX_SPOOLED = 4
+
+    def __init__(self, spool_dir: Optional[str] = None):
+        self._spool_dir = spool_dir
+        self._lock = threading.Lock()
+        self._busy = False
+        self._last: Optional[dict] = None
+
+    @property
+    def spool_dir(self) -> str:
+        if self._spool_dir is None:
+            import tempfile
+
+            self._spool_dir = os.path.join(
+                tempfile.gettempdir(), "pio-profile-spool"
+            )
+        return self._spool_dir
+
+    def status(self) -> dict:
+        with self._lock:
+            last = None
+            if self._last is not None:
+                last = {
+                    k: v
+                    for k, v in self._last.items()
+                    if k != "archive_b64"
+                }
+            return {"running": self._busy, "last": last}
+
+    def last(self) -> Optional[dict]:
+        with self._lock:
+            return self._last
+
+    def capture(self, seconds: float) -> "tuple[int, dict]":
+        """Run one bounded capture; returns ``(http_status, payload)``.
+        409 while another capture (or a --profile-dir training session)
+        holds the profiler; the payload carries the zipped trace tree
+        base64-encoded plus its file listing."""
+        seconds = max(0.1, min(float(seconds), self.MAX_SECONDS))
+        with self._lock:
+            if self._busy:
+                _m_captures().labels(outcome="busy").inc()
+                return 409, {"message": "a profile capture is already running"}
+            self._busy = True
+        try:
+            # non-blocking probe AND hold: a --profile-dir training
+            # session owning the lock answers 409 immediately, and the
+            # lock stays held through the capture so a session starting
+            # in between cannot park this route-pool worker
+            if not _SESSION_LOCK.acquire(blocking=False):
+                _m_captures().labels(outcome="busy").inc()
+                return 409, {
+                    "message": "a --profile-dir profiler session is active"
+                }
+            started = time.time()
+            cap_dir = os.path.join(
+                self.spool_dir, f"capture-{int(started * 1000)}"
+            )
+            try:
+                with _session_body(cap_dir):
+                    time.sleep(seconds)
+                payload = self._archive(cap_dir, started, seconds)
+            except Exception as e:
+                logger.exception("profile capture failed")
+                _m_captures().labels(outcome="error").inc()
+                return 500, {"message": f"capture failed: {e}"}
+            finally:
+                _SESSION_LOCK.release()
+            self._trim_spool()
+            with self._lock:
+                self._last = payload
+            _m_captures().labels(outcome="ok").inc()
+            return 200, payload
+        finally:
+            with self._lock:
+                self._busy = False
+
+    def _archive(self, cap_dir: str, started: float, seconds: float) -> dict:
+        import base64
+        import io
+        import zipfile
+
+        names: list = []
+        buf = io.BytesIO()
+        with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as zf:
+            for root, _dirs, files in os.walk(cap_dir):
+                for name in sorted(files):
+                    full = os.path.join(root, name)
+                    rel = os.path.relpath(full, cap_dir)
+                    zf.write(full, rel)
+                    names.append(rel)
+        data = buf.getvalue()
+        return {
+            "startedAt": started,
+            "seconds": seconds,
+            "dir": cap_dir,
+            "files": names,
+            "archiveBytes": len(data),
+            "archive_b64": base64.b64encode(data).decode("ascii"),
+        }
+
+    def _trim_spool(self) -> None:
+        try:
+            caps = sorted(
+                d
+                for d in os.listdir(self.spool_dir)
+                if d.startswith("capture-")
+            )
+        except OSError:
+            return
+        import shutil
+
+        for stale in caps[: -self.MAX_SPOOLED]:
+            shutil.rmtree(
+                os.path.join(self.spool_dir, stale), ignore_errors=True
+            )
+
+
+# THE process-global capture driver (all three servers' /debug/profile
+# routes share it — one profiler, one spool).
+_CAPTURE = ProfileCapture()
+
+
+def get_capture() -> ProfileCapture:
+    return _CAPTURE
+
+
+def profile_route(
+    method: str, query, authorized: bool
+) -> "tuple[int, dict]":
+    """The shared ``/debug/profile`` request core (all three servers
+    route here after their own auth gate, like http.traces_payload):
+    ``POST ?seconds=N`` runs one bounded capture and returns the
+    archive; ``GET`` returns capture status (and the last archive with
+    ``?archive=1``)."""
+    if not authorized:
+        return 401, {"message": "invalid or missing credentials"}
+    cap = get_capture()
+    if method == "POST":
+        raw = (query or {}).get("seconds", "2")
+        try:
+            seconds = float(raw)
+        except (TypeError, ValueError):
+            return 400, {"message": f"invalid seconds {raw!r}"}
+        return cap.capture(seconds)
+    if method == "GET":
+        if (query or {}).get("archive"):
+            last = cap.last()
+            if last is None:
+                return 404, {"message": "no capture taken yet"}
+            return 200, last
+        return 200, cap.status()
+    return 405, {"message": "Method not allowed."}

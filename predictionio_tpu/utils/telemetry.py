@@ -91,6 +91,12 @@ DEFAULT_SPAN_RETENTION = 32768
 # target timing out serially) must degrade the collector's /readyz
 COLLECTOR_DEADLINE_S = 120.0
 
+# |ledger drift| beyond this on any target trips pio_ledger_drift_alert
+# (docs/OBSERVABILITY.md, device-plane section). Sized well above the
+# allocator slack/workspace noise a healthy serving process shows, far
+# below a leaked factor matrix.
+DRIFT_ALERT_BYTES = 256 * 1024 * 1024
+
 
 # --- SLO declarations ---
 
@@ -340,6 +346,21 @@ class Collector:
             "its threshold (the multiwindow page condition)",
             labels=("slo",),
         )
+        # the stock SLO-adjacent device-ledger gauges: fleet-wide
+        # registered residency, and a drift alert when any target's
+        # ledger-vs-memory_stats drift exceeds the threshold (untracked
+        # HBM growth — the leak signal)
+        self._m_fleet_ledger = reg.gauge(
+            "pio_fleet_ledger_bytes",
+            "Registered HBM-ledger residency summed across the fleet's "
+            "latest scrapes",
+        )
+        self._m_drift_alert = reg.gauge(
+            "pio_ledger_drift_alert",
+            "1 while any fleet target's |pio_device_ledger_drift_bytes| "
+            "exceeds the collector's drift threshold (untracked device "
+            "residency — the leak signal)",
+        )
         for url in targets:
             self.add_target(url)
 
@@ -542,6 +563,7 @@ class Collector:
         elif states:
             self._poll_target(states[0])
         report = self.evaluate_slos()
+        self.evaluate_ledger()
         with self._lock:
             up = sum(1 for s in states if s.up)
         return {
@@ -549,6 +571,65 @@ class Collector:
             "up": up,
             "alerts": sum(1 for r in report if r["firing"]),
         }
+
+    def evaluate_ledger(self) -> dict:
+        """The device-ledger fleet view: total registered residency and
+        the worst per-target drift across the latest scrapes; sets the
+        ``pio_fleet_ledger_bytes`` / ``pio_ledger_drift_alert`` gauges
+        and returns the fleet.json ``ledger`` block."""
+        total = 0.0
+        worst_drift = None
+        for state in self._states():
+            with self._lock:
+                latest = state.latest()
+            if latest is None:
+                continue
+            samples = latest[1]
+            total += _metrics.counter_sum(
+                samples, "pio_device_ledger_bytes"
+            )
+            for key, value in samples.items():
+                if (
+                    _metrics.sample_family_name(key)
+                    == "pio_device_ledger_drift_bytes"
+                ):
+                    if worst_drift is None or abs(value) > abs(worst_drift):
+                        worst_drift = value
+        alert = (
+            worst_drift is not None
+            and abs(worst_drift) > DRIFT_ALERT_BYTES
+        )
+        self._m_fleet_ledger.set(total)
+        self._m_drift_alert.set(1.0 if alert else 0.0)
+        out: dict = {
+            "hbm_mb": round(total / 2**20, 3),
+            "drift_alert": bool(alert),
+            "drift_threshold_mb": round(DRIFT_ALERT_BYTES / 2**20, 1),
+        }
+        if worst_drift is not None:
+            out["max_drift_mb"] = round(worst_drift / 2**20, 3)
+        return out
+
+    def capture_profile(self, target_url: str, seconds: float = 2.0) -> dict:
+        """Trigger one bounded profiler capture on a fleet target
+        (``POST /debug/profile`` with the collector's configured
+        accessKey/secret forwarded) and return its payload — the zipped
+        trace archive base64-encoded plus its file listing."""
+        params: Dict[str, str] = {"seconds": str(float(seconds))}
+        if self.access_key:
+            params["accessKey"] = self.access_key
+        if self.secret:
+            params["secret"] = self.secret
+        url = (
+            target_url.rstrip("/")
+            + "/debug/profile?"
+            + urllib.parse.urlencode(params)
+        )
+        req = urllib.request.Request(url, data=b"", method="POST")
+        with urllib.request.urlopen(
+            req, timeout=float(seconds) + 30.0
+        ) as resp:
+            return json.loads(resp.read().decode("utf-8"))
 
     def run(self, stop_event: Optional[threading.Event] = None) -> None:
         """The poll loop (stop-event idiom; ``pio collector`` wires
@@ -744,6 +825,36 @@ class Collector:
         errors = _metrics.counter_sum(samples, "pio_http_errors_total")
         if errors:
             row["errors"] = int(errors)
+        # device-plane columns: registered HBM residency (with a
+        # per-component breakdown — the `pio top` detail view), the
+        # ledger-vs-memory_stats drift, padding waste, and shard skew
+        hbm = _metrics.counter_sum(samples, "pio_device_ledger_bytes")
+        if hbm:
+            row["hbm_mb"] = hbm / 2**20
+            comps: Dict[str, float] = {}
+            for key, value in samples.items():
+                if (
+                    _metrics.sample_family_name(key)
+                    != "pio_device_ledger_bytes"
+                    or not value
+                ):
+                    continue
+                c = _metrics.sample_label_value(key, "component") or "?"
+                comps[c] = comps.get(c, 0.0) + value
+            row["hbm_components_mb"] = {
+                c: round(v / 2**20, 3) for c, v in sorted(comps.items())
+            }
+        drift = _metrics.gauge_max(
+            samples, "pio_device_ledger_drift_bytes"
+        )
+        if drift:
+            row["drift_mb"] = drift / 2**20
+        pad = _metrics.gauge_max(samples, "pio_padding_waste_ratio")
+        if pad is not None:
+            row["pad"] = round(pad, 4)
+        skew = _metrics.gauge_max(samples, "pio_retrieval_shard_skew")
+        if skew is not None:
+            row["skew"] = round(skew, 3)
         windowed = self._windowed(state, window_s)
         if windowed is not None:
             span_s, delta = windowed
@@ -813,6 +924,7 @@ class Collector:
             "window_s": window_s,
             "targets": rows,
             "fleet": fleet,
+            "ledger": self.evaluate_ledger(),
             "slos": self.slo_report(),
             "alerts": self.alerts(),
         }

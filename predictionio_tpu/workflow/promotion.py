@@ -757,6 +757,23 @@ class PromotionPipeline:
                 _end_stage("drain", t0)
                 _drain_seconds().observe(time.perf_counter() - t0)
                 report["drained"] = bool(drained)
+                # HBM residency of the displaced instance after drain
+                # (utils/device_ledger.py): it stays RETAINED (warm,
+                # factors resident) for instant rollback, so nonzero is
+                # the healthy state here — release at LRU eviction (or
+                # server shutdown) drives it to zero, and
+                # DeployedEngine.release() asserts exactly that,
+                # counting violations in pio_device_ledger_leaks_total.
+                ledger_bytes = getattr(displaced, "ledger_bytes", None)
+                if callable(ledger_bytes):
+                    try:
+                        report["displaced_ledger_bytes"] = int(
+                            ledger_bytes()
+                        )
+                    except Exception:
+                        logger.debug(
+                            "displaced ledger read failed", exc_info=True
+                        )
                 if not drained:
                     logger.warning(
                         "displaced instance %s did not drain within %.1fs; "

@@ -16,7 +16,12 @@ class WorkflowParams:
     skip_sanity_check: bool = False
     stop_after_read: bool = False
     stop_after_prepare: bool = False
-    # TPU additions: jax.profiler trace output dir (None disables)
+    # TPU additions: jax.profiler trace output dir (None disables).
+    # Rides utils/profiling's shared capture machinery — the same
+    # session path the servers' POST /debug/profile endpoint uses, so a
+    # CLI-launched capture (`pio train --profile-dir`) and an
+    # HTTP-triggered one produce identical trace layouts, and the two
+    # serialize on one process-wide profiler session lock.
     profile_dir: Optional[str] = None
     # Concurrent workers for the per-EngineParams evaluation grid — the
     # reference's `.par` over param sets (MetricEvaluator.scala:221-230).

@@ -766,6 +766,120 @@ def test_except_pass_allowlist_is_not_stale():
     )
 
 
+# --- long-lived device placements outside the residency ledger ---
+#
+# The bug class (round 16's device-observability tentpole): a component
+# that parks buffers on device in a long-lived attribute
+# (``self._x = jax.device_put(...)``) without registering in the HBM
+# residency ledger (utils/device_ledger.py) is exactly the untracked
+# residency the ledger-vs-memory_stats drift gauge exists to flag — the
+# PR 13 leak class was only findable by reading code. Scope: ops/ and
+# api/ — the tiers that own resident serving/training state. A flagged
+# assignment must either register a LedgerHandle covering the buffers
+# (the ItemRetriever/ServingFactors idiom: register at construction
+# with an ``anchor`` finalizer, explicit close on the free path) or be
+# allowlisted with a justification. The allowlist below was seeded
+# from a review of every existing site — each one IS covered by a
+# ledger registration in the same class — and is shrink-only.
+
+_DEVICE_RESIDENCY_DIRS = ("ops", "api")
+
+# call names whose result parked in a self attribute is device residency
+_DEVICE_PLACEMENT_CALLS = {"device_put", "put"}
+
+# (relative path, stripped source line) pairs reviewed as safe: every
+# entry's buffers are registered in the device ledger by the same
+# class (ItemRetriever registers component + component-mask handles;
+# ServingFactors registers serving-factors with an anchor finalizer).
+DEVICE_RESIDENCY_ALLOWED = {
+    # ItemRetriever.__init__ / set_excluded_ids: covered by the
+    # _ledger_factors/_ledger_mask handles registered right below them
+    ("ops/retrieval.py", "self._y_dev = put(padded)"),
+    ("ops/retrieval.py", "self._rn_dev = put(rn)"),
+    ("ops/retrieval.py", "self._allow_dev = put(self._valid)"),
+    ("ops/retrieval.py", "self._y_dev = jax.device_put("),
+    ("ops/retrieval.py", "self._rn_dev = jax.device_put(rn, NamedSharding(mesh, P(axis)))"),
+    ("ops/retrieval.py", "self._allow_dev = jax.device_put("),
+    ("ops/retrieval.py", "self._allow_dev = ("),
+    # ServingFactors.__init__: covered by the serving-factors handle
+    # with the anchor finalizer (release is refcount-driven)
+    ("ops/als.py", "self._uf_dev = jax.device_put("),
+    ("ops/als.py", "self._if_dev = jax.device_put("),
+    # SimilarityScorer.__init__: covered by the similarity-factors
+    # handle registered right below (anchor finalizer, refcount free)
+    ("ops/similarity.py", "self._dev = jax.device_put(jnp.asarray(self.normed))"),
+}
+
+
+def _device_residency_occurrences():
+    import ast
+
+    found = set()
+    for d in _DEVICE_RESIDENCY_DIRS:
+        for path in sorted((PACKAGE / d).rglob("*.py")):
+            rel = f"{d}/" + path.relative_to(PACKAGE / d).as_posix()
+            source = path.read_text(encoding="utf-8")
+            lines = source.splitlines()
+            tree = ast.parse(source, filename=str(path))
+
+            def places_on_device(node) -> bool:
+                for sub in ast.walk(node):
+                    if not isinstance(sub, ast.Call):
+                        continue
+                    fn = sub.func
+                    name = (
+                        fn.attr if isinstance(fn, ast.Attribute)
+                        else fn.id if isinstance(fn, ast.Name)
+                        else None
+                    )
+                    if name in _DEVICE_PLACEMENT_CALLS:
+                        return True
+                return False
+
+            for node in ast.walk(tree):
+                if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+                    continue
+                targets = (
+                    node.targets
+                    if isinstance(node, ast.Assign)
+                    else [node.target]
+                )
+                to_self = any(
+                    isinstance(t, ast.Attribute)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id == "self"
+                    for t in targets
+                )
+                if not to_self or node.value is None:
+                    continue
+                if places_on_device(node.value):
+                    found.add((rel, lines[node.lineno - 1].strip()))
+    return found
+
+
+def test_long_lived_device_placements_route_through_ledger():
+    found = _device_residency_occurrences()
+    new = found - DEVICE_RESIDENCY_ALLOWED
+    assert not new, (
+        "long-lived device placement (self.<attr> = device_put(...)) "
+        "under ops/ or api/ without a reviewed ledger registration — "
+        "untracked residency is invisible to pio_device_ledger_bytes "
+        "and reads as drift (the PR 13 leak class); register a "
+        "LedgerHandle (utils/device_ledger.py, see ItemRetriever / "
+        "ServingFactors) covering the buffers, then allowlist the "
+        f"line with a justification: {sorted(new)}"
+    )
+
+
+def test_device_residency_allowlist_is_not_stale():
+    found = _device_residency_occurrences()
+    stale = DEVICE_RESIDENCY_ALLOWED - found
+    assert not stale, (
+        f"device-residency allowlist entries no longer in the tree: "
+        f"{sorted(stale)}"
+    )
+
+
 def test_no_mutable_module_state_in_segment_tier():
     found = _mutable_module_state_occurrences()
     new = found - MUTABLE_MODULE_STATE_ALLOWED
