@@ -2184,7 +2184,7 @@ def bench_segment_scan(device_name):
 
 
 def bench_delta_train(device_name):
-    """Delta-training trajectory (round 9): retrain cost for a
+    """Delta-training trajectory (rounds 9 + 17): retrain cost for a
     10k-event delta on the 1M-event bench store vs a full cold retrain
     of the same (grown) store. The delta round scans only rows above the
     cursor, folds them into the cached pack state, and warm-starts the
@@ -2193,6 +2193,17 @@ def bench_delta_train(device_name):
     RMSE(cold-trained)| over the full training ratings — the
     factor-quality parity gate (<= 1e-3). Acceptance:
     ``delta_retrain_s <= 0.1 * cold_retrain_s``.
+
+    Round 17 keeps the packed wire + factor state device-resident
+    between rounds (ops/streaming.ResidentPack): the measured
+    steady-state round scatters only the delta rows onto the resident
+    pack, so ``delta_upload_bytes`` (read from the
+    ``pio_train_delta_upload_bytes`` metrics window, like
+    ``resident_pack_hit`` from ``pio_resident_pack_rounds_total``) is
+    proportional to the DELTA, not the store — hard gate: ≤ 10× the
+    delta rows' encoded size. ``delta_retrain_resident_off_s`` is the
+    same steady-state fold with residency released + disabled, the
+    host-fold baseline the scatter round is judged against.
     """
     import datetime as dt
     import shutil
@@ -2203,11 +2214,19 @@ def bench_delta_train(device_name):
     from predictionio_tpu.data.storage.base import App
     from predictionio_tpu.data.store import PEventStore
     from predictionio_tpu.models.recommendation.engine import RATING_SPEC
-    from predictionio_tpu.ops.als import ALSConfig, rmse
+    from predictionio_tpu.ops.als import (
+        ALSConfig,
+        auto_segment_length,
+        rmse,
+    )
     from predictionio_tpu.ops.streaming import (
         pack_cache_clear,
+        release_resident_packs,
+        set_resident_training,
         train_als_streaming,
     )
+    from predictionio_tpu.utils import metrics as _metrics
+    from predictionio_tpu.utils.device_ledger import get_ledger
 
     n_events = int(os.environ.get("BENCH_DELTA_EVENTS", 1_000_000))
     n_delta = int(os.environ.get("BENCH_DELTA_DELTA_EVENTS", 10_000))
@@ -2230,11 +2249,17 @@ def bench_delta_train(device_name):
         le.init(1)
         rng = np.random.default_rng(23)
         when = dt.datetime(2026, 1, 1, tzinfo=dt.timezone.utc)
+        # live per-id event counts, so the steady-state rounds can craft
+        # deltas the resident scatter arm accepts (see below)
+        cnt_u = np.zeros(int(n_users * 1.01) + 2, np.int64)
+        cnt_i = np.zeros(n_items + 2, np.int64)
 
         def make_events(n, t_base, u_hi, i_hi):
             u = rng.integers(0, u_hi, n)
             i = rng.integers(0, i_hi, n)
             r = (rng.integers(1, 11, n) / 2.0).astype(np.float32)
+            cnt_u[: len(cnt_u)] += np.bincount(u, minlength=len(cnt_u))
+            cnt_i[: len(cnt_i)] += np.bincount(i, minlength=len(cnt_i))
             return [
                 Event(
                     event="rate",
@@ -2247,6 +2272,49 @@ def bench_delta_train(device_name):
                 )
                 for j in range(n)
             ]
+
+        def make_existing_events(n, t_base):
+            """A delta of n events on EXISTING ids whose counts stay
+            clear of a segment-length multiple — the steady-state shape
+            of live traffic the resident scatter arm is built for (a
+            new id or a segment-boundary crossing is a designed
+            fallback-to-host trigger, exercised by the warmup round)."""
+            cu_nz = cnt_u[cnt_u > 0].astype(np.int32)
+            ci_nz = cnt_i[cnt_i > 0].astype(np.int32)
+            L_u = auto_segment_length(
+                None, len(cu_nz), config.segment_length, counts=cu_nz
+            )
+            L_i = auto_segment_length(
+                None, len(ci_nz), config.segment_length, counts=ci_nz
+            )
+            users = np.nonzero(cnt_u)[0]
+            items = np.nonzero(cnt_i)[0]
+            events = []
+            ui = ii = 0
+            for j in range(n):
+                while cnt_u[users[ui % len(users)]] % L_u == 0:
+                    ui += 1
+                while cnt_i[items[ii % len(items)]] % L_i == 0:
+                    ii += 1
+                u = int(users[ui % len(users)])
+                i = int(items[ii % len(items)])
+                cnt_u[u] += 1
+                cnt_i[i] += 1
+                ui += 1
+                ii += 1
+                events.append(
+                    Event(
+                        event="rate",
+                        entity_type="user",
+                        entity_id=f"u{u}",
+                        target_entity_type="item",
+                        target_entity_id=f"i{i}",
+                        properties={"rating": float((j % 10) + 1) / 2.0},
+                        event_time=when
+                        + dt.timedelta(seconds=t_base + j),
+                    )
+                )
+            return events
 
         t0 = time.perf_counter()
         chunk = 100_000
@@ -2269,8 +2337,11 @@ def bench_delta_train(device_name):
         config = ALSConfig(rank=10, iterations=10, reg=0.05)
 
         # round 0: populate XLA caches AND the fold state (cursor +
-        # factors) the continuous loop would carry between rounds
+        # factors) the continuous loop would carry between rounds.
+        # Residency on: the cold round parks the device wire + factor
+        # state under a ResidentPack, as the continuous loop would.
         pack_cache_clear()
+        prev_resident = set_resident_training(True)
         t_first = {}
         train_als_streaming(
             store.stream_columns("delta", **scan_kwargs), config,
@@ -2281,7 +2352,8 @@ def bench_delta_train(device_name):
         # pays the one-off XLA compiles for the grown shapes; the
         # continuous loop's steady state — what this config tracks — has
         # them in the jit + persistent caches. ~1% new user ids, so the
-        # warm start exercises the dense-id relabel.
+        # warm start exercises the dense-id relabel AND the resident
+        # pack's fallback-to-host demotion.
         le.insert_batch(
             make_events(
                 n_delta, n_events + 10, int(n_users * 1.01), n_items
@@ -2294,14 +2366,44 @@ def bench_delta_train(device_name):
             timings=t_warmup, warm_sweeps=warm_sweeps,
         )
         assert t_warmup["pack_cache"] == "fold", t_warmup["pack_cache"]
-
-        # fold round 2: the measured 10k-event delta retrain
-        le.insert_batch(
-            make_events(
-                n_delta, 2 * n_events, int(n_users * 1.01), n_items
-            ),
-            1,
+        assert t_warmup.get("resident") == "fallback", t_warmup
+        assert get_ledger().total_bytes(component="train-pack") == 0, (
+            "fallback round must release the resident pack"
         )
+
+        # fold round 2 (unmeasured): an existing-id delta through the
+        # host fold — re-establishes residency on the grown geometry
+        le.insert_batch(make_existing_events(n_delta, 2 * n_events), 1)
+        t_reseat = {}
+        train_als_streaming(
+            store.stream_columns("delta", **scan_kwargs), config,
+            timings=t_reseat, warm_sweeps=warm_sweeps,
+        )
+        assert t_reseat["pack_cache"] == "fold", t_reseat["pack_cache"]
+
+        # scatter round 3 (unmeasured): first on-device delta scatter
+        # pays the scatter kernels' one-off compiles
+        le.insert_batch(make_existing_events(n_delta, 3 * n_events), 1)
+        t_scatter0 = {}
+        train_als_streaming(
+            store.stream_columns("delta", **scan_kwargs), config,
+            timings=t_scatter0, warm_sweeps=warm_sweeps,
+        )
+        assert t_scatter0.get("resident") == "scatter", t_scatter0
+
+        # scatter round 4: the measured steady-state 10k-event delta
+        # retrain, with delta_upload_bytes/resident_pack_hit read from
+        # the metrics window around the round
+        le.insert_batch(make_existing_events(n_delta, 4 * n_events), 1)
+        reg = _metrics.get_registry()
+        rounds_counter = reg.counter(
+            "pio_resident_pack_rounds_total",
+            "Streaming train rounds by resident-pack outcome: scatter "
+            "(delta applied on device), fallback (pack demoted to the "
+            "host fold), cold (no pack involved)",
+            labels=("outcome",),
+        )
+        scatter_before = rounds_counter.labels(outcome="scatter").value
         t_delta = {}
         t0 = time.perf_counter()
         res_delta = train_als_streaming(
@@ -2310,8 +2412,38 @@ def bench_delta_train(device_name):
         )
         delta_retrain_s = time.perf_counter() - t0
         assert t_delta["pack_cache"] == "fold", t_delta["pack_cache"]
+        assert t_delta.get("resident") == "scatter", t_delta
+        resident_pack_hit = (
+            rounds_counter.labels(outcome="scatter").value
+            - scatter_before
+        ) >= 1
+        delta_upload_bytes = int(
+            reg.gauge(
+                "pio_train_delta_upload_bytes",
+                "Host→device bytes the last streaming train round "
+                "uploaded (resident scatter rounds: delta rows + "
+                "touched regularizer entries only; full rounds: the "
+                "whole wire + factor state)",
+            ).value
+        )
+        resident_pack_bytes = int(
+            get_ledger().total_bytes(component="train-pack")
+        )
+        # the delta rows' own encoded size on the wire: int32 user ids
+        # + uint16 item ids + int8 half-step value codes
+        delta_encoded_bytes = n_delta * (4 + 2 + 1)
+        assert delta_upload_bytes <= 10 * delta_encoded_bytes, (
+            f"scatter round uploaded {delta_upload_bytes} B for a "
+            f"{delta_encoded_bytes} B delta — not delta-proportional"
+        )
 
-        # cold retrain of the SAME grown store (scan + pack + full train)
+        # cold retrain of the SAME grown store (scan + pack + full
+        # train), residency released + disabled so the rmse comparison
+        # and the timing are the plain host pipeline
+        released = release_resident_packs()
+        assert released == 1, released
+        assert get_ledger().total_bytes(component="train-pack") == 0
+        set_resident_training(False)
         pack_cache_clear()
         t_cold = {}
         t0 = time.perf_counter()
@@ -2320,6 +2452,20 @@ def bench_delta_train(device_name):
             timings=t_cold,
         )
         cold_retrain_s = time.perf_counter() - t0
+
+        # steady-state host fold with residency still off: the
+        # resident-off baseline of the same delta shape, folding off
+        # the cold round's cache entry
+        le.insert_batch(make_existing_events(n_delta, 5 * n_events), 1)
+        t_off = {}
+        t0 = time.perf_counter()
+        train_als_streaming(
+            store.stream_columns("delta", **scan_kwargs), config,
+            timings=t_off, warm_sweeps=warm_sweeps,
+        )
+        delta_retrain_resident_off_s = time.perf_counter() - t0
+        assert t_off["pack_cache"] == "fold", t_off["pack_cache"]
+        set_resident_training(prev_resident)
 
         cols = store.find_columns("delta", **scan_kwargs)
         rmse_delta = rmse(
@@ -2356,8 +2502,20 @@ def bench_delta_train(device_name):
                 "rmse_delta_model": round(rmse_delta, 6),
                 "rmse_cold_model": round(rmse_cold, 6),
                 "delta_events": n_delta,
-                "events": n_events + 2 * n_delta,
+                "events": n_events + 5 * n_delta,
                 "warm_sweeps": warm_sweeps,
+                # round-17 resident-pack telemetry (metrics window
+                # around the measured scatter round)
+                "resident_pack_hit": bool(resident_pack_hit),
+                "delta_upload_bytes": delta_upload_bytes,
+                "delta_encoded_bytes": delta_encoded_bytes,
+                "upload_over_encoded": round(
+                    delta_upload_bytes / delta_encoded_bytes, 3
+                ),
+                "resident_pack_bytes": resident_pack_bytes,
+                "delta_retrain_resident_off_s": round(
+                    delta_retrain_resident_off_s, 3
+                ),
                 "delta_scan_s": round(t_delta.get("delta_scan_s", 0.0), 3),
                 "fold_exposed_s": round(
                     t_delta.get("fold_exposed_s", 0.0), 3

@@ -1016,6 +1016,10 @@ class HostWire:
     aux: dict  # su/bu/si/bi int32 CSR offsets + segment bases (aux_pad'd)
     counts_u: np.ndarray  # [n_users] int32 observation counts
     counts_i: np.ndarray  # [n_items]
+    # a STRIPPED wire kept only its geometry/metadata: the COO planes
+    # (iw/vw) and aux offsets live on device under a ResidentPack
+    # (ops/streaming.py) and must be restored before any host use
+    stripped: bool = False
 
     @property
     def wire_mb(self) -> float:
@@ -1317,10 +1321,17 @@ def device_pack_from_wire(
     wire: HostWire,
     device_wire: Optional[tuple] = None,  # (i_dev, v_dev, aux_dev) pre-shipped
     timings: Optional[dict] = None,
+    geo_dev: Optional[tuple] = None,  # resident (sr_u, rem_u, sr_i, rem_i)
 ) -> Tuple[tuple, tuple]:
     """Transfer the wire (unless pre-shipped) and build the padded
     segment layout in HBM. Returns (user_pack, item_pack) ready for
-    :func:`_train_packed`."""
+    :func:`_train_packed`.
+
+    ``geo_dev`` — device-resident ``(seg_rows_u, rem_u, seg_rows_i,
+    rem_i)`` flat int32 arrays (the ResidentPack's copies): when given,
+    the per-call ``jnp.asarray`` upload of the host geometry arrays is
+    skipped — on a resident scatter round nothing store-sized crosses
+    the link."""
     import time as _time
 
     t_phase = _time.perf_counter()
@@ -1352,15 +1363,29 @@ def device_pack_from_wire(
         # pack-executable compile time, not the scatter itself
         timings["device_pack_dispatch_s"] = _time.perf_counter() - t_phase
 
-    def geo_pack(geo: _SegGeometry, pc, pv):
+    def geo_pack(geo: _SegGeometry, pc, pv, sr_dev=None, rem_dev=None):
         return (
-            jnp.asarray(geo.seg_rows.reshape(geo.n_chunks, geo.sc)),
+            (
+                sr_dev.reshape(geo.n_chunks, geo.sc)
+                if sr_dev is not None
+                else jnp.asarray(geo.seg_rows.reshape(geo.n_chunks, geo.sc))
+            ),
             pc.reshape(geo.n_chunks, geo.sc, geo.L),
             pv.reshape(geo.n_chunks, geo.sc, geo.L),
-            jnp.asarray(geo.rem.reshape(geo.n_chunks, geo.sc)),
+            (
+                rem_dev.reshape(geo.n_chunks, geo.sc)
+                if rem_dev is not None
+                else jnp.asarray(geo.rem.reshape(geo.n_chunks, geo.sc))
+            ),
         )
 
-    return geo_pack(wire.geo_u, pcu, pvu), geo_pack(wire.geo_i, pci, pvi)
+    sr_u = rem_u = sr_i = rem_i = None
+    if geo_dev is not None:
+        sr_u, rem_u, sr_i, rem_i = geo_dev
+    return (
+        geo_pack(wire.geo_u, pcu, pvu, sr_u, rem_u),
+        geo_pack(wire.geo_i, pci, pvi, sr_i, rem_i),
+    )
 
 
 def train_from_wire(
@@ -1376,13 +1401,21 @@ def train_from_wire(
     factor_state: Optional[tuple] = None,  # pre-placed (X, Y, lam/obs x4)
     warm_start: Optional[ALSModelArrays] = None,
     _fp_material=None,
+    geo_dev: Optional[tuple] = None,  # resident geometry device arrays
+    factor_slots_out: Optional[dict] = None,  # receives final device X/Y
 ) -> ALSModelArrays:
     """Train from a :class:`HostWire` (single-device device-pack path).
 
     ``device_wire``/``factor_state``/``compile_wait`` let the streaming
     pipeline hand in work it already overlapped with the store scan;
     left as None, this performs the same transfer → device-pack →
-    compile → loop sequence train_als always did.
+    compile → loop sequence train_als always did. ``geo_dev`` passes
+    resident segment-geometry device arrays straight through to
+    :func:`device_pack_from_wire`; ``factor_slots_out`` (a dict)
+    receives the fused loop's FINAL device-resident factor arrays under
+    ``"X"``/``"Y"`` — the donated slots round-trip back to the caller
+    (the ResidentPack keeps them for the next round) instead of being
+    dropped after the host fetch.
 
     ``warm_start`` seeds the factor state from a previous model whose
     rows are ALREADY aligned to this wire's dense id spaces (shapes must
@@ -1406,7 +1439,7 @@ def train_from_wire(
             ),
         )
     user_pack, item_pack = device_pack_from_wire(
-        wire, device_wire=device_wire, timings=timings
+        wire, device_wire=device_wire, timings=timings, geo_dev=geo_dev
     )
     if timings is not None:
         timings["padded_slots"] = wire.padded_slots
@@ -1436,6 +1469,7 @@ def train_from_wire(
             _fp_material if _fp_material is not None else wire.identity_bytes
         ),
         compile_wait=compile_wait,
+        factor_slots_out=factor_slots_out,
     )
 
 
@@ -1717,6 +1751,7 @@ def _train_packed(
     profile_dir: Optional[str],
     fp_material,  # Callable[[], bytes] — data identity for checkpoints
     compile_wait=None,  # callable from start_compile_async, or None
+    factor_slots_out: Optional[dict] = None,  # receives final device X/Y
 ) -> ALSModelArrays:
     """The shared training tail: compile warm-up, checkpoint/resume, the
     fused iteration loop, and the factor fetch. Every entry path (COO,
@@ -1896,6 +1931,13 @@ def _train_packed(
     finally:
         ckpt.close()
 
+    if factor_slots_out is not None:
+        # the donated slots' FINAL buffers: after the loop X/Y are fresh
+        # device arrays (donation consumed the inputs, not these) — the
+        # resident-pack path parks them for the next round's warm start
+        # so no factor state ever re-crosses the host→device link
+        factor_slots_out["X"] = X
+        factor_slots_out["Y"] = Y
     with _device_loop_guard():
         if getattr(X, "is_fully_addressable", True) and getattr(
             Y, "is_fully_addressable", True

@@ -81,6 +81,11 @@ class _PackEntry:
     # HBM residency ledger entry (device="host": cached wires are host
     # RAM, but they are long-lived residency the capacity view must see)
     ledger: Optional[object] = None
+    # device-resident arm (round 17): when set, the wire's COO planes +
+    # factor state live in HBM under this handle and ``wire`` is the
+    # STRIPPED metadata shell (wire.stripped) — delta rounds scatter
+    # onto the resident buffers instead of re-shipping the store
+    resident: Optional["ResidentPack"] = None
 
     def resident_bytes(self) -> int:
         wire = self.wire
@@ -127,6 +132,7 @@ def pack_cache_clear() -> None:
         evicted = list(_PACK_CACHE.values())
         _PACK_CACHE.clear()
     for entry in evicted:
+        _release_resident(entry)
         if entry.ledger is not None:
             entry.ledger.close()
     _cache_counter().reset()
@@ -222,9 +228,329 @@ def _cache_put(
         while len(_PACK_CACHE) > PACK_CACHE_MAX_ENTRIES:
             evicted.append(_PACK_CACHE.popitem(last=False)[1])
     for old in evicted:
+        _release_resident(old)
         if old.ledger is not None:
             old.ledger.close()
     return entry
+
+
+# --- device-resident pack (round 17) ---
+#
+# ALX keeps factor and rating state resident on the accelerator between
+# solve rounds and moves only what changed (PAPERS.md, arXiv:2112.02194).
+# Here that means: after a full round ships the wire, the device copies
+# of the COO planes, the CSR/segment-geometry offsets, and the trained
+# factor slots PARK in HBM under a ResidentPack handle (registered in
+# the device ledger's ``train-pack`` component, so retention is measured
+# and leak-gated). The next delta round then computes its id resolution
+# and scatter bookkeeping on host (delta-sized) and applies ONE on-device
+# scatter into the resident planes — nothing store-sized crosses the
+# host→device link, converting round cost from O(store) to O(delta).
+#
+# The device arm is an optimization of the host fold, never a semantic
+# fork: any condition it cannot scatter through — segment-geometry
+# buckets grew, a row crossed a segment boundary, unseen ids arrived,
+# the value tier or id dtype would change, the device/mesh changed, or
+# the cursor invalidated — demotes the pack (device_get restores the
+# byte-identical host wire) and takes the existing host fold. Packs
+# release on continuous-loop shutdown, on fallback, and on cache
+# eviction; ``pio_resident_pack_bytes`` must read zero afterwards.
+
+_RESIDENT_ENABLED = False
+
+
+def resident_training_enabled() -> bool:
+    return _RESIDENT_ENABLED
+
+
+def set_resident_training(enabled: bool) -> bool:
+    """Toggle the device-resident incremental-pack arm (default OFF —
+    batch trains gain nothing from parking state in HBM; the continuous
+    loop turns it on for its lifetime). Returns the previous setting."""
+    global _RESIDENT_ENABLED
+    with _PACK_CACHE_LOCK:
+        prev = _RESIDENT_ENABLED
+        _RESIDENT_ENABLED = bool(enabled)
+    return prev
+
+
+def _resident_bytes_gauge():
+    from predictionio_tpu.utils import metrics as _metrics
+
+    return _metrics.get_registry().gauge(
+        "pio_resident_pack_bytes",
+        "Bytes of training-pack state (COO planes, segment geometry, "
+        "factor slots) parked device-resident between continuous rounds",
+        labels=("device",),
+    )
+
+
+def _resident_rounds_counter():
+    from predictionio_tpu.utils import metrics as _metrics
+
+    return _metrics.get_registry().counter(
+        "pio_resident_pack_rounds_total",
+        "Streaming train rounds by resident-pack outcome: scatter "
+        "(delta applied on device), fallback (pack demoted to the host "
+        "fold), cold (no pack involved)",
+        labels=("outcome",),
+    )
+
+
+def _delta_upload_gauge():
+    from predictionio_tpu.utils import metrics as _metrics
+
+    return _metrics.get_registry().gauge(
+        "pio_train_delta_upload_bytes",
+        "Host→device bytes the last streaming train round uploaded "
+        "(resident scatter rounds: delta rows + touched regularizer "
+        "entries only; full rounds: the whole wire + factor state)",
+    )
+
+
+def _refresh_resident_gauge(device_label: str) -> None:
+    from predictionio_tpu.utils import device_ledger as _ledger
+
+    _resident_bytes_gauge().labels(device=device_label).set(
+        float(
+            _ledger.get_ledger().total_bytes(
+                component="train-pack", device=device_label
+            )
+        )
+    )
+
+
+@dataclasses.dataclass
+class ResidentPack:
+    """The device-resident arm of one :class:`_PackEntry`: the wire's
+    COO planes, CSR/segment-geometry offsets, and the trained factor
+    state, all as device arrays. The paired entry's ``wire`` is stripped
+    to its metadata shell while a pack is live; ``_reconstruct_wire``
+    restores the byte-identical host wire from these buffers."""
+
+    # wire planes: item ids (uint16|int32) and value codes (int8 decoded
+    # from nibbles, or float32), both length plane_len, user-sorted
+    i_plane: object
+    v_plane: object
+    # aux CSR offsets / segment bases (aux_pad'd int32 device copies)
+    su: object
+    bu: object
+    si: object
+    bi: object
+    # flat segment-geometry arrays (int32): the per-round device pack
+    # consumes these instead of re-uploading geo.seg_rows/geo.rem
+    seg_rows_u: object
+    rem_u: object
+    seg_rows_i: object
+    rem_i: object
+    # padded factor slots (the fused loop's donated X/Y round-trip back
+    # here after every round) + the non-donated lam/obs vectors
+    X: object
+    Y: object
+    user_lam: object
+    item_lam: object
+    user_obs: object
+    item_obs: object
+    # host-side metadata
+    device: object  # jax device the buffers live on (identity-compared)
+    device_label: str
+    plane_len: int  # bucketed COO length of the planes
+    n: int  # real (unpadded) observation count
+    v_lo: int  # min/max of the REAL int8 value codes (nibble recompute)
+    v_hi: int
+    config_key: tuple  # (rank, reg, reg_mode) the factor state matches
+    ledger: object = None  # train-pack LedgerHandle
+    valid: bool = True
+
+    _ARRAY_FIELDS = (
+        "i_plane", "v_plane", "su", "bu", "si", "bi",
+        "seg_rows_u", "rem_u", "seg_rows_i", "rem_i",
+        "X", "Y", "user_lam", "item_lam", "user_obs", "item_obs",
+    )
+
+    def device_arrays(self) -> list:
+        return [
+            a
+            for a in (getattr(self, f) for f in self._ARRAY_FIELDS)
+            if a is not None
+        ]
+
+    def device_bytes(self) -> int:
+        return int(sum(int(a.nbytes) for a in self.device_arrays()))
+
+    def release(self) -> None:
+        """Close the ledger entry and drop every device reference
+        (idempotent; the buffers free by refcount once training's own
+        references go)."""
+        self.valid = False
+        if self.ledger is not None and not self.ledger.closed:
+            self.ledger.close()
+        for f in self._ARRAY_FIELDS:
+            setattr(self, f, None)
+        _refresh_resident_gauge(self.device_label)
+
+
+def _release_resident(entry: _PackEntry) -> None:
+    """Release an entry's device pack WITHOUT restoring the host wire —
+    only for entries being discarded (eviction, cache clear)."""
+    pack = entry.resident
+    if pack is None:
+        return
+    entry.resident = None
+    pack.release()
+
+
+def _reconstruct_wire(entry: _PackEntry) -> "_als.HostWire":
+    """The full host wire of a resident entry, rebuilt byte-identically
+    from the device planes (every device copy is an exact integer image
+    of the host plane it replaced) and the retained geometry."""
+    meta = entry.wire
+    if not meta.stripped:
+        return meta
+    import jax
+
+    pack = entry.resident
+    i_host = np.asarray(jax.device_get(pack.i_plane))
+    v_host = np.asarray(jax.device_get(pack.v_plane))
+    vw = _als._pack_nibbles_host(v_host) if meta.nibble else v_host
+    aux = {
+        "su": _als.aux_pad(meta.geo_u.starts.astype(np.int32)),
+        "bu": _als.aux_pad(meta.geo_u.seg_base.astype(np.int32)),
+        "si": _als.aux_pad(meta.geo_i.starts.astype(np.int32)),
+        "bi": _als.aux_pad(meta.geo_i.seg_base.astype(np.int32)),
+    }
+    return dataclasses.replace(
+        meta, iw=i_host, vw=vw, aux=aux, stripped=False
+    )
+
+
+def _demote_resident(entry: _PackEntry) -> None:
+    """Fallback-to-host: restore the entry's full host wire from the
+    device planes, then release the pack (train-pack ledger → 0). The
+    entry stays a valid host-fold checkpoint."""
+    if entry.resident is None:
+        return
+    restored = _reconstruct_wire(entry)
+    with _PACK_CACHE_LOCK:
+        entry.wire = restored
+    _release_resident(entry)
+    if entry.ledger is not None and not entry.ledger.closed:
+        entry.ledger.set(entry.resident_bytes())
+
+
+def release_resident_packs() -> int:
+    """Demote every cached entry's device-resident pack back to its
+    host wire — continuous-loop shutdown and promotion handoff call
+    this so the ``train-pack`` ledger reads zero afterwards. Returns
+    the number of packs released."""
+    with _PACK_CACHE_LOCK:
+        entries = list(_PACK_CACHE.values())
+    released = 0
+    for entry in entries:
+        if entry.resident is not None:
+            _demote_resident(entry)
+            released += 1
+    return released
+
+
+def _resident_usable(pack: Optional[ResidentPack]) -> bool:
+    """A pack is only reusable on the device that owns its buffers —
+    a backend/mesh change between rounds demotes instead."""
+    if pack is None or not pack.valid or pack.i_plane is None:
+        return False
+    import jax
+
+    return jax.devices()[0] is pack.device
+
+
+def _resolve_existing(codes, names_arr, index: BiMap):
+    """Resolve delta codes (the delta stream's shared code space) to
+    the cached side's EXISTING dense ids. Returns None when any name is
+    unseen — the resident scatter cannot grow a side's id space (a new
+    id reshuffles the sorted-name relabel), so the caller falls back."""
+    codes = np.asarray(codes, np.int64)
+    if not len(codes):
+        return codes
+    uniq = np.unique(codes)
+    lut = np.zeros(int(uniq[-1]) + 1, np.int64)
+    names = np.asarray(names_arr)
+    for c in uniq:
+        dense = index.get(str(names[int(c)]))
+        if dense is None:
+            return None
+        lut[int(c)] = dense
+    return lut[codes]
+
+
+def _establish_resident(
+    entry: _PackEntry, wire, device_wire, factor_state, fs_out, config
+) -> Optional[ResidentPack]:
+    """Park a just-trained round's device state under a ResidentPack:
+    the shipped planes/aux keep living in HBM, the geometry arrays the
+    per-round device pack needs are placed once, and the fused loop's
+    final X/Y slots (``fs_out``) carry the trained factors without ever
+    re-crossing the link. The entry's host wire is then stripped to its
+    metadata shell — the redundant host plane copy frees (satellite:
+    the ``pack-cache`` host ledger entry shrinks accordingly)."""
+    X, Y = fs_out.get("X"), fs_out.get("Y")
+    if X is None or Y is None:
+        return None
+    import jax
+    import jax.numpy as jnp
+
+    from predictionio_tpu.utils import device_ledger as _ledger
+
+    i_dev, v_dev, aux_dev = device_wire
+    if wire.nibble:
+        codes = _als._unpack_nibbles_host(wire.vw)
+        v_lo, v_hi = int(codes.min()), int(codes.max())
+    elif wire.vw.dtype == np.int8:
+        v_lo, v_hi = int(wire.vw.min()), int(wire.vw.max())
+    else:
+        v_lo = v_hi = 0
+    # the long-lived device placements below are the reviewed resident
+    # sites the device-residency lint allowlists (tests/test_lint.py):
+    # every buffer registers in the train-pack ledger entry right after
+    entry.resident = ResidentPack(
+        i_plane=i_dev,
+        v_plane=v_dev,
+        su=jnp.asarray(aux_dev["su"]),
+        bu=jnp.asarray(aux_dev["bu"]),
+        si=jnp.asarray(aux_dev["si"]),
+        bi=jnp.asarray(aux_dev["bi"]),
+        seg_rows_u=jnp.asarray(wire.geo_u.seg_rows),
+        rem_u=jnp.asarray(wire.geo_u.rem),
+        seg_rows_i=jnp.asarray(wire.geo_i.seg_rows),
+        rem_i=jnp.asarray(wire.geo_i.rem),
+        X=X, Y=Y,
+        user_lam=factor_state[2], item_lam=factor_state[3],
+        user_obs=factor_state[4], item_obs=factor_state[5],
+        device=jax.devices()[0],
+        device_label=_ledger.device_label_of(i_dev),
+        plane_len=int(i_dev.shape[0]),
+        n=int(wire.counts_u.sum()),
+        v_lo=v_lo, v_hi=v_hi,
+        config_key=(config.rank, config.reg, config.reg_mode),
+    )
+    pack = entry.resident
+    label, nbytes, members = _ledger.device_footprint(
+        *pack.device_arrays()
+    )
+    pack.ledger = _ledger.get_ledger().register(
+        component="train-pack",
+        nbytes=nbytes,
+        device=label,
+        anchor=pack,
+        members=members,
+    )
+    with _PACK_CACHE_LOCK:
+        entry.wire = dataclasses.replace(
+            wire, iw=wire.iw[:0], vw=wire.vw[:0], aux={}, stripped=True
+        )
+    if entry.ledger is not None and not entry.ledger.closed:
+        entry.ledger.set(entry.resident_bytes())
+    _refresh_resident_gauge(pack.device_label)
+    return pack
 
 
 # --- incremental pack state ---
@@ -540,11 +866,11 @@ def _side_fold_codes(codes: np.ndarray, names_arr, old_names: np.ndarray):
     return merged, old_to_new, lut[np.asarray(codes, np.int64)]
 
 
-def _fold_delta(entry: _PackEntry, dstream, config, timings: dict):
-    """Fold a delta stream into a cached pack entry: re-finished wire,
-    merged id indexes, warm-start factor seeds, and the chained cursor.
-    Returns None when the delta stream cannot vouch for its own chain
-    (no cursor) — the caller falls back to the full repack."""
+def _scan_delta(dstream, timings: dict) -> Optional[dict]:
+    """Consume a delta stream into flat code/value arrays (shared by
+    the host fold and the resident scatter arm). Returns None when the
+    stream cannot vouch for its own chain (no cursor) — the caller
+    falls back to the full repack."""
     t0 = time.perf_counter()
     parts = []
     n_delta = 0
@@ -561,10 +887,6 @@ def _fold_delta(entry: _PackEntry, dstream, config, timings: dict):
     if new_cursor is None:
         return None
     timings["delta_scan_s"] = time.perf_counter() - t0
-
-    t0 = time.perf_counter()
-    old_u_names = _names_of(entry.user_index)
-    old_i_names = _names_of(entry.item_index)
     if parts:
         e_codes = np.concatenate([p[0] for p in parts])
         g_codes = np.concatenate([p[1] for p in parts])
@@ -574,6 +896,55 @@ def _fold_delta(entry: _PackEntry, dstream, config, timings: dict):
         e_codes = g_codes = np.empty(0, np.int64)
         dv = np.empty(0, np.float32)
         names_arr = None
+    return {
+        "e_codes": e_codes,
+        "g_codes": g_codes,
+        "dv": dv,
+        "names": names_arr,
+        "cursor": new_cursor,
+        "fingerprint": dstream.fingerprint,
+        "n_delta": n_delta,
+    }
+
+
+def _fold_delta(entry: _PackEntry, dstream, config, timings: dict):
+    """Fold a delta stream into a cached pack entry: re-finished wire,
+    merged id indexes, warm-start factor seeds, and the chained cursor.
+    Returns None when the delta stream cannot vouch for its own chain
+    (no cursor) — the caller falls back to the full repack.
+
+    With residency enabled and a device pack on the entry, the delta is
+    first offered to the on-device scatter arm; any condition it cannot
+    scatter through demotes the pack (restoring the byte-identical host
+    wire) and the host fold runs unchanged."""
+    scanned = _scan_delta(dstream, timings)
+    if scanned is None:
+        return None
+    if _RESIDENT_ENABLED and entry.resident is not None:
+        folded = _fold_delta_resident(entry, scanned, config, timings)
+        if folded is not None:
+            return folded
+    if entry.resident is not None:
+        _demote_resident(entry)
+        timings["resident"] = "fallback"
+    return _fold_delta_host(entry, scanned, config, timings)
+
+
+def _fold_delta_host(
+    entry: _PackEntry, scanned: dict, config, timings: dict
+):
+    """The host fold (round 9): invert the cached wire to COO, merge
+    the delta in, re-finish. Needs the entry's FULL host wire — a
+    resident entry is demoted before this runs."""
+    n_delta = scanned["n_delta"]
+    new_cursor = scanned["cursor"]
+    t0 = time.perf_counter()
+    old_u_names = _names_of(entry.user_index)
+    old_i_names = _names_of(entry.item_index)
+    e_codes = scanned["e_codes"]
+    g_codes = scanned["g_codes"]
+    dv = scanned["dv"]
+    names_arr = scanned["names"]
     u_names, u_old2new, du = _side_fold_codes(
         e_codes, names_arr, old_u_names
     )
@@ -656,9 +1027,288 @@ def _fold_delta(entry: _PackEntry, dstream, config, timings: dict):
         "item_index": item_index,
         "compile_wait": compile_wait,
         "cursor": new_cursor,
-        "fingerprint": dstream.fingerprint,
+        "fingerprint": scanned["fingerprint"],
         "warm": warm,
         "delta_events": n_delta,
+    }
+
+
+def _fold_delta_resident(
+    entry: _PackEntry, scanned: dict, config, timings: dict
+) -> Optional[dict]:
+    """The on-device scatter arm of the delta fold. Host work here is
+    delta-sized (id resolution, sort, shift prefix-sums come from
+    catalog-sized bincounts); the only host→device traffic is the delta
+    rows themselves plus the touched regularizer entries. Returns None
+    whenever the scatter cannot reproduce the cold wire byte-for-byte —
+    the caller demotes the pack and takes the host fold.
+
+    Fallback triggers, each checked against what a cold re-finish of
+    the grown store would produce: an unseen user/item id (the
+    sorted-name relabel would reshuffle old rows), a value outside the
+    pack's int8 half-step tier, a changed auto segment length, a row
+    crossing a segment boundary or the segment grid re-bucketing
+    (seg_rows/chunk mismatch), an item-id plane dtype flip, and a
+    device change (caught by ``_resident_usable`` upstream)."""
+    pack = entry.resident
+    if not _resident_usable(pack) or pack.X is None or pack.Y is None:
+        return None
+    if pack.config_key != (config.rank, config.reg, config.reg_mode):
+        return None
+    old = entry.wire
+    names_arr = scanned["names"]
+    du = _resolve_existing(scanned["e_codes"], names_arr, entry.user_index)
+    if du is None:
+        return None
+    di = _resolve_existing(scanned["g_codes"], names_arr, entry.item_index)
+    if di is None:
+        return None
+    t0 = time.perf_counter()
+    d = int(scanned["n_delta"])
+    dv = scanned["dv"]
+    n_users, n_items = old.n_users, old.n_items
+
+    # value-tier stability: the merged plane must stay on the pack's
+    # tier or the cold wire's value dtype would differ
+    if old.v_scale == 0.5:
+        doubled = dv * 2.0
+        codes = np.rint(doubled)
+        if d and (
+            np.abs(doubled - codes).max() != 0.0
+            or np.abs(codes).max() > 127
+        ):
+            return None
+        d_codes = codes.astype(np.int8)
+    else:
+        d_codes = dv.astype(np.float32)
+
+    counts_u = old.counts_u.astype(np.int64) + np.bincount(
+        du, minlength=n_users
+    )
+    counts_i = old.counts_i.astype(np.int64) + np.bincount(
+        di, minlength=n_items
+    )
+    counts_u32 = counts_u.astype(np.int32)
+    counts_i32 = counts_i.astype(np.int32)
+    n_new = pack.n + d
+    L_u = _als.auto_segment_length(
+        None, n_users, config.segment_length, counts=counts_u32
+    )
+    L_i = _als.auto_segment_length(
+        None, n_items, config.segment_length, counts=counts_i32
+    )
+    if L_u != old.L_u or L_i != old.L_i:
+        return None
+    geo_u = _als._segment_geometry(
+        counts_u32, n_users, L_u, 1, config.chunk_slots
+    )
+    geo_i = _als._segment_geometry(
+        counts_i32, n_items, L_i, 1, config.chunk_slots
+    )
+    for g2, g1 in ((geo_u, old.geo_u), (geo_i, old.geo_i)):
+        if (
+            g2.n_chunks != g1.n_chunks
+            or g2.sc != g1.sc
+            or g2.total != g1.total
+            or not np.array_equal(g2.seg_rows, g1.seg_rows)
+        ):
+            return None
+    P_old = pack.plane_len
+    P_new = _als._bucket_count(n_new)
+    i_dtype = old.iw.dtype  # stripped planes keep their dtype
+    top_id = n_items if P_new > n_new else n_items - 1
+    if np.dtype(np.uint16 if top_id < 65536 else np.int32) != i_dtype:
+        return None
+    if d_codes.dtype == np.int8:
+        v_lo = min(pack.v_lo, int(d_codes.min()) if d else pack.v_lo)
+        v_hi = max(pack.v_hi, int(d_codes.max()) if d else pack.v_hi)
+        nibble = P_new % 2 == 0 and v_lo >= 0 and v_hi <= 15
+    else:
+        v_lo = v_hi = 0
+        nibble = False
+
+    compile_wait = _als.start_compile_async(
+        n_users, n_items, geo_u, geo_i, L_u, L_i, config
+    )
+
+    import jax
+    import jax.numpy as jnp
+
+    upload = 0
+    weighted = config.reg_mode == "weighted"
+    i3, v3 = pack.i_plane, pack.v_plane
+    su2, si2 = pack.su, pack.si
+    rem_u2, rem_i2 = pack.rem_u, pack.rem_i
+    user_lam2, item_lam2 = pack.user_lam, pack.item_lam
+    if d:
+        order = np.argsort(du, kind="stable")
+        du_s = du[order].astype(np.int32)
+        di_s = di[order].astype(i_dtype)
+        dc_s = d_codes[order]
+        du_dev = jax.device_put(du_s)
+        di_dev = jax.device_put(di_s)
+        dv_dev = jax.device_put(dc_s)
+        upload += du_s.nbytes + di_s.nbytes + dc_s.nbytes
+
+        # per-row delta counts and their prefix shifts, on device from
+        # the uploaded ids alone (+1 slot so padding rows gather 0)
+        dense_u = jnp.zeros((n_users + 1,), jnp.int32).at[du_dev].add(1)
+        dense_i = (
+            jnp.zeros((n_items + 1,), jnp.int32)
+            .at[di_dev.astype(jnp.int32)]
+            .add(1)
+        )
+        sh_u = jnp.concatenate(
+            [
+                jnp.zeros((1,), jnp.int32),
+                jnp.cumsum(dense_u[:n_users], dtype=jnp.int32),
+            ]
+        )
+        sh_i = jnp.concatenate(
+            [
+                jnp.zeros((1,), jnp.int32),
+                jnp.cumsum(dense_i[:n_items], dtype=jnp.int32),
+            ]
+        )
+
+        # old planes → shifted slots: rebuild each slot's user key from
+        # the resident CSR offsets (the _device_pack_presorted trick),
+        # shift by how many delta rows land before that user, and move.
+        # new_pos is strictly increasing; old padding slots carry
+        # sentinel/zero and either rewrite identical values or drop.
+        marks = (
+            jnp.zeros((P_old + 1,), jnp.int32)
+            .at[pack.su[1:]]
+            .add(1, mode="drop")
+        )
+        keys = jnp.cumsum(marks[:P_old], dtype=jnp.int32)
+        new_pos = jnp.arange(P_old, dtype=jnp.int32) + sh_u[keys]
+        opts = dict(
+            unique_indices=True, indices_are_sorted=True, mode="drop"
+        )
+        init_id = n_items if P_new > n_new else 0
+        i2 = (
+            jnp.full((P_new,), init_id, dtype=pack.i_plane.dtype)
+            .at[new_pos]
+            .set(pack.i_plane, **opts)
+        )
+        v2 = (
+            jnp.zeros((P_new,), pack.v_plane.dtype)
+            .at[new_pos]
+            .set(pack.v_plane, **opts)
+        )
+
+        # delta rows append after each user's old run: occurrence rank
+        # within the (user-sorted) delta + the user's new end offset
+        idx = jnp.arange(d, dtype=jnp.int32)
+        newgrp = jnp.concatenate(
+            [jnp.ones((1,), bool), du_dev[1:] != du_dev[:-1]]
+        )
+        first = jax.lax.cummax(jnp.where(newgrp, idx, 0))
+        d_pos = pack.su[du_dev + 1] + sh_u[du_dev] + (idx - first)
+        i3 = i2.at[d_pos].set(di_dev, **opts)
+        v3 = v2.at[d_pos].set(dv_dev, **opts)
+
+        # CSR offsets shift by the per-user/item prefix counts (edge
+        # padding rides the clip to the final total); segment bases are
+        # unchanged (seg_rows equality above), and only each row's LAST
+        # segment gains the row's delta count
+        su2 = pack.su + sh_u[
+            jnp.clip(
+                jnp.arange(pack.su.shape[0], dtype=jnp.int32), 0, n_users
+            )
+        ]
+        si2 = pack.si + sh_i[
+            jnp.clip(
+                jnp.arange(pack.si.shape[0], dtype=jnp.int32), 0, n_items
+            )
+        ]
+        seg_idx_u = jnp.arange(pack.seg_rows_u.shape[0], dtype=jnp.int32)
+        is_last_u = (seg_idx_u + 1) == pack.bu[pack.seg_rows_u + 1]
+        rem_u2 = pack.rem_u + jnp.where(
+            is_last_u, dense_u[pack.seg_rows_u], 0
+        )
+        seg_idx_i = jnp.arange(pack.seg_rows_i.shape[0], dtype=jnp.int32)
+        is_last_i = (seg_idx_i + 1) == pack.bi[pack.seg_rows_i + 1]
+        rem_i2 = pack.rem_i + jnp.where(
+            is_last_i, dense_i[pack.seg_rows_i], 0
+        )
+
+        if weighted:
+            # weighted regularization tracks counts: upload the
+            # host-computed values at the touched rows (guaranteed
+            # bit-equal to a cold _lam_obs_host; obs never changes —
+            # touched rows already had observations)
+            lam_u_full, _ = _als._lam_obs_host(
+                counts_u32, n_users, pack.user_lam.shape[0], config
+            )
+            uniq_u = np.unique(du_s).astype(np.int32)
+            vals_u = np.ascontiguousarray(lam_u_full[uniq_u])
+            user_lam2 = pack.user_lam.at[jax.device_put(uniq_u)].set(
+                jax.device_put(vals_u),
+                unique_indices=True, indices_are_sorted=True,
+            )
+            lam_i_full, _ = _als._lam_obs_host(
+                counts_i32, n_items, pack.item_lam.shape[0], config
+            )
+            uniq_i = np.unique(di_s.astype(np.int64)).astype(np.int32)
+            vals_i = np.ascontiguousarray(lam_i_full[uniq_i])
+            item_lam2 = pack.item_lam.at[jax.device_put(uniq_i)].set(
+                jax.device_put(vals_i),
+                unique_indices=True, indices_are_sorted=True,
+            )
+            upload += (
+                uniq_u.nbytes + vals_u.nbytes
+                + uniq_i.nbytes + vals_i.nbytes
+            )
+
+    new_meta = dataclasses.replace(
+        old,
+        geo_u=geo_u, geo_i=geo_i,
+        counts_u=counts_u32, counts_i=counts_i32,
+        iw=np.empty(0, i_dtype),
+        vw=np.empty(0, np.uint8 if nibble else d_codes.dtype),
+        nibble=nibble, aux={}, stripped=True,
+    )
+    pack.i_plane, pack.v_plane = i3, v3
+    pack.su, pack.si = su2, si2
+    pack.rem_u, pack.rem_i = rem_u2, rem_i2
+    pack.user_lam, pack.item_lam = user_lam2, item_lam2
+    pack.plane_len = P_new
+    pack.n = n_new
+    pack.v_lo, pack.v_hi = v_lo, v_hi
+    if pack.ledger is not None and not pack.ledger.closed:
+        pack.ledger.set(pack.device_bytes())
+    _refresh_resident_gauge(pack.device_label)
+    with _PACK_CACHE_LOCK:
+        entry.wire = new_meta
+        entry.fingerprint = scanned["fingerprint"]
+        entry.cursor = scanned["cursor"]
+    if entry.ledger is not None and not entry.ledger.closed:
+        entry.ledger.set(entry.resident_bytes())
+
+    timings["fold_exposed_s"] = time.perf_counter() - t0
+    timings["resident"] = "scatter"
+    timings["delta_upload_bytes"] = int(upload)
+    return {
+        "wire": new_meta,
+        "user_index": entry.user_index,
+        "item_index": entry.item_index,
+        "compile_wait": compile_wait,
+        "cursor": scanned["cursor"],
+        "fingerprint": scanned["fingerprint"],
+        "warm": None,
+        "delta_events": d,
+        "resident_pack": pack,
+        "device_wire": (
+            i3, v3, {"su": su2, "bu": pack.bu, "si": si2, "bi": pack.bi}
+        ),
+        "geo_dev": (pack.seg_rows_u, rem_u2, pack.seg_rows_i, rem_i2),
+        "factor_state": (
+            pack.X, pack.Y, user_lam2, item_lam2,
+            pack.user_obs, pack.item_obs,
+        ),
+        "upload_bytes": int(upload),
     }
 
 
@@ -738,6 +1388,10 @@ def _attribute_phases(timer, timings: dict) -> None:
     )
     if "delta_events" in timings:
         note("delta_events", timings["delta_events"])
+    if timings.get("resident"):
+        # device-resident pack outcome (round 17): scatter / fallback /
+        # cold — the continuous loop's RoundReport picks this up
+        note("resident", timings["resident"])
     # convergence telemetry from the fused loop (ops/als.py): the sweep
     # count and the final factor-delta RMS are the round's convergence
     # headline; the full curve stays in timings["sweep_telemetry"] and
@@ -796,6 +1450,12 @@ def train_als_streaming(
     warm_arrays = None
     train_config = config
     cache_entry: Optional[_PackEntry] = None
+    resident_round = False  # wire planes already live in HBM
+    resident_pack: Optional[ResidentPack] = None
+    resident_geo = None
+    resident_wire_dev = None
+    pre_factor_state = None  # scatter rounds: device-resident factors
+    demoted = False  # a resident pack fell back to host this round
     entry = _cache_get(stream, config) if cache else None
     if entry is not None:
         _stat_bump("hit")
@@ -803,6 +1463,16 @@ def train_als_streaming(
         timings["scan_s"] = timings["fold_s"] = 0.0
         timings["pack_exposed_s"] = 0.0
         cache_entry = entry
+        if entry.resident is not None:
+            if _RESIDENT_ENABLED and _resident_usable(entry.resident):
+                # zero-upload hit: planes + geometry stay resident; the
+                # factor state is rebuilt fresh below, so the trained
+                # result is the plain hit path's, bit for bit
+                resident_round = True
+                resident_pack = entry.resident
+            else:
+                _demote_resident(entry)
+                demoted = True
         wire = entry.wire
         user_index, item_index = entry.user_index, entry.item_index
         compile_wait = _als.start_compile_async(
@@ -811,18 +1481,24 @@ def train_als_streaming(
         )
         logger.info(
             "streaming ALS: pack cache HIT (%d users, %d items, %.1f MB "
-            "wire) — skipping scan+pack", wire.n_users, wire.n_items,
-            wire.wire_mb,
+            "wire%s) — skipping scan+pack", wire.n_users, wire.n_items,
+            wire.wire_mb, ", device-resident" if resident_round else "",
         )
     else:
         folded = None
-        if cache and delta:
-            stale = _cache_get_foldable(stream, config)
+        prior = (
+            _cache_lookup(stream, config, any_fingerprint=True)
+            if cache
+            else None
+        )
+        if delta and prior is not None and prior.cursor is not None:
             dfactory = getattr(stream, "delta_factory", None)
-            if stale is not None and dfactory is not None:
-                dstream = dfactory(stale.cursor)
+            if dfactory is not None:
+                dstream = dfactory(prior.cursor)
                 if dstream is not None:
-                    folded = _fold_delta(stale, dstream, config, timings)
+                    folded = _fold_delta(prior, dstream, config, timings)
+        if timings.get("resident") == "fallback":
+            demoted = True
         if folded is not None:
             _stat_bump("fold")
             timings["pack_cache"] = "fold"
@@ -834,7 +1510,27 @@ def train_als_streaming(
             item_index = folded["item_index"]
             compile_wait = folded["compile_wait"]
             warm_arrays = folded["warm"]
-            if warm_arrays is not None and 0 < warm_sweeps < config.iterations:
+            if "resident_pack" in folded:
+                # the device arm already scattered the delta into the
+                # resident planes and updated the entry in place — no
+                # _cache_put (that would displace the entry and release
+                # the very pack this round trains from)
+                resident_round = True
+                resident_pack = folded["resident_pack"]
+                resident_wire_dev = folded["device_wire"]
+                resident_geo = folded["geo_dev"]
+                pre_factor_state = folded["factor_state"]
+                cache_entry = prior
+            else:
+                cache_entry = _cache_put(
+                    stream, config, wire, user_index, item_index,
+                    fingerprint=folded["fingerprint"],
+                    cursor=folded["cursor"],
+                )
+            if (
+                (warm_arrays is not None or pre_factor_state is not None)
+                and 0 < warm_sweeps < config.iterations
+            ):
                 # warm-started factors recover full quality in a few
                 # sweeps after a small delta (ALX / GPU-MF, PAPERS.md);
                 # the iteration count is a dynamic scalar, so the warm
@@ -843,17 +1539,20 @@ def train_als_streaming(
                     config, iterations=warm_sweeps
                 )
                 timings["warm_sweeps"] = warm_sweeps
-            cache_entry = _cache_put(
-                stream, config, wire, user_index, item_index,
-                fingerprint=folded["fingerprint"],
-                cursor=folded["cursor"],
-            )
             logger.info(
-                "streaming ALS: delta FOLD of %d events into cached "
+                "streaming ALS: delta %s of %d events into cached "
                 "wire (%d users, %d items) — skipping full rescan",
+                "SCATTER" if resident_round else "FOLD",
                 folded["delta_events"], wire.n_users, wire.n_items,
             )
         else:
+            if prior is not None and prior.resident is not None:
+                # the full repack replaces the entry: restore the host
+                # wire and release the pack, so the train-pack ledger
+                # reads zero on this fallback round even if the rescan
+                # comes up empty
+                _demote_resident(prior)
+                demoted = True
             _stat_bump("miss" if cache else "off")
             timings["pack_cache"] = "miss" if cache else "off"
             packed = _scan_and_pack(stream, config, timings, queue_batches)
@@ -866,53 +1565,119 @@ def train_als_streaming(
                     cursor=cursor,
                 )
 
-    # ship (async) first, then factor-state init: the RNG + small
-    # factor/regularizer puts run while the wire chunks are in flight
-    device_wire = _ship_wire(wire, n_chunks=ship_chunks)
-    # HBM residency ledger: the staged wire is device-resident from
-    # ship until the device pack consumes it; the Anchor backstops an
-    # exception path, the explicit close below the normal one
     from predictionio_tpu.utils import device_ledger as _ledger
 
-    _staging_anchor = _ledger.Anchor()
-    _st_label, _st_bytes, _st_members = _ledger.device_footprint(
-        device_wire[0], device_wire[1], *device_wire[2].values()
+    fs_out: Optional[dict] = (
+        {}
+        if (_RESIDENT_ENABLED and cache_entry is not None and not demoted)
+        else None
     )
-    staging = _ledger.get_ledger().register(
-        component="stream-staging",
-        nbytes=_st_bytes,
-        device=_st_label,
-        anchor=_staging_anchor,
-        members=_st_members,
-    )
-    factor_state = _als.init_factor_state_single(
-        wire.counts_u, wire.counts_i, wire.n_users, wire.n_items,
-        train_config,
-        warm=(
-            None
-            if warm_arrays is None
-            else (warm_arrays.user_factors, warm_arrays.item_factors)
-        ),
-    )
-    t0 = time.perf_counter()
-    # aux was enqueued last: fetching it (small) fences the serialized
-    # transfer queue behind the COO chunks; the 1-element fence then
-    # waits out the concat/unpack tail
-    _als._sync_fetch(device_wire[2])
-    _als._fence((device_wire[0], device_wire[1]))
-    timings["device_put_exposed_s"] = time.perf_counter() - t0
+    staging = None
+    if resident_round:
+        # nothing store-sized crosses the link: planes, aux, and
+        # geometry are already device-resident under the train-pack
+        # ledger — no staging entry, no transfer fence
+        pack = resident_pack
+        if pre_factor_state is not None:
+            device_wire = resident_wire_dev
+            factor_state = pre_factor_state
+        else:
+            device_wire = (
+                pack.i_plane, pack.v_plane,
+                {"su": pack.su, "bu": pack.bu,
+                 "si": pack.si, "bi": pack.bi},
+            )
+            resident_geo = (
+                pack.seg_rows_u, pack.rem_u, pack.seg_rows_i, pack.rem_i
+            )
+            factor_state = _als.init_factor_state_single(
+                wire.counts_u, wire.counts_i, wire.n_users, wire.n_items,
+                train_config,
+            )
+            timings["delta_upload_bytes"] = int(
+                factor_state[1].nbytes
+                + sum(int(a.nbytes) for a in factor_state[2:])
+            )
+        timings["device_put_exposed_s"] = 0.0
+    else:
+        # ship (async) first, then factor-state init: the RNG + small
+        # factor/regularizer puts run while the wire chunks are in flight
+        device_wire = _ship_wire(wire, n_chunks=ship_chunks)
+        # HBM residency ledger: the staged wire is device-resident from
+        # ship until the device pack consumes it; the Anchor backstops an
+        # exception path, the explicit close below the normal one
+        _staging_anchor = _ledger.Anchor()
+        _st_label, _st_bytes, _st_members = _ledger.device_footprint(
+            device_wire[0], device_wire[1], *device_wire[2].values()
+        )
+        staging = _ledger.get_ledger().register(
+            component="stream-staging",
+            nbytes=_st_bytes,
+            device=_st_label,
+            anchor=_staging_anchor,
+            members=_st_members,
+        )
+        factor_state = _als.init_factor_state_single(
+            wire.counts_u, wire.counts_i, wire.n_users, wire.n_items,
+            train_config,
+            warm=(
+                None
+                if warm_arrays is None
+                else (warm_arrays.user_factors, warm_arrays.item_factors)
+            ),
+        )
+        timings["delta_upload_bytes"] = int(
+            wire.iw.nbytes + wire.vw.nbytes
+            + sum(int(a.nbytes) for a in wire.aux.values())
+            + factor_state[1].nbytes
+            + (factor_state[0].nbytes if warm_arrays is not None else 0)
+            + sum(int(a.nbytes) for a in factor_state[2:])
+        )
+        t0 = time.perf_counter()
+        # aux was enqueued last: fetching it (small) fences the serialized
+        # transfer queue behind the COO chunks; the 1-element fence then
+        # waits out the concat/unpack tail
+        _als._sync_fetch(device_wire[2])
+        _als._fence((device_wire[0], device_wire[1]))
+        timings["device_put_exposed_s"] = time.perf_counter() - t0
 
-    arrays = _als.train_from_wire(
-        wire, train_config,
-        device_wire=device_wire,
-        timings=timings,
-        checkpoint_dir=checkpoint_dir,
-        checkpoint_every=checkpoint_every,
-        profile_dir=profile_dir,
-        compile_wait=compile_wait,
-        factor_state=factor_state,
-    )
-    staging.close()
+    try:
+        arrays = _als.train_from_wire(
+            wire, train_config,
+            device_wire=device_wire,
+            timings=timings,
+            checkpoint_dir=checkpoint_dir,
+            checkpoint_every=checkpoint_every,
+            profile_dir=profile_dir,
+            compile_wait=compile_wait,
+            factor_state=factor_state,
+            geo_dev=resident_geo,
+            factor_slots_out=fs_out,
+            _fp_material=(
+                (
+                    lambda: repr(
+                        (cache_entry.fingerprint, cache_entry.cursor)
+                    ).encode()
+                )
+                if resident_round
+                else None
+            ),
+        )
+    except BaseException:
+        if resident_round and cache_entry is not None:
+            # the donated X/Y slots may be consumed mid-loop; the
+            # planes are not — restore the host wire and release the
+            # pack so a failed round never strands train-pack bytes
+            if resident_pack is not None:
+                resident_pack.X = resident_pack.Y = None
+            if cache_entry.resident is not None:
+                _demote_resident(cache_entry)
+            with _PACK_CACHE_LOCK:
+                cache_entry.arrays = None
+        raise
+    finally:
+        if staging is not None:
+            staging.close()
     if cache_entry is not None:
         # the trained factors ride the entry so the NEXT delta round can
         # warm-start; plain attribute store under the cache lock (the
@@ -921,6 +1686,55 @@ def train_als_streaming(
             cache_entry.arrays = arrays
         if cache_entry.ledger is not None and not cache_entry.ledger.closed:
             cache_entry.ledger.set(cache_entry.resident_bytes())
+    if fs_out is not None and cache_entry is not None:
+        if (
+            resident_round
+            and resident_pack is not None
+            and resident_pack.valid
+        ):
+            if fs_out.get("X") is None or fs_out.get("Y") is None:
+                # defensive: without the final slots the pack has no
+                # factors for the next scatter — demote instead of
+                # keeping consumed references alive
+                resident_pack.X = resident_pack.Y = None
+                _demote_resident(cache_entry)
+            else:
+                # the fused loop's final device X/Y round-trip back
+                # into the pack (donation consumed the previous slots);
+                # lam/obs follow so the next scatter reuses them
+                resident_pack.X = fs_out["X"]
+                resident_pack.Y = fs_out["Y"]
+                resident_pack.user_lam = factor_state[2]
+                resident_pack.item_lam = factor_state[3]
+                resident_pack.user_obs = factor_state[4]
+                resident_pack.item_obs = factor_state[5]
+                resident_pack.config_key = (
+                    config.rank, config.reg, config.reg_mode
+                )
+                if (
+                    resident_pack.ledger is not None
+                    and not resident_pack.ledger.closed
+                ):
+                    resident_pack.ledger.set(resident_pack.device_bytes())
+                _refresh_resident_gauge(resident_pack.device_label)
+        elif (
+            not resident_round
+            and cache_entry.resident is None
+            and not wire.stripped
+        ):
+            _establish_resident(
+                cache_entry, wire, device_wire, factor_state, fs_out,
+                config,
+            )
+    if _RESIDENT_ENABLED:
+        outcome = timings.get("resident") or (
+            "scatter" if resident_round
+            else ("fallback" if demoted else "cold")
+        )
+        timings["resident"] = outcome
+        _resident_rounds_counter().labels(outcome=outcome).inc()
+    if "delta_upload_bytes" in timings:
+        _delta_upload_gauge().set(float(timings["delta_upload_bytes"]))
     timings["stream_wall_s"] = time.perf_counter() - t_start
     if timer is not None:
         _attribute_phases(timer, timings)

@@ -252,8 +252,13 @@ def _train_continuous(
             )
             return
         logger.info(
-            "round %d: instance %s in %.3fs (pack_cache=%s%s%s)",
+            "round %d: instance %s in %.3fs (pack_cache=%s%s%s%s)",
             rep.round, rep.instance_id, rep.wall_s, rep.pack_cache,
+            (
+                f", resident={rep.resident}"
+                if rep.resident is not None
+                else ""
+            ),
             (
                 f", {rep.delta_events} delta events"
                 if rep.delta_events is not None

@@ -89,6 +89,12 @@ class RoundReport:
     # sweep count and the final sweep's factor-delta RMS per side
     sweeps: Optional[int] = None
     final_factor_delta: Optional[str] = None
+    # device-resident pack outcome for this round (ops/streaming.py):
+    # "scatter" when the delta was scattered onto the resident HBM
+    # pack, "fallback" when a resident pack had to be demoted to the
+    # host fold, "cold" for a from-scratch round. None when residency
+    # is disabled or the round was skipped.
+    resident: Optional[str] = None
     # shadow-scoring verdict (workflow/quality.py shadow_score): the
     # candidate instance scored against the previous round's (live)
     # instance on the captured query sample — jaccard/displacement/
@@ -170,6 +176,7 @@ def continuous_train(
     shadow_queries: int = 0,
     shadow_min_jaccard: float = 0.5,
     promotion=None,
+    resident: bool = True,
 ) -> int:
     """Run the poll→delta-fold→warm-train→checkpoint loop until
     ``stop_event`` is set (or ``max_rounds`` rounds ran — tests/bench).
@@ -203,9 +210,18 @@ def continuous_train(
     LIVE instance (the shadow baseline) then follows what the serving
     target actually serves, so a refused or rolled-back round keeps
     shadow-scoring future candidates against the version still taking
-    traffic."""
-    from predictionio_tpu.workflow.context import workflow_context
-    from predictionio_tpu.workflow.core_workflow import CoreWorkflow
+    traffic.
+
+    ``resident`` keeps the packed wire + factor state in device memory
+    between rounds (ops/streaming.ResidentPack), so a steady-state
+    round uploads only the delta rows. The loop OWNS the handles: they
+    are released (and the byte-identical host wire restored) when the
+    loop exits — shutdown, max_rounds, or an error — and the
+    streaming trainer itself demotes a pack on any fallback-to-cold
+    round, so the ``train-pack`` device-ledger component reads zero
+    whenever no loop is live. Residency is scoped to the loop: the
+    previous process-wide setting is restored on exit."""
+    from predictionio_tpu.ops import streaming as _streaming
 
     if mesh is None:
         import jax
@@ -218,9 +234,6 @@ def continuous_train(
         ensure_compilation_cache()
         mesh = make_mesh({"data": 1}, jax.devices()[:1])
     stop = stop_event if stop_event is not None else threading.Event()
-    rounds = 0
-    last_fp: Optional[tuple] = None
-    trained_once = False
     # the "live" reference for shadow scoring: the previous trained
     # round's instance (what a deployed server would be serving now).
     # With a promotion pipeline wired in, seed it from what the serving
@@ -240,6 +253,42 @@ def continuous_train(
     # flips every in-process server's /readyz to 503 once it overruns
     # the deadline — the signal the hot-swap/fleet tier routes on
     hb = _health.heartbeat("continuous-train", deadline_s=ROUND_DEADLINE_S)
+    prev_resident: Optional[bool] = None
+    if resident:
+        prev_resident = _streaming.set_resident_training(True)
+    try:
+        rounds = _continuous_loop(
+            engine, engine_params, instance_template, workflow_params,
+            storage, mesh, interval_s, stop, max_rounds, on_round,
+            shadow_queries, shadow_min_jaccard, promotion,
+            live_instance_id, hb,
+        )
+    finally:
+        if resident:
+            released = _streaming.release_resident_packs()
+            if released:
+                logger.info(
+                    "continuous: released %d resident pack(s) on exit",
+                    released,
+                )
+            _streaming.set_resident_training(bool(prev_resident))
+    return rounds
+
+
+def _continuous_loop(
+    engine, engine_params, instance_template, workflow_params, storage,
+    mesh, interval_s, stop, max_rounds, on_round, shadow_queries,
+    shadow_min_jaccard, promotion, live_instance_id, hb,
+) -> int:
+    """The poll→train→report loop body of :func:`continuous_train`,
+    split out so the resident-pack lifecycle wraps it in one
+    try/finally."""
+    from predictionio_tpu.workflow.context import workflow_context
+    from predictionio_tpu.workflow.core_workflow import CoreWorkflow
+
+    rounds = 0
+    last_fp: Optional[tuple] = None
+    trained_once = False
     while not stop.is_set():
         t0 = time.perf_counter()
         ctx = workflow_context(
@@ -290,6 +339,7 @@ def continuous_train(
                 timer_summary=ctx.timer.summary(),
                 sweeps=notes.get("sweeps"),
                 final_factor_delta=notes.get("final_factor_delta"),
+                resident=notes.get("resident"),
             )
             if shadow_queries > 0 and live_instance_id and instance_id:
                 report.shadow = _shadow_round(

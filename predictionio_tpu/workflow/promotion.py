@@ -774,6 +774,24 @@ class PromotionPipeline:
                         logger.debug(
                             "displaced ledger read failed", exc_info=True
                         )
+                # HBM the continuous trainer keeps resident between
+                # rounds (ops/streaming.ResidentPack, train-pack
+                # component): nonzero under a live continuous loop,
+                # zero otherwise — reported beside the displaced
+                # instance so an operator sees the full post-swap HBM
+                # retention picture in one place.
+                try:
+                    from predictionio_tpu.utils.device_ledger import (
+                        get_ledger,
+                    )
+
+                    report["resident_pack_bytes"] = int(
+                        get_ledger().total_bytes(component="train-pack")
+                    )
+                except Exception:
+                    logger.debug(
+                        "resident-pack ledger read failed", exc_info=True
+                    )
                 if not drained:
                     logger.warning(
                         "displaced instance %s did not drain within %.1fs; "

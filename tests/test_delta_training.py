@@ -123,6 +123,9 @@ class TestFoldByteIdentity:
             )
             assert t["pack_cache"] == "fold"
             assert t["delta_events"] == 150
+            # batch trains keep the resident arm off by default —
+            # round-17 state only parks under the continuous loop
+            assert "resident" not in t
             assert res is not None
             assert _wire_bytes(_cached_wire()) == _wire_bytes(
                 _cold_wire(store, CONFIG)
@@ -478,6 +481,12 @@ class TestContinuousLoop:
         assert reports[1].pack_cache == "fold"
         assert reports[1].delta_events == 40
         assert "delta_events=40" in reports[1].timer_summary
+        # the loop runs with the resident arm on: every trained round
+        # reports an outcome (tests/test_resident_pack.py covers the
+        # scatter/fallback matrix), skipped rounds report none
+        assert reports[0].resident == "cold"
+        assert reports[1].resident in ("scatter", "fallback")
+        assert reports[2].resident is None
         # checkpoint step: each trained round recorded an instance
         ids = [r.instance_id for r in reports if not r.skipped]
         instances = mem_storage.get_meta_data_engine_instances()

@@ -787,6 +787,16 @@ _DEVICE_RESIDENCY_DIRS = ("ops", "api")
 # call names whose result parked in a self attribute is device residency
 _DEVICE_PLACEMENT_CALLS = {"device_put", "put"}
 
+# ops/streaming.py (round 17) parks long-lived device buffers on cache
+# objects rather than ``self`` (``entry.resident = ResidentPack(...)``
+# holds the resident COO planes + factor slots between continuous
+# rounds), so for that file the lint widens to ANY attribute receiver
+# and to the calls that build/absorb device arrays there. Everything it
+# flags must register a train-pack LedgerHandle or be allowlisted.
+_DEVICE_RESIDENCY_WIDENED = {
+    "ops/streaming.py": {"device_put", "put", "asarray", "ResidentPack"},
+}
+
 # (relative path, stripped source line) pairs reviewed as safe: every
 # entry's buffers are registered in the device ledger by the same
 # class (ItemRetriever registers component + component-mask handles;
@@ -808,6 +818,11 @@ DEVICE_RESIDENCY_ALLOWED = {
     # SimilarityScorer.__init__: covered by the similarity-factors
     # handle registered right below (anchor finalizer, refcount free)
     ("ops/similarity.py", "self._dev = jax.device_put(jnp.asarray(self.normed))"),
+    # _establish_resident: the resident incremental pack — covered by
+    # the train-pack handle registered over device_footprint(*arrays)
+    # right below, with an anchor finalizer on the pack itself;
+    # release()/demotion close the handle and zero the gauge
+    ("ops/streaming.py", "entry.resident = ResidentPack("),
 }
 
 
@@ -821,6 +836,10 @@ def _device_residency_occurrences():
             source = path.read_text(encoding="utf-8")
             lines = source.splitlines()
             tree = ast.parse(source, filename=str(path))
+            placement_calls = _DEVICE_RESIDENCY_WIDENED.get(
+                rel, _DEVICE_PLACEMENT_CALLS
+            )
+            any_receiver = rel in _DEVICE_RESIDENCY_WIDENED
 
             def places_on_device(node) -> bool:
                 for sub in ast.walk(node):
@@ -832,7 +851,7 @@ def _device_residency_occurrences():
                         else fn.id if isinstance(fn, ast.Name)
                         else None
                     )
-                    if name in _DEVICE_PLACEMENT_CALLS:
+                    if name in placement_calls:
                         return True
                 return False
 
@@ -844,13 +863,13 @@ def _device_residency_occurrences():
                     if isinstance(node, ast.Assign)
                     else [node.target]
                 )
-                to_self = any(
+                to_attr = any(
                     isinstance(t, ast.Attribute)
                     and isinstance(t.value, ast.Name)
-                    and t.value.id == "self"
+                    and (any_receiver or t.value.id == "self")
                     for t in targets
                 )
-                if not to_self or node.value is None:
+                if not to_attr or node.value is None:
                     continue
                 if places_on_device(node.value):
                     found.add((rel, lines[node.lineno - 1].strip()))
