@@ -2698,6 +2698,88 @@ def bench_retrieval_kernel(device_name, n_items=50_000, rank=16, batch=64):
         storage_mod.set_storage(None)
 
 
+def bench_retrieval_quantized(
+    device_name, n_items=50_000, rank=64, batch=64, n=10
+):
+    """The quantized arm of the saturation config (round 18): int8
+    residency + two-stage retrieval + exact host refinement vs the
+    exact float32 retriever on the SAME rank-64 catalog. HARD gates:
+
+    - recall@n >= 0.999 against the exact path, over every sampled
+      query batch;
+    - id parity on the rescored shortlist: every id the quantized path
+      returns carries the EXACT float32 score of that item (the host
+      refinement rescores against the original rows, so a mismatch
+      means the rescore drifted);
+    - resident-bytes reduction >= 3x vs the float32 instance (the
+      capacity claim, read from the same `resident_bytes` the device
+      ledger registers).
+    """
+    from predictionio_tpu.ops.retrieval import ItemRetriever
+
+    rng = np.random.default_rng(37)
+    base = rng.standard_normal((256, rank)).astype(np.float32)
+    Y = (
+        base[rng.integers(0, 256, n_items)]
+        + 0.3 * rng.standard_normal((n_items, rank))
+    ).astype(np.float32)
+    exact = ItemRetriever(Y, component="bench-exact")
+    quant = ItemRetriever(Y, component="bench-quant", precision="int8")
+    try:
+        reduction = exact.resident_bytes / quant.resident_bytes
+        assert reduction >= 3.0, (
+            f"resident-bytes reduction {reduction:.2f}x is below the 3x "
+            f"acceptance gate (float32 {exact.resident_bytes}B vs int8 "
+            f"{quant.resident_bytes}B on the same catalog)"
+        )
+        hits = total = 0
+        parity_fail = 0
+        q_times, e_times = [], []
+        for rep in range(8):
+            q = rng.standard_normal((batch, rank)).astype(np.float32)
+            t0 = time.perf_counter()
+            es, ei = exact.topn(q, n)
+            e_times.append(time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            qs, qi = quant.topn(q, n)
+            q_times.append(time.perf_counter() - t0)
+            for r in range(batch):
+                want = set(ei[r].tolist())
+                hits += len(want & set(qi[r].tolist()))
+                total += n
+                # rescore parity: each returned id's score must equal
+                # the exact dot product over the ORIGINAL f32 rows
+                ref = Y[qi[r]] @ q[r]
+                if not np.allclose(qs[r], ref, rtol=1e-5, atol=1e-5):
+                    parity_fail += 1
+        recall = hits / total
+        assert recall >= 0.999, (
+            f"quantized recall@{n} {recall:.5f} is below the 0.999 "
+            "acceptance gate"
+        )
+        assert parity_fail == 0, (
+            f"rescore id/score parity FAILED on {parity_fail} sampled "
+            "queries — the exact host refinement drifted from the "
+            "original factor rows"
+        )
+        return {
+            "quantized_recall_at_n": round(recall, 5),
+            "quantized_rescore_parity": "ok",
+            "quantized_bytes_reduction_x": round(reduction, 2),
+            "quantized_batch_ms": round(min(q_times) * 1e3, 2),
+            "exact_batch_ms": round(min(e_times) * 1e3, 2),
+            "quantized_bytes_per_item": round(
+                quant.resident_bytes / n_items, 1
+            ),
+            "float32_bytes_per_item": round(
+                exact.resident_bytes / n_items, 1
+            ),
+        }
+    finally:
+        exact.free()
+        quant.free()
+
+
 def bench_serving_saturation(device_name):
     """The round-12 acceptance rig: an SO_REUSEPORT `pio deploy
     --workers` fleet (each worker its own process, prepared serving
@@ -2727,6 +2809,9 @@ def bench_serving_saturation(device_name):
     import datetime as dt
 
     kernel = bench_retrieval_kernel(device_name)
+    # the quantized arm: int8 residency gates (recall/rescore parity/
+    # bytes reduction) on a rank-64 variant of the same catalog scale
+    quantized = bench_retrieval_quantized(device_name)
 
     tmp = tempfile.mkdtemp(prefix="pio_saturation_")
     workers, clients, n_requests = 2, 32, 25
@@ -2974,6 +3059,7 @@ def bench_serving_saturation(device_name):
                 "errors": errors,
                 "fleet_parity_queries": len(sample_users),
                 **kernel,
+                **quantized,
                 "device": device_name,
             }
         )

@@ -1445,6 +1445,15 @@ class QueryAPI:
             "algorithmsParams": [
                 repr(a.params) for a in self.deployed.algorithms
             ],
+            # active residency precision per algorithm for THIS deployed
+            # version (quantized retrieval tier, ops/retrieval.py);
+            # None = no quantization-aware serving state
+            "servingPrecision": [
+                a.serving_precision(m)
+                for a, m in zip(
+                    self.deployed.algorithms, self.deployed.models
+                )
+            ],
             "serving": type(self.deployed.serving).__name__,
             "feedback": self.config.feedback,
             "eventServerIp": self.config.event_server_ip,

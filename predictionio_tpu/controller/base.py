@@ -188,6 +188,14 @@ class BaseAlgorithm(Controller, Generic[PD, M, Q, P]):
         once per algorithm when a DeployedEngine is constructed. Default:
         nothing."""
 
+    def serving_precision(self, model: M) -> Optional[str]:
+        """The residency precision ("float32"/"bf16"/"int8") the model's
+        prepared serving state stores the catalog at, or None when no
+        quantization-aware serving state exists (training-time predicts,
+        or an engine without the retrieval tier). Surfaces in the engine
+        server's status.json per deployed version. Default: None."""
+        return None
+
     def release_serving(self, model: M) -> None:
         """Undeploy-time inverse of ``prepare_serving`` (no reference
         analog): free the device-resident serving state a displaced

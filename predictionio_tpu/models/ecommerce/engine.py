@@ -192,6 +192,14 @@ class ECommAlgorithmParams(Params):
     # micro-batch pays its compile on live traffic (docs/PERF.md)
     warm_num: int = 16
     warm_max_batch: int = 128
+    # serving residency precision for the resident item matrix
+    # (ops/retrieval.py): "float32" = exact single-stage retrieval;
+    # "bf16"/"int8" store the catalog quantized (~2x / ~3.6x fewer
+    # resident bytes) and serve via the two-stage shortlist + exact
+    # host rescore (recall@n >= 0.999 gated in bench.py)
+    precision: str = "float32"
+    # stage-1 shortlist width multiplier c (shortlist = pow2(c*n))
+    shortlist_mult: int = 4
 
 
 @dataclasses.dataclass
@@ -396,7 +404,9 @@ class ECommAlgorithm(BaseAlgorithm):
         if mesh is not None:
             model.attach_serving_mesh(mesh)
         retriever = ItemRetriever(
-            model.item_factors, mesh=mesh, component="ecommerce"
+            model.item_factors, mesh=mesh, component="ecommerce",
+            precision=self.params.precision,
+            shortlist_mult=self.params.shortlist_mult,
         )
         cache = ConstraintCache(
             self.params.app_name, ttl_s=self.params.constraint_ttl_s
@@ -419,6 +429,11 @@ class ECommAlgorithm(BaseAlgorithm):
         model._retriever = retriever
         model._constraints = cache
         return model
+
+    def serving_precision(self, model: ECommModel) -> Optional[str]:
+        if model._retriever is not None:
+            return model._retriever.precision
+        return None
 
     def release_serving(self, model: ECommModel) -> None:
         """Free the device-resident serving state of a displaced model

@@ -256,7 +256,13 @@ class ALSAlgorithm(BaseAlgorithm):
             logger.info("No prediction for unknown user %s.", query.user)
             return PredictedResult()
         num = min(query.num, len(model.items))
-        scores, idx = model.serving.topn_by_user([uix], num)
+        # pad the requested width to the shared pow2 ladder so varying
+        # `num`s share O(log) compiled executables (tests/test_lint.py
+        # enforces routing through pow2_topk_width at every call site)
+        from predictionio_tpu.ops.retrieval import pow2_topk_width
+
+        n_req = pow2_topk_width(num, len(model.items))
+        scores, idx = model.serving.topn_by_user([uix], n_req)
         return PredictedResult(
             item_scores=tuple(
                 ItemScore(item=model.items[int(j)], score=float(s))

@@ -35,6 +35,7 @@ from predictionio_tpu.ops.als import (
     ServingFactors,
     train_als,
 )
+from predictionio_tpu.ops.retrieval import ItemRetriever
 
 logger = logging.getLogger(__name__)
 
@@ -320,6 +321,15 @@ class ALSAlgorithmParams(Params):
     # pack cache folds a delta and warm-starts from the previous model
     # (ops/streaming). 0 keeps the full num_iterations on delta rounds.
     delta_sweeps: int = 2
+    # serving residency precision for the resident item matrix
+    # (ops/retrieval.py). "float32" keeps the replicated ServingFactors
+    # path; "bf16"/"int8" deploy an ItemRetriever storing the catalog
+    # quantized (~2x / ~3.6x fewer resident bytes) and serve via the
+    # two-stage shortlist + exact host rescore (recall@n >= 0.999 gated
+    # in bench.py)
+    precision: str = "float32"
+    # stage-1 shortlist width multiplier c (shortlist = pow2(c*n))
+    shortlist_mult: int = 4
 
 
 @dataclasses.dataclass
@@ -344,12 +354,19 @@ class ALSModel:
     _serving_mesh: Optional[object] = dataclasses.field(
         default=None, repr=False, compare=False
     )
+    # quantized-residency serving path (ops/retrieval.py), built by
+    # prepare_serving when params.precision != "float32". Device state;
+    # never pickled.
+    _retriever: Optional[ItemRetriever] = dataclasses.field(
+        default=None, repr=False, compare=False
+    )
 
     def __getstate__(self):
         state = self.__dict__.copy()
         state["_serving"] = None
         state["_inv_item"] = None
         state["_serving_mesh"] = None
+        state["_retriever"] = None
         return state
 
     def attach_serving_mesh(self, mesh) -> None:
@@ -393,9 +410,16 @@ class ALSModel:
         from predictionio_tpu.ops.retrieval import pow2_topk_width
 
         max_num = pow2_topk_width(max_num, len(self.item_index))
-        scores, idx = self.serving.topn_by_user(
-            [u for _, u, _ in known], max_num
-        )
+        users = [u for _, u, _ in known]
+        if self._retriever is not None:
+            # quantized residency path: the retriever holds the catalog
+            # as int8/bf16 rows and rescores its shortlist exactly
+            scores, idx = self._retriever.topn(
+                self.arrays.user_factors[np.asarray(users, np.int64)],
+                max_num,
+            )
+        else:
+            scores, idx = self.serving.topn_by_user(users, max_num)
         # the inverse index is catalog-sized — build it once, not per request
         if self._inv_item is None:
             self._inv_item = self.item_index.inverse()
@@ -512,10 +536,30 @@ class ALSAlgorithm(BaseAlgorithm):
     def prepare_serving(self, ctx, model: ALSModel) -> ALSModel:
         """Bind deploy-time serving to the workflow mesh: query batches
         shard over its data axis (catalog replicated), so a multi-chip
-        deployment serves at N x the single-chip batch throughput."""
+        deployment serves at N x the single-chip batch throughput.
+        With ``precision`` set to a quantized tier, deploy an
+        ItemRetriever instead: the catalog resides as int8/bf16 rows
+        (row-sharded over the mesh) and retrieval runs the two-stage
+        shortlist + exact rescore."""
         if ctx is not None:
             model.attach_serving_mesh(ctx.mesh)
+        p: ALSAlgorithmParams = self.params
+        if p.precision != "float32":
+            model._retriever = ItemRetriever(
+                model.arrays.item_factors,
+                mesh=ctx.mesh if ctx is not None else None,
+                component="recommendation",
+                precision=p.precision,
+                shortlist_mult=p.shortlist_mult,
+            )
         return model
+
+    def serving_precision(self, model: ALSModel) -> Optional[str]:
+        if model._retriever is not None:
+            return model._retriever.precision
+        if model._serving is not None:
+            return "float32"
+        return None
 
     def predict(self, model: ALSModel, query: Query) -> PredictedResult:
         return model.recommend(query.user, query.num)
@@ -533,13 +577,25 @@ class ALSAlgorithm(BaseAlgorithm):
         re-upload, never an error."""
         model._serving = None
         model._serving_mesh = None
+        retriever, model._retriever = model._retriever, None
+        if retriever is not None:
+            retriever.free()
 
     def warm(self, model: ALSModel) -> None:
         """Compile the padded serving executables at deploy (tail-latency
         control; no reference analog — Spark has no JIT cold start).
         Covers every top-k tier up to warm_num and every padded batch
-        size up to warm_max_batch."""
+        size up to warm_max_batch. A quantized deployment warms the
+        retriever's precision x shortlist ladder instead (the serving
+        path never touches ServingFactors then)."""
         p: ALSAlgorithmParams = self.params
+        if model._retriever is not None:
+            model._retriever.warm(
+                n=p.warm_num, max_batch=p.warm_max_batch,
+                flag_combos=((False, False),),
+                exclude_widths=(1,),
+            )
+            return
         n = 16
         while True:
             model.serving.warm(n=n, max_batch=p.warm_max_batch)

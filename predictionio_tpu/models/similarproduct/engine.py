@@ -202,6 +202,14 @@ class ALSAlgorithmParams(Params):
     # micro-batch pays its compile on live traffic (docs/PERF.md)
     warm_num: int = 16
     warm_max_batch: int = 128
+    # serving residency precision for the resident item matrix
+    # (ops/retrieval.py): "float32" = exact single-stage retrieval;
+    # "bf16"/"int8" store the catalog quantized (~2x / ~3.6x fewer
+    # resident bytes) and serve via the two-stage shortlist + exact
+    # host rescore (recall@n >= 0.999 gated in bench.py)
+    precision: str = "float32"
+    # stage-1 shortlist width multiplier c (shortlist = pow2(c*n))
+    shortlist_mult: int = 4
 
 
 @dataclasses.dataclass
@@ -476,9 +484,16 @@ class ALSAlgorithm(BaseAlgorithm):
         if mesh is not None:
             model.attach_serving_mesh(mesh)
         model._retriever = ItemRetriever(
-            model.item_factors, mesh=mesh, component="similarproduct"
+            model.item_factors, mesh=mesh, component="similarproduct",
+            precision=self.params.precision,
+            shortlist_mult=self.params.shortlist_mult,
         )
         return model
+
+    def serving_precision(self, model: SPModel) -> Optional[str]:
+        if model._retriever is not None:
+            return model._retriever.precision
+        return None
 
     def release_serving(self, model: SPModel) -> None:
         """Free a displaced model's device-resident serving state

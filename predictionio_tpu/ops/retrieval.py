@@ -61,6 +61,34 @@ serving trace. Sampled batches also record padding waste
 imbalance over the mesh, the stage-1 load-imbalance proxy: per-shard
 scoring work is shape-uniform, so imbalance shows up in candidate
 survival, not FLOPs).
+
+Quantized residency (the approximate-computing MF / ALX recipe for
+10M+-item catalogs, arXiv:1808.03843 + arXiv:2112.02194): with
+``precision="int8"`` the resident rows store as int8 with one float32
+scale per row (symmetric per-row quantization, ``scale =
+max|row|/127``); ``"bf16"`` is the middle tier. Retrieval becomes
+two stages fused into the SAME per-shard program: stage 1 quantizes
+the query block the same way and contracts in the quantized domain
+(int8 x int8 -> int32 accumulate — the MXU-native form) with the
+dequant-rescale epilogue (``* q_scale * row_scale``) fused onto the
+accumulator, masks exactly as the float32 path does, and shortlists
+the top-(c·n) candidates; stage 2 gathers ONLY those c·n rows,
+dequantizes them to float32, and rescores against the full-precision
+query BEFORE the (unchanged) cross-shard merge, so the per-shard
+truncation keeps the right candidates. The merge returns the full
+c·n-wide candidate list, and a final host refinement rescores those
+c·n rows per query against the ORIGINAL float32 factors — which stay
+in host RAM, where every engine already keeps them for pickling; HBM
+holds only the quantized rows. B·c·n·k host FLOPs per batch is noise
+next to the device matmul, and it buys id parity with the exact path:
+returned scores are exact over the original matrix, and recall can
+only be lost when a true top-n item misses the entire merged c·n
+shortlist (int8 round-trip error at the top-n boundary alone costs
+~0.5% recall; the wide-shortlist + original-rows refine is what gets
+the gate to ≥ 0.999). ``float32`` keeps the single-stage exact path
+byte-for-byte. Capacity shows up in the ledger (component
+``<component>/<precision>`` for quantized deployments) and in
+``pio_retrieval_bytes_per_item{component,precision}``.
 """
 
 from __future__ import annotations
@@ -93,6 +121,33 @@ _SPLIT_SAMPLE_EVERY = 16
 # the cache is process-global, so the seen-set must be too — a second
 # retriever with identical shapes hits jit's cache, not a compile)
 _FUSED_SEEN: set = set()
+
+
+# serving-time residency precisions for the resident item matrix
+# (ItemRetriever ``precision=``, plumbed from the engines' params)
+PRECISIONS = ("float32", "bf16", "int8")
+
+
+def quantize_rows_int8(
+    factors: np.ndarray,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Symmetric per-row int8 quantization: ``scale = max|row|/127``,
+    ``row_q = round(row/scale)``. Zero rows get scale 1.0 (their
+    quantized form is all-zero either way), so dequantization never
+    divides by zero and padding rows stay exactly zero."""
+    f = np.asarray(factors, np.float32)
+    scale = np.abs(f).max(axis=1) / 127.0
+    scale = np.where(scale > 0, scale, 1.0).astype(np.float32)
+    q = np.clip(np.rint(f / scale[:, None]), -127, 127).astype(np.int8)
+    return q, scale
+
+
+def dequantize_rows_int8(
+    rows_q: np.ndarray, scale: np.ndarray
+) -> np.ndarray:
+    """f32 rows the int8 storage round-trips to — the matrix the exact
+    stage-2 rescore (and therefore the parity oracle) scores against."""
+    return rows_q.astype(np.float32) * np.asarray(scale, np.float32)[:, None]
 
 
 def _reciprocal_norms(factors: np.ndarray) -> np.ndarray:
@@ -142,15 +197,19 @@ def unpack_topn(packed: np.ndarray, n: int) -> Tuple[np.ndarray, np.ndarray]:
     )
 
 
-def pow2_topk_width(max_num: int, n_items: int) -> int:
+def pow2_topk_width(
+    max_num: int, n_items: int, site: str = "retrieval_topk"
+) -> int:
     """The top-k width to request for a batch whose largest query wants
     ``max_num`` results: a power of two (min 16) so varying ``num``s
-    share O(log) compiled executables, clamped to the catalog. Records
-    the ladder's padding waste (requested vs padded width) in
-    ``pio_padding_waste_ratio{site="retrieval_topk"}``."""
+    share O(log) compiled executables, clamped to the catalog. EVERY
+    top-k / shortlist width the serving tier requests routes through
+    here (tests/test_lint.py enforces it) — a raw width is one
+    executable per distinct ``num``. Records the ladder's padding waste
+    (requested vs padded width) in ``pio_padding_waste_ratio{site}``."""
     w = min(max(16, pow2_at_least(max_num)), n_items)
     if w > 0:
-        _m_padding_waste().labels(site="retrieval_topk").set(
+        _m_padding_waste().labels(site=site).set(
             (w - min(max_num, w)) / w
         )
     return w
@@ -231,6 +290,108 @@ def _fused_topn_single(
     scores = _mask_scores(scores, allow0, excl, incl, has_incl, positive_only)
     s, i = jax.lax.top_k(scores, n)
     return _pack(s, i)
+
+
+def _approx_scores(q, Yq, scale, precision):
+    """Stage-1 score block in the RESIDENT precision. ``int8`` runs the
+    contraction in the quantized domain — the query block quantizes
+    per-row the same way the resident rows did, the matmul accumulates
+    int8 x int8 -> int32 (the MXU-native form), and the dequant-rescale
+    epilogue ``* q_scale * row_scale`` is fused onto the accumulator in
+    the same program. ``bf16`` contracts in bf16 with an f32
+    accumulator; ``scale`` is unread there (and DCE'd)."""
+    if precision == "int8":
+        qs = jnp.max(jnp.abs(q), axis=1) / 127.0
+        qs = jnp.where(qs > 0, qs, 1.0)
+        qi = jnp.clip(
+            jnp.round(q / qs[:, None]), -127, 127
+        ).astype(jnp.int8)
+        acc = jnp.dot(qi, Yq.T, preferred_element_type=jnp.int32)
+        return acc.astype(jnp.float32) * qs[:, None] * scale[None, :]
+    return jnp.dot(
+        q.astype(jnp.bfloat16), Yq.T, preferred_element_type=jnp.float32
+    )
+
+
+def _rescore_exact(
+    q, Yq, scale, s1, i1, rn, positive_only, normalize, precision
+):
+    """Stage 2: gather ONLY the shortlisted rows, dequantize to f32,
+    and rescore against the full-precision query — a returned score is
+    exact over the dequantized matrix, so quantization can only cost
+    stage-1 shortlist misses, never wrong scores. ``positive_only``
+    re-applies on the EXACT score (a borderline approx-positive item
+    must not leak through), and stage-1 ``-inf`` (masked/dead) slots
+    stay ``-inf``."""
+    rows = jnp.take(Yq, i1, axis=0).astype(jnp.float32)
+    if precision == "int8":
+        rows = rows * jnp.take(scale, i1)[:, :, None]
+    rescored = jnp.einsum("bk,bck->bc", q, rows)
+    if normalize:
+        rescored = rescored * jnp.take(rn, i1)
+    if positive_only:
+        rescored = jnp.where(rescored > 0, rescored, -jnp.inf)
+    return jnp.where(s1 == -jnp.inf, -jnp.inf, rescored)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "n", "shortlist", "positive_only", "normalize", "precision"
+    ),
+)
+def _fused_topn_single_2s(
+    q, Yq, scale, rn, allow0, excl, incl, has_incl,
+    n, shortlist, positive_only, normalize, precision,
+):
+    """Quantized single-device path: BOTH stages in one program —
+    approx score with the fused dequant-rescale epilogue + the same
+    mask scatter as the exact path + top-(c·n) shortlist, then the
+    exact-f32 rescore of just the shortlist rows and the final
+    top_k."""
+    approx = _approx_scores(q, Yq, scale, precision)
+    if normalize:
+        approx = approx * rn[None, :]
+    approx = _mask_scores(
+        approx, allow0, excl, incl, has_incl, positive_only
+    )
+    s1, i1 = jax.lax.top_k(approx, shortlist)
+    rescored = _rescore_exact(
+        q, Yq, scale, s1, i1, rn, positive_only, normalize, precision
+    )
+    s, j = jax.lax.top_k(rescored, n)
+    return _pack(s, jnp.take_along_axis(i1, j, axis=1))
+
+
+def _shard_topk_kernel_2s(
+    q, Yq, scale, rn, allow0, excl, incl, has_incl,
+    *, axis, n_local, shortlist, positive_only, normalize, precision,
+):
+    """Per-shard two-stage body (runs under shard_map): the quantized
+    counterpart of ``_shard_topk_kernel`` — candidacy masks and the
+    id-list localize/scatter are IDENTICAL; only the score producer
+    (quantized stage 1 + exact rescore of the top-(c·n_local)
+    shortlist) differs. Emits packed top-n_local EXACT candidates with
+    global ids, so the cross-shard merge is unchanged."""
+    rows_l = Yq.shape[0]
+    off = jax.lax.axis_index(axis).astype(jnp.int32) * rows_l
+
+    def localize(g):
+        return jnp.where((g >= off) & (g < off + rows_l), g - off, rows_l)
+
+    approx = _approx_scores(q, Yq, scale, precision)
+    if normalize:
+        approx = approx * rn[None, :]
+    approx = _mask_scores(
+        approx, allow0, localize(excl), localize(incl), has_incl,
+        positive_only,
+    )
+    s1, i1 = jax.lax.top_k(approx, shortlist)
+    rescored = _rescore_exact(
+        q, Yq, scale, s1, i1, rn, positive_only, normalize, precision
+    )
+    s, j = jax.lax.top_k(rescored, n_local)
+    return _pack(s, jnp.take_along_axis(i1, j, axis=1) + off)
 
 
 def _shard_topk_kernel(
@@ -333,6 +494,21 @@ def _m_resident_bytes():
     )
 
 
+def _m_bytes_per_item():
+    # the name is bytes PER ITEM — a per-row ratio, deliberately not
+    # suffixed `_bytes` (that reads as a footprint total, which is
+    # pio_retrieval_resident_bytes); tests/test_lint.py's
+    # METRIC_NAME_ALLOWED carries the reviewed deviation
+    return _metrics.get_registry().gauge(
+        "pio_retrieval_bytes_per_item",
+        "Device bytes of resident retrieval factor state per catalog "
+        "item (rows + per-row scale + folded norms) by serving "
+        "precision — the capacity-planning number behind the "
+        "float32/bf16/int8 residency ladder",
+        labels=("component", "precision"),
+    )
+
+
 def _m_padding_waste():
     return _metrics.get_registry().gauge(
         "pio_padding_waste_ratio",
@@ -377,6 +553,18 @@ class ItemRetriever:
     device) and retrieval is the fused single-program path. Rows are
     zero-padded so the row count divides the shard count; padding rows
     are permanently masked out.
+
+    ``precision`` selects the residency tier: ``"float32"`` (exact,
+    single-stage — the historical path, byte-for-byte), ``"bf16"``, or
+    ``"int8"`` (rows + one f32 scale per row). Quantized tiers serve
+    through the fused two-stage kernels — stage 1 shortlists the
+    top-(``shortlist_mult``·n) candidates from the quantized scores,
+    stage 2 rescores the shortlist in exact f32 over the dequantized
+    rows before the merge — plus a final host refinement of the merged
+    c·n candidates against the ORIGINAL f32 rows (host RAM, zero HBM):
+    returned scores are exact over the original matrix, ids match the
+    exact path except for whole-shortlist misses, and recall is gated
+    (≥ 0.999 in tests/bench).
     """
 
     def __init__(
@@ -386,7 +574,17 @@ class ItemRetriever:
         axis: str = "data",
         component: str = "retrieval",
         device=None,
+        precision: str = "float32",
+        shortlist_mult: int = 4,
     ):
+        if precision not in PRECISIONS:
+            raise ValueError(
+                f"precision must be one of {PRECISIONS}, got {precision!r}"
+            )
+        if shortlist_mult < 1:
+            raise ValueError(
+                f"shortlist_mult must be >= 1, got {shortlist_mult}"
+            )
         if mesh is not None and mesh.shape[axis] == 1:
             # collapse to the fused single-device path, but KEEP the
             # mesh's device: a `pio deploy --workers` worker pinned to
@@ -399,6 +597,8 @@ class ItemRetriever:
         self.mesh = mesh
         self._axis = axis
         self.component = component
+        self.precision = precision
+        self.shortlist_mult = int(shortlist_mult)
         factors = np.asarray(item_factors, np.float32)
         self.n_items, self.rank = factors.shape
         n_shards = mesh.shape[axis] if mesh is not None else 1
@@ -407,8 +607,34 @@ class ItemRetriever:
         self._n_pad = n_pad
         padded = np.zeros((n_pad, self.rank), np.float32)
         padded[: self.n_items] = factors
+        # residency tier: the resident row storage + the f32 matrix the
+        # device rescore (and the parity oracle) actually scores
+        # against. Norms fold from the DEQUANTIZED rows, so the cosine
+        # path is self-consistent with stage 2's exact rescore.
+        scale_host: Optional[np.ndarray] = None
+        if precision == "int8":
+            y_host, scale_host = quantize_rows_int8(padded)
+            deq = dequantize_rows_int8(y_host, scale_host)
+        elif precision == "bf16":
+            y_host = padded.astype(jnp.bfloat16)
+            deq = y_host.astype(np.float32)
+        else:
+            y_host, deq = padded, padded
+        self._y_host = y_host
+        self._scale_host = scale_host
+        # the final exact-rescore stage reads the ORIGINAL f32 rows out
+        # of host RAM (every engine keeps item_factors host-resident
+        # for pickling anyway) — only the quantized rows occupy HBM
+        if precision != "float32":
+            self._y_f32_host: Optional[np.ndarray] = padded
+            rn_exact = np.zeros(n_pad, np.float32)
+            rn_exact[: self.n_items] = _reciprocal_norms(factors)
+            self._rn_f32_host: Optional[np.ndarray] = rn_exact
+        else:
+            self._y_f32_host = None
+            self._rn_f32_host = None
         rn = np.zeros(n_pad, np.float32)
-        rn[: self.n_items] = _reciprocal_norms(factors)
+        rn[: self.n_items] = _reciprocal_norms(deq[: self.n_items])
         self._valid = np.zeros(n_pad, bool)
         self._valid[: self.n_items] = True
         self._excluded_ids: Optional[np.ndarray] = None
@@ -418,14 +644,21 @@ class ItemRetriever:
                 jax.device_put(a, device) if device is not None
                 else jax.device_put(a)
             )
-            self._y_dev = put(padded)
+            self._y_dev = put(y_host)
+            self._scale_dev = (
+                put(scale_host) if scale_host is not None else None
+            )
             self._rn_dev = put(rn)
             self._allow_dev = put(self._valid)
             self._rep_q = None
         else:
             self._device = None
             self._y_dev = jax.device_put(
-                padded, NamedSharding(mesh, P(axis, None))
+                y_host, NamedSharding(mesh, P(axis, None))
+            )
+            self._scale_dev = (
+                jax.device_put(scale_host, NamedSharding(mesh, P(axis)))
+                if scale_host is not None else None
             )
             self._rn_dev = jax.device_put(rn, NamedSharding(mesh, P(axis)))
             self._allow_dev = jax.device_put(
@@ -433,7 +666,8 @@ class ItemRetriever:
             )
             self._rep_q = NamedSharding(mesh, P())
             self._rep_out = NamedSharding(mesh, P(None, None))
-            # per-(n_local, flags) jitted shard_map stage-1 executables
+            # per-(n_local, flags, shortlist) jitted shard_map stage-1
+            # executables
             self._stage1_cache: Dict[tuple, object] = {}
         self._batches = 0
         self._freed = False
@@ -443,26 +677,42 @@ class ItemRetriever:
         self._exec_seen: set = set()
         self._mask_stamp = time.monotonic()
         _m_mask_age().labels(component=component).set(0.0)
+        # the gauge reads the ACTUAL device arrays, not the f32 host
+        # staging copy — on a quantized deployment those differ by the
+        # whole point of this mode
         _m_resident_bytes().labels(component=component).set(
-            padded.nbytes + rn.nbytes + self._valid.nbytes
+            self.resident_bytes
         )
-        # HBM residency ledger: factors+norms under the component name,
-        # the constraint-fed candidacy mask under <component>-mask (its
-        # lifecycle differs — re-uploaded on constraint change). The
-        # per-device footprint maps attribute each shard's bytes to its
-        # own device for drift reconciliation; the anchor finalizers
-        # are the refcount backstop and free() closes explicitly on the
-        # drain/release path.
+        # HBM residency ledger: factors+norms (+ per-row scales) under
+        # the component name — suffixed /<precision> for quantized
+        # deployments so pio_device_ledger_bytes attributes capacity
+        # per precision tier — and the constraint-fed candidacy mask
+        # under <component>-mask (its lifecycle differs — re-uploaded
+        # on constraint change). The per-device footprint maps
+        # attribute each shard's bytes to its own device for drift
+        # reconciliation; the anchor finalizers are the refcount
+        # backstop and free() closes explicitly on the drain/release
+        # path.
+        factor_arrays = [self._y_dev, self._rn_dev]
+        if self._scale_dev is not None:
+            factor_arrays.append(self._scale_dev)
         f_label, f_bytes, f_members = _ledger.device_footprint(
-            self._y_dev, self._rn_dev
+            *factor_arrays
+        )
+        self._ledger_component = (
+            component if precision == "float32"
+            else f"{component}/{precision}"
         )
         self._ledger_factors = _ledger.get_ledger().register(
-            component=component,
+            component=self._ledger_component,
             nbytes=f_bytes,
             device=f_label,
             anchor=self,
             members=f_members,
         )
+        _m_bytes_per_item().labels(
+            component=component, precision=precision
+        ).set(f_bytes / max(1, self.n_items))
         m_label, m_bytes, m_members = _ledger.device_footprint(
             self._allow_dev
         )
@@ -474,8 +724,8 @@ class ItemRetriever:
             members=m_members,
         )
         logger.info(
-            "ItemRetriever[%s]: %d items (rank %d) resident %s",
-            component, self.n_items, self.rank,
+            "ItemRetriever[%s]: %d items (rank %d, %s) resident %s",
+            component, self.n_items, self.rank, precision,
             f"row-sharded over {n_shards} devices" if mesh is not None
             else "on one device",
         )
@@ -515,8 +765,17 @@ class ItemRetriever:
                 allow, NamedSharding(self.mesh, P(self._axis))
             )
         self._excluded_ids = idx
+        # re-`set` from the FRESH device footprint (never the size
+        # captured at prepare): on a quantized deployment the prepare-
+        # time f32 staging sizes are 2-4x the resident truth, and a
+        # stale number here is exactly the reconcile() drift the ledger
+        # exists to catch. The resident-bytes gauge re-reads the actual
+        # arrays for the same reason.
         _, m_bytes, m_members = _ledger.device_footprint(self._allow_dev)
         self._ledger_mask.set(m_bytes, members=m_members)
+        _m_resident_bytes().labels(component=self.component).set(
+            self.resident_bytes
+        )
         _m_mask_refresh().labels(
             component=self.component, outcome="refreshed"
         ).inc()
@@ -533,9 +792,23 @@ class ItemRetriever:
 
     @property
     def resident_bytes(self) -> int:
-        return int(
-            self._y_dev.nbytes + self._rn_dev.nbytes + self._allow_dev.nbytes
-        )
+        arrays = [self._y_dev, self._rn_dev, self._allow_dev]
+        if self._scale_dev is not None:
+            arrays.append(self._scale_dev)
+        return int(sum(a.nbytes for a in arrays))
+
+    def dequantized_factors(self) -> np.ndarray:
+        """Host f32 matrix the device path actually scores against —
+        the original factors for float32, the dequantized resident rows
+        otherwise. This is the reference the exact-rescore parity
+        oracle (tests/bench) feeds to ``naive_topn_reference``."""
+        if self.precision == "int8":
+            deq = dequantize_rows_int8(self._y_host, self._scale_host)
+        elif self.precision == "bf16":
+            deq = self._y_host.astype(np.float32)
+        else:
+            deq = self._y_host
+        return deq[: self.n_items]
 
     # --- the hot path ---
 
@@ -599,6 +872,16 @@ class ItemRetriever:
             raise ValueError(
                 f"n must be in [1, {self.n_items}], got {n}"
             )
+        # quantized precisions: the DEVICE pipeline returns the full
+        # c·n-wide merged candidate list (not just n) and a final host
+        # refinement rescores it against the ORIGINAL f32 rows — the
+        # dequantized matrix reorders items at the top-n boundary, so
+        # taking n on-device would cap recall below the 0.999 gate no
+        # matter how wide the shard shortlist is
+        n_dev = (
+            n if self.precision == "float32"
+            else self._shortlist_width(n, self.n_items)
+        )
         qp = pad_rows_pow2(q, 8)
         b_pad = qp.shape[0]
         excl, _ = self._assemble_idx(
@@ -620,19 +903,45 @@ class ItemRetriever:
             # executable-cache accounting: the fused program's jit cache
             # is keyed by shapes + statics; a NEW key here is a compile
             # (cold if it happens under a serving compile_site)
-            exec_key = (
-                self._n_pad, self.rank, b_pad,
-                excl.shape[1], incl.shape[1],
-                n, positive_only, normalize,
-            )
-            with _cc.track_compile("retrieval-fused", _FUSED_SEEN, exec_key):
-                packed = _fused_topn_single(
-                    put(qp), self._y_dev, self._rn_dev, self._allow_dev,
-                    put(excl), put(incl), put(has_incl),
+            if self.precision == "float32":
+                exec_key = (
+                    self._n_pad, self.rank, b_pad,
+                    excl.shape[1], incl.shape[1],
                     n, positive_only, normalize,
                 )
+                with _cc.track_compile(
+                    "retrieval-fused", _FUSED_SEEN, exec_key
+                ):
+                    packed = _fused_topn_single(
+                        put(qp), self._y_dev, self._rn_dev,
+                        self._allow_dev,
+                        put(excl), put(incl), put(has_incl),
+                        n, positive_only, normalize,
+                    )
+            else:
+                shortlist = self._shortlist_width(n_dev, self._n_pad)
+                exec_key = (
+                    self._n_pad, self.rank, b_pad,
+                    excl.shape[1], incl.shape[1],
+                    n_dev, shortlist, positive_only, normalize,
+                    self.precision,
+                )
+                with _cc.track_compile(
+                    "retrieval-fused", _FUSED_SEEN, exec_key
+                ):
+                    packed = _fused_topn_single_2s(
+                        put(qp), self._y_dev, self._scale_operand,
+                        self._rn_dev, self._allow_dev,
+                        put(excl), put(incl), put(has_incl),
+                        n_dev, shortlist, positive_only, normalize,
+                        self.precision,
+                    )
             host = np.asarray(packed)[:b]
             _m_shard_seconds().observe(time.perf_counter() - t0)
+            if self.precision != "float32":
+                return self._refine_exact(
+                    q, host, n_dev, n, positive_only, normalize
+                )
             return unpack_topn(host, n)
 
         rep = self._rep_q
@@ -640,8 +949,14 @@ class ItemRetriever:
         excl_dev = jax.device_put(excl, rep)
         incl_dev = jax.device_put(incl, rep)
         has_dev = jax.device_put(has_incl, rep)
-        n_local = min(n, self._n_pad // self._n_shards)
-        stage1 = self._stage1(n_local, positive_only, normalize)
+        n_local = min(n_dev, self._n_pad // self._n_shards)
+        shortlist = (
+            None if self.precision == "float32"
+            else self._shortlist_width(
+                n_local, self._n_pad // self._n_shards
+            )
+        )
+        stage1 = self._stage1(n_local, positive_only, normalize, shortlist)
         # the shard-vs-merge timing split needs a host sync between the
         # two programs, which would serialize an otherwise back-to-back
         # dispatch on EVERY batch — so the split is SAMPLED (first
@@ -651,27 +966,73 @@ class ItemRetriever:
         split = self._batches % _SPLIT_SAMPLE_EVERY == 1
         exec_key = (
             n_local, positive_only, normalize, b_pad,
-            excl.shape[1], incl.shape[1],
+            excl.shape[1], incl.shape[1], shortlist, self.precision,
         )
-        t0 = time.perf_counter()
-        with _cc.track_compile("retrieval-stage1", self._exec_seen, exec_key):
-            cand = stage1(
+        if shortlist is None:
+            args = (
                 q_dev, self._y_dev, self._rn_dev, self._allow_dev,
                 excl_dev, incl_dev, has_dev,
             )
+        else:
+            args = (
+                q_dev, self._y_dev, self._scale_operand, self._rn_dev,
+                self._allow_dev, excl_dev, incl_dev, has_dev,
+            )
+        t0 = time.perf_counter()
+        with _cc.track_compile("retrieval-stage1", self._exec_seen, exec_key):
+            cand = stage1(*args)
         if split:
             jax.block_until_ready(cand)
             t1 = time.perf_counter()
             _m_shard_seconds().observe(t1 - t0)
-        packed = _merge_candidates(cand, n, n_local, self._rep_out)
+        packed = _merge_candidates(cand, n_dev, n_local, self._rep_out)
         host = np.asarray(packed)[:b]
         if split:
             _m_merge_seconds().observe(time.perf_counter() - t1)
             # sampled skew: the candidate buffer is already synced (the
             # split's block_until_ready), so the extra fetch costs one
             # host copy on 1/_SPLIT_SAMPLE_EVERY batches only
-            self._record_skew(np.asarray(cand)[:b], host, n, n_local)
+            self._record_skew(np.asarray(cand)[:b], host, n_dev, n_local)
+        if self.precision != "float32":
+            return self._refine_exact(
+                q, host, n_dev, n, positive_only, normalize
+            )
         return unpack_topn(host, n)
+
+    def _refine_exact(
+        self,
+        q: np.ndarray,
+        packed: np.ndarray,
+        n_dev: int,
+        n: int,
+        positive_only: bool,
+        normalize: bool,
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """Final exact rescore of the device's merged c·n candidates
+        against the ORIGINAL float32 rows (host RAM — the engines keep
+        ``item_factors`` host-resident anyway, so this costs zero HBM).
+        B·c·n·k host FLOPs per batch, negligible next to the B·N·k the
+        device just did; recall@n is then limited only by whole-shortlist
+        misses and id parity vs the exact path holds by construction."""
+        s_d, i_d = unpack_topn(packed, n_dev)
+        rows = self._y_f32_host[i_d]  # [B, n_dev, k] gather, host RAM
+        sc = np.einsum(
+            "bk,bnk->bn", q, rows, optimize=True
+        ).astype(np.float32)
+        if normalize:
+            sc = sc * self._rn_f32_host[i_d]
+        if positive_only:
+            sc = np.where(sc > 0, sc, -np.inf)
+        # dead device slots (masked / past live-candidate count) stay
+        # dead regardless of what their placeholder id rescores to
+        sc = np.where(s_d == -np.inf, -np.inf, sc)
+        # descending exact score, ties broken by LOWEST global id — the
+        # same order naive_topn_reference's stable sort produces
+        order = np.lexsort((i_d, -sc), axis=1)[:, :n]
+        return (
+            np.take_along_axis(sc, order, axis=1),
+            np.take_along_axis(i_d, order, axis=1),
+        )
 
     def _record_skew(
         self, cand: np.ndarray, host: np.ndarray, n: int, n_local: int
@@ -702,29 +1063,78 @@ class ItemRetriever:
                 float(counts.max() / counts.mean())
             )
 
-    def _stage1(self, n_local: int, positive_only: bool, normalize: bool):
-        key = (n_local, positive_only, normalize)
+    @property
+    def _scale_operand(self):
+        """The per-row scale operand of the two-stage kernels. bf16 has
+        no scales; the norm vector rides in the slot (same shape and
+        sharding spec) and the kernel — static on precision — never
+        reads it, so XLA DCEs the input instead of us shipping a dummy
+        catalog-length buffer."""
+        return (
+            self._scale_dev if self._scale_dev is not None
+            else self._rn_dev
+        )
+
+    def _shortlist_width(self, n: int, rows: int) -> int:
+        """Stage-1 shortlist width for a final top-``n`` over ``rows``
+        candidate rows: ``shortlist_mult``·n, pow2-bucketed through the
+        shared ladder (O(log) compiled widths) and clamped to the row
+        count — never below ``n``, so the stage-2 top_k is always
+        satisfiable."""
+        return pow2_topk_width(
+            min(self.shortlist_mult * n, rows), rows,
+            site="retrieval_shortlist",
+        )
+
+    def _stage1(
+        self,
+        n_local: int,
+        positive_only: bool,
+        normalize: bool,
+        shortlist: Optional[int] = None,
+    ):
+        key = (n_local, positive_only, normalize, shortlist)
         fn = self._stage1_cache.get(key)
         if fn is None:
-            kernel = functools.partial(
-                _shard_topk_kernel,
-                axis=self._axis, n_local=n_local,
-                positive_only=positive_only, normalize=normalize,
-            )
             axis = self._axis
+            if shortlist is None:
+                kernel = functools.partial(
+                    _shard_topk_kernel,
+                    axis=self._axis, n_local=n_local,
+                    positive_only=positive_only, normalize=normalize,
+                )
+                in_specs = (
+                    P(None, None),  # q: replicated
+                    P(axis, None),  # Y: row-sharded
+                    P(axis),        # rn
+                    P(axis),        # allow
+                    P(None, None),  # excl (global ids, replicated)
+                    P(None, None),  # incl
+                    P(None,),       # has_incl
+                )
+            else:
+                kernel = functools.partial(
+                    _shard_topk_kernel_2s,
+                    axis=self._axis, n_local=n_local,
+                    shortlist=shortlist,
+                    positive_only=positive_only, normalize=normalize,
+                    precision=self.precision,
+                )
+                in_specs = (
+                    P(None, None),  # q: replicated
+                    P(axis, None),  # Yq: row-sharded quantized rows
+                    P(axis),        # per-row scales
+                    P(axis),        # rn
+                    P(axis),        # allow
+                    P(None, None),  # excl (global ids, replicated)
+                    P(None, None),  # incl
+                    P(None,),       # has_incl
+                )
             fn = jax.jit(
                 shard_map(
                     kernel,
                     mesh=self.mesh,
-                    in_specs=(
-                        P(None, None),  # q: replicated
-                        P(axis, None),  # Y: row-sharded
-                        P(axis),        # rn
-                        P(axis),        # allow
-                        P(None, None),  # excl (global ids, replicated)
-                        P(None, None),  # incl
-                        P(None,),       # has_incl
-                    ),
+                    in_specs=in_specs,
                     # per-shard candidate blocks concatenate along the
                     # candidate dim: the stage-1 output STAYS sharded
                     out_specs=P(None, axis),
@@ -745,11 +1155,17 @@ class ItemRetriever:
         so nothing is ever freed underneath a running batch."""
         self._freed = True
         self._y_dev = None
+        self._scale_dev = None
         self._rn_dev = None
         self._allow_dev = None
+        self._y_f32_host = None
+        self._rn_f32_host = None
         if self.mesh is not None:
             self._stage1_cache = {}
         _m_resident_bytes().labels(component=self.component).set(0.0)
+        _m_bytes_per_item().labels(
+            component=self.component, precision=self.precision
+        ).set(0.0)
         self._ledger_factors.close()
         self._ledger_mask.close()
 
@@ -761,33 +1177,50 @@ class ItemRetriever:
         exclude_widths: Sequence[int] = (1, 16, 64),
     ) -> None:
         """Deploy-time compile of the padded-batch executables the
-        serving path can hit (O(log max_batch) per flag combo x
-        exclude width; see BaseAlgorithm.warm). ``flag_combos`` lists
-        the (positive_only, normalize) pairs the engine serves with;
+        serving path can hit (O(log) per flag combo x exclude width;
+        see BaseAlgorithm.warm). ``flag_combos`` lists the
+        (positive_only, normalize) pairs the engine serves with;
         ``exclude_widths`` the per-query exclusion-list widths to
         pre-trace — the id-list block pads to a power of two, so a
         query arriving with a blacklist/seen set is a DIFFERENT traced
         shape than a bare query, and without warming it the first such
         query would pay an XLA compile inside a live batch. 1/16/64
         cover bare queries and the common seen/blacklist sizes; rarer
-        widths (and whitelists) still compile on first use."""
-        n = min(n, self.n_items)
+        widths (and whitelists) still compile on first use.
+
+        The top-k width itself LADDERS (16 doubling to ``n``): each
+        pow2 tier the pow2_topk_width router can request is a distinct
+        executable, and on a quantized retriever each tier also pins
+        its derived stage-1 shortlist width — so the whole
+        precision x shortlist combination space this instance can
+        serve compiles here, never inside the first live batch that
+        asks for a wider ``num``."""
         k = self.rank
-        for positive_only, normalize in flag_combos:
-            for w in exclude_widths:
-                excl_row = np.zeros(w, np.int64) if w > 1 else None
-                b = 8
-                while True:
-                    self.topn(
-                        np.zeros((b, k), np.float32), n,
-                        exclude=(
-                            [excl_row] * b if excl_row is not None else None
-                        ),
-                        positive_only=positive_only, normalize=normalize,
-                    )
-                    if b >= max_batch:
-                        break
-                    b *= 2
+        tiers: List[int] = []
+        w = 16
+        while True:
+            tiers.append(min(w, self.n_items))
+            if w >= min(n, self.n_items):
+                break
+            w *= 2
+        for nn in sorted(set(tiers)):
+            for positive_only, normalize in flag_combos:
+                for ew in exclude_widths:
+                    excl_row = np.zeros(ew, np.int64) if ew > 1 else None
+                    b = 8
+                    while True:
+                        self.topn(
+                            np.zeros((b, k), np.float32), nn,
+                            exclude=(
+                                [excl_row] * b
+                                if excl_row is not None else None
+                            ),
+                            positive_only=positive_only,
+                            normalize=normalize,
+                        )
+                        if b >= max_batch:
+                            break
+                        b *= 2
 
 
 def naive_topn_reference(

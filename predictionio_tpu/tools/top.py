@@ -62,6 +62,23 @@ def attributed_hit_rate(samples: Dict[str, float]) -> Optional[float]:
     return (converted / denom) if denom else None
 
 
+def quantized_residency(samples: Dict[str, float]) -> Optional[str]:
+    """PREC detail from ``pio_retrieval_bytes_per_item{precision}``:
+    ``"int8:73B"`` — the residency precision(s) the server's retrieval
+    tier is actually serving at and what each resident row costs. A
+    float32-only (or retriever-less) server shows no detail."""
+    per: Dict[str, float] = {}
+    for key, value in samples.items():
+        if _family_name(key) != "pio_retrieval_bytes_per_item":
+            continue
+        prec = _label_value(key, "precision")
+        if prec and value > 0:
+            per[prec] = max(per.get(prec, 0.0), value)
+    if not per:
+        return None
+    return ",".join(f"{p}:{b:.0f}B" for p, b in sorted(per.items()))
+
+
 def fetch_server(base_url: str, timeout: float = 5.0) -> dict:
     """One snapshot of a server's health + readiness + metrics. Network
     failures degrade to ``{"up": False}`` — the console must keep
@@ -146,6 +163,9 @@ def _row(snap: dict, prev: Optional[dict], elapsed_s: float) -> dict:
     resident = counter_sum(m, "pio_retrieval_resident_bytes")
     if resident:
         row["resident_mb"] = resident / 2**20
+    prec = quantized_residency(m)
+    if prec is not None:
+        row["prec"] = prec
     mask_age = gauge_max(m, "pio_retrieval_mask_age_seconds")
     if mask_age is not None:
         row["mask_age_s"] = mask_age
@@ -224,6 +244,7 @@ _COLUMNS = (
     ("rounds", "ROUNDS", 7),
     ("last_delta", "CONV", 9),
     ("resident_mb", "RES_MB", 7),
+    ("prec", "PREC", 10),
     ("hbm_mb", "HBM_MB", 7),
     ("pad", "PAD", 6),
     ("skew", "SKEW", 5),
@@ -295,7 +316,7 @@ def _row_from_fleet(t: dict) -> dict:
         row["p50_ms"] = p50
         row["p99_ms"] = t.get("window_p99_ms", t.get("p99_ms"))
     # device-plane columns federated by the collector
-    for key in ("hbm_mb", "pad", "skew", "drift_mb"):
+    for key in ("hbm_mb", "pad", "skew", "drift_mb", "prec"):
         if t.get(key) is not None:
             row[key] = t[key]
     return row

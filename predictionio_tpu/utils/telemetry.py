@@ -855,6 +855,13 @@ class Collector:
         skew = _metrics.gauge_max(samples, "pio_retrieval_shard_skew")
         if skew is not None:
             row["skew"] = round(skew, 3)
+        # quantized-residency detail (pio_retrieval_bytes_per_item):
+        # the same "prec:bytesB" string the direct-scrape console shows
+        from predictionio_tpu.tools.top import quantized_residency
+
+        prec = quantized_residency(samples)
+        if prec is not None:
+            row["prec"] = prec
         windowed = self._windowed(state, window_s)
         if windowed is not None:
             span_s, delta = windowed

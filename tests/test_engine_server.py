@@ -138,6 +138,9 @@ class TestQueryAPI:
         assert s["requestCount"] == 3
         assert s["avgServingSec"] > 0
         assert s["algorithms"] == ["Algo0", "Algo1"]
+        # fake algorithms carry no quantization-aware serving state:
+        # the per-version precision report is present but None-valued
+        assert s["servingPrecision"] == [None, None]
 
     def test_status_html(self, query_api):
         status, page, ctype = query_api.handle("GET", "/")

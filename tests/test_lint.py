@@ -513,8 +513,13 @@ _NON_BASE_UNIT_SUFFIXES = (
 )
 
 # (relative path, family name) pairs reviewed as acceptable deviations.
-# Seeded empty — every family in the tree conforms; shrink-only.
-METRIC_NAME_ALLOWED: set = set()
+# Shrink-only. pio_retrieval_bytes_per_item is a RATIO (resident bytes
+# per catalog item, the quantization capacity figure `pio top` renders
+# as PREC detail), not a size series — an `_bytes` suffix would claim a
+# summable byte total, which per-item bytes is not.
+METRIC_NAME_ALLOWED: set = {
+    ("ops/retrieval.py", "pio_retrieval_bytes_per_item"),
+}
 
 
 def _metric_name_violation(name: str, kind: str):
@@ -804,7 +809,10 @@ _DEVICE_RESIDENCY_WIDENED = {
 DEVICE_RESIDENCY_ALLOWED = {
     # ItemRetriever.__init__ / set_excluded_ids: covered by the
     # _ledger_factors/_ledger_mask handles registered right below them
-    ("ops/retrieval.py", "self._y_dev = put(padded)"),
+    # (y_host is the precision-selected storage rows — f32/bf16/int8 —
+    # and _scale_dev the int8 per-row scales, all in the factors handle)
+    ("ops/retrieval.py", "self._y_dev = put(y_host)"),
+    ("ops/retrieval.py", "self._scale_dev = ("),
     ("ops/retrieval.py", "self._rn_dev = put(rn)"),
     ("ops/retrieval.py", "self._allow_dev = put(self._valid)"),
     ("ops/retrieval.py", "self._y_dev = jax.device_put("),
@@ -1067,4 +1075,90 @@ def test_storage_unbounded_socket_allowlist_is_not_stale():
     assert not stale, (
         f"storage unbounded-socket allowlist entries no longer in "
         f"the tree: {sorted(stale)}"
+    )
+
+
+# --- retrieval top-k widths route through the pow2 ladder ---
+#
+# The bug class (PR 8's blacklist-width lesson, now with a quantized
+# shortlist tier multiplying the executable space): a serving call
+# site that passes a raw query `num` straight into a retrieval top-k
+# entry point compiles ONE executable per distinct num — under varied
+# live traffic that turns the micro-batch executor into a compile
+# queue. Every function that calls a retrieval top-k entry point
+# (`topn`/`topn_by_user`/`topn_by_rows`/`topn_packed_device`) must
+# route its width through `retrieval.pow2_topk_width` in the SAME
+# function (the ladder also records padding waste per site).
+# ops/retrieval.py and ops/als.py are exempt — they ARE the ladder's
+# implementation (internal stage widths are already pow2-derived, and
+# warm() deliberately walks the ladder tiers). The allowlist is
+# seeded EMPTY and shrink-only.
+
+_TOPK_ENTRY_POINTS = (
+    "topn", "topn_by_user", "topn_by_rows", "topn_packed_device",
+)
+
+_TOPK_LINT_EXEMPT_FILES = ("ops/retrieval.py", "ops/als.py")
+
+# (relative path, enclosing function name) pairs excused from routing.
+SHORTLIST_WIDTH_ALLOWED: set = set()
+
+
+def _unrouted_topk_occurrences():
+    import ast
+
+    found = set()
+    for path in sorted(PACKAGE.rglob("*.py")):
+        rel = path.relative_to(PACKAGE).as_posix()
+        if rel in _TOPK_LINT_EXEMPT_FILES:
+            continue
+        tree = ast.parse(
+            path.read_text(encoding="utf-8"), filename=str(path)
+        )
+        for node in ast.walk(tree):
+            if not isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ):
+                continue
+            calls_topk = False
+            calls_router = False
+            for sub in ast.walk(node):
+                if not (
+                    isinstance(sub, ast.Call)
+                    and isinstance(sub.func, (ast.Attribute, ast.Name))
+                ):
+                    continue
+                attr = (
+                    sub.func.attr
+                    if isinstance(sub.func, ast.Attribute)
+                    else sub.func.id
+                )
+                if attr in _TOPK_ENTRY_POINTS:
+                    calls_topk = True
+                if attr == "pow2_topk_width":
+                    calls_router = True
+            if calls_topk and not calls_router:
+                found.add((rel, node.name))
+    return found
+
+
+def test_topk_widths_route_through_pow2_ladder():
+    found = _unrouted_topk_occurrences()
+    new = found - SHORTLIST_WIDTH_ALLOWED
+    assert not new, (
+        "retrieval top-k call site without pow2_topk_width in the "
+        "same function — a raw width is one compiled executable per "
+        "distinct num (and on a quantized retriever also pins an "
+        "unwarmed stage-1 shortlist width); route the width through "
+        "retrieval.pow2_topk_width or justify an allowlist entry: "
+        f"{sorted(new)}"
+    )
+
+
+def test_shortlist_width_allowlist_is_not_stale():
+    found = _unrouted_topk_occurrences()
+    stale = SHORTLIST_WIDTH_ALLOWED - found
+    assert not stale, (
+        f"shortlist-width allowlist entries no longer in the tree: "
+        f"{sorted(stale)}"
     )
