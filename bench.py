@@ -206,6 +206,10 @@ _SUMMARY_FIELDS = {
         "delta_events", "delta_convergence", "cold_convergence",
         "sweep_telemetry_overhead_frac",
     ),
+    "implicit_train_s": (
+        "value", "exact_loop_s", "solve_speedup", "hit_rate_exact",
+        "hit_rate_subspace", "oracle_rmse_gap", "upload_over_encoded",
+    ),
     "retrieval_qps": (
         "value", "retrieval_p99_ms", "retrieval_vs_naive_speedup",
         "workers", "errors", "retrieval_parity", "catalog_items",
@@ -2541,6 +2545,323 @@ def bench_delta_train(device_name):
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+# --- config: implicit-feedback training (round 19) — exact-solver
+# oracle parity, iALS++ blocked-subspace speedup at equal ranking
+# quality, and delta-proportional implicit scatter rounds ---
+
+
+def _zipf_view_buy(rng, n_users, n_items, n_events):
+    """Synthetic zipfian view/buy stream: item popularity ~ 1/(j+1),
+    ~30% buys. Returns deduped (u, i, r) with the per-event-type
+    confidence ratings the e-commerce DataSource assigns (view=1.0,
+    buy=4.0)."""
+    w = 1.0 / (1.0 + np.arange(n_items))
+    w /= w.sum()
+    u = rng.integers(0, n_users, n_events).astype(np.int32)
+    i = rng.choice(n_items, size=n_events, p=w).astype(np.int32)
+    r = np.where(rng.random(n_events) < 0.3, 4.0, 1.0).astype(np.float32)
+    key = u.astype(np.int64) * n_items + i
+    _, first = np.unique(key, return_index=True)
+    return u[first], i[first], r[first]
+
+
+def _implicit_hit_rate(model, u, i, r, n=10):
+    """Mean per-user fraction of observed BUY items (r > 2) in the
+    model's top-n — the ranking-quality gate for the subspace solver."""
+    X = np.asarray(model.user_factors, np.float64)
+    Y = np.asarray(model.item_factors, np.float64)
+    scores = X @ Y.T
+    buys_u, buys_i = u[r > 2], i[r > 2]
+    hits = total = 0
+    for uu in np.unique(buys_u):
+        obs = set(buys_i[buys_u == uu].tolist())
+        top = set(np.argsort(-scores[uu])[:n].tolist())
+        hits += len(obs & top)
+        total += min(len(obs), n)
+    return hits / total
+
+
+def bench_implicit_train(device_name):
+    """Implicit-feedback ALS (round 19): confidence-weighted training
+    on a synthetic zipfian view/buy stream. Three hard gates:
+
+    1. ``solver=exact`` parity with the float64 host oracle
+       (ops/als_reference): factor agreement within float32
+       accumulation tolerance AND preference-RMSE gap < 0.01.
+    2. the iALS++ blocked subspace solver (rank=64, block_size=8)
+       reaches the exact solver's hit-rate@10 (within 0.01) in >= 2x
+       less device solve wall-time — the per-row solve drops from
+       O(k^2) to O(k^2/b + kb) gathered work per sweep.
+    3. an implicit delta round still takes the resident-pack scatter
+       path with ``delta_upload_bytes`` <= 10x the delta rows' encoded
+       size (the wire carries raw ratings; confidences derive
+       on-device, so implicit mode adds zero wire bytes).
+    """
+    import datetime as dt
+
+    from predictionio_tpu.data import storage as storage_mod
+    from predictionio_tpu.data.event import Event
+    from predictionio_tpu.data.storage.base import App
+    from predictionio_tpu.data.store import PEventStore
+    from predictionio_tpu.models.recommendation.engine import RATING_SPEC
+    from predictionio_tpu.ops.als import (
+        ALSConfig,
+        auto_segment_length,
+        rmse,
+        train_als,
+    )
+    from predictionio_tpu.ops.als_reference import (
+        rmse_reference,
+        train_als_reference,
+    )
+    from predictionio_tpu.ops.streaming import (
+        pack_cache_clear,
+        release_resident_packs,
+        set_resident_training,
+        train_als_streaming,
+    )
+    from predictionio_tpu.utils import metrics as _metrics
+    from predictionio_tpu.utils.device_ledger import get_ledger
+
+    rng = np.random.default_rng(5)
+
+    # --- gate 1: exact-solver parity vs the float64 oracle (small
+    # config so the O(n_users * k^3) host oracle stays fast) ---
+    uo, io, ro = _zipf_view_buy(rng, 300, 120, 6_000)
+    oracle_cfg = dict(rank=16, iterations=8, reg=0.05, alpha=2.0)
+    m_exact_small = train_als(
+        uo, io, ro, 300, 120,
+        ALSConfig(
+            implicit_prefs=True, seed=0, sweep_telemetry=False,
+            **oracle_cfg,
+        ),
+    )
+    Xr, Yr = train_als_reference(
+        uo, io, ro, 300, 120, implicit_prefs=True, reg_mode="weighted",
+        seed=0, **oracle_cfg,
+    )
+    factor_gap = max(
+        float(np.max(np.abs(m_exact_small.user_factors - Xr))),
+        float(np.max(np.abs(m_exact_small.item_factors - Yr))),
+    )
+    assert factor_gap < 5e-3, (
+        f"implicit exact solver drifted {factor_gap} from the float64 "
+        "oracle — not the same math"
+    )
+    ones = np.ones_like(ro)
+    rmse_gap = abs(
+        rmse(m_exact_small, uo, io, ones)
+        - rmse_reference(Xr, Yr, uo, io, ones)
+    )
+    assert rmse_gap < 0.01, rmse_gap
+
+    # --- gate 2: subspace speedup at equal ranking quality ---
+    n_users = int(os.environ.get("BENCH_IMPLICIT_USERS", 4_000))
+    n_items = int(os.environ.get("BENCH_IMPLICIT_ITEMS", 800))
+    n_events = int(os.environ.get("BENCH_IMPLICIT_EVENTS", 120_000))
+    sweeps = int(os.environ.get("BENCH_IMPLICIT_SWEEPS", 8))
+    u, i, r = _zipf_view_buy(rng, n_users, n_items, n_events)
+    base = dict(
+        rank=64, iterations=sweeps, reg=0.05, alpha=2.0,
+        implicit_prefs=True, seed=0,
+    )
+    cfg_exact = ALSConfig(**base)
+    cfg_sub = ALSConfig(solver="subspace", block_size=8, **base)
+    results = {}
+    for label, cfg in (("exact", cfg_exact), ("subspace", cfg_sub)):
+        t_cold = {}
+        train_als(u, i, r, n_users, n_items, cfg, timings=t_cold)
+        t_warm = {}  # measured pass: executables already compiled
+        model = train_als(u, i, r, n_users, n_items, cfg, timings=t_warm)
+        results[label] = {
+            "loop_s": t_warm["device_loop_s"],
+            "hit_rate": _implicit_hit_rate(model, u, i, r),
+            "timings": t_warm,
+        }
+    exact_loop_s = results["exact"]["loop_s"]
+    sub_loop_s = results["subspace"]["loop_s"]
+    hr_exact = results["exact"]["hit_rate"]
+    hr_sub = results["subspace"]["hit_rate"]
+    solve_speedup = exact_loop_s / sub_loop_s
+    assert hr_sub >= hr_exact - 0.01, (
+        f"subspace hit-rate@10 {hr_sub:.4f} below exact "
+        f"{hr_exact:.4f} — not equal ranking quality"
+    )
+    assert solve_speedup >= 2.0, (
+        f"subspace solve wall-time {sub_loop_s:.3f}s vs exact "
+        f"{exact_loop_s:.3f}s — {solve_speedup:.2f}x < the 2x gate"
+    )
+
+    # --- gate 3: implicit delta round stays delta-proportional over
+    # the resident pack ---
+    n_seed = int(os.environ.get("BENCH_IMPLICIT_SEED_EVENTS", 100_000))
+    n_delta = int(os.environ.get("BENCH_IMPLICIT_DELTA_EVENTS", 2_000))
+    d_users, d_items = 2_000, 400
+    when = dt.datetime(2026, 1, 1, tzinfo=dt.timezone.utc)
+    cnt_u: dict = {}
+    cnt_i: dict = {}
+
+    def make_view_buy_events(n, t_base):
+        uu = rng.integers(0, d_users, n)
+        ii = rng.integers(0, d_items, n)
+        buy = rng.random(n) < 0.3
+        out = []
+        for j in range(n):
+            un, it = f"u{uu[j]}", f"i{ii[j]}"
+            cnt_u[un] = cnt_u.get(un, 0) + 1
+            cnt_i[it] = cnt_i.get(it, 0) + 1
+            out.append(
+                Event(
+                    event="buy" if buy[j] else "view",
+                    entity_type="user",
+                    entity_id=un,
+                    target_entity_type="item",
+                    target_entity_id=it,
+                    properties={"rating": 4.0 if buy[j] else 1.0},
+                    event_time=when + dt.timedelta(seconds=t_base + j),
+                )
+            )
+        return out
+
+    d_config = ALSConfig(
+        rank=16, iterations=6, reg=0.05, alpha=2.0, implicit_prefs=True,
+        seed=0, solver="subspace", block_size=8,
+    )
+
+    def make_scatterable(n, t_base):
+        """Existing-id deltas clear of segment boundaries (the
+        steady-state live-traffic shape the scatter arm accepts)."""
+        L_u = auto_segment_length(
+            None, len(cnt_u), d_config.segment_length,
+            counts=np.array(sorted(cnt_u.values()), np.int32),
+        )
+        L_i = auto_segment_length(
+            None, len(cnt_i), d_config.segment_length,
+            counts=np.array(sorted(cnt_i.values()), np.int32),
+        )
+        users, items = sorted(cnt_u), sorted(cnt_i)
+        out, ui, ii = [], 0, 0
+        for j in range(n):
+            while cnt_u[users[ui % len(users)]] % L_u == 0:
+                ui += 1
+            while cnt_i[items[ii % len(items)]] % L_i == 0:
+                ii += 1
+            un, it = users[ui % len(users)], items[ii % len(items)]
+            cnt_u[un] += 1
+            cnt_i[it] += 1
+            ui += 1
+            ii += 1
+            buy = j % 3 == 0
+            out.append(
+                Event(
+                    event="buy" if buy else "view",
+                    entity_type="user",
+                    entity_id=un,
+                    target_entity_type="item",
+                    target_entity_id=it,
+                    properties={"rating": 4.0 if buy else 1.0},
+                    event_time=when + dt.timedelta(seconds=t_base + j),
+                )
+            )
+        return out
+
+    storage = storage_mod.memory_storage()
+    storage.get_meta_data_apps().insert(App(id=0, name="impl"))
+    le = storage.get_l_events()
+    le.init(1)
+    le.insert_batch(make_view_buy_events(n_seed, 0), 1)
+    store = PEventStore(storage)
+    scan_kwargs = dict(
+        value_spec=RATING_SPEC,
+        entity_type="user",
+        target_entity_type="item",
+        event_names=["view", "buy"],
+    )
+    pack_cache_clear()
+    prev_resident = set_resident_training(True)
+    try:
+        t_cold = {}
+        train_als_streaming(
+            store.stream_columns("impl", **scan_kwargs), d_config,
+            timings=t_cold,
+        )
+        assert t_cold.get("resident") == "cold", t_cold
+        # warmup scatter round: pays the scatter kernels' compiles
+        le.insert_batch(make_scatterable(n_delta, n_seed + 10), 1)
+        t_s0 = {}
+        train_als_streaming(
+            store.stream_columns("impl", **scan_kwargs), d_config,
+            timings=t_s0, warm_sweeps=2,
+        )
+        assert t_s0.get("resident") == "scatter", t_s0
+        # measured implicit scatter round
+        le.insert_batch(make_scatterable(n_delta, 2 * n_seed), 1)
+        t_delta = {}
+        t0 = time.perf_counter()
+        train_als_streaming(
+            store.stream_columns("impl", **scan_kwargs), d_config,
+            timings=t_delta, warm_sweeps=2,
+        )
+        delta_retrain_s = time.perf_counter() - t0
+        assert t_delta.get("resident") == "scatter", t_delta
+        delta_upload_bytes = int(
+            _metrics.get_registry().gauge(
+                "pio_train_delta_upload_bytes",
+                "Host→device bytes the last streaming train round "
+                "uploaded (resident scatter rounds: delta rows + "
+                "touched regularizer entries only; full rounds: the "
+                "whole wire + factor state)",
+            ).value
+        )
+        delta_encoded_bytes = n_delta * (4 + 2 + 1)
+        assert delta_upload_bytes <= 10 * delta_encoded_bytes, (
+            f"implicit scatter round uploaded {delta_upload_bytes} B "
+            f"for a {delta_encoded_bytes} B delta — not "
+            "delta-proportional"
+        )
+        released = release_resident_packs()
+        assert get_ledger().total_bytes(component="train-pack") == 0
+    finally:
+        set_resident_training(prev_resident)
+        pack_cache_clear()
+
+    # objective trajectory of the measured subspace run (implicit-only
+    # telemetry column, satellite of round 19)
+    objective_curve = [
+        round(row["objective"], 5)
+        for row in results["subspace"]["timings"].get(
+            "sweep_telemetry", []
+        )
+        if "objective" in row
+    ]
+    emit(
+        {
+            "metric": "implicit_train_s",
+            "unit": "s",
+            "value": round(sub_loop_s, 3),
+            "exact_loop_s": round(exact_loop_s, 3),
+            "solve_speedup": round(solve_speedup, 2),
+            "hit_rate_exact": round(hr_exact, 4),
+            "hit_rate_subspace": round(hr_sub, 4),
+            "rank": 64,
+            "block_size": 8,
+            "sweeps": sweeps,
+            "observations": int(len(u)),
+            "oracle_factor_gap": factor_gap,
+            "oracle_rmse_gap": round(rmse_gap, 6),
+            "objective_curve": objective_curve,
+            "delta_retrain_s": round(delta_retrain_s, 3),
+            "delta_upload_bytes": delta_upload_bytes,
+            "delta_encoded_bytes": delta_encoded_bytes,
+            "upload_over_encoded": round(
+                delta_upload_bytes / delta_encoded_bytes, 3
+            ),
+            "resident_packs_released": released,
+            "device": device_name,
+        }
+    )
+
+
 # --- config 12: sharded retrieval serving — parity gate, speedup, and
 # the SO_REUSEPORT multi-worker saturation rig ---
 
@@ -4377,6 +4698,7 @@ BENCHES = {
     "quality": bench_quality,
     "segment_scan": bench_segment_scan,
     "delta_train": bench_delta_train,
+    "implicit_train": bench_implicit_train,
     "serving_saturation": bench_serving_saturation,
     "promotion_under_load": bench_promotion_under_load,
     "cluster_ingest": bench_cluster_ingest,

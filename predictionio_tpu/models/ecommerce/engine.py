@@ -40,7 +40,7 @@ from predictionio_tpu.data.constraints import (
 )
 from predictionio_tpu.data.store import LEventStore, PEventStore
 from predictionio_tpu.ops import retrieval
-from predictionio_tpu.ops.als import ALSConfig, train_als
+from predictionio_tpu.ops.als import ALSConfig, train_als, validate_solver
 from predictionio_tpu.ops.retrieval import ItemRetriever
 from predictionio_tpu.ops.similarity import SimilarityScorer, normalize_rows
 
@@ -120,6 +120,16 @@ class PreparedData:
 class DataSourceParams(Params):
     app_name: str = "default"
     channel_name: Optional[str] = None
+    # event types read as training signal, and the confidence weight
+    # each carries. "rate" events keep their rating property; any other
+    # listed event falls back to its entry here (1.0 when absent) — the
+    # per-event-type confidence feeding implicit ALS (c = alpha*|r|).
+    # Defaults reproduce the reference's rate/buy behavior exactly.
+    event_names: Tuple[str, ...] = ("rate", "buy")
+    event_weights: Tuple[Tuple[str, float], ...] = (
+        ("buy", 4.0),
+        ("view", 1.0),
+    )
 
 
 class DataSource(BaseDataSource):
@@ -140,14 +150,15 @@ class DataSource(BaseDataSource):
                 p.app_name, entity_type="item", channel_name=p.channel_name
             ).items()
         }
+        weights = dict(p.event_weights)
         rates = [
             RateEvent(
                 user=e.entity_id,
                 item=e.target_entity_id,
                 rating=(
-                    4.0
-                    if e.event == "buy"
-                    else float(e.properties.get_or_else("rating", 1.0))
+                    float(e.properties.get_or_else("rating", 1.0))
+                    if e.event == "rate"
+                    else float(weights.get(e.event, 1.0))
                 ),
                 t=e.event_time.timestamp(),
             )
@@ -155,7 +166,7 @@ class DataSource(BaseDataSource):
                 p.app_name,
                 channel_name=p.channel_name,
                 entity_type="user",
-                event_names=["rate", "buy"],
+                event_names=list(p.event_names),
                 target_entity_type="item",
             )
         ]
@@ -200,6 +211,20 @@ class ECommAlgorithmParams(Params):
     precision: str = "float32"
     # stage-1 shortlist width multiplier c (shortlist = pow2(c*n))
     shortlist_mult: int = 4
+    # implicit-feedback training (MLlib ALS.trainImplicit parity): treat
+    # the rating column as a confidence signal c = alpha*|r| on the
+    # preference p = 1(r > 0). The real e-commerce workload — view/buy
+    # events with per-event-type weights from DataSourceParams — is the
+    # intended input.
+    implicit_prefs: bool = False
+    alpha: float = 1.0
+    # "exact" or the iALS++ blocked "subspace" solver (block_size must
+    # divide rank)
+    solver: str = "exact"
+    block_size: int = 0
+
+    def __post_init__(self):
+        validate_solver(self.solver, self.block_size, self.rank)
 
 
 @dataclasses.dataclass
@@ -285,8 +310,10 @@ class ECommModel:
 
 
 class ECommAlgorithm(BaseAlgorithm):
-    """Explicit ALS + predict-time business rules (reference
-    ALSAlgorithm.scala of the train-with-rate-event variant)."""
+    """ALS + predict-time business rules (reference ALSAlgorithm.scala
+    of the train-with-rate-event variant). Explicit by default; set
+    ``implicit_prefs`` to train confidence-weighted on view/buy events
+    (MLlib ALS.trainImplicit semantics)."""
 
     params_class = ECommAlgorithmParams
     query_class = Query
@@ -321,8 +348,11 @@ class ECommAlgorithm(BaseAlgorithm):
                 rank=p.rank,
                 iterations=p.num_iterations,
                 reg=p.lambda_,
-                implicit_prefs=False,
+                implicit_prefs=p.implicit_prefs,
+                alpha=p.alpha,
                 seed=p.seed if p.seed is not None else 0,
+                solver=p.solver,
+                block_size=p.block_size,
             ),
             mesh=ctx.mesh if ctx is not None else None,
         )

@@ -34,6 +34,7 @@ from predictionio_tpu.ops.als import (
     ALSModelArrays,
     ServingFactors,
     train_als,
+    validate_solver,
 )
 from predictionio_tpu.ops.retrieval import ItemRetriever
 
@@ -330,6 +331,14 @@ class ALSAlgorithmParams(Params):
     precision: str = "float32"
     # stage-1 shortlist width multiplier c (shortlist = pow2(c*n))
     shortlist_mult: int = 4
+    # normal-equation solver: "exact" (full rank x rank Cholesky per
+    # row) or "subspace" (iALS++ blocked coordinate descent over
+    # block_size-wide column blocks — block_size must divide rank)
+    solver: str = "exact"
+    block_size: int = 0
+
+    def __post_init__(self):
+        validate_solver(self.solver, self.block_size, self.rank)
 
 
 @dataclasses.dataclass
@@ -457,6 +466,8 @@ class ALSAlgorithm(BaseAlgorithm):
                 return None  # differ beyond the reg axis
             if p.checkpoint_dir is not None:
                 return None  # checkpoint state is per-run, not per-grid
+            if p.solver != "exact":
+                return None  # blocked solver trains per-algo, not vmapped
         td = pd.td
         config = ALSConfig(
             rank=base.rank,
@@ -492,6 +503,8 @@ class ALSAlgorithm(BaseAlgorithm):
             alpha=p.alpha,
             implicit_prefs=p.implicit_prefs,
             seed=p.seed if p.seed is not None else 0,
+            solver=p.solver,
+            block_size=p.block_size,
         )
         mesh = ctx.mesh if ctx is not None else None
         if mesh is not None and mesh.devices.size == 1:

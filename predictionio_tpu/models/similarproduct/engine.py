@@ -35,7 +35,7 @@ from predictionio_tpu.controller.engine import Engine
 from predictionio_tpu.data.bimap import BiMap
 from predictionio_tpu.data.store import PEventStore
 from predictionio_tpu.ops import retrieval
-from predictionio_tpu.ops.als import ALSConfig, train_als
+from predictionio_tpu.ops.als import ALSConfig, train_als, validate_solver
 from predictionio_tpu.ops.retrieval import ItemRetriever
 from predictionio_tpu.ops.similarity import SimilarityScorer, normalize_rows
 
@@ -210,6 +210,16 @@ class ALSAlgorithmParams(Params):
     precision: str = "float32"
     # stage-1 shortlist width multiplier c (shortlist = pow2(c*n))
     shortlist_mult: int = 4
+    # confidence scale for the implicit objective this engine always
+    # trains (c = alpha*|r| on view events, MLlib trainImplicit parity)
+    alpha: float = 1.0
+    # "exact" or the iALS++ blocked "subspace" solver (block_size must
+    # divide rank)
+    solver: str = "exact"
+    block_size: int = 0
+
+    def __post_init__(self):
+        validate_solver(self.solver, self.block_size, self.rank)
 
 
 @dataclasses.dataclass
@@ -454,7 +464,10 @@ class ALSAlgorithm(BaseAlgorithm):
                 iterations=p.num_iterations,
                 reg=p.lambda_,
                 implicit_prefs=True,
+                alpha=p.alpha,
                 seed=p.seed if p.seed is not None else 0,
+                solver=p.solver,
+                block_size=p.block_size,
             ),
             mesh=ctx.mesh if ctx is not None else None,
         )

@@ -103,10 +103,69 @@ class ALSConfig:
     # Cholesky work (bench.py gates the overhead at <2% of sweep time).
     # Off = a separate executable (the flag is a static jit arg).
     sweep_telemetry: bool = True
+    # per-row solver. "exact" solves the full k x k normal equations with
+    # one batched Cholesky per half-sweep; "subspace" runs the iALS++
+    # blocked Gauss-Seidel update (arXiv:2110.14044): one pass over
+    # rank/block_size column blocks per half-sweep, each block a batched
+    # block_size x block_size solve against the residual — the [R, k, k]
+    # system tensor is never materialized, so solve FLOPs and HBM traffic
+    # drop by ~rank/block_size at equal per-sweep quality. Both solvers
+    # target the same normal equations (same fixed point); block_size
+    # must divide rank.
+    solver: str = "exact"
+    block_size: int = 0
 
     def __post_init__(self):
         if self.reg_mode not in ("weighted", "plain"):
             raise ValueError(f"reg_mode must be weighted|plain, got {self.reg_mode}")
+        validate_solver(self.solver, self.block_size, self.rank)
+
+    @property
+    def telemetry_rows_per_sweep(self) -> int:
+        """Telemetry rows the fused loop records per sweep: one for the
+        exact solver, one PER BLOCK for the subspace solver (the
+        per-block convergence curve of satellite telemetry)."""
+        if self.solver == "subspace" and self.block_size:
+            return self.rank // self.block_size
+        return 1
+
+
+def validate_solver(solver: str, block_size: int, rank: int) -> None:
+    """Shared solver-param coherence check: ALSConfig and every engine's
+    algorithm params call this at construction, so an incoherent
+    solver/block_size pair fails at PARAM PARSE time with a clear error
+    instead of surfacing as a shape error inside the jit."""
+    if solver not in ("exact", "subspace"):
+        raise ValueError(
+            f"solver must be 'exact' or 'subspace', got {solver!r}"
+        )
+    if solver == "subspace":
+        if not isinstance(block_size, int) or block_size <= 0:
+            raise ValueError(
+                "solver='subspace' requires block_size > 0 (a divisor of "
+                f"rank={rank}); got block_size={block_size!r}"
+            )
+        if rank % block_size != 0:
+            raise ValueError(
+                f"block_size={block_size} must divide rank={rank} for "
+                "the iALS++ blocked subspace solver (the rank splits "
+                "into rank/block_size equal column blocks)"
+            )
+
+
+def config_train_key(config: "ALSConfig") -> tuple:
+    """The training-semantics identity of a config — everything that
+    changes what the fused loop COMPUTES for fixed data. The resident
+    pack (ops/streaming.py) keys its device-held factor/regularizer
+    state on this: a mismatch on any component (reg sweep, implicit
+    flip, alpha retune, solver or block-size change) demotes the round
+    to the host wire instead of warm-starting from factors trained
+    under different semantics."""
+    return (
+        config.rank, config.reg, config.reg_mode,
+        config.implicit_prefs, config.alpha,
+        config.solver, config.block_size,
+    )
 
 
 @dataclasses.dataclass
@@ -578,6 +637,107 @@ def _solve_side(
     return jnp.where(has_obs[:, None], x.astype(X_prev.dtype), X_prev)
 
 
+def _solve_side_subspace(
+    X_prev: jax.Array,  # [R, k] previous factors (updated in place per block)
+    Y: jax.Array,  # [n_cols(+pad), k] counter-side factors
+    G: jax.Array,  # [k, k] shared Gramian YᵀY (implicit) or zeros
+    pack,  # (seg_rows, cols, vals, rem) pre-shaped [C, Sc(, L)]
+    lam: jax.Array,  # [R] per-row regularizer
+    has_obs: jax.Array,  # [R] bool
+    alpha,
+    *,
+    implicit: bool,
+    compute_dtype: str,
+    block_size: int,
+) -> Tuple[jax.Array, jax.Array]:
+    """One iALS++ block-Gauss-Seidel pass over the side's normal
+    equations (arXiv:2110.14044): for each of the rank/block_size column
+    blocks B, accumulate only the [R, b, b] block system and the [R, b]
+    residual right-hand side ``b_B - (M x)_B`` (M = A + G + lam·I), solve
+    the batched b x b systems, and update the block columns in place —
+    later blocks see earlier blocks' updates (Gauss-Seidel), which is
+    what buys the faster per-sweep convergence the paper measures.
+
+    Versus the exact solver this never materializes the [R, k, k]
+    systems: per slot the einsum work drops from k² to k²/b + k·b
+    (score recompute + block outer products) and the batched solve from
+    k³ to k·b² — ~4x fewer solve-phase FLOPs at rank 64 / block 8, and
+    [R, k, b]-not-[R, k, k] of HBM behind the Cholesky. The (A x)_B
+    residual term reuses the per-slot score d = y·x, so dislikes /
+    confidence weights flow through exactly as in the exact accumulator.
+
+    Returns ``(X_new, block_deltas)`` with ``block_deltas`` the [n_blocks]
+    per-block update RMS — the subspace convergence telemetry. Rows with
+    no observations keep their previous factors (their block deltas are
+    forced to zero before the update lands)."""
+    k = Y.shape[-1]
+    b = block_size
+    n_blocks = k // b
+    seg_rows, cols, vals, rem = pack
+    L = cols.shape[-1]
+    cdt = jnp.dtype(compute_dtype)
+    prec = "highest" if cdt == jnp.float32 else "default"
+    Yc = Y.astype(cdt)
+    iota_l = jnp.arange(L, dtype=jnp.int32)
+    R = X_prev.shape[0]
+    eye_b = jnp.eye(b, dtype=jnp.float32)
+
+    x = X_prev.astype(jnp.float32)
+    deltas = []
+    for bi in range(n_blocks):  # static unroll: block slices stay static
+        s0 = bi * b
+        A0 = jnp.zeros((R, b, b), jnp.float32)
+        r0 = jnp.zeros((R, b), jnp.float32)
+
+        def body(c, carry, s0=s0, x=x):
+            A, rs = carry
+            rows_c = jax.lax.dynamic_index_in_dim(seg_rows, c, keepdims=False)
+            cols_c = jax.lax.dynamic_index_in_dim(cols, c, keepdims=False)
+            vals_c = jax.lax.dynamic_index_in_dim(vals, c, keepdims=False)
+            rem_c = jax.lax.dynamic_index_in_dim(rem, c, keepdims=False)
+            mask_c = (iota_l[None, :] < rem_c[:, None]).astype(jnp.float32)
+            Yg = Yc[cols_c]  # [Sc, L, k]
+            Yb = jax.lax.slice_in_dim(Yg, s0, s0 + b, axis=2)  # [Sc, L, b]
+            xg = x[rows_c].astype(cdt)  # [Sc, k] CURRENT factors
+            # per-slot score d = y·x against the current (partially
+            # updated) factors — the Gauss-Seidel residual ingredient
+            d = jnp.einsum(
+                "slk,sk->sl", Yg, xg,
+                preferred_element_type=jnp.float32, precision=prec,
+            )
+            if implicit:
+                aw = alpha * jnp.abs(vals_c) * mask_c
+                pref = (vals_c > 0).astype(jnp.float32) * mask_c
+                bw = pref * (1.0 + alpha * jnp.abs(vals_c))
+            else:
+                aw = mask_c
+                bw = vals_c * mask_c
+            A_seg = jnp.einsum(
+                "slb,sl,slc->sbc", Yb, aw.astype(cdt), Yb,
+                preferred_element_type=jnp.float32, precision=prec,
+            )
+            # b_B - (A x)_B in one weighted reduction: Σ (bw - aw·d)·y_B
+            r_seg = jnp.einsum(
+                "sl,slb->sb", (bw - aw * d).astype(cdt), Yb,
+                preferred_element_type=jnp.float32, precision=prec,
+            )
+            return A.at[rows_c].add(A_seg), rs.at[rows_c].add(r_seg)
+
+        A, rs = jax.lax.fori_loop(0, seg_rows.shape[0], body, (A0, r0))
+        xB = jax.lax.slice_in_dim(x, s0, s0 + b, axis=1)  # [R, b]
+        if implicit:
+            GB = jax.lax.slice_in_dim(G, s0, s0 + b, axis=0)  # [b, k]
+            A = A + jax.lax.slice_in_dim(GB, s0, s0 + b, axis=1)[None]
+            rs = rs - x @ GB.T  # (G x)_B — G is symmetric
+        A = A + lam[:, None, None] * eye_b
+        rs = rs - lam[:, None] * xB
+        delta = _spd_solve(A, rs)
+        delta = jnp.where(has_obs[:, None], delta, 0.0)
+        x = jax.lax.dynamic_update_slice_in_dim(x, xB + delta, s0, axis=1)
+        deltas.append(jnp.sqrt(jnp.mean(jnp.square(delta))))
+    return x.astype(X_prev.dtype), jnp.stack(deltas)
+
+
 @jax.jit
 def _gramian(Y: jax.Array) -> jax.Array:
     """YᵀY in float32. With Y row-sharded this is a reduce over the data
@@ -587,6 +747,64 @@ def _gramian(Y: jax.Array) -> jax.Array:
         "nk,nj->kj", Yf, Yf,
         preferred_element_type=jnp.float32, precision="highest",
     )
+
+
+def _implicit_objective(
+    X: jax.Array,
+    Y: jax.Array,
+    user_pack,
+    user_lam: jax.Array,
+    item_lam: jax.Array,
+    alpha,
+    *,
+    compute_dtype: str,
+) -> jax.Array:
+    """The Hu-Koren-Volinsky implicit objective at the current factors:
+    ``Σ_all s² + Σ_obs [c·s² − 2(1+c)·p·s + (1+c)·p²] + Σ lam·‖·‖²``
+    (c = α·|r|, p = 1(r>0), s = x·y). The full-matrix term collapses via
+    the Gramian trick — ⟨XᵀX, YᵀY⟩, two k×k matmuls — and the observed
+    correction is one extra gather+score pass over the user-side pack
+    (k·L per slot, ~1/k of a solve sweep's einsum work). Padding rows
+    are zero on both sides, so the Gramians are exact over the padded
+    matrices. The pack is event-level (duplicate (u,i) events are not
+    merged — delta folds depend on that), so each repeat subtracts its
+    cell's s² again while the all-pairs term counts it once: stores
+    with repeated interactions can report negative values. The
+    per-sweep trend is the convergence signal, not the absolute
+    level."""
+    seg_rows, cols, vals, rem = user_pack
+    L = cols.shape[-1]
+    cdt = jnp.dtype(compute_dtype)
+    prec = "highest" if cdt == jnp.float32 else "default"
+    Xc = X.astype(cdt)
+    Yc = Y.astype(cdt)
+    iota_l = jnp.arange(L, dtype=jnp.int32)
+
+    def body(c, acc):
+        rows_c = jax.lax.dynamic_index_in_dim(seg_rows, c, keepdims=False)
+        cols_c = jax.lax.dynamic_index_in_dim(cols, c, keepdims=False)
+        vals_c = jax.lax.dynamic_index_in_dim(vals, c, keepdims=False)
+        rem_c = jax.lax.dynamic_index_in_dim(rem, c, keepdims=False)
+        mask_c = (iota_l[None, :] < rem_c[:, None]).astype(jnp.float32)
+        s = jnp.einsum(
+            "slk,sk->sl", Yc[cols_c], Xc[rows_c],
+            preferred_element_type=jnp.float32, precision=prec,
+        )
+        cw = alpha * jnp.abs(vals_c) * mask_c
+        p = (vals_c > 0).astype(jnp.float32) * mask_c
+        term = cw * s * s - 2.0 * (1.0 + cw) * p * s + (1.0 + cw) * p * p
+        return acc + jnp.sum(term)
+
+    obs = jax.lax.fori_loop(
+        0, seg_rows.shape[0], body, jnp.float32(0.0)
+    )
+    all_sq = jnp.sum(_gramian(X) * _gramian(Y))
+    Xf = X.astype(jnp.float32)
+    Yf = Y.astype(jnp.float32)
+    reg = jnp.sum(user_lam * jnp.sum(Xf * Xf, axis=-1)) + jnp.sum(
+        item_lam * jnp.sum(Yf * Yf, axis=-1)
+    )
+    return all_sq + obs + reg
 
 
 def _constrain(a: jax.Array, sharding) -> jax.Array:
@@ -599,16 +817,20 @@ def _constrain(a: jax.Array, sharding) -> jax.Array:
 
 # per-sweep telemetry rows the fused loop can record before the ring
 # wraps (sweeps past this many stop recording — mode="drop" scatter);
-# each row is [dx_rms, dy_rms, x_rms, y_rms] float32, so the whole
-# buffer is ~1 KB and rides the existing factor fetch
+# each row is [dx_rms, dy_rms, x_rms, y_rms, objective] float32. The
+# subspace solver records ONE ROW PER BLOCK per sweep, so its buffer is
+# allocated at TELEMETRY_SLOTS x rows_per_sweep rows (the block count is
+# a jit static) — the same TELEMETRY_SLOTS sweeps fit either way, and
+# sweeps x blocks rows never silently truncate into the sweep budget.
 TELEMETRY_SLOTS = 64
+TELEMETRY_COLS = 5
 
 
 @functools.partial(
     jax.jit,
     static_argnames=(
         "implicit", "compute_dtype", "rep_sharding", "row_sharding",
-        "telemetry",
+        "telemetry", "solver", "block_size",
     ),
     donate_argnums=(0, 1),
 )
@@ -629,51 +851,84 @@ def _run_iterations(
     rep_sharding,  # NamedSharding(P()) or None — replicate for gathers
     row_sharding,  # NamedSharding(P(axis)) or None
     telemetry: bool = True,
+    solver: str = "exact",
+    block_size: int = 0,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """The whole training loop as ONE XLA program: lax.fori_loop over
     iterations, each half-iteration a chunked gather/einsum accumulation
-    plus one batched solve. One dispatch covers all iterations — no host
-    round trip per half-step, factors never leave HBM, and the
-    replicate/shard handoffs become compiled all-gathers instead of
-    per-step device_puts. The trip count is a runtime value so warm-up,
-    checkpoint chunks, and resumes all reuse the same executable. The
-    regularizer (with reg and, in weighted mode, per-row counts baked in)
-    arrives as data, so sweeping reg reuses the executable too.
+    plus one batched solve (``solver="exact"``) or an iALS++ block
+    Gauss-Seidel pass (``solver="subspace"``, see _solve_side_subspace).
+    One dispatch covers all iterations — no host round trip per
+    half-step, factors never leave HBM, and the replicate/shard handoffs
+    become compiled all-gathers instead of per-step device_puts. The
+    trip count is a runtime value so warm-up, checkpoint chunks, and
+    resumes all reuse the same executable. The regularizer (with reg
+    and, in weighted mode, per-row counts baked in) arrives as data, so
+    sweeping reg reuses the executable too.
 
     With ``telemetry`` (the convergence tentpole), sweep ``i`` also
-    writes [RMS(X_i - X_{i-1}), RMS(Y_i - Y_{i-1}), RMS(X_i), RMS(Y_i)]
-    into row ``i`` of a fixed [TELEMETRY_SLOTS, 4] output — the
-    factor-delta convergence proxy, computed IN the loop (two cheap
-    elementwise reductions per side; on a mesh the sharded mean lowers
-    to a psum) and fetched alongside the factors, never via a host
-    callback inside the jit."""
+    writes [RMS(X_i - X_{i-1}), RMS(Y_i - Y_{i-1}), RMS(X_i), RMS(Y_i),
+    objective] rows into a fixed [TELEMETRY_SLOTS x rows_per_sweep, 5]
+    output — one row per sweep (exact) or per sweep x block (subspace,
+    with per-block update RMS in the delta columns). The objective
+    column carries the Hu-Koren-Volinsky implicit loss via the Gramian
+    trick in implicit mode and 0 otherwise. All of it is computed IN the
+    loop and fetched alongside the factors, never via a host callback
+    inside the jit."""
     k = X.shape[-1]
     zeros_g = jnp.zeros((k, k), jnp.float32)
+    subspace = solver == "subspace"
+    nb = (k // block_size) if (subspace and block_size) else 1
 
     def half(X, Y, pack, lam, has_obs):
         G = _gramian(Y) if implicit else zeros_g
         Y_rep = _constrain(Y, rep_sharding)
-        X = _solve_side(
-            X, Y_rep, G, pack, lam, has_obs, alpha,
-            implicit=implicit, compute_dtype=compute_dtype,
-        )
-        return _constrain(X, row_sharding)
+        if subspace:
+            X, block_d = _solve_side_subspace(
+                X, Y_rep, G, pack, lam, has_obs, alpha,
+                implicit=implicit, compute_dtype=compute_dtype,
+                block_size=block_size,
+            )
+        else:
+            X = _solve_side(
+                X, Y_rep, G, pack, lam, has_obs, alpha,
+                implicit=implicit, compute_dtype=compute_dtype,
+            )
+            block_d = None
+        return _constrain(X, row_sharding), block_d
 
     def _rms(a):
         return jnp.sqrt(jnp.mean(jnp.square(a.astype(jnp.float32))))
 
     def body(i, carry):
         X, Y, tel = carry
-        Xn = half(X, Y, user_pack, user_lam, user_has_obs)
-        Yn = half(Y, Xn, item_pack, item_lam, item_has_obs)
+        Xn, dxb = half(X, Y, user_pack, user_lam, user_has_obs)
+        Yn, dyb = half(Y, Xn, item_pack, item_lam, item_has_obs)
         if telemetry:
-            row = jnp.stack(
-                [_rms(Xn - X), _rms(Yn - Y), _rms(Xn), _rms(Yn)]
+            obj = (
+                _implicit_objective(
+                    Xn, Yn, user_pack, user_lam, item_lam, alpha,
+                    compute_dtype=compute_dtype,
+                )
+                if implicit
+                else jnp.float32(0.0)
             )
-            tel = tel.at[i].set(row, mode="drop")
+            x_rms, y_rms = _rms(Xn), _rms(Yn)
+            if subspace:
+                # one row per block; sweep-level deltas reassemble on
+                # host as sqrt(mean(block_delta²)) — blocks are disjoint
+                # column sets, so the identity is exact
+                for j in range(nb):
+                    row = jnp.stack([dxb[j], dyb[j], x_rms, y_rms, obj])
+                    tel = tel.at[i * nb + j].set(row, mode="drop")
+            else:
+                row = jnp.stack(
+                    [_rms(Xn - X), _rms(Yn - Y), x_rms, y_rms, obj]
+                )
+                tel = tel.at[i].set(row, mode="drop")
         return (Xn, Yn, tel)
 
-    tel0 = jnp.zeros((TELEMETRY_SLOTS, 4), jnp.float32)
+    tel0 = jnp.zeros((TELEMETRY_SLOTS * nb, TELEMETRY_COLS), jnp.float32)
     return jax.lax.fori_loop(0, n_iters, body, (X, Y, tel0))
 
 
@@ -788,6 +1043,12 @@ def train_als_grid(
     the variant axis unsharded, so the whole grid still runs as ONE
     device program with the same collective pattern as train_als.
     """
+    if config.solver != "exact":
+        raise ValueError(
+            "train_als_grid supports solver='exact' only (the vmapped "
+            "grid program has no subspace variant); train subspace "
+            "configs one at a time via train_als"
+        )
     if mesh is not None and mesh.size == 1:
         mesh = None
     k = config.rank
@@ -1190,7 +1451,7 @@ def start_compile_async(
         _padded_rows(n_users, 1), _padded_rows(n_items, 1),
         geo_u.n_chunks, geo_u.sc, L_u, geo_i.n_chunks, geo_i.sc, L_i,
         config.rank, config.implicit_prefs, config.compute_dtype,
-        config.sweep_telemetry,
+        config.sweep_telemetry, config.solver, config.block_size,
     )
     with _WARMED_LOCK:
         warmed = geo_key in _WARMED_GEOMETRIES
@@ -1235,6 +1496,7 @@ def start_compile_async(
                     compute_dtype=config.compute_dtype,
                     rep_sharding=None, row_sharding=None,
                     telemetry=config.sweep_telemetry,
+                    solver=config.solver, block_size=config.block_size,
                 )
                 _fence(out)
             with _WARMED_LOCK:
@@ -1667,14 +1929,17 @@ def _record_compile(outcome: str, busy_s: float = 0.0) -> None:
     ).set(n_warm)
 
 
-def _fetch_telemetry(tel_parts) -> Optional[np.ndarray]:
-    """Concatenate the per-chunk telemetry buffers into one [n_sweeps, 4]
-    host array (rows past TELEMETRY_SLOTS per chunk were dropped by the
-    in-loop scatter). Multi-host-sharded outputs skip telemetry rather
-    than force a cross-process gather."""
+def _fetch_telemetry(tel_parts, rows_per_sweep: int = 1) -> Optional[np.ndarray]:
+    """Concatenate the per-chunk telemetry buffers into one
+    [n_sweeps x rows_per_sweep, TELEMETRY_COLS] host array (rows past
+    the TELEMETRY_SLOTS sweep budget per chunk were dropped by the
+    in-loop scatter; the subspace solver's buffers carry rows_per_sweep
+    block rows per sweep). Multi-host-sharded outputs skip telemetry
+    rather than force a cross-process gather."""
+    rps = max(1, int(rows_per_sweep))
     rows = []
     for tel, n in tel_parts:
-        k = min(int(n), TELEMETRY_SLOTS)
+        k = min(int(n), TELEMETRY_SLOTS) * rps
         if k <= 0:
             continue
         if not getattr(tel, "is_fully_addressable", True):
@@ -1685,16 +1950,35 @@ def _fetch_telemetry(tel_parts) -> Optional[np.ndarray]:
     return np.concatenate(rows, axis=0)
 
 
+def _sweep_aggregate(sweep_rows: np.ndarray, rows_per_sweep: int) -> np.ndarray:
+    """Collapse per-block telemetry rows to one row per sweep: the delta
+    columns combine as sqrt(mean(block_rms²)) — exact, since blocks are
+    disjoint column sets of equal width — and the per-sweep columns
+    (factor RMS, objective) come from the sweep's last block row."""
+    rps = max(1, int(rows_per_sweep))
+    if rps == 1:
+        return sweep_rows
+    per = sweep_rows.reshape(-1, rps, sweep_rows.shape[-1])
+    out = per[:, -1, :].copy()
+    out[:, 0] = np.sqrt(np.mean(np.square(per[:, :, 0]), axis=1))
+    out[:, 1] = np.sqrt(np.mean(np.square(per[:, :, 1]), axis=1))
+    return out
+
+
 def _record_sweep_telemetry(
     sweep_rows: np.ndarray,
     device_loop_s: Optional[float],
     n_executed: Optional[int] = None,
+    rows_per_sweep: int = 1,
+    implicit: bool = False,
 ) -> None:
     reg = _metrics.get_registry()
-    # the telemetry buffer caps at TELEMETRY_SLOTS rows per fused-loop
+    rps = max(1, int(rows_per_sweep))
+    per_sweep = _sweep_aggregate(sweep_rows, rps)
+    # the telemetry buffer caps at TELEMETRY_SLOTS sweeps per fused-loop
     # call; the sweep counter (and the per-sweep time gauge) must count
     # EXECUTED sweeps, not fetched rows, or a >64-sweep round undercounts
-    n = len(sweep_rows)
+    n = len(per_sweep)
     executed = n if n_executed is None else int(n_executed)
     reg.counter(
         "pio_train_sweeps_total", "ALS sweeps executed by the fused loop"
@@ -1712,12 +1996,35 @@ def _record_sweep_telemetry(
     )
     for side, col in (("user", 0), ("item", 1)):
         child = h.labels(side=side)
-        for v in sweep_rows[:, col]:
+        for v in per_sweep[:, col]:
             if np.isfinite(v):
                 child.observe(float(v))
-        last = float(sweep_rows[-1, col])
+        last = float(per_sweep[-1, col])
         if np.isfinite(last):
             g_last.labels(side=side).set(last)
+    if rps > 1:
+        # per-block convergence curve of the subspace solver: every
+        # block row's update RMS, by side (docs/OBSERVABILITY.md)
+        hb = reg.histogram(
+            "pio_train_block_factor_delta",
+            "Per-block subspace-update RMS of the iALS++ solver, by side",
+            labels=("side",),
+            buckets=_metrics.CONVERGENCE_BUCKETS,
+        )
+        for side, col in (("user", 0), ("item", 1)):
+            child = hb.labels(side=side)
+            for v in sweep_rows[:, col]:
+                if np.isfinite(v):
+                    child.observe(float(v))
+    if implicit:
+        obj = float(per_sweep[-1, 4])
+        if np.isfinite(obj):
+            reg.gauge(
+                "pio_train_objective",
+                "Implicit (Hu-Koren-Volinsky) training objective at the "
+                "latest round's final sweep, Gramian-trick full-matrix "
+                "term included",
+            ).set(obj)
     if device_loop_s is not None and executed:
         reg.histogram(
             "pio_train_device_loop_seconds",
@@ -1788,6 +2095,7 @@ def _train_packed(
             rep_sharding=rep_sharding,
             row_sharding=row_sharding,
             telemetry=config.sweep_telemetry,
+            solver=config.solver, block_size=config.block_size,
         )
 
     if compile_wait is not None:
@@ -1949,22 +2257,46 @@ def _train_packed(
             X_host, Y_host = np.asarray(X_host), np.asarray(Y_host)
         else:
             X_host, Y_host = _fetch_global(X), _fetch_global(Y)
-        sweep_rows = _fetch_telemetry(tel_parts) if config.sweep_telemetry else None
+        rows_per_sweep = config.telemetry_rows_per_sweep
+        sweep_rows = (
+            _fetch_telemetry(tel_parts, rows_per_sweep)
+            if config.sweep_telemetry
+            else None
+        )
     _ledger_handle.close()
     if sweep_rows is not None and len(sweep_rows):
         _record_sweep_telemetry(
             sweep_rows,
             None if timings is None else timings.get("device_loop_s"),
             n_executed=sum(n for _, n in tel_parts),
+            rows_per_sweep=rows_per_sweep,
+            implicit=config.implicit_prefs,
         )
         if timings is not None:
+            per_sweep = _sweep_aggregate(sweep_rows, rows_per_sweep)
             timings["sweep_telemetry"] = [
                 {
                     "dx": float(r[0]), "dy": float(r[1]),
                     "x_rms": float(r[2]), "y_rms": float(r[3]),
+                    # objective only carries meaning in implicit mode;
+                    # explicit rounds keep the historical 4-key rows
+                    **(
+                        {"objective": float(r[4])}
+                        if config.implicit_prefs
+                        else {}
+                    ),
                 }
-                for r in sweep_rows
+                for r in per_sweep
             ]
+            if rows_per_sweep > 1:
+                timings["block_telemetry"] = [
+                    {
+                        "sweep": ri // rows_per_sweep,
+                        "block": ri % rows_per_sweep,
+                        "dx": float(r[0]), "dy": float(r[1]),
+                    }
+                    for ri, r in enumerate(sweep_rows)
+                ]
     # OWN the returned factors: on the CPU backend device_get is
     # zero-copy (owndata=False views over XLA-owned buffers). A model —
     # or the delta fold's warm-start seed — outlives the jax.Arrays it

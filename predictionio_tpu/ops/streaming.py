@@ -358,7 +358,7 @@ class ResidentPack:
     n: int  # real (unpadded) observation count
     v_lo: int  # min/max of the REAL int8 value codes (nibble recompute)
     v_hi: int
-    config_key: tuple  # (rank, reg, reg_mode) the factor state matches
+    config_key: tuple  # _als.config_train_key(...) the factor state matches
     ledger: object = None  # train-pack LedgerHandle
     valid: bool = True
 
@@ -530,7 +530,7 @@ def _establish_resident(
         plane_len=int(i_dev.shape[0]),
         n=int(wire.counts_u.sum()),
         v_lo=v_lo, v_hi=v_hi,
-        config_key=(config.rank, config.reg, config.reg_mode),
+        config_key=_als.config_train_key(config),
     )
     pack = entry.resident
     label, nbytes, members = _ledger.device_footprint(
@@ -1048,12 +1048,16 @@ def _fold_delta_resident(
     sorted-name relabel would reshuffle old rows), a value outside the
     pack's int8 half-step tier, a changed auto segment length, a row
     crossing a segment boundary or the segment grid re-bucketing
-    (seg_rows/chunk mismatch), an item-id plane dtype flip, and a
-    device change (caught by ``_resident_usable`` upstream)."""
+    (seg_rows/chunk mismatch), an item-id plane dtype flip, a
+    training-semantics change (any ``config_train_key`` component:
+    rank/reg/reg_mode, an implicit flip, an alpha retune, a solver or
+    block-size change — the parked factors were trained under different
+    semantics and must not warm-start the new ones), and a device
+    change (caught by ``_resident_usable`` upstream)."""
     pack = entry.resident
     if not _resident_usable(pack) or pack.X is None or pack.Y is None:
         return None
-    if pack.config_key != (config.rank, config.reg, config.reg_mode):
+    if pack.config_key != _als.config_train_key(config):
         return None
     old = entry.wire
     names_arr = scanned["names"]
@@ -1403,6 +1407,11 @@ def _attribute_phases(timer, timings: dict) -> None:
             "final_factor_delta",
             f"user={tel[-1]['dx']:.2e} item={tel[-1]['dy']:.2e}",
         )
+        # implicit mode only: the HKV objective at the final sweep
+        # (ops/als.py telemetry) — the training-loss headline the
+        # continuous round line and RoundReport surface
+        if "objective" in tel[-1]:
+            note("objective", f"{tel[-1]['objective']:.6g}")
 
 
 def train_als_streaming(
@@ -1708,9 +1717,7 @@ def train_als_streaming(
                 resident_pack.item_lam = factor_state[3]
                 resident_pack.user_obs = factor_state[4]
                 resident_pack.item_obs = factor_state[5]
-                resident_pack.config_key = (
-                    config.rank, config.reg, config.reg_mode
-                )
+                resident_pack.config_key = _als.config_train_key(config)
                 if (
                     resident_pack.ledger is not None
                     and not resident_pack.ledger.closed

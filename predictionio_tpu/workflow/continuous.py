@@ -89,6 +89,11 @@ class RoundReport:
     # sweep count and the final sweep's factor-delta RMS per side
     sweeps: Optional[int] = None
     final_factor_delta: Optional[str] = None
+    # implicit-feedback training objective (Hu-Koren-Volinsky loss via
+    # the Gramian trick) at the round's final sweep — the loss headline
+    # trended alongside hit-rate by the quality/promotion tier. None in
+    # explicit mode or when telemetry is off.
+    objective: Optional[str] = None
     # device-resident pack outcome for this round (ops/streaming.py):
     # "scatter" when the delta was scattered onto the resident HBM
     # pack, "fallback" when a resident pack had to be demoted to the
@@ -339,6 +344,7 @@ def _continuous_loop(
                 timer_summary=ctx.timer.summary(),
                 sweeps=notes.get("sweeps"),
                 final_factor_delta=notes.get("final_factor_delta"),
+                objective=notes.get("objective"),
                 resident=notes.get("resident"),
             )
             if shadow_queries > 0 and live_instance_id and instance_id:
@@ -360,7 +366,7 @@ def _continuous_loop(
             elif instance_id:
                 live_instance_id = instance_id
             logger.info(
-                "continuous round %d: %s in %.3fs (%s%s%s)",
+                "continuous round %d: %s in %.3fs (%s%s%s%s)",
                 report.round, instance_id, report.wall_s,
                 report.pack_cache or "n/a",
                 (
@@ -372,6 +378,11 @@ def _continuous_loop(
                     f", {report.sweeps} sweeps, final delta "
                     f"{report.final_factor_delta}"
                     if report.sweeps is not None
+                    else ""
+                ),
+                (
+                    f", objective {report.objective}"
+                    if report.objective is not None
                     else ""
                 ),
             )

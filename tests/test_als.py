@@ -459,3 +459,108 @@ class TestGridALS:
                 grid[v].item_factors, single.item_factors,
                 rtol=2e-4, atol=2e-5,
             )
+
+class TestSubspaceSolver:
+    """iALS++ blocked subspace solver (solver="subspace"): full-rank-block
+    equivalence to the exact solver, convergence in explicit and implicit
+    mode, mesh parity, and config validation."""
+
+    def test_full_rank_block_matches_exact(self):
+        """With block_size == rank the residual-form block solve collapses
+        to x_new = A^-1 b — the exact normal-equation update — so factors
+        must agree with solver="exact" to float tolerance, explicit and
+        implicit."""
+        import dataclasses
+
+        u, i, r = synthetic(noise=0.1)
+        for implicit in (False, True):
+            cfg = ALSConfig(
+                rank=4, iterations=3, reg=0.05, implicit_prefs=implicit,
+                solver="subspace", block_size=4,
+            )
+            sub = train_als(u, i, r, 60, 40, cfg)
+            exact = train_als(
+                u, i, r, 60, 40,
+                dataclasses.replace(cfg, solver="exact", block_size=0),
+            )
+            np.testing.assert_allclose(
+                sub.user_factors, exact.user_factors, rtol=2e-4, atol=2e-5
+            )
+            np.testing.assert_allclose(
+                sub.item_factors, exact.item_factors, rtol=2e-4, atol=2e-5
+            )
+
+    def test_subspace_explicit_converges(self):
+        u, i, r = synthetic(n_users=80, n_items=50, k=4, density=0.5)
+        cfg = ALSConfig(
+            rank=8, iterations=16, reg=0.01, solver="subspace", block_size=2
+        )
+        model = train_als(u, i, r, 80, 50, cfg)
+        assert rmse(model, u, i, r) < 0.1
+
+    def test_subspace_implicit_fits_preferences(self):
+        rng = np.random.default_rng(3)
+        n_users, n_items = 50, 30
+        u_list, i_list, c_list = [], [], []
+        for uu in range(n_users):
+            group = uu % 2
+            items = rng.choice(
+                np.arange(group * 15, group * 15 + 15), size=8, replace=False
+            )
+            for it in items:
+                u_list.append(uu)
+                i_list.append(it)
+                c_list.append(rng.integers(1, 5))
+        u = np.array(u_list, np.int32)
+        i = np.array(i_list, np.int32)
+        r = np.array(c_list, np.float32)
+        cfg = ALSConfig(
+            rank=8, iterations=12, reg=0.01, alpha=2.0, implicit_prefs=True,
+            solver="subspace", block_size=2,
+        )
+        model = train_als(u, i, r, n_users, n_items, cfg)
+        pred_obs = predict_ratings(model, u, i).mean()
+        cross_i = (i + 15) % 30
+        pred_cross = predict_ratings(model, u, cross_i).mean()
+        assert pred_obs > 0.5
+        assert pred_obs > pred_cross + 0.3
+
+    def test_subspace_deterministic_given_seed(self):
+        u, i, r = synthetic()
+        cfg = ALSConfig(
+            rank=4, iterations=2, seed=42, solver="subspace", block_size=2
+        )
+        m1 = train_als(u, i, r, 60, 40, cfg)
+        m2 = train_als(u, i, r, 60, 40, cfg)
+        np.testing.assert_array_equal(m1.user_factors, m2.user_factors)
+
+    def test_subspace_mesh_matches_single_device(self):
+        u, i, r = synthetic(n_users=64, n_items=40)
+        cfg = ALSConfig(
+            rank=4, iterations=3, reg=0.05, implicit_prefs=True,
+            solver="subspace", block_size=2,
+        )
+        single = train_als(u, i, r, 64, 40, cfg)
+        sharded = train_als(u, i, r, 64, 40, cfg, mesh=default_mesh("data"))
+        np.testing.assert_allclose(
+            single.user_factors, sharded.user_factors, rtol=1e-4, atol=1e-5
+        )
+        np.testing.assert_allclose(
+            single.item_factors, sharded.item_factors, rtol=1e-4, atol=1e-5
+        )
+
+    def test_config_validation(self):
+        with pytest.raises(ValueError, match="block_size > 0"):
+            ALSConfig(rank=4, solver="subspace")
+        with pytest.raises(ValueError, match="must divide rank"):
+            ALSConfig(rank=4, solver="subspace", block_size=3)
+        with pytest.raises(ValueError, match="'exact' or 'subspace'"):
+            ALSConfig(rank=4, solver="cg")
+
+    def test_grid_rejects_subspace(self):
+        from predictionio_tpu.ops.als import train_als_grid
+
+        u, i, r = synthetic()
+        cfg = ALSConfig(rank=4, iterations=2, solver="subspace", block_size=2)
+        with pytest.raises(ValueError, match="solver='exact'"):
+            train_als_grid(u, i, r, 60, 40, cfg, [0.01, 0.1])

@@ -495,6 +495,52 @@ class TestContinuousLoop:
         )
         assert len(set(ids)) == 2
 
+    def test_implicit_round_reports_objective(self, mem_storage):
+        """Implicit-mode rounds surface the Hu-Koren objective value in
+        the RoundReport (round 19); explicit rounds and skipped rounds
+        report None."""
+        from predictionio_tpu.controller.engine import EngineParams
+        from predictionio_tpu.data.storage.base import EngineInstance
+        from predictionio_tpu.models.recommendation.engine import (
+            ALSAlgorithmParams,
+            DataSourceParams,
+            recommendation_engine,
+        )
+        from predictionio_tpu.workflow.continuous import continuous_train
+
+        _seed_app(mem_storage, n=1_200, name="capp")
+        engine = recommendation_engine()
+        now = dt.datetime.now(dt.timezone.utc)
+        template = EngineInstance(
+            id="", status="", start_time=now, end_time=now,
+            engine_id="e", engine_version="1", engine_variant="v",
+            engine_factory="f",
+        )
+        reports = []
+        for algo_params in (
+            ALSAlgorithmParams(
+                rank=4, num_iterations=4, implicit_prefs=True, alpha=2.0
+            ),
+            ALSAlgorithmParams(rank=4, num_iterations=4),
+        ):
+            params = EngineParams(
+                data_source_params=("", DataSourceParams(app_name="capp")),
+                algorithm_params_list=[("als", algo_params)],
+            )
+            continuous_train(
+                engine, params, template,
+                storage=mem_storage, interval_s=0.01, max_rounds=2,
+                on_round=reports.append,
+            )
+        implicit_trained, implicit_skipped, explicit_trained, _ = reports
+        assert not implicit_trained.skipped
+        obj = float(implicit_trained.objective)  # parseable, finite
+        assert np.isfinite(obj)
+        assert implicit_skipped.skipped
+        assert implicit_skipped.objective is None
+        assert not explicit_trained.skipped
+        assert explicit_trained.objective is None
+
     def test_cli_flags_parse(self):
         from predictionio_tpu.tools.cli import build_parser
 

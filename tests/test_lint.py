@@ -1162,3 +1162,121 @@ def test_shortlist_width_allowlist_is_not_stale():
         f"shortlist-width allowlist entries no longer in the tree: "
         f"{sorted(stale)}"
     )
+
+
+# --- subspace solver param coherence (round 19) ---
+#
+# Any construction of an ALS param/config object with solver="subspace"
+# must pass a block_size that the iALS++ blocked solver can use: a
+# positive integer literal that divides the (statically visible) rank.
+# A violating combination raises at runtime (ops/als.validate_solver),
+# but only on the code path that builds it — this lint moves the check
+# to test time for every in-repo construction, bench configs included
+# (a bench gate that dies an hour in on a bad literal is the expensive
+# version of this assert).
+
+_SUBSPACE_CTOR_NAMES = ("ALSConfig",)
+_SUBSPACE_CTOR_SUFFIX = "AlgorithmParams"
+# default rank of every ALS params class AND ALSConfig (ops/als.py)
+_SUBSPACE_DEFAULT_RANK = 10
+
+# (relative path, line description) pairs excused from the lint.
+SUBSPACE_PARAMS_ALLOWED: set = set()
+
+
+def _subspace_param_violations():
+    import ast
+
+    paths = sorted(PACKAGE.rglob("*.py")) + [PACKAGE.parent / "bench.py"]
+    found = set()
+    for path in paths:
+        try:
+            rel = path.relative_to(PACKAGE).as_posix()
+        except ValueError:
+            rel = path.name
+        tree = ast.parse(
+            path.read_text(encoding="utf-8"), filename=str(path)
+        )
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            name = (
+                fn.attr if isinstance(fn, ast.Attribute)
+                else fn.id if isinstance(fn, ast.Name)
+                else None
+            )
+            if name is None or not (
+                name in _SUBSPACE_CTOR_NAMES
+                or name.endswith(_SUBSPACE_CTOR_SUFFIX)
+            ):
+                continue
+            kw = {
+                k.arg: k.value for k in node.keywords if k.arg is not None
+            }
+            solver = kw.get("solver")
+            if not (
+                isinstance(solver, ast.Constant)
+                and solver.value == "subspace"
+            ):
+                continue
+            where = f"{rel}:{node.lineno}"
+            bs = kw.get("block_size")
+            if bs is None:
+                found.add((where, "solver='subspace' without block_size"))
+                continue
+            if not (
+                isinstance(bs, ast.Constant)
+                and isinstance(bs.value, int)
+                and not isinstance(bs.value, bool)
+            ):
+                found.add(
+                    (where, "block_size must be an int literal here")
+                )
+                continue
+            if bs.value <= 0:
+                found.add((where, f"block_size={bs.value} <= 0"))
+                continue
+            rank = kw.get("rank")
+            if rank is None and any(
+                k.arg is None for k in node.keywords
+            ):
+                continue  # rank travels in **kwargs: runtime-checked
+            rank_val = (
+                rank.value
+                if isinstance(rank, ast.Constant)
+                and isinstance(rank.value, int)
+                else _SUBSPACE_DEFAULT_RANK if rank is None
+                else None
+            )
+            if rank_val is None:
+                continue  # dynamic rank: runtime-checked
+            if rank_val % bs.value != 0:
+                found.add(
+                    (
+                        where,
+                        f"block_size={bs.value} does not divide "
+                        f"rank={rank_val}",
+                    )
+                )
+    return found
+
+
+def test_subspace_block_size_divides_rank():
+    found = _subspace_param_violations()
+    new = found - SUBSPACE_PARAMS_ALLOWED
+    assert not new, (
+        "solver='subspace' construction whose block_size cannot drive "
+        "the iALS++ blocked solver (ops/als.validate_solver would "
+        "raise at runtime); fix the literal or justify a "
+        f"SUBSPACE_PARAMS_ALLOWED entry: {sorted(new)}"
+    )
+
+
+def test_subspace_params_allowlist_is_not_stale():
+    found = _subspace_param_violations()
+    stale = SUBSPACE_PARAMS_ALLOWED - found
+    assert not stale, (
+        f"subspace-params allowlist entries no longer in the tree: "
+        f"{sorted(stale)}"
+    )

@@ -772,3 +772,85 @@ class TestSweepTelemetry:
         )
         # chunks of 2+2+1 sweeps still yield one 5-row curve
         assert len(timings["sweep_telemetry"]) == 5
+
+
+class TestBlockAndObjectiveTelemetry:
+    """Round-19 telemetry: per-block subspace deltas (iALS++ solver) and
+    the implicit training objective, threaded through the widened
+    [sweeps * blocks, 5] device buffer."""
+
+    def _train(self, iterations=4, **config_kwargs):
+        import numpy as np
+
+        from predictionio_tpu.ops.als import ALSConfig, train_als
+
+        rng = np.random.default_rng(9)
+        n = 1500
+        u = rng.integers(0, 120, n)
+        i = rng.integers(0, 40, n)
+        r = (rng.integers(1, 11, n) / 2.0).astype(np.float32)
+        timings = {}
+        model = train_als(
+            u, i, r, 120, 40,
+            ALSConfig(rank=4, iterations=iterations, **config_kwargs),
+            timings=timings,
+        )
+        return model, timings
+
+    def test_subspace_emits_per_block_rows(self):
+        _, timings = self._train(
+            iterations=3, solver="subspace", block_size=2
+        )
+        # sweep-level curve keeps one row per sweep (aggregated)
+        assert len(timings["sweep_telemetry"]) == 3
+        blocks = timings["block_telemetry"]
+        assert len(blocks) == 3 * 2  # sweeps x (rank // block_size)
+        for row in blocks:
+            assert set(row) == {"sweep", "block", "dx", "dy"}
+            assert row["dx"] >= 0 and row["dy"] >= 0
+        assert [(b["sweep"], b["block"]) for b in blocks] == [
+            (s, j) for s in range(3) for j in range(2)
+        ]
+
+    def test_block_rows_do_not_truncate_at_many_sweeps(self):
+        # 20 sweeps x 2 blocks = 40 device rows: the widened buffer must
+        # hold every one (TELEMETRY_SLOTS scales by rows-per-sweep)
+        _, timings = self._train(
+            iterations=20, solver="subspace", block_size=2
+        )
+        assert len(timings["sweep_telemetry"]) == 20
+        assert len(timings["block_telemetry"]) == 40
+
+    def test_exact_mode_has_no_block_rows(self):
+        _, timings = self._train(iterations=3)
+        assert "block_telemetry" not in timings
+
+    def test_implicit_objective_in_sweep_rows_and_gauge(self):
+        _, timings = self._train(iterations=5, implicit_prefs=True, alpha=2.0)
+        tel = timings["sweep_telemetry"]
+        assert len(tel) == 5
+        for row in tel:
+            assert set(row) == {"dx", "dy", "x_rms", "y_rms", "objective"}
+        # ALS monotonically decreases the implicit objective per sweep
+        objs = [row["objective"] for row in tel]
+        assert objs[-1] <= objs[0]
+        reg = m.get_registry()
+        gauge = reg.gauge(
+            "pio_train_objective",
+            "Implicit (Hu-Koren-Volinsky) training objective at the "
+            "latest round's final sweep, Gramian-trick full-matrix term "
+            "included",
+        )
+        assert gauge.value == pytest.approx(objs[-1], rel=1e-6)
+
+    def test_explicit_rows_have_no_objective_key(self):
+        # the historical 4-key contract holds outside implicit mode
+        _, timings = self._train(iterations=2)
+        for row in timings["sweep_telemetry"]:
+            assert set(row) == {"dx", "dy", "x_rms", "y_rms"}
+
+    def test_block_delta_histogram_registered(self):
+        self._train(iterations=3, solver="subspace", block_size=2)
+        text = m.get_registry().render()
+        assert "pio_train_block_factor_delta_bucket" in text
+        assert 'side="user"' in text

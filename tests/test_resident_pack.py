@@ -586,3 +586,109 @@ class TestContinuousResident:
         assert rounds == 3
         assert [r.skipped for r in reports] == [False, True, True]
         assert _train_pack_bytes() == 0
+
+# round 19: implicit-feedback training over the resident pack. The wire
+# is confidence-agnostic (raw ratings travel; c = alpha*|r| derives on
+# device), so implicit delta rounds must scatter exactly like explicit
+# ones — and every implicit-param change is a config_train_key mismatch
+# that demotes to the host wire.
+ICONFIG = ALSConfig(
+    rank=6, iterations=6, reg=0.05, implicit_prefs=True, alpha=2.0
+)
+
+
+class TestImplicitResidentPack:
+    """The PR 17 fallback matrix rerun in implicit mode: on a delta
+    round, alpha retune, implicit flip, solver flip, and block-size
+    change each demote to the host fold (train-pack ledger zero, leak
+    counter unmoved) — the parked factor state only warm-starts under an
+    identical config_train_key. Same-config implicit delta rounds keep
+    the O(delta) scatter path. (A *hit* round with no delta may scatter
+    under any config: the data planes are config-independent and the
+    factor state rebuilds fresh.)"""
+
+    def _seed_implicit(self, config, n=4_000, name="rapp"):
+        seed_events = _events(n, 0, seed=1)
+        cu, ci = _counts(seed_events)
+        storage = storage_mod.memory_storage()
+        app_id, le = _seed(storage, name, seed_events)
+        store = PEventStore(storage)
+        res, t = _train(store, name, config=config)
+        assert t["pack_cache"] == "miss"
+        assert t["resident"] == "cold"
+        assert _train_pack_bytes() > 0
+        return store, le, app_id, cu, ci, t
+
+    def _assert_fell_back(self, store, t, leaks0, config):
+        assert t["resident"] == "fallback", t
+        assert _train_pack_bytes() == 0
+        entry = _entry()
+        assert not entry.wire.stripped and entry.resident is None
+        assert _wire_bytes(entry.wire) == _wire_bytes(
+            _cold_wire(store, "rapp", config=config)
+        )
+        assert _leaks() == leaks0
+
+    def test_implicit_delta_round_scatters(self, resident_on):
+        leaks0 = _leaks()
+        store, le, app_id, cu, ci, t0 = self._seed_implicit(ICONFIG)
+        delta = _scatterable_delta(150, 100_000, cu, ci, config=ICONFIG)
+        le.insert_batch(delta, app_id)
+        res, t = _train(store, "rapp", config=ICONFIG)
+        assert t["pack_cache"] == "fold"
+        assert t["resident"] == "scatter", t
+        assert t["delta_upload_bytes"] < t0["delta_upload_bytes"] / 4
+        assert np.isfinite(np.asarray(res.arrays.user_factors)).all()
+        assert _train_pack_bytes() > 0 and _leaks() == leaks0
+
+    def test_subspace_delta_round_scatters(self, resident_on):
+        cfg = dataclasses.replace(ICONFIG, solver="subspace", block_size=2)
+        store, le, app_id, cu, ci, t0 = self._seed_implicit(cfg)
+        delta = _scatterable_delta(150, 100_000, cu, ci, config=cfg)
+        le.insert_batch(delta, app_id)
+        res, t = _train(store, "rapp", config=cfg)
+        assert t["resident"] == "scatter", t
+        assert t["delta_upload_bytes"] < t0["delta_upload_bytes"] / 4
+
+    def test_alpha_change_falls_back(self, resident_on):
+        store, le, app_id, cu, ci, _ = self._seed_implicit(ICONFIG)
+        leaks0 = _leaks()
+        delta = _scatterable_delta(100, 100_000, cu, ci, config=ICONFIG)
+        le.insert_batch(delta, app_id)
+        retuned = dataclasses.replace(ICONFIG, alpha=3.0)
+        res, t = _train(store, "rapp", config=retuned)
+        assert t["pack_cache"] == "fold"
+        self._assert_fell_back(store, t, leaks0, retuned)
+
+    def test_implicit_flip_falls_back(self, resident_on):
+        store, le, app_id, cu, ci, _ = self._seed_implicit(ICONFIG)
+        leaks0 = _leaks()
+        delta = _scatterable_delta(100, 100_000, cu, ci, config=ICONFIG)
+        le.insert_batch(delta, app_id)
+        explicit = dataclasses.replace(ICONFIG, implicit_prefs=False)
+        res, t = _train(store, "rapp", config=explicit)
+        assert t["pack_cache"] == "fold"
+        self._assert_fell_back(store, t, leaks0, explicit)
+
+    def test_solver_flip_falls_back(self, resident_on):
+        store, le, app_id, cu, ci, _ = self._seed_implicit(ICONFIG)
+        leaks0 = _leaks()
+        delta = _scatterable_delta(100, 100_000, cu, ci, config=ICONFIG)
+        le.insert_batch(delta, app_id)
+        flipped = dataclasses.replace(
+            ICONFIG, solver="subspace", block_size=3
+        )
+        res, t = _train(store, "rapp", config=flipped)
+        assert t["pack_cache"] == "fold"
+        self._assert_fell_back(store, t, leaks0, flipped)
+
+    def test_block_size_change_falls_back(self, resident_on):
+        cfg = dataclasses.replace(ICONFIG, solver="subspace", block_size=2)
+        store, le, app_id, cu, ci, _ = self._seed_implicit(cfg)
+        leaks0 = _leaks()
+        delta = _scatterable_delta(100, 100_000, cu, ci, config=cfg)
+        le.insert_batch(delta, app_id)
+        rebl = dataclasses.replace(cfg, block_size=3)
+        res, t = _train(store, "rapp", config=rebl)
+        assert t["pack_cache"] == "fold"
+        self._assert_fell_back(store, t, leaks0, rebl)

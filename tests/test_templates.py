@@ -365,6 +365,80 @@ class TestECommerceTemplate:
                 rtol=1e-5,
             )
 
+    def test_implicit_view_buy_training(self, mem_storage):
+        """Round 19: the real e-commerce workload — view/buy events with
+        per-event-type confidence weights — trained through implicit
+        ALS with the blocked subspace solver. Group-0 users view/buy
+        only electronics; their recommendations must come from there."""
+        from predictionio_tpu.models.ecommerce.engine import (
+            DataSource,
+            DataSourceParams,
+            ECommAlgorithm,
+            ECommAlgorithmParams,
+            Preparator,
+            Query,
+        )
+
+        app_id = make_app(mem_storage, "vbapp")
+        for i in range(6):
+            cats = ["electronics"] if i < 3 else ["books"]
+            put(mem_storage, app_id, "$set", "item", f"i{i}",
+                props={"categories": cats})
+        rng = np.random.default_rng(7)
+        t0 = dt.datetime(2026, 7, 1, tzinfo=dt.timezone.utc)
+        for uid in range(20):
+            put(mem_storage, app_id, "$set", "user", f"u{uid}", props={})
+            pref = 0 if uid % 2 == 0 else 3
+            for k in range(5):
+                item = pref + int(rng.integers(0, 3))
+                put(
+                    mem_storage, app_id,
+                    "buy" if k == 0 else "view",
+                    "user", f"u{uid}", target=f"i{item}",
+                    t=t0 + dt.timedelta(minutes=k),
+                )
+        ctx = WorkflowContext(mode="training", storage=mem_storage)
+        ds_params = DataSourceParams(
+            app_name="vbapp", event_names=("view", "buy"),
+            event_weights=(("buy", 4.0), ("view", 1.0)),
+        )
+        td = DataSource(ds_params).read_training(ctx)
+        # per-event-type confidence reached the rating column
+        assert {r.rating for r in td.rate_events} == {1.0, 4.0}
+        pd = Preparator().prepare(ctx, td)
+        algo = ECommAlgorithm(
+            ECommAlgorithmParams(
+                app_name="vbapp", rank=8, num_iterations=10, seed=4,
+                implicit_prefs=True, alpha=2.0,
+                solver="subspace", block_size=2,
+            )
+        )
+        model = algo.train(ctx, pd)
+        result = algo.predict(model, Query(user="u0", num=2))
+        assert len(result.item_scores) == 2
+        assert all(
+            s.item in ("i0", "i1", "i2") for s in result.item_scores
+        ), result.item_scores
+
+    def test_subspace_params_validated_at_parse_time(self):
+        from predictionio_tpu.models.ecommerce.engine import (
+            ECommAlgorithmParams,
+        )
+        from predictionio_tpu.models.recommendation.engine import (
+            ALSAlgorithmParams as RecParams,
+        )
+        from predictionio_tpu.models.similarproduct.engine import (
+            ALSAlgorithmParams as SPParams,
+        )
+
+        for cls in (ECommAlgorithmParams, RecParams, SPParams):
+            with pytest.raises(ValueError, match="block_size > 0"):
+                cls(rank=8, solver="subspace")
+            with pytest.raises(ValueError, match="must divide rank"):
+                cls(rank=8, solver="subspace", block_size=3)
+            with pytest.raises(ValueError, match="'exact' or 'subspace'"):
+                cls(rank=8, solver="cg")
+
 
 class TestCosineSumPadding:
     def test_padding_preserves_scores_and_buckets_compiles(self):
