@@ -218,6 +218,11 @@ _SUMMARY_FIELDS = {
         "value", "p99_baseline_ms", "swap_window_s", "qps_under_load",
         "errors", "shadow_refusal_enforced", "rollback_on_regression",
     ),
+    "experiment_plane": (
+        "value", "winner_promoted", "aa_no_winner",
+        "cross_variant_reassignments", "errors", "loser_ledger_zero",
+        "attribution_overhead_frac",
+    ),
     "cluster_ingest": (
         "value", "events_per_sec_1node", "scaling_4_over_1", "cores",
         "acked_events_lost", "wire_identical_node_down",
@@ -4105,6 +4110,344 @@ def bench_promotion_under_load(device_name):
         storage_mod.set_storage(None)
 
 
+def bench_experiment(device_name):
+    """The round-20 acceptance rig: the online experimentation plane
+    end to end on one box, under sustained query load.
+
+    Hard gates:
+    - a 2-variant experiment where the LIVE arm is a deliberately
+      degraded truncated-rank retrain loses to the candidate: the
+      sequential (mSPRT) test declares the winner and the winner
+      auto-promotes through the gated promotion pipeline with ZERO
+      dropped/erroring queries across the whole run including the
+      swap window;
+    - allocation is exactly sticky: 0 cross-variant reassignments
+      among all sampled users, and every observed assignment equals
+      the pure allocation function;
+    - an A/A run (two identically trained arms, identical conversion
+      law) over the same horizon declares NO winner, and its losing
+      arm's device state drains back to the pre-experiment ledger
+      level (ledger-zero release);
+    - the ingest-path attribution hook stays within the PR 11 <2%
+      throughput gate.
+    """
+    import datetime as dt
+    import http.client
+    import threading
+    import zlib
+
+    from predictionio_tpu.api.engine_server import (
+        EngineServer,
+        ServerConfig,
+    )
+    from predictionio_tpu.data import storage as storage_mod
+    from predictionio_tpu.data.event import DataMap, Event
+    from predictionio_tpu.data.storage.base import App, EngineInstance
+    from predictionio_tpu.models.ecommerce.engine import ecommerce_engine
+    from predictionio_tpu.utils.device_ledger import get_ledger
+    from predictionio_tpu.workflow import quality as quality_mod
+    from predictionio_tpu.workflow.context import WorkflowContext
+    from predictionio_tpu.workflow.core_workflow import CoreWorkflow
+    from predictionio_tpu.workflow.experiment import (
+        ExperimentRunner,
+        ExperimentSpec,
+        allocate,
+    )
+    from predictionio_tpu.workflow.promotion import (
+        InProcessTarget,
+        PromotionConfig,
+        PromotionPipeline,
+    )
+
+    storage = storage_mod.memory_storage()
+    storage_mod.set_storage(storage)
+    server = None
+    stop_load = threading.Event()
+    try:
+        app_id = storage.get_meta_data_apps().insert(
+            App(id=0, name="default")
+        )
+        events = storage.get_l_events()
+        events.init(app_id)
+        rng = np.random.default_rng(20)
+        n_users, n_items = 200, 600
+        batch_ev = [
+            Event(
+                event="$set", entity_type="item", entity_id=f"i{j}",
+                properties=DataMap({"categories": ["all"]}),
+            )
+            for j in range(n_items)
+        ]
+        for uu in range(n_users):
+            for it in rng.choice(n_items, size=10, replace=False):
+                batch_ev.append(
+                    Event(
+                        event="rate", entity_type="user",
+                        entity_id=f"u{uu}", target_entity_type="item",
+                        target_entity_id=f"i{it}",
+                        properties=DataMap(
+                            {"rating": float(rng.integers(1, 6))}
+                        ),
+                    )
+                )
+        for s in range(0, len(batch_ev), 500):
+            events.insert_batch(batch_ev[s : s + 500], app_id)
+
+        engine = ecommerce_engine()
+
+        def make_params(rank, num_iterations):
+            return engine.jvalue_to_engine_params(
+                {
+                    "datasource": {"params": {"app_name": "default"}},
+                    "algorithms": [
+                        {
+                            "name": "ecomm",
+                            "params": {
+                                "app_name": "default", "rank": rank,
+                                "num_iterations": num_iterations,
+                                "lambda_": 0.05, "seed": 7,
+                            },
+                        }
+                    ],
+                }
+            )
+
+        def train_once(params):
+            now = dt.datetime.now(dt.timezone.utc)
+            iid = CoreWorkflow.run_train(
+                engine, params, EngineInstance(
+                    id="", status="", start_time=now, end_time=now,
+                    engine_id="exp", engine_version="1",
+                    engine_variant="engine.json",
+                    engine_factory=(
+                        "predictionio_tpu.models.ecommerce.engine."
+                        "ECommerceEngineFactory"
+                    ),
+                ),
+                ctx=WorkflowContext(mode="training", storage=storage),
+            )
+            assert iid
+            return iid
+
+        full = make_params(rank=8, num_iterations=4)
+        v_good = train_once(full)
+        # the deliberately degraded arm: truncated rank, single sweep —
+        # trained LAST so a fresh server deploys it as the live control
+        v_deg = train_once(make_params(rank=2, num_iterations=1))
+        server = EngineServer(
+            engine, ServerConfig(port=0, batch_window_ms=1.0),
+            storage=storage,
+        ).start()
+        assert server.api.deployed.engine_instance.id == v_deg
+        port = server.port
+
+        # --- sustained sticky load + deterministic conversion law ---
+        # Conversions ride the REAL attribution join (the table the
+        # ingest path uses), keyed per arm: the degraded arm converts
+        # at 10%, a full-rank arm at 30%; the A/A law below is keyed
+        # off the user alone, so identical arms convert identically.
+        attribution = quality_mod.get_attribution()
+        deg_arms = {v_deg}
+        lat_lock = threading.Lock()
+        samples = []  # (t_done, ms, ok)
+        assignments = {}  # user -> set of variants observed
+
+        class _Conv:
+            def __init__(self, pr_id, target):
+                self.pr_id = pr_id
+                self.target_entity_id = target
+
+        def client(worker):
+            conn = http.client.HTTPConnection("localhost", port, timeout=30)
+            try:
+                j = 0
+                while not stop_load.is_set():
+                    user = f"u{(worker * 131 + j * 7) % n_users}"
+                    body = json.dumps({"user": user, "num": 5})
+                    t0 = time.perf_counter()
+                    ok, resp_json = False, None
+                    try:
+                        conn.request(
+                            "POST", "/queries.json", body,
+                            {"Content-Type": "application/json"},
+                        )
+                        resp = conn.getresponse()
+                        raw = resp.read()
+                        ok = resp.status == 200
+                        resp_json = json.loads(raw) if ok else None
+                    except OSError:
+                        conn.close()
+                        conn = http.client.HTTPConnection(
+                            "localhost", port, timeout=30
+                        )
+                    ms = (time.perf_counter() - t0) * 1000
+                    with lat_lock:
+                        samples.append((time.perf_counter(), ms, ok))
+                    if resp_json is not None:
+                        variant = resp_json.get("variant")
+                        if variant is not None:
+                            with lat_lock:
+                                assignments.setdefault(user, set()).add(
+                                    variant
+                                )
+                        arm = variant or resp_json.get("modelVersion")
+                        items = [
+                            s["item"]
+                            for s in resp_json.get("itemScores") or []
+                        ]
+                        if arm and items:
+                            pr = f"pr-{worker}-{j}"
+                            attribution.register(pr, arm, items)
+                            rate = 10 if arm in deg_arms else 30
+                            roll = zlib.crc32(
+                                f"conv:{user}:{j}".encode()
+                            ) % 100
+                            target = items[0] if roll < rate else "i-none"
+                            attribution.observe(_Conv(pr, target))
+                    j += 1
+            finally:
+                conn.close()
+
+        clients = 4
+        threads = [
+            threading.Thread(target=client, args=(w,), daemon=True)
+            for w in range(clients)
+        ]
+        for t in threads:
+            t.start()
+
+        # --- run 1: degraded live arm vs full-rank candidate ---
+        spec = ExperimentSpec(
+            name="bench-deg", variants=(v_deg, v_good),
+            min_samples=100, alpha=0.05, tau=0.3, horizon_s=600.0,
+        )
+        runner = ExperimentRunner(
+            server, storage, spec,
+            pipeline=PromotionPipeline(
+                InProcessTarget(server),
+                PromotionConfig(observe_s=0.5, observe_poll_s=0.1),
+                storage=storage,
+            ),
+        )
+        t_run0 = time.perf_counter()
+        runner.start()
+        final = None
+        deadline = time.time() + 120
+        while final is None and time.time() < deadline:
+            time.sleep(0.3)
+            final = runner.step()
+        decision_s = time.perf_counter() - t_run0
+        assert final is not None, "sequential test never decided"
+        assert final["status"] == "decided", final["status"]
+        assert final["winner"] == v_good, final
+        assert final["resolved_winner"] == v_good
+        promo = final["promotion"]
+        assert promo and promo["outcome"] == "promoted", promo
+        assert server.api.deployed.engine_instance.id == v_good
+
+        # sticky allocation: 0 cross-variant reassignments, and every
+        # observed assignment is exactly the pure function's answer
+        with lat_lock:
+            assigned = {u: set(vs) for u, vs in assignments.items()}
+        reassigned = sum(1 for vs in assigned.values() if len(vs) > 1)
+        assert reassigned == 0, f"{reassigned} users saw >1 variant"
+        mismatches = sum(
+            1
+            for u, vs in assigned.items()
+            if next(iter(vs)) != allocate(spec, u)
+        )
+        assert mismatches == 0, f"{mismatches} allocation mismatches"
+
+        # --- run 2 (A/A): two identically trained arms, identical
+        # conversion law -> NO winner at the horizon, loser drains ---
+        v_aa = train_once(full)  # same params+seed as the live winner
+        ledger_before = get_ledger().total_bytes()
+        spec_aa = ExperimentSpec(
+            name="bench-aa", variants=(v_good, v_aa),
+            min_samples=50, alpha=0.05, tau=0.3, horizon_s=6.0,
+        )
+        runner_aa = ExperimentRunner(
+            server, storage, spec_aa,
+            pipeline=PromotionPipeline(
+                InProcessTarget(server),
+                PromotionConfig(observe_s=0.0),
+                storage=storage,
+            ),
+        )
+        runner_aa.start()
+        assert get_ledger().total_bytes() > ledger_before, (
+            "the A/A arm deployed no resident state to drain"
+        )
+        final_aa = None
+        deadline = time.time() + 60
+        while final_aa is None and time.time() < deadline:
+            time.sleep(0.3)
+            final_aa = runner_aa.step()
+        assert final_aa is not None
+        assert final_aa["status"] == "horizon", final_aa["status"]
+        assert final_aa["winner"] is None, final_aa
+        assert final_aa["resolved_winner"] == v_good  # keep-control
+        assert final_aa["promotion"] is None
+        assert server.api.deployed.engine_instance.id == v_good
+
+        stop_load.set()
+        for t in threads:
+            t.join(timeout=15)
+
+        # the losing A/A arm's device state drains to a ledger-zero
+        # release (back to the pre-experiment residency level)
+        drain_deadline = time.time() + 30
+        while (
+            get_ledger().total_bytes() > ledger_before
+            and time.time() < drain_deadline
+        ):
+            time.sleep(0.1)
+        ledger_after = get_ledger().total_bytes()
+        assert ledger_after <= ledger_before, (
+            f"loser not drained: {ledger_after} > {ledger_before} "
+            "ledger bytes after release"
+        )
+
+        with lat_lock:
+            total = len(samples)
+            errors = sum(1 for (_, _, ok) in samples if not ok)
+        assert errors == 0, (
+            f"{errors} dropped/erroring queries — the acceptance "
+            "criterion requires zero across the whole run"
+        )
+
+        # ingest-path attribution overhead: the PR 11 gate still holds
+        # with the variant-labeled join in place
+        overhead = measure_attribution_overhead()
+        assert overhead["attribution_overhead_frac"] < 0.02, overhead
+
+        emit(
+            {
+                "metric": "experiment_plane",
+                "unit": "mixed",
+                "value": round(decision_s, 2),
+                "decision_s": round(decision_s, 2),
+                "winner_promoted": promo["outcome"] == "promoted",
+                "aa_no_winner": final_aa["winner"] is None,
+                "cross_variant_reassignments": reassigned,
+                "allocation_mismatches": mismatches,
+                "users_sampled": len(assigned),
+                "queries_total": total,
+                "errors": errors,
+                "loser_ledger_zero": ledger_after <= ledger_before,
+                "attribution_overhead_frac": overhead[
+                    "attribution_overhead_frac"
+                ],
+                "device": device_name,
+            }
+        )
+    finally:
+        stop_load.set()
+        if server is not None:
+            server.shutdown()
+        storage_mod.set_storage(None)
+
+
 def _spawn_gateway(port, db_path):
     """One storage-gateway NODE as a separate OS process (sqlite-backed,
     restartable on the same port + store for the kill sweep)."""
@@ -4701,6 +5044,7 @@ BENCHES = {
     "implicit_train": bench_implicit_train,
     "serving_saturation": bench_serving_saturation,
     "promotion_under_load": bench_promotion_under_load,
+    "experiment": bench_experiment,
     "cluster_ingest": bench_cluster_ingest,
     "collector": bench_collector,
     "device_obs": bench_device_obs,

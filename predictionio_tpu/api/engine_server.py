@@ -61,6 +61,7 @@ from predictionio_tpu.utils import health as _health
 from predictionio_tpu.utils import metrics as _metrics
 from predictionio_tpu.utils import tracing as _tracing
 from predictionio_tpu.utils.serialize import loads_model
+from predictionio_tpu.workflow import experiment as _experiment
 from predictionio_tpu.workflow import quality as _quality
 from predictionio_tpu.workflow.context import WorkflowContext
 from predictionio_tpu.workflow.workflow_params import WorkflowParams
@@ -702,12 +703,21 @@ class QueryAPI:
         plugin_context: Optional[EngineServerPluginContext] = None,
         reload_fn=None,
         stop_fn=None,
+        experiment_start_fn=None,
+        experiment_stop_fn=None,
     ):
         self.deployed = deployed
         self.config = config or ServerConfig()
         self.plugin_context = plugin_context or EngineServerPluginContext()
         self._reload_fn = reload_fn
         self._stop_fn = stop_fn
+        self._experiment_start_fn = experiment_start_fn
+        self._experiment_stop_fn = experiment_stop_fn
+        # active experiment (sticky multi-variant serving). Reads on the
+        # hot path take one reference snapshot — no lock: CPython
+        # attribute assignment is atomic, and routing itself is a pure
+        # hash of (salt, user_key), so workers need no shared state.
+        self._experiment: Optional[_experiment.ActiveExperiment] = None
         self._executor = _BatchingExecutor(
             self.config.batch_window_ms,
             self.config.max_batch,
@@ -763,6 +773,22 @@ class QueryAPI:
         self._m_feedback_dropped = reg.counter(
             "pio_feedback_queue_dropped_total",
             "Feedback posts dropped because the bounded queue was full",
+        )
+        # experimentation plane: per-arm allocation counts plus the
+        # experiment's presence/split, federable off the same scrape as
+        # every per-version family (the variant id IS the version label
+        # on those)
+        self._m_exp_requests = reg.counter(
+            "pio_experiment_requests_total",
+            "Queries served per experiment arm (variant = the arm's "
+            "engine instance id)",
+            labels=("experiment", "variant"),
+        )
+        self._m_exp_info = reg.gauge(
+            "pio_experiment_info",
+            "Traffic split fraction per experiment arm while the "
+            "experiment runs; 0 once it stops",
+            labels=("experiment", "variant"),
         )
         # per-instance "since this server deployed" views: snapshot every
         # pre-existing version child now (the families are process-global
@@ -844,6 +870,44 @@ class QueryAPI:
             self._m_model_info.labels(
                 engine=old_label[0], version=old_label[1]
             ).set(0)
+
+    # --- experimentation plane (sticky multi-variant serving) ---
+
+    def set_experiment(self, active: "_experiment.ActiveExperiment") -> None:
+        """Bind an :class:`ActiveExperiment`: subsequent queries route
+        by the sticky allocation hash to the arm's own DeployedEngine
+        (so every per-version family is per-variant for free)."""
+        for vid, frac in zip(active.spec.variants, active.spec.split):
+            self._m_exp_info.labels(
+                experiment=active.spec.name, variant=vid
+            ).set(frac)
+        self._experiment = active
+
+    def clear_experiment(self) -> Optional["_experiment.ActiveExperiment"]:
+        """Unbind the running experiment (allocation stops immediately;
+        in-flight queries finish on the arm that served them). Returns
+        the displaced ActiveExperiment so the server can retire its
+        engines."""
+        active = self._experiment
+        self._experiment = None
+        if active is not None:
+            for vid in active.spec.variants:
+                self._m_exp_info.labels(
+                    experiment=active.spec.name, variant=vid
+                ).set(0)
+        return active
+
+    def experiment_status(self) -> Optional[Dict[str, Any]]:
+        active = self._experiment
+        if active is None:
+            return None
+        status = active.status()
+        requests = {}
+        for (exp, vid), child in self._m_exp_requests.children():
+            if exp == active.spec.name:
+                requests[vid] = child.value
+        status["requests"] = requests
+        return status
 
     def _serving_totals(self) -> Tuple["_metrics.HistogramSnapshot", int]:
         """Latency histogram + request count summed across every model
@@ -1099,6 +1163,13 @@ class QueryAPI:
             return self._debug_predictions(query)
         if path == "/queries.json" and method == "POST":
             return self._handle_query(body, headers)
+        if path == "/experiment.json" and method in ("GET", "POST"):
+            # like /reload this is an operator surface: under the async
+            # transport it runs on the route pool, so a start (which may
+            # read + warm variant states from storage) never blocks the
+            # event loop. When an access key is configured it is
+            # required, matching the other mutating surfaces.
+            return self._experiment_route(method, query, body)
         if path == "/reload" and method in ("GET", "POST"):
             # synchronous: the promotion pipeline (and any fleet
             # orchestrator) needs the success/failure verdict in the
@@ -1152,6 +1223,59 @@ class QueryAPI:
                 return 404, {"message": f"Plugin {plugin_name} not found."}, "application/json"
             return 200, table[plugin_name].handle_rest(args), "application/json"
         return 404, {"message": "Not Found"}, "application/json"
+
+    # --- experimentation surface ---
+
+    def _experiment_route(
+        self, method: str, query: Dict[str, str], body: Optional[bytes]
+    ) -> Tuple[int, Any, str]:
+        if self.config.access_key and not secrets.compare_digest(
+            query.get("accessKey", ""), self.config.access_key
+        ):
+            return (
+                401, {"message": "Invalid accessKey."}, "application/json"
+            )
+        if method == "GET":
+            return (
+                200,
+                {"experiment": self.experiment_status()},
+                "application/json",
+            )
+        try:
+            payload = json.loads((body or b"").decode("utf-8"))
+            if not isinstance(payload, dict):
+                raise ValueError("body must be a JSON object")
+        except Exception as e:
+            return 400, {"message": str(e)}, "application/json"
+        if payload.get("stop"):
+            if self._experiment_stop_fn is None:
+                return (
+                    501,
+                    {"message": "no experiment hook on this server"},
+                    "application/json",
+                )
+            winner = payload.get("winner")
+            report = self._experiment_stop_fn(
+                winner=str(winner) if winner else None
+            )
+            return 200, report, "application/json"
+        if self._experiment_start_fn is None:
+            return (
+                501,
+                {"message": "no experiment hook on this server"},
+                "application/json",
+            )
+        try:
+            spec = _experiment.ExperimentSpec.from_json(
+                payload.get("spec") or payload
+            )
+            status = self._experiment_start_fn(spec)
+        except ValueError as e:
+            return 400, {"message": str(e)}, "application/json"
+        except Exception as e:
+            logger.exception("experiment start failed")
+            return 500, {"message": str(e)}, "application/json"
+        return 200, status, "application/json"
 
     # --- debug span dump (access-key gated when a key is configured) ---
 
@@ -1230,7 +1354,9 @@ class QueryAPI:
             200,
             {
                 "predictions": _quality.get_capture().dump(
-                    limit=limit, version=query.get("version") or None
+                    limit=limit,
+                    version=query.get("version") or None,
+                    variant=query.get("variant") or None,
                 )
             },
             "application/json",
@@ -1268,8 +1394,20 @@ class QueryAPI:
             tctx, inbound_parent = _tracing.from_headers(headers)
         else:
             tctx, inbound_parent = None, None
+        active = self._experiment  # snapshot: stop mid-request is safe
+        experiment = None
         try:
             query_json = json.loads((body or b"").decode("utf-8"))
+            if active is not None:
+                # sticky allocation: a pure hash of (salt, user_key) —
+                # per-request, stateless, so every SO_REUSEPORT worker
+                # and every restart assigns this user the same arm. The
+                # chosen arm's DeployedEngine replaces the snapshot, so
+                # batching, metrics, feedback, and capture all see the
+                # variant as "the" deployed engine.
+                _, deployed = active.route(query_json)
+                algorithms = deployed.algorithms
+                experiment = active.spec.name
             query = algorithms[0].query_from_json(query_json)
         except Exception as e:
             logger.error("query %r is invalid: %s", body, e)
@@ -1285,6 +1423,7 @@ class QueryAPI:
                 result = self._finish_query(
                     deployed, query, query_json, f.result(), query_time,
                     serving_start, tctx, inbound_parent,
+                    experiment=experiment,
                 )
             except concurrent.futures.CancelledError:
                 return  # request was cancelled before its batch formed
@@ -1311,7 +1450,7 @@ class QueryAPI:
 
     def _finish_query(
         self, deployed, query, query_json, prediction, query_time,
-        serving_start, tctx=None, inbound_parent=None,
+        serving_start, tctx=None, inbound_parent=None, experiment=None,
     ) -> Tuple[int, Any, str]:
         prediction_json = deployed.algorithms[0].result_to_json(prediction)
         # the capture baseline is the RAW model output (pre-stamp,
@@ -1331,6 +1470,11 @@ class QueryAPI:
         # name the exact persisted round that produced it
         if isinstance(prediction_json, dict):
             prediction_json = dict(prediction_json, modelVersion=version)
+            if experiment is not None:
+                # stamp the arm onto the response BEFORE the feedback
+                # post, so the prId attribution record carries it too
+                prediction_json["experiment"] = experiment
+                prediction_json["variant"] = version
 
         pr_id = None
         if self.config.feedback:
@@ -1354,6 +1498,10 @@ class QueryAPI:
         self._m_latency_fam.labels(version=version).observe(elapsed)
         self._m_requests_fam.labels(version=version).inc()
         self._m_last_fam.labels(version=version).set(elapsed)
+        if experiment is not None:
+            self._m_exp_requests.labels(
+                experiment=experiment, variant=version
+            ).inc()
         if do_capture:
             _quality.get_capture().record(
                 version=version,
@@ -1362,6 +1510,8 @@ class QueryAPI:
                 pr_id=pr_id,
                 trace_id=tctx.trace_id if tctx is not None else None,
                 latency_s=elapsed,
+                experiment=experiment,
+                variant=version if experiment is not None else None,
             )
         if tctx is not None:
             _tracing.record_span(
@@ -1573,6 +1723,8 @@ class EngineServer:
             plugin_context,
             reload_fn=self.reload,
             stop_fn=self.shutdown,
+            experiment_start_fn=self.start_experiment,
+            experiment_stop_fn=self.stop_experiment,
         )
 
         def handle(method, path, query, body, form=None, headers=None):
@@ -1607,6 +1759,15 @@ class EngineServer:
 
     def shutdown(self) -> None:
         self._http.shutdown()
+        # a still-running experiment's non-live arms are owned by the
+        # ActiveExperiment, not the retained LRU — retire them first so
+        # their device buffers are released below, not leaked
+        active = self.api.clear_experiment()
+        if active is not None:
+            with self._retained_lock:
+                for vid, dep in active.engines.items():
+                    if dep is not self.api.deployed:
+                        self._retained.setdefault(vid, dep)
         self.api.close()
         # free the retained rollback states' device buffers AND the
         # actively deployed instance's — tests and operators cycle many
@@ -1726,6 +1887,98 @@ class EngineServer:
         self.swap_deployed(fresh)
         logger.info("reloaded engine instance %s", new_id)
         return new_id
+
+    # --- experimentation plane ---
+
+    def start_experiment(self, spec) -> Dict[str, Any]:
+        """Deploy every arm of ``spec`` warm and bind the experiment
+        into the QueryAPI. Arms resolve in order: the live instance is
+        reused as-is; a retained-LRU hit is popped out warm (the PR 13
+        machinery — no store read, no recompile); anything else builds
+        from storage onto the serving device slice. Idempotent per spec:
+        re-posting the same experiment (a fleet-converge nudge or a
+        restart) is a no-op."""
+        with self._swap_lock:
+            current = self.api._experiment
+            if current is not None:
+                if current.spec == spec:
+                    return self.api.experiment_status()
+                raise ValueError(
+                    f"experiment {current.spec.name!r} is already running"
+                )
+            live = self.api.deployed
+            live_id = live.engine_instance.id
+            engines: Dict[str, DeployedEngine] = {}
+            created: List[DeployedEngine] = []
+            try:
+                for vid in spec.variants:
+                    if vid == live_id:
+                        engines[vid] = live
+                        continue
+                    with self._retained_lock:
+                        dep = self._retained.pop(vid, None)
+                    if dep is None:
+                        dep = DeployedEngine.from_storage(
+                            self.engine,
+                            self.storage,
+                            engine_instance_id=vid,
+                            ctx=self._serving_ctx,
+                        )
+                    engines[vid] = dep
+                    created.append(dep)
+            except Exception:
+                # partial deploy must not leak device state
+                for dep in created:
+                    dep.release(timeout_s=1.0)
+                raise
+            self.api.set_experiment(
+                _experiment.ActiveExperiment(spec, engines)
+            )
+            logger.info(
+                "experiment %s started: variants=%s split=%s",
+                spec.name, spec.variants, spec.split,
+            )
+            return self.api.experiment_status()
+
+    def stop_experiment(
+        self, winner: Optional[str] = None
+    ) -> Dict[str, Any]:
+        """Unbind the experiment. The winner (and, on a plain stop, every
+        non-live arm) retires into the retained LRU — warm for the
+        promotion pipeline's pinned ``/reload``; losing arms skip the
+        LRU and go straight onto the background drain+release path, so
+        their device state lands at a ledger-zero release."""
+        with self._swap_lock:
+            active = self.api.clear_experiment()
+            if active is None:
+                return {"stopped": False, "experiment": None}
+            live_id = self.api.deployed.engine_instance.id
+            drained: List[str] = []
+            retained: List[str] = []
+            for vid, dep in active.engines.items():
+                if dep is self.api.deployed:
+                    continue
+                if winner is not None and vid != winner:
+                    drained.append(vid)
+                    threading.Thread(
+                        target=self._drain_and_release, args=(dep,),
+                        daemon=True, name="serving-drain",
+                    ).start()
+                else:
+                    retained.append(vid)
+                    self._retire(dep)
+            logger.info(
+                "experiment %s stopped: winner=%s drained=%s retained=%s",
+                active.spec.name, winner, drained, retained,
+            )
+            return {
+                "stopped": True,
+                "experiment": active.spec.name,
+                "winner": winner,
+                "live": live_id,
+                "drained": drained,
+                "retained": retained,
+            }
 
 
 def create_server(

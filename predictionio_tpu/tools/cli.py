@@ -1044,6 +1044,165 @@ def cmd_collector(args) -> int:
     return 0
 
 
+def _experiment_http(url: str, payload=None, timeout: float = 30.0):
+    """One JSON round-trip for the experiment surfaces; HTTP errors
+    surface the server's message as a CommandError."""
+    import urllib.error
+    import urllib.request
+
+    data = None
+    headers = {}
+    if payload is not None:
+        data = json.dumps(payload).encode("utf-8")
+        headers["Content-Type"] = "application/json"
+    req = urllib.request.Request(
+        url, data=data, headers=headers,
+        method="POST" if payload is not None else "GET",
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return json.loads(r.read().decode("utf-8"))
+    except urllib.error.HTTPError as e:
+        try:
+            detail = json.loads(e.read().decode("utf-8")).get("message")
+        except Exception:
+            detail = str(e)
+        raise CommandError(f"experiment: {detail}") from e
+    except OSError as e:
+        raise CommandError(f"experiment: {url}: {e}") from e
+
+
+def _experiment_converge(server_url, payload, done, workers, timeout_s=60.0):
+    """Converge an SO_REUSEPORT fleet on an experiment control action.
+
+    A POST to a shared serving port reaches ONE arbitrary worker, so —
+    exactly like the promotion tier's ``FleetTarget`` — keep re-POSTing
+    the idempotent request (each round-trip is a fresh connection the
+    kernel balances onto some worker) and require ``max(3, 2*workers)``
+    consecutive GETs to satisfy ``done`` before declaring the fleet
+    converged. Returns every non-trivial POST report, first first."""
+    import time
+
+    confirms = max(3, 2 * max(1, int(workers)))
+    deadline = time.monotonic() + timeout_s
+    streak = 0
+    reports = []
+    while time.monotonic() < deadline:
+        reports.append(_experiment_http(server_url, payload))
+        if done(_experiment_http(server_url)):
+            streak += 1
+            if streak >= confirms:
+                return reports
+        else:
+            streak = 0
+        time.sleep(0.1)
+    raise CommandError(
+        f"experiment: fleet did not converge within {timeout_s:g}s "
+        f"(workers={workers}; is every worker serving an arm's "
+        f"instance?)"
+    )
+
+
+def cmd_experiment(args) -> int:
+    """``pio experiment start|status|stop``: drive the online
+    experimentation plane (workflow/experiment.py) on a running engine
+    server — and, with ``--collector``, register the experiment for
+    fleet-wide sequential evaluation on the telemetry collector."""
+    import urllib.parse
+
+    base = args.url.rstrip("/")
+    qs = (
+        "?" + urllib.parse.urlencode({"accessKey": args.access_key})
+        if args.access_key
+        else ""
+    )
+    server_url = base + "/experiment.json" + qs
+    collector = (args.collector or "").rstrip("/")
+
+    if args.experiment_command == "status":
+        status = _experiment_http(server_url)
+        print(json.dumps(status, indent=2))
+        if collector:
+            reports = _experiment_http(
+                collector + "/api/experiments.json"
+            )
+            print(json.dumps(reports, indent=2))
+        return 0
+
+    if args.experiment_command == "stop":
+        payload = {"stop": True}
+        if args.winner:
+            payload["winner"] = args.winner
+        # converge: a worker that already stopped answers
+        # {"stopped": false} — harmless; done when consecutive reads
+        # all report no active experiment
+        reports = _experiment_converge(
+            server_url, payload,
+            done=lambda s: s.get("experiment") is None,
+            workers=args.workers,
+        )
+        stopped = [r for r in reports if r.get("stopped")]
+        report = stopped[0] if stopped else reports[0]
+        for extra in stopped[1:]:  # other workers' drain/retain sets
+            for k in ("drained", "retained"):
+                report[k] = sorted(set(report.get(k, [])) | set(extra.get(k, [])))
+        print(json.dumps(report, indent=2))
+        if collector and report.get("experiment"):
+            _experiment_http(
+                collector + "/api/experiments.json",
+                {"remove": report["experiment"], "secret": args.secret},
+            )
+            print(f"removed from collector: {report['experiment']}")
+        return 0
+
+    # start
+    if args.spec:
+        try:
+            with open(args.spec, "r", encoding="utf-8") as f:
+                spec = json.load(f)
+        except (OSError, ValueError) as e:
+            raise CommandError(f"experiment: bad --spec: {e}") from e
+    else:
+        if not args.name or len(args.variant_id or []) < 2:
+            raise CommandError(
+                "experiment start needs --spec, or --name plus at "
+                "least two --variant-id"
+            )
+        spec = {"name": args.name, "variants": list(args.variant_id)}
+        if args.split:
+            try:
+                spec["split"] = [
+                    float(s) for s in args.split.split(",") if s
+                ]
+            except ValueError as e:
+                raise CommandError(
+                    f"experiment: bad --split: {e}"
+                ) from e
+        if args.salt:
+            spec["salt"] = args.salt
+        if args.user_field:
+            spec["user_field"] = args.user_field
+        spec["horizon_s"] = args.horizon_s
+        spec["alpha"] = args.alpha
+        spec["on_inconclusive"] = args.on_inconclusive
+    exp_name = str(spec.get("name", ""))
+    _experiment_converge(
+        server_url, {"spec": spec},
+        done=lambda s: (s.get("experiment") or {}).get("spec", {})
+        .get("name") == exp_name,
+        workers=args.workers,
+    )
+    status = _experiment_http(server_url)
+    print(json.dumps(status, indent=2))
+    if collector:
+        out = _experiment_http(
+            collector + "/api/experiments.json",
+            {"spec": spec, "secret": args.secret},
+        )
+        print(f"registered on collector: {json.dumps(out)}")
+    return 0
+
+
 def cmd_replay(args) -> int:
     """``pio replay``: re-run a prediction capture (a saved
     ``/debug/predictions.json`` dump or a JSON-lines capture file)
@@ -1061,6 +1220,14 @@ def cmd_replay(args) -> int:
     records = load_capture(args.capture)
     if args.version:
         records = [r for r in records if r.get("version") == args.version]
+    if args.serving_variant:
+        # per-arm replay: keep only records served by that experiment
+        # arm (records carry "variant" when captured under a running
+        # experiment) — self-replay divergence checked per arm
+        records = [
+            r for r in records
+            if r.get("variant") == args.serving_variant
+        ]
     if args.num:
         records = records[-args.num:]
     if not records:
@@ -1797,6 +1964,12 @@ def build_parser() -> argparse.ArgumentParser:
         "--fail-on-divergence", action="store_true",
         help="exit nonzero when any replayed query diverged",
     )
+    rp.add_argument(
+        "--serving-variant", default="",
+        help="replay only records served by this experiment arm "
+        "(records carry 'variant' when captured under a running "
+        "experiment; -v/--variant remains the engine variant JSON)",
+    )
     rp.set_defaults(func=cmd_replay)
 
     top = sub.add_parser(
@@ -1875,6 +2048,99 @@ def build_parser() -> argparse.ArgumentParser:
         "--transport", choices=("async", "threaded"), default="async",
     )
     col.set_defaults(func=cmd_collector)
+
+    exp = sub.add_parser(
+        "experiment",
+        help="online experimentation plane: sticky multi-variant "
+        "serving with sequential-test-driven promotion "
+        "(workflow/experiment.py)",
+    )
+    exp_sub = exp.add_subparsers(dest="experiment_command", required=True)
+    exp_common = {
+        "--url": dict(
+            default="http://localhost:8000",
+            help="engine server base URL (default "
+            "http://localhost:8000)",
+        ),
+        "--accesskey": dict(
+            dest="access_key", default="",
+            help="engine server access key (required when the server "
+            "was deployed with one)",
+        ),
+        "--collector": dict(
+            default="",
+            help="telemetry collector base URL: also register/read the "
+            "experiment there for fleet-wide sequential evaluation",
+        ),
+        "--secret": dict(
+            default="",
+            help="collector admin secret (POST /api/experiments.json "
+            "is admin-gated)",
+        ),
+        "--workers": dict(
+            type=int, default=1,
+            help="worker processes behind the URL (SO_REUSEPORT fleet): "
+            "start/stop re-POST the idempotent request and require "
+            "max(3, 2*workers) consecutive agreeing reads before "
+            "declaring the fleet converged (the promotion tier's "
+            "FleetTarget idiom)",
+        ),
+    }
+    exp_start = exp_sub.add_parser(
+        "start", help="deploy all arms warm and start allocating"
+    )
+    exp_start.add_argument(
+        "--spec", help="ExperimentSpec JSON file (overrides the flags)"
+    )
+    exp_start.add_argument("--name", default="", help="experiment name")
+    exp_start.add_argument(
+        "--variant-id", action="append",
+        help="arm engine-instance id (repeat >= 2 times; the FIRST is "
+        "control)",
+    )
+    exp_start.add_argument(
+        "--split", default="",
+        help="comma-separated traffic fractions, one per arm "
+        "(default: uniform)",
+    )
+    exp_start.add_argument(
+        "--salt", default="",
+        help="allocation salt (default: the experiment name — same "
+        "name, same assignment across restarts)",
+    )
+    exp_start.add_argument(
+        "--user-field", default="user",
+        help="query JSON field used as the sticky key (default "
+        "'user'; absent, the whole query is the key)",
+    )
+    exp_start.add_argument(
+        "--horizon-s", type=float, default=3600.0,
+        help="experiment horizon in seconds (default 3600)",
+    )
+    exp_start.add_argument(
+        "--alpha", type=float, default=0.05,
+        help="sequential-test type-I error bound (default 0.05)",
+    )
+    exp_start.add_argument(
+        "--on-inconclusive", choices=("keep-control", "keep-live"),
+        default="keep-control",
+        help="verdict when the horizon passes undecided",
+    )
+    exp_status = exp_sub.add_parser(
+        "status", help="current experiment + sequential-test report"
+    )
+    exp_stop = exp_sub.add_parser(
+        "stop", help="stop allocating; drain losing arms"
+    )
+    exp_stop.add_argument(
+        "--winner", default="",
+        help="retain this arm warm; every other non-live arm drains "
+        "to release",
+    )
+    for sp in (exp_start, exp_status, exp_stop):
+        for flag, kwargs in exp_common.items():
+            sp.add_argument(flag, **kwargs)
+    exp.set_defaults(func=cmd_experiment)
 
     admin = sub.add_parser("adminserver", help="start the admin server")
     admin.add_argument("--ip", default="localhost")

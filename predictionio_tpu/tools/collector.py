@@ -17,6 +17,10 @@ serves the merged operator view:
 - ``GET  /api/targets.json`` / ``POST /api/targets`` (``{"url": …}``)
   — the target registry; ``tools/fleet.py`` auto-registers its workers
   here;
+- ``GET  /api/experiments.json`` / ``POST /api/experiments.json``
+  (``{"spec": {…}}`` to register, ``{"remove": name}`` to drop) — the
+  experiment registry + the sequential-test reports; POST is
+  admin-gated (a registration drives an automatic promotion decision);
 - ``GET  /healthz`` / ``GET /readyz`` — the collector's own health
   (ready = the poll loop scraped something recently and is not
   stalled).
@@ -164,6 +168,37 @@ class CollectorServer:
             if self._transport == "async":
                 return fut
             return fut.result()
+        if path == "/api/experiments.json" and method == "GET":
+            return 200, c.experiments_json()
+        if path == "/api/experiments.json" and method == "POST":
+            # register / remove an experiment for sequential evaluation.
+            # Admin-gated: an experiment registration drives an
+            # automatic promotion decision downstream.
+            try:
+                payload = json.loads((body or b"{}").decode("utf-8"))
+            except (ValueError, UnicodeDecodeError) as e:
+                return 400, {"message": f"invalid JSON body: {e}"}
+            if not isinstance(payload, dict):
+                return 400, {"message": "body must be a JSON object"}
+            if not self._authorized(query, payload):
+                return 401, {"message": "invalid or missing secret"}
+            if payload.get("remove"):
+                removed = c.remove_experiment(str(payload["remove"]))
+                return 200, {"removed": removed}
+            from predictionio_tpu.workflow.experiment import ExperimentSpec
+
+            try:
+                spec = ExperimentSpec.from_json(
+                    payload.get("spec") or {
+                        k: v
+                        for k, v in payload.items()
+                        if k != "secret"
+                    }
+                )
+            except ValueError as e:
+                return 400, {"message": str(e)}
+            added = c.register_experiment(spec)
+            return 200, {"added": added, "experiment": spec.name}
         if path == "/api/targets.json" and method == "GET":
             return 200, {"targets": c.target_urls()}
         if path == "/api/targets" and method == "POST":

@@ -45,13 +45,45 @@ def active_model_version(samples: Dict[str, float]) -> Optional[str]:
     return ",".join(active) if active else None
 
 
-def attributed_hit_rate(samples: Dict[str, float]) -> Optional[float]:
-    """converted / (converted + miss) over the online feedback-join
-    counters, summed across versions ('unknown' outcomes — expired or
-    foreign prIds — are excluded from the denominator)."""
+def attributed_hit_rates(
+    samples: Dict[str, float],
+) -> Dict[str, float]:
+    """PER-VERSION converted / (converted + miss) over the online
+    feedback-join counters ('unknown' outcomes — expired or foreign
+    prIds — are excluded from the denominator). Per-version is the only
+    honest view: summing across versions blends a live experiment's
+    arms into one meaningless number."""
+    per: Dict[str, List[float]] = {}
+    for key, value in samples.items():
+        if _family_name(key) != "pio_online_attributed_total":
+            continue
+        version = _label_value(key, "version") or "?"
+        outcome = _label_value(key, "outcome")
+        if outcome == "converted":
+            per.setdefault(version, [0.0, 0.0])[0] += value
+        elif outcome == "miss":
+            per.setdefault(version, [0.0, 0.0])[1] += value
+    return {
+        v: c / (c + m)
+        for v, (c, m) in per.items()
+        if (c + m) > 0
+    }
+
+
+def attributed_hit_rate(
+    samples: Dict[str, float], version: Optional[str] = None
+) -> Optional[float]:
+    """One version's attributed hit rate; without ``version``, the sum
+    across versions — only meaningful when a single version is serving
+    (use :func:`attributed_hit_rates` otherwise)."""
     converted = missed = 0.0
     for key, value in samples.items():
         if _family_name(key) != "pio_online_attributed_total":
+            continue
+        if (
+            version is not None
+            and _label_value(key, "version") != version
+        ):
             continue
         outcome = _label_value(key, "outcome")
         if outcome == "converted":
@@ -60,6 +92,31 @@ def attributed_hit_rate(samples: Dict[str, float]) -> Optional[float]:
             missed += value
     denom = converted + missed
     return (converted / denom) if denom else None
+
+
+def _short_vid(vid: str, limit: int = 8) -> str:
+    return vid if len(vid) <= limit else vid[: limit - 1] + "…"
+
+
+def experiment_info(samples: Dict[str, float]) -> Optional[str]:
+    """EXP column detail from ``pio_experiment_info{experiment,variant}``
+    (value = split fraction while running, 0 after): ``"exp-a
+    v1:50/v2:50"``. None when no experiment is running on the server."""
+    name = None
+    per: Dict[str, float] = {}
+    for key, value in samples.items():
+        if _family_name(key) != "pio_experiment_info" or value <= 0:
+            continue
+        name = _label_value(key, "experiment") or "?"
+        vid = _label_value(key, "variant") or "?"
+        per[vid] = value
+    if not per:
+        return None
+    detail = "/".join(
+        f"{_short_vid(v)}:{round(s * 100):.0f}"
+        for v, s in sorted(per.items())
+    )
+    return f"{name} {detail}"
 
 
 def quantized_residency(samples: Dict[str, float]) -> Optional[str]:
@@ -193,9 +250,20 @@ def _row(snap: dict, prev: Optional[dict], elapsed_s: float) -> dict:
         row["version"] = (
             version if len(version) <= 12 else version[:11] + "…"
         )
-    hit = attributed_hit_rate(m)
-    if hit is not None:
-        row["hit_rate"] = round(hit * 100.0, 1)
+    # HIT% is per version: one serving version renders the bare number
+    # (the pre-experiment shape); several — a live experiment's arms, or
+    # versions around a swap — render "vid:rate" pairs, never blended
+    hits = attributed_hit_rates(m)
+    if len(hits) == 1:
+        row["hit_rate"] = round(next(iter(hits.values())) * 100.0, 1)
+    elif hits:
+        row["hit_rate"] = " ".join(
+            f"{_short_vid(v)}:{r * 100.0:.1f}"
+            for v, r in sorted(hits.items())
+        )
+    exp = experiment_info(m)
+    if exp is not None:
+        row["exp"] = exp
     # storage-cluster column (data/storage/cluster.py): per-node breaker
     # gauges from the process embedding the routing client — "2/3"
     # means one node's breaker is open; "+1s" appends the count of
@@ -241,6 +309,7 @@ _COLUMNS = (
     ("errors", "ERR", 5),
     ("version", "VERSION", 12),
     ("hit_rate", "HIT%", 6),
+    ("exp", "EXP", 16),
     ("rounds", "ROUNDS", 7),
     ("last_delta", "CONV", 9),
     ("resident_mb", "RES_MB", 7),
@@ -315,8 +384,8 @@ def _row_from_fleet(t: dict) -> dict:
     if p50 is not None:
         row["p50_ms"] = p50
         row["p99_ms"] = t.get("window_p99_ms", t.get("p99_ms"))
-    # device-plane columns federated by the collector
-    for key in ("hbm_mb", "pad", "skew", "drift_mb", "prec"):
+    # device-plane + model-quality columns federated by the collector
+    for key in ("hbm_mb", "pad", "skew", "drift_mb", "prec", "hit_rate", "exp"):
         if t.get(key) is not None:
             row[key] = t[key]
     return row
@@ -358,6 +427,15 @@ def render_fleet(fleet: dict) -> str:
                 f"{s['slo']} burn fast={fast} slow={slow}{tag}"
             )
         lines.append("slo: " + "; ".join(rendered))
+    experiments = fleet.get("experiments") or []
+    if experiments:
+        rendered = []
+        for e in experiments:
+            part = f"{e.get('experiment')} {e.get('status')}"
+            if e.get("winner"):
+                part += f" winner={_short_vid(str(e['winner']))}"
+            rendered.append(part)
+        lines.append("exp: " + "; ".join(rendered))
     return "\n".join(lines)
 
 

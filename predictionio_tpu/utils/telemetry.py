@@ -248,6 +248,18 @@ def _delta_samples(
 # --- the collector ---
 
 
+@dataclasses.dataclass
+class _ExperimentEntry:
+    """One registered experiment: its spec, the per-target cumulative
+    baseline captured at registration, and (once reached) the sticky
+    final report."""
+
+    spec: object
+    registered_s: float
+    baselines: Dict[str, Dict[str, float]]
+    final: Optional[dict] = None
+
+
 class Collector:
     """Poll a fleet's existing public endpoints; serve the merged view.
 
@@ -311,6 +323,15 @@ class Collector:
         self._thread: Optional[threading.Thread] = None
         self._last_alerts: List[dict] = []
         self._last_slo_report: List[dict] = []
+        # experimentation plane: registered ExperimentSpecs plus, per
+        # experiment, the cumulative-counter baseline captured at
+        # registration (per target, so a restarted worker's counter
+        # reset clamps to zero instead of going negative) and — once the
+        # sequential test crosses its boundary — the STICKY final
+        # report: an always-valid test's verdict is a stopping rule, a
+        # later fluctuation must not un-decide it.
+        self._experiments: Dict[str, _ExperimentEntry] = {}
+        self._last_experiments: Dict[str, dict] = {}
         reg = _metrics.get_registry()
         self._m_scrapes = reg.counter(
             "pio_collector_scrapes_total",
@@ -360,6 +381,34 @@ class Collector:
             "1 while any fleet target's |pio_device_ledger_drift_bytes| "
             "exceeds the collector's drift threshold (untracked device "
             "residency — the leak signal)",
+        )
+        # experimentation gauges, evaluated in the ring the way SLO
+        # burns are: one peek per poll tick, licensed by the test being
+        # always-valid
+        self._m_exp_lambda = reg.gauge(
+            "pio_experiment_log_lambda",
+            "mSPRT log likelihood ratio of the arm's attributed "
+            "hit-rate vs control (crosses ln(1/alpha) exactly once, at "
+            "the verdict)",
+            labels=("experiment", "variant"),
+        )
+        self._m_exp_rate = reg.gauge(
+            "pio_experiment_hit_rate",
+            "Attributed hit-rate per experiment arm since the "
+            "experiment registered with this collector",
+            labels=("experiment", "variant"),
+        )
+        self._m_exp_p99 = reg.gauge(
+            "pio_experiment_p99_seconds",
+            "Windowed serving p99 per experiment arm (the latency "
+            "guardrail input)",
+            labels=("experiment", "variant"),
+        )
+        self._m_exp_decided = reg.gauge(
+            "pio_experiment_decided",
+            "1 once the experiment's sequential test has a verdict (or "
+            "its horizon passed), 0 while running",
+            labels=("experiment",),
         )
         for url in targets:
             self.add_target(url)
@@ -564,13 +613,17 @@ class Collector:
             self._poll_target(states[0])
         report = self.evaluate_slos()
         self.evaluate_ledger()
-        with self._lock:
-            up = sum(1 for s in states if s.up)
-        return {
+        experiments = self.evaluate_experiments()
+        summary = {
             "targets": len(states),
-            "up": up,
+            "up": sum(1 for s in states if s.up),
             "alerts": sum(1 for r in report if r["firing"]),
         }
+        if experiments:
+            summary["experiments"] = {
+                r["experiment"]: r["status"] for r in experiments
+            }
+        return summary
 
     def evaluate_ledger(self) -> dict:
         """The device-ledger fleet view: total registered residency and
@@ -857,11 +910,30 @@ class Collector:
             row["skew"] = round(skew, 3)
         # quantized-residency detail (pio_retrieval_bytes_per_item):
         # the same "prec:bytesB" string the direct-scrape console shows
-        from predictionio_tpu.tools.top import quantized_residency
+        from predictionio_tpu.tools.top import (
+            _short_vid,
+            attributed_hit_rates,
+            experiment_info,
+            quantized_residency,
+        )
 
         prec = quantized_residency(samples)
         if prec is not None:
             row["prec"] = prec
+        # model-quality columns, per version (an experiment's arms must
+        # never blend into one number — `pio top --collector` renders
+        # these straight off the federated row)
+        hits = attributed_hit_rates(samples)
+        if len(hits) == 1:
+            row["hit_rate"] = round(next(iter(hits.values())) * 100.0, 1)
+        elif hits:
+            row["hit_rate"] = " ".join(
+                f"{_short_vid(v)}:{r * 100.0:.1f}"
+                for v, r in sorted(hits.items())
+            )
+        exp = experiment_info(samples)
+        if exp is not None:
+            row["exp"] = exp
         windowed = self._windowed(state, window_s)
         if windowed is not None:
             span_s, delta = windowed
@@ -934,6 +1006,7 @@ class Collector:
             "ledger": self.evaluate_ledger(),
             "slos": self.slo_report(),
             "alerts": self.alerts(),
+            "experiments": self.experiment_reports(),
         }
 
     # -- trace stitching (/api/traces.json) --
@@ -1109,3 +1182,164 @@ class Collector:
             "slos": self.slo_report(),
             "alerts": self.alerts(),
         }
+
+    # -- the sequential experimentation engine --
+
+    # windowed per-variant p99 for the latency guardrail reads this
+    # window's deltas (cumulative counts would let ancient traffic mask
+    # a current regression)
+    EXPERIMENT_LATENCY_WINDOW_S = 60.0
+
+    def register_experiment(self, spec) -> bool:
+        """Register an :class:`ExperimentSpec` for sequential
+        evaluation. The per-variant attributed counts are read as deltas
+        against the fleet's cumulative counters AT REGISTRATION, per
+        target (a restarted worker clamps to zero). Re-registering an
+        identical spec is a no-op (fleet-converge nudges are free);
+        a different spec under the same name re-baselines."""
+        with self._lock:
+            existing = self._experiments.get(spec.name)
+            if existing is not None and existing.spec == spec:
+                return False
+            baselines: Dict[str, Dict[str, float]] = {}
+            for state in self._targets.values():
+                latest = state.latest()
+                if latest is not None:
+                    baselines[state.url] = dict(latest[1])
+            self._experiments[spec.name] = _ExperimentEntry(
+                spec=spec,
+                registered_s=time.time(),
+                baselines=baselines,
+            )
+            self._last_experiments.pop(spec.name, None)
+        return True
+
+    def remove_experiment(self, name: str) -> bool:
+        with self._lock:
+            removed = self._experiments.pop(name, None)
+            self._last_experiments.pop(name, None)
+        if removed is not None:
+            self._m_exp_decided.labels(experiment=name).set(0.0)
+        return removed is not None
+
+    def experiment_report(self, name: str) -> Optional[dict]:
+        with self._lock:
+            return self._last_experiments.get(name)
+
+    def experiment_reports(self) -> List[dict]:
+        with self._lock:
+            return list(self._last_experiments.values())
+
+    def experiments_json(self) -> dict:
+        with self._lock:
+            entries = [
+                {
+                    "spec": e.spec.to_json(),
+                    "registered_s": e.registered_s,
+                    "report": self._last_experiments.get(name),
+                }
+                for name, e in self._experiments.items()
+            ]
+        return {"ts": time.time(), "experiments": entries}
+
+    def evaluate_experiments(self) -> List[dict]:
+        """One peek of every registered experiment's sequential test
+        over the federated ring — run on each poll tick exactly the way
+        SLO burn rates are (the mSPRT is always-valid, so continuous
+        peeking spends no extra alpha). Per-variant attributed counts
+        come from ``pio_online_attributed_total{version=<variant>}``
+        deltas since registration; the latency guardrail reads each
+        arm's windowed ``pio_serving_latency_seconds`` p99. A verdict is
+        STICKY: once crossed, later polls re-report it unchanged."""
+        from predictionio_tpu.workflow.experiment import evaluate_sequential
+
+        with self._lock:
+            entries = list(self._experiments.items())
+        if not entries:
+            return []
+        reports: List[dict] = []
+        window_s = self.EXPERIMENT_LATENCY_WINDOW_S
+        _, wdelta = self._fleet_window_delta(window_s)
+        states = self._states()
+        for name, entry in entries:
+            if entry.final is not None:
+                reports.append(entry.final)
+                continue
+            spec = entry.spec
+            stats: Dict[str, Dict[str, object]] = {
+                vid: {
+                    "converted": 0.0,
+                    "miss": 0.0,
+                    "requests": 0.0,
+                    "p99_s": None,
+                }
+                for vid in spec.variants
+            }
+            for state in states:
+                with self._lock:
+                    latest = state.latest()
+                if latest is None:
+                    continue
+                base = entry.baselines.get(state.url, {})
+                for key, value in latest[1].items():
+                    family = _metrics.sample_family_name(key)
+                    if family == "pio_online_attributed_total":
+                        vid = _metrics.sample_label_value(key, "version")
+                        outcome = _metrics.sample_label_value(
+                            key, "outcome"
+                        )
+                        if vid in stats and outcome in (
+                            "converted", "miss",
+                        ):
+                            stats[vid][outcome] += max(
+                                0.0, value - base.get(key, 0.0)
+                            )
+                    elif family == "pio_serving_requests_total":
+                        vid = _metrics.sample_label_value(key, "version")
+                        if vid in stats:
+                            stats[vid]["requests"] += max(
+                                0.0, value - base.get(key, 0.0)
+                            )
+            per_variant_lat: Dict[str, Dict[str, float]] = {}
+            for key, value in wdelta.items():
+                if (
+                    _metrics.sample_family_name(key)
+                    == "pio_serving_latency_seconds_bucket"
+                ):
+                    vid = _metrics.sample_label_value(key, "version")
+                    if vid in stats:
+                        per_variant_lat.setdefault(vid, {})[key] = value
+            for vid, sub in per_variant_lat.items():
+                stats[vid]["p99_s"] = (
+                    _metrics.histogram_quantile_from_samples(
+                        sub, "pio_serving_latency_seconds", 0.99
+                    )
+                )
+            report = evaluate_sequential(
+                spec,
+                stats,
+                elapsed_s=time.time() - entry.registered_s,
+            )
+            for vid, v in report["variants"].items():
+                self._m_exp_lambda.labels(
+                    experiment=name, variant=vid
+                ).set(v["log_lambda"])
+                self._m_exp_rate.labels(
+                    experiment=name, variant=vid
+                ).set(v["hit_rate"] or 0.0)
+                if v.get("p99_s") is not None:
+                    self._m_exp_p99.labels(
+                        experiment=name, variant=vid
+                    ).set(v["p99_s"])
+            decided = report["status"] != "running"
+            self._m_exp_decided.labels(experiment=name).set(
+                1.0 if decided else 0.0
+            )
+            if decided:
+                entry.final = report
+            reports.append(report)
+        with self._lock:
+            self._last_experiments = {
+                r["experiment"]: r for r in reports
+            }
+        return reports

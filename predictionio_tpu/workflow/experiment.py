@@ -1,0 +1,564 @@
+"""Online experimentation plane: sticky multi-variant serving with a
+sequential (always-valid) significance engine driving automatic
+promotion.
+
+An experiment is "a promotion whose observation window is a statistical
+test": the :class:`ExperimentSpec` names >= 2 trained engine-instance
+ids (the *variants* — variant ids ARE engine-instance ids), all of which
+are deployed warm via the retained-state machinery, and traffic is split
+with a sticky deterministic hash::
+
+    crc32(salt + ":" + user_key) % 10000  ->  bucket  ->  variant
+
+The allocation is a pure function of (salt, user_key, split): every
+worker of an SO_REUSEPORT fleet and every restart computes the same
+assignment with zero coordination, and a given user can never be
+reassigned mid-experiment (0 cross-variant reassignments).
+
+Because each variant is served by its own ``DeployedEngine``, every
+existing per-version family (``pio_serving_latency_seconds{version=..}``,
+``pio_serving_requests_total{version=..}``,
+``pio_online_attributed_total{version=..}``) is per-variant for free, and
+one federated collector scrape sees every arm of every worker.
+
+The verdict comes from a mixture sequential probability ratio test
+(mSPRT) over the attributed hit-rate — an always-valid test whose
+type-I error stays <= alpha under *continuous* peeking, so the collector
+may evaluate it on every poll tick exactly the way SLO burn rates are
+evaluated.  A latency guardrail (windowed p99 per variant) disqualifies
+a fast-converting but slow arm from winning.  Winner -> automatic
+promotion through the gated :mod:`predictionio_tpu.workflow.promotion`
+pipeline (shadow + observation window intact); losers -> drain/release
+through the retained-LRU path; inconclusive at horizon -> configurable
+keep-control.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+import time
+import zlib
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple
+
+__all__ = [
+    "ALLOCATION_BUCKETS",
+    "ExperimentSpec",
+    "ActiveExperiment",
+    "ExperimentRunner",
+    "allocate",
+    "allocate_bucket",
+    "user_key_from_query",
+    "msprt_log_lambda",
+    "evaluate_sequential",
+    "local_variant_stats",
+]
+
+# Allocation granularity: splits are quantised to 1/10000ths of traffic.
+ALLOCATION_BUCKETS = 10000
+
+_ON_INCONCLUSIVE = ("keep-control", "keep-live")
+
+
+# --- spec ---
+
+
+@dataclasses.dataclass(frozen=True)
+class ExperimentSpec:
+    """Declarative definition of one online experiment.
+
+    ``variants[0]`` is the control arm.  ``split`` is the traffic
+    fraction per variant (same order); it defaults to uniform and is
+    normalised to sum to 1.  ``salt`` defaults to the experiment name so
+    re-running an experiment under a new name reshuffles users while a
+    restart of the *same* experiment never does.
+    """
+
+    name: str
+    variants: Tuple[str, ...]
+    split: Tuple[float, ...] = ()
+    primary_metric: str = "hit_rate"
+    horizon_s: float = 3600.0
+    salt: str = ""
+    user_field: str = "user"
+    alpha: float = 0.05
+    tau: float = 0.2
+    min_samples: int = 50
+    latency_guard_ms: float = 0.0
+    latency_guard_ratio: float = 0.0
+    on_inconclusive: str = "keep-control"
+
+    def __post_init__(self):
+        if not self.name:
+            raise ValueError("experiment name must be non-empty")
+        variants = tuple(str(v) for v in self.variants)
+        if len(variants) < 2:
+            raise ValueError("experiment needs >= 2 variants")
+        if len(set(variants)) != len(variants):
+            raise ValueError("experiment variants must be distinct")
+        object.__setattr__(self, "variants", variants)
+        split = tuple(float(s) for s in self.split)
+        if not split:
+            split = tuple(1.0 / len(variants) for _ in variants)
+        if len(split) != len(variants):
+            raise ValueError(
+                "split must have one fraction per variant "
+                f"({len(split)} != {len(variants)})"
+            )
+        if any(s <= 0.0 for s in split):
+            raise ValueError("split fractions must be > 0")
+        total = sum(split)
+        object.__setattr__(self, "split", tuple(s / total for s in split))
+        if not self.salt:
+            object.__setattr__(self, "salt", self.name)
+        if not (0.0 < self.alpha < 1.0):
+            raise ValueError("alpha must be in (0, 1)")
+        if self.tau <= 0.0:
+            raise ValueError("tau must be > 0")
+        if self.horizon_s <= 0.0:
+            raise ValueError("horizon_s must be > 0")
+        if self.min_samples < 1:
+            raise ValueError("min_samples must be >= 1")
+        if self.on_inconclusive not in _ON_INCONCLUSIVE:
+            raise ValueError(
+                f"on_inconclusive must be one of {_ON_INCONCLUSIVE}"
+            )
+
+    @property
+    def control(self) -> str:
+        return self.variants[0]
+
+    def split_edges(self) -> Tuple[int, ...]:
+        """Cumulative integer bucket edges (last edge pinned to the
+        bucket count so rounding can never orphan a bucket)."""
+        edges = []
+        cum = 0.0
+        for frac in self.split:
+            cum += frac
+            edges.append(int(round(cum * ALLOCATION_BUCKETS)))
+        edges[-1] = ALLOCATION_BUCKETS
+        return tuple(edges)
+
+    def to_json(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_json(cls, payload: Dict[str, Any]) -> "ExperimentSpec":
+        if not isinstance(payload, dict):
+            raise ValueError("experiment spec must be a JSON object")
+        valid = {f.name for f in dataclasses.fields(cls)}
+        unknown = set(payload) - valid
+        if unknown:
+            raise ValueError(
+                f"unknown experiment spec keys: {sorted(unknown)}"
+            )
+        if "variants" in payload:
+            payload = dict(payload, variants=tuple(payload["variants"]))
+        if "split" in payload:
+            payload = dict(payload, split=tuple(payload["split"]))
+        try:
+            return cls(**payload)
+        except TypeError as exc:
+            raise ValueError(f"invalid experiment spec: {exc}") from exc
+
+
+# --- sticky allocation (pure; keep free of randomness AND clocks —
+# tests/test_lint.py enforces determinism on this module) ---
+
+
+def user_key_from_query(query_json: Any, user_field: str = "user") -> str:
+    """Extract the sticky key from a query.  Falls back to the canonical
+    JSON form of the whole query so even user-less traffic is sticky
+    (identical query -> identical arm)."""
+    if isinstance(query_json, dict):
+        value = query_json.get(user_field)
+        if value is not None:
+            return str(value)
+    return json.dumps(query_json, sort_keys=True, default=str)
+
+
+def allocate_bucket(salt: str, user_key: str) -> int:
+    """``crc32(salt + ":" + user_key) % 10000`` — the entire allocation
+    contract.  Stateless and deterministic, so every worker and every
+    restart agrees without coordination."""
+    return zlib.crc32(
+        (str(salt) + ":" + str(user_key)).encode("utf-8")
+    ) % ALLOCATION_BUCKETS
+
+
+def allocate(spec: ExperimentSpec, user_key: str) -> str:
+    """Map a user key to its (permanent) variant id."""
+    bucket = allocate_bucket(spec.salt, user_key)
+    for vid, edge in zip(spec.variants, spec.split_edges()):
+        if bucket < edge:
+            return vid
+    return spec.variants[-1]
+
+
+# --- sequential significance engine ---
+
+
+def msprt_log_lambda(
+    conv_a: float, n_a: float, conv_b: float, n_b: float, tau: float
+) -> float:
+    """Log of the mixture-SPRT likelihood ratio for a two-sample
+    difference in proportions, with a Gaussian mixture of scale ``tau``
+    over the effect size.
+
+    ``a`` is control, ``b`` the candidate.  Rejecting H0 (no difference)
+    when ``Lambda >= 1/alpha`` keeps the type-I error <= alpha at EVERY
+    peek (always-valid inference), which is what licenses evaluating it
+    on each collector poll without alpha-spending bookkeeping.
+    """
+    if n_a <= 0 or n_b <= 0:
+        return 0.0
+    p_a = conv_a / n_a
+    p_b = conv_b / n_b
+    pooled = (conv_a + conv_b) / (n_a + n_b)
+    variance = pooled * (1.0 - pooled) * (1.0 / n_a + 1.0 / n_b)
+    if variance <= 0.0:
+        return 0.0
+    tau2 = tau * tau
+    delta = p_b - p_a
+    return 0.5 * math.log(variance / (variance + tau2)) + (
+        delta * delta * tau2
+    ) / (2.0 * variance * (variance + tau2))
+
+
+def _guard_ok(
+    spec: ExperimentSpec,
+    p99_s: Optional[float],
+    control_p99_s: Optional[float],
+) -> bool:
+    """Latency guardrail: a candidate may not win while its windowed p99
+    violates the absolute bound (``latency_guard_ms``) or exceeds
+    ``latency_guard_ratio`` x the control's p99.  Missing data passes —
+    the guard disqualifies on evidence, not on absence."""
+    if p99_s is None:
+        return True
+    if spec.latency_guard_ms > 0.0 and p99_s * 1000.0 > spec.latency_guard_ms:
+        return False
+    if (
+        spec.latency_guard_ratio > 0.0
+        and control_p99_s is not None
+        and control_p99_s > 0.0
+        and p99_s > spec.latency_guard_ratio * control_p99_s
+    ):
+        return False
+    return True
+
+
+def evaluate_sequential(
+    spec: ExperimentSpec,
+    stats: Dict[str, Dict[str, Any]],
+    elapsed_s: Optional[float] = None,
+) -> Dict[str, Any]:
+    """One peek of the sequential test over per-variant attributed
+    outcome counts.
+
+    ``stats`` maps variant id -> ``{"converted", "miss", "requests",
+    "p99_s"}`` accumulated *since the experiment started*.  Returns a
+    report with ``status`` in ``("running", "decided", "horizon")``; on
+    ``decided`` the ``winner`` is either a candidate that significantly
+    beats control (and passes the latency guard) or the control itself
+    when every candidate has significantly lost.
+    """
+    control = spec.control
+    threshold = math.log(1.0 / spec.alpha)
+    c_stats = stats.get(control, {})
+    c_conv = float(c_stats.get("converted", 0))
+    c_miss = float(c_stats.get("miss", 0))
+    c_n = c_conv + c_miss
+    c_rate = (c_conv / c_n) if c_n else None
+    c_p99 = c_stats.get("p99_s")
+
+    variants: Dict[str, Dict[str, Any]] = {}
+    variants[control] = {
+        "converted": c_conv,
+        "miss": c_miss,
+        "attributed": c_n,
+        "requests": float(c_stats.get("requests", 0)),
+        "hit_rate": c_rate,
+        "p99_s": c_p99,
+        "log_lambda": 0.0,
+        "significant": False,
+        "better": False,
+        "guard_ok": True,
+    }
+
+    contenders = []
+    all_lost = True
+    for vid in spec.variants[1:]:
+        v_stats = stats.get(vid, {})
+        conv = float(v_stats.get("converted", 0))
+        miss = float(v_stats.get("miss", 0))
+        n = conv + miss
+        rate = (conv / n) if n else None
+        p99 = v_stats.get("p99_s")
+        enough = n >= spec.min_samples and c_n >= spec.min_samples
+        log_lambda = (
+            msprt_log_lambda(c_conv, c_n, conv, n, spec.tau) if enough else 0.0
+        )
+        significant = enough and log_lambda >= threshold
+        better = (
+            rate is not None and c_rate is not None and rate > c_rate
+        )
+        guard = _guard_ok(spec, p99, c_p99)
+        variants[vid] = {
+            "converted": conv,
+            "miss": miss,
+            "attributed": n,
+            "requests": float(v_stats.get("requests", 0)),
+            "hit_rate": rate,
+            "p99_s": p99,
+            "log_lambda": log_lambda,
+            "significant": significant,
+            "better": better,
+            "guard_ok": guard,
+        }
+        if significant and better and guard:
+            contenders.append((rate, vid))
+        if not (significant and not better):
+            all_lost = False
+
+    report: Dict[str, Any] = {
+        "experiment": spec.name,
+        "control": control,
+        "primary_metric": spec.primary_metric,
+        "alpha": spec.alpha,
+        "threshold_log_lambda": threshold,
+        "elapsed_s": elapsed_s,
+        "status": "running",
+        "winner": None,
+        "action": None,
+        "variants": variants,
+    }
+    if contenders:
+        report["status"] = "decided"
+        report["winner"] = max(contenders)[1]
+        report["action"] = f"promote:{report['winner']}"
+    elif all_lost:
+        # Every candidate significantly underperforms: control wins.
+        report["status"] = "decided"
+        report["winner"] = control
+        report["action"] = "keep-control"
+    elif elapsed_s is not None and elapsed_s >= spec.horizon_s:
+        report["status"] = "horizon"
+        report["action"] = spec.on_inconclusive
+    return report
+
+
+# --- server-side active state (held by QueryAPI; routing is the pure
+# allocation above applied to the request's user key) ---
+
+
+class ActiveExperiment:
+    """Spec + the per-variant DeployedEngines, as bound into a serving
+    ``QueryAPI``.  Routing is stateless; the only state here is the
+    engine map itself."""
+
+    def __init__(self, spec: ExperimentSpec, engines: Dict[str, Any]):
+        missing = set(spec.variants) - set(engines)
+        if missing:
+            raise ValueError(f"experiment missing engines for {sorted(missing)}")
+        self.spec = spec
+        self.engines = dict(engines)
+        self.started_s = time.time()
+
+    def route(self, query_json: Any) -> Tuple[str, Any]:
+        vid = allocate(
+            self.spec, user_key_from_query(query_json, self.spec.user_field)
+        )
+        return vid, self.engines[vid]
+
+    def status(self) -> Dict[str, Any]:
+        return {
+            "spec": self.spec.to_json(),
+            "startedS": self.started_s,
+            "elapsedS": max(0.0, time.time() - self.started_s),
+            "variants": list(self.spec.variants),
+        }
+
+
+# --- local (in-process) stats source ---
+
+
+def local_variant_stats(spec: ExperimentSpec) -> Dict[str, Dict[str, Any]]:
+    """Per-variant cumulative counts read from the process-global
+    registry — the single-box evaluation source (the fleet-shaped source
+    is the collector's federated ring)."""
+    from predictionio_tpu.utils.metrics import (
+        get_registry,
+        histogram_quantile_from_samples,
+        parse_exposition,
+        sample_family_name,
+        sample_label_value,
+    )
+
+    samples = parse_exposition(get_registry().render())
+    stats: Dict[str, Dict[str, Any]] = {
+        vid: {"converted": 0.0, "miss": 0.0, "requests": 0.0, "p99_s": None}
+        for vid in spec.variants
+    }
+    by_variant_latency: Dict[str, Dict[str, float]] = {}
+    for key, value in samples.items():
+        family = sample_family_name(key)
+        if family == "pio_online_attributed_total":
+            vid = sample_label_value(key, "version")
+            outcome = sample_label_value(key, "outcome")
+            if vid in stats and outcome in ("converted", "miss"):
+                stats[vid][outcome] += value
+        elif family == "pio_serving_requests_total":
+            vid = sample_label_value(key, "version")
+            if vid in stats:
+                stats[vid]["requests"] += value
+        elif family == "pio_serving_latency_seconds_bucket":
+            vid = sample_label_value(key, "version")
+            if vid in stats:
+                by_variant_latency.setdefault(vid, {})[key] = value
+    for vid, lat in by_variant_latency.items():
+        stats[vid]["p99_s"] = histogram_quantile_from_samples(
+            lat, "pio_serving_latency_seconds", 0.99
+        )
+    return stats
+
+
+def _delta_stats(
+    now: Dict[str, Dict[str, Any]], base: Dict[str, Dict[str, Any]]
+) -> Dict[str, Dict[str, Any]]:
+    out: Dict[str, Dict[str, Any]] = {}
+    for vid, cur in now.items():
+        prev = base.get(vid, {})
+        out[vid] = {
+            "converted": max(
+                0.0, cur.get("converted", 0.0) - prev.get("converted", 0.0)
+            ),
+            "miss": max(0.0, cur.get("miss", 0.0) - prev.get("miss", 0.0)),
+            "requests": max(
+                0.0, cur.get("requests", 0.0) - prev.get("requests", 0.0)
+            ),
+            "p99_s": cur.get("p99_s"),
+        }
+    return out
+
+
+# --- runner: evaluation loop + verdict execution ---
+
+
+class ExperimentRunner:
+    """Drives one experiment end to end on an in-process server: start
+    (all arms warm), peek the sequential test each step, and on a
+    verdict execute it — winner promoted through the gated promotion
+    pipeline, losers drained/released via the retained-LRU path.
+
+    ``collector`` (a :class:`predictionio_tpu.utils.telemetry.Collector`)
+    supplies the fleet-shaped stats when given; otherwise counts come
+    from the process-global registry.
+    """
+
+    def __init__(
+        self,
+        server,
+        storage,
+        spec: ExperimentSpec,
+        collector=None,
+        pipeline=None,
+        promotion_config=None,
+        poll_s: float = 1.0,
+        clock: Callable[[], float] = time.time,
+    ):
+        self.server = server
+        self.storage = storage
+        self.spec = spec
+        self.collector = collector
+        self.poll_s = poll_s
+        self._clock = clock
+        self._started_s: Optional[float] = None
+        self._baseline: Dict[str, Dict[str, Any]] = {}
+        if pipeline is None:
+            from predictionio_tpu.workflow.promotion import (
+                InProcessTarget,
+                PromotionPipeline,
+            )
+
+            pipeline = PromotionPipeline(
+                InProcessTarget(server), promotion_config, storage
+            )
+        self.pipeline = pipeline
+        self.final_report: Optional[Dict[str, Any]] = None
+
+    def start(self) -> Dict[str, Any]:
+        status = self.server.start_experiment(self.spec)
+        self._started_s = self._clock()
+        if self.collector is not None:
+            self.collector.register_experiment(self.spec)
+        else:
+            self._baseline = local_variant_stats(self.spec)
+        return status
+
+    def peek(self) -> Dict[str, Any]:
+        """Evaluate the sequential test once (no side effects)."""
+        elapsed = (
+            max(0.0, self._clock() - self._started_s)
+            if self._started_s is not None
+            else 0.0
+        )
+        if self.collector is not None:
+            report = self.collector.experiment_report(self.spec.name)
+            if report is not None:
+                return report
+            return evaluate_sequential(self.spec, {}, elapsed_s=elapsed)
+        stats = _delta_stats(local_variant_stats(self.spec), self._baseline)
+        return evaluate_sequential(self.spec, stats, elapsed_s=elapsed)
+
+    def step(self) -> Optional[Dict[str, Any]]:
+        """One peek; executes the verdict when the test has decided (or
+        the horizon passed).  Returns the final report then, else None."""
+        report = self.peek()
+        if report.get("status") == "running":
+            return None
+        return self._finish(report)
+
+    def run(
+        self,
+        stop_event=None,
+        max_steps: Optional[int] = None,
+    ) -> Dict[str, Any]:
+        steps = 0
+        while True:
+            final = self.step()
+            if final is not None:
+                return final
+            steps += 1
+            if max_steps is not None and steps >= max_steps:
+                return self._finish(self.peek())
+            if stop_event is not None and stop_event.wait(self.poll_s):
+                return self._finish(self.peek())
+            if stop_event is None:
+                time.sleep(self.poll_s)
+
+    def _finish(self, report: Dict[str, Any]) -> Dict[str, Any]:
+        """Execute the verdict: stop allocation, drain losers, promote
+        the winner through the gated pipeline (shadow + observation
+        window intact — an experiment win is evidence, not a bypass)."""
+        if self.final_report is not None:
+            return self.final_report
+        winner = report.get("winner")
+        if winner is None:
+            # Inconclusive at horizon (or forced stop).
+            winner = (
+                self.spec.control
+                if self.spec.on_inconclusive == "keep-control"
+                else None
+            )
+        live = self.server.api.deployed.engine_instance.id
+        self.server.stop_experiment(winner=winner)
+        if self.collector is not None:
+            self.collector.remove_experiment(self.spec.name)
+        promotion = None
+        if winner is not None and winner != live:
+            promotion = self.pipeline.promote(winner)
+        report = dict(report, resolved_winner=winner, promotion=promotion)
+        self.final_report = report
+        return report

@@ -71,7 +71,7 @@ __all__ = [
 # response/result keys the serving tier injects after the model ran —
 # stripped before the generic whole-result comparison so a replayed
 # result (no prId minted, same model) still matches its capture
-_VOLATILE_RESULT_KEYS = ("prId", "modelVersion")
+_VOLATILE_RESULT_KEYS = ("prId", "modelVersion", "experiment", "variant")
 
 ATTRIBUTION_OUTCOMES = ("converted", "miss", "unknown")
 
@@ -311,6 +311,11 @@ class PredictionCapture:
     ``{"prId", "version", "query", "result", "items", "scores",
     "traceId", "tMs", "latencyMs"}``
 
+    Records served under an experiment additionally carry
+    ``{"experiment", "variant"}`` (variant = the serving arm's engine
+    instance id), so a capture taken during an A/B run can be replayed
+    per arm (``pio replay --serving-variant``).
+
     ``items``/``scores`` are extracted at capture time so the replay
     comparison never depends on how an engine's result JSON evolves.
     """
@@ -337,6 +342,8 @@ class PredictionCapture:
         pr_id: Optional[str] = None,
         trace_id: Optional[str] = None,
         latency_s: float = 0.0,
+        experiment: Optional[str] = None,
+        variant: Optional[str] = None,
     ) -> dict:
         items, scores = extract_items(result_json)
         entry = {
@@ -350,18 +357,26 @@ class PredictionCapture:
             "tMs": round(time.time() * 1000.0, 3),
             "latencyMs": round(latency_s * 1000.0, 3),
         }
+        if experiment is not None:
+            entry["experiment"] = experiment
+            entry["variant"] = variant if variant is not None else version
         with self._lock:
             self._records.append(entry)
         _captured_counter().labels(version=version).inc()
         return entry
 
     def dump(
-        self, limit: Optional[int] = None, version: Optional[str] = None
+        self,
+        limit: Optional[int] = None,
+        version: Optional[str] = None,
+        variant: Optional[str] = None,
     ) -> List[dict]:
         with self._lock:
             records = list(self._records)
         if version:
             records = [r for r in records if r.get("version") == version]
+        if variant:
+            records = [r for r in records if r.get("variant") == variant]
         if limit is not None:
             records = records[-int(limit):]
         return records

@@ -1280,3 +1280,128 @@ def test_subspace_params_allowlist_is_not_stale():
         f"subspace-params allowlist entries no longer in the tree: "
         f"{sorted(stale)}"
     )
+
+
+# --- experiment allocation determinism ------------------------------
+#
+# The sticky-allocation contract (workflow/experiment.py): every
+# SO_REUSEPORT worker and every restart must map the same user to the
+# same variant with ZERO coordination. That only holds if the
+# allocation path is a pure function of (salt, user_key, split) — any
+# randomness or clock read silently breaks stickiness and corrupts the
+# sequential test's exchangeability assumption.
+#
+# Scope of the ban:
+#   1. ALL of workflow/experiment.py: no random-source calls anywhere
+#      (the module's runner legitimately reads time.time for horizon
+#      bookkeeping, so clocks are only banned in the pure functions);
+#   2. the pure allocation functions (allocate*, split_edges,
+#      user_key_from_query, ActiveExperiment.route): no clock reads;
+#   3. the QueryAPI allocation hook in api/engine_server.py
+#      (_handle_query_nowait, _finish_query, and every
+#      experiment-named function): no random-source calls.
+#
+# Shrink-only allowlist, seeded empty on purpose: additions require a
+# reviewed justification in the PR that adds them.
+
+_RANDOM_SOURCE_NAMES = frozenset({
+    "random", "randint", "randrange", "choice", "choices", "shuffle",
+    "sample", "uniform", "betavariate", "gauss", "normalvariate",
+    "getrandbits", "urandom", "token_hex", "token_bytes", "uuid1",
+    "uuid4",
+})
+_CLOCK_NAMES = frozenset({
+    "time", "time_ns", "monotonic", "monotonic_ns", "perf_counter",
+    "perf_counter_ns", "now", "utcnow",
+})
+_PURE_ALLOCATION_FNS = frozenset({
+    "split_edges", "user_key_from_query", "allocate_bucket", "allocate",
+    "route",
+})
+
+EXPERIMENT_DETERMINISM_ALLOWED: set = set()
+
+
+def _experiment_determinism_occurrences():
+    import ast
+
+    def call_name(node):
+        fn = node.func
+        return (
+            fn.attr if isinstance(fn, ast.Attribute)
+            else fn.id if isinstance(fn, ast.Name)
+            else None
+        )
+
+    found = set()
+
+    exp_path = PACKAGE / "workflow" / "experiment.py"
+    tree = ast.parse(
+        exp_path.read_text(encoding="utf-8"), filename=str(exp_path)
+    )
+    # module-wide random ban
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            name = call_name(node)
+            if name in _RANDOM_SOURCE_NAMES:
+                found.add((
+                    "workflow/experiment.py",
+                    f"random source {name}() at line {node.lineno}",
+                ))
+    # clock ban inside the pure allocation functions
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if node.name not in _PURE_ALLOCATION_FNS:
+            continue
+        for inner in ast.walk(node):
+            if isinstance(inner, ast.Call):
+                name = call_name(inner)
+                if name in _CLOCK_NAMES:
+                    found.add((
+                        "workflow/experiment.py",
+                        f"clock read {name}() in pure allocation "
+                        f"function {node.name}() at line {inner.lineno}",
+                    ))
+
+    srv_path = PACKAGE / "api" / "engine_server.py"
+    srv_tree = ast.parse(
+        srv_path.read_text(encoding="utf-8"), filename=str(srv_path)
+    )
+    hook_fns = {"_handle_query_nowait", "_finish_query"}
+    for node in ast.walk(srv_tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if not (node.name in hook_fns or "experiment" in node.name):
+            continue
+        for inner in ast.walk(node):
+            if isinstance(inner, ast.Call):
+                name = call_name(inner)
+                if name in _RANDOM_SOURCE_NAMES:
+                    found.add((
+                        "api/engine_server.py",
+                        f"random source {name}() in allocation hook "
+                        f"{node.name}() at line {inner.lineno}",
+                    ))
+    return found
+
+
+def test_experiment_allocation_is_deterministic():
+    found = _experiment_determinism_occurrences()
+    new = found - EXPERIMENT_DETERMINISM_ALLOWED
+    assert not new, (
+        "randomness or clock reads in the sticky-allocation path — "
+        "variant assignment must be a pure function of "
+        "(salt, user_key, split) so SO_REUSEPORT workers and restarts "
+        "agree with zero coordination; remove the call or justify an "
+        f"EXPERIMENT_DETERMINISM_ALLOWED entry: {sorted(new)}"
+    )
+
+
+def test_experiment_determinism_allowlist_is_not_stale():
+    found = _experiment_determinism_occurrences()
+    stale = EXPERIMENT_DETERMINISM_ALLOWED - found
+    assert not stale, (
+        f"experiment-determinism allowlist entries no longer in the "
+        f"tree: {sorted(stale)}"
+    )
